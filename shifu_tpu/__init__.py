@@ -1,0 +1,32 @@
+"""shifu-tpu: a TPU-native end-to-end tabular ML pipeline framework.
+
+A ground-up rebuild of the capabilities of Shifu (reference: DevinWu/shifu)
+on JAX/XLA: one CLI drives the fixed model-building lifecycle
+
+    new -> init -> stats -> norm -> varsel -> train -> posttrain -> eval -> export
+
+configured entirely by two JSON files (``ModelConfig.json`` / ``ColumnConfig.json``,
+format-compatible with the reference, see
+/root/reference src/main/java/ml/shifu/shifu/container/obj/ModelConfig.java:57).
+
+Where the reference runs Pig/MapReduce jobs and a Guagua BSP master/worker ring
+over Hadoop+ZooKeeper, this framework runs jit-compiled SPMD programs over a
+``jax.sharding.Mesh``: gradient and histogram aggregation are XLA collectives
+over ICI/DCN, data prep is a sharded columnar pipeline feeding an HBM-resident
+dense feature matrix, and checkpoint/resume is asynchronous host-side IO.
+"""
+
+__version__ = "0.1.0"
+
+# Lifecycle step names, in canonical order (reference: ShifuCLI.java:818-866).
+LIFECYCLE_STEPS = (
+    "new",
+    "init",
+    "stats",
+    "norm",
+    "varsel",
+    "train",
+    "posttrain",
+    "eval",
+    "export",
+)

@@ -1,0 +1,213 @@
+"""`shifu` CLI — one command drives the whole model-building lifecycle.
+
+Parity: ShifuCLI.java:145 command table (ShifuCLI.java:818-866):
+new/init/stats/norm/varsel/train/posttrain/eval/export/combo/encode/test/
+convert/analysis, plus -Dk=v property overrides hoisted into the environment
+(ShifuCLI.java:430-453).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from shifu_tpu.utils import environment
+from shifu_tpu.utils.errors import ShifuError
+from shifu_tpu.utils.log import configure, get_logger
+
+log = get_logger("shifu")
+
+
+def _extract_props(argv: List[str]) -> List[str]:
+    """Pull -Dk=v args out (anywhere on the line) into the environment."""
+    rest = []
+    for arg in argv:
+        if arg.startswith("-D") and "=" in arg:
+            key, value = arg[2:].split("=", 1)
+            environment.set_property(key, value)
+        else:
+            rest.append(arg)
+    return rest
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="shifu",
+        description="TPU-native end-to-end tabular ML pipeline framework",
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    sub = parser.add_subparsers(dest="command")
+
+    p_new = sub.add_parser("new", help="create a new model set")
+    p_new.add_argument("name")
+    p_new.add_argument("-t", "--type", default="NN", help="algorithm (NN/LR/GBT/RF/WDL)")
+
+    sub.add_parser("init", help="initialize ColumnConfig.json from the data header")
+
+    p_stats = sub.add_parser("stats", help="compute column statistics and binning")
+    p_stats.add_argument("-correlation", "--correlation", action="store_true")
+    p_stats.add_argument("-psi", "--psi", action="store_true")
+    p_stats.add_argument("-rebin", "--rebin", action="store_true")
+
+    p_norm = sub.add_parser("norm", aliases=["normalize"], help="normalize training data")
+    p_norm.add_argument("-shuffle", "--shuffle", action="store_true")
+
+    p_varsel = sub.add_parser(
+        "varsel", aliases=["varselect"], help="variable selection"
+    )
+    p_varsel.add_argument("-list", "--list", action="store_true", dest="list_vars")
+    p_varsel.add_argument("-reset", "--reset", action="store_true")
+    p_varsel.add_argument("-recover", "--recover", action="store_true")
+
+    p_train = sub.add_parser("train", help="train model(s)")
+    p_train.add_argument("-dry", "--dry", action="store_true", help="dry run")
+
+    sub.add_parser("posttrain", help="post-train bin metrics and feature importance")
+
+    p_eval = sub.add_parser("eval", help="evaluate model(s)")
+    p_eval.add_argument("-new", dest="new_name", default=None, help="create eval set")
+    p_eval.add_argument("-list", action="store_true", dest="list_sets")
+    p_eval.add_argument("-delete", dest="delete_name", default=None)
+    p_eval.add_argument("-run", dest="run_name", nargs="?", const="", default=None)
+    p_eval.add_argument("-score", dest="score_name", nargs="?", const="", default=None)
+    p_eval.add_argument("-norm", dest="norm_name", nargs="?", const="", default=None)
+    p_eval.add_argument("-confmat", dest="confmat_name", nargs="?", const="", default=None)
+    p_eval.add_argument("-perf", dest="perf_name", nargs="?", const="", default=None)
+
+    p_export = sub.add_parser("export", help="export model (pmml, columnstats, ...)")
+    p_export.add_argument("-t", "--type", default="pmml")
+    p_export.add_argument("-c", "--concise", action="store_true")
+
+    p_combo = sub.add_parser("combo", help="ensemble-of-algorithms workflow")
+    p_combo.add_argument("-new", dest="new_algs", default=None, help="e.g. NN,GBT,LR")
+    p_combo.add_argument("-init", action="store_true", dest="do_init")
+    p_combo.add_argument("-run", action="store_true", dest="do_run")
+    p_combo.add_argument("-eval", action="store_true", dest="do_eval")
+
+    p_encode = sub.add_parser("encode", help="encode dataset with a trained model")
+    p_encode.add_argument("-d", "--dataset", default=None)
+
+    p_test = sub.add_parser("test", help="dry-run filter expressions on sample rows")
+    p_test.add_argument("-n", type=int, default=100)
+
+    p_convert = sub.add_parser("convert", help="convert model spec formats")
+    p_convert.add_argument("-tozip", action="store_true")
+    p_convert.add_argument("-tobin", action="store_true")
+    p_convert.add_argument("input", nargs="?")
+    p_convert.add_argument("output", nargs="?")
+
+    sub.add_parser("analysis", help="model/data analysis report")
+
+    p_manage = sub.add_parser("save", help="save current model-set version")
+    p_manage.add_argument("version", nargs="?")
+    p_switch = sub.add_parser("switch", help="switch model-set version")
+    p_switch.add_argument("version")
+    sub.add_parser("show", help="show model-set versions")
+
+    sub.add_parser("version", help="print version")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    argv = _extract_props(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    configure(getattr(args, "verbose", False))
+
+    if args.command is None:
+        parser.print_help()
+        return 1
+
+    try:
+        return dispatch(args)
+    except ShifuError as e:
+        log.error("%s", e)
+        return 1
+    except ModuleNotFoundError as e:
+        if (e.name or "").startswith("shifu_tpu."):
+            log.error("step `%s` is not implemented yet", args.command)
+            return 2
+        raise
+    except NotImplementedError as e:
+        log.error("not implemented yet: %s", e)
+        return 2
+
+
+def dispatch(args: argparse.Namespace) -> int:
+    cmd = args.command
+    if cmd == "version":
+        import shifu_tpu
+
+        print(shifu_tpu.__version__)
+        return 0
+    if cmd == "new":
+        from shifu_tpu.processor.create import run_new
+
+        return run_new(args.name, args.type)
+    if cmd == "init":
+        from shifu_tpu.processor.init import InitProcessor
+
+        return InitProcessor().run()
+    if cmd == "stats":
+        from shifu_tpu.processor.stats import StatsProcessor
+
+        return StatsProcessor(
+            correlation=args.correlation, psi=args.psi, rebin=args.rebin
+        ).run()
+    if cmd in ("norm", "normalize"):
+        from shifu_tpu.processor.norm import NormProcessor
+
+        return NormProcessor(shuffle=args.shuffle).run()
+    if cmd in ("varsel", "varselect"):
+        from shifu_tpu.processor.varsel import VarSelProcessor
+
+        return VarSelProcessor(
+            list_vars=args.list_vars, reset=args.reset, recover=args.recover
+        ).run()
+    if cmd == "train":
+        from shifu_tpu.processor.train import TrainProcessor
+
+        return TrainProcessor(dry=args.dry).run()
+    if cmd == "posttrain":
+        from shifu_tpu.processor.posttrain import PostTrainProcessor
+
+        return PostTrainProcessor().run()
+    if cmd == "eval":
+        from shifu_tpu.processor.evaluate import EvalProcessor
+
+        return EvalProcessor.from_args(args).run()
+    if cmd == "export":
+        from shifu_tpu.processor.export import ExportProcessor
+
+        return ExportProcessor(kind=args.type, concise=args.concise).run()
+    if cmd == "combo":
+        from shifu_tpu.processor.combo import ComboProcessor
+
+        return ComboProcessor.from_args(args).run()
+    if cmd == "encode":
+        from shifu_tpu.processor.encode import EncodeProcessor
+
+        return EncodeProcessor(dataset=args.dataset).run()
+    if cmd == "test":
+        from shifu_tpu.processor.testdata import TestDataProcessor
+
+        return TestDataProcessor(n=args.n).run()
+    if cmd == "convert":
+        from shifu_tpu.processor.convert import ConvertProcessor
+
+        return ConvertProcessor.from_args(args).run()
+    if cmd == "analysis":
+        from shifu_tpu.processor.analysis import AnalysisProcessor
+
+        return AnalysisProcessor().run()
+    if cmd in ("save", "switch", "show"):
+        from shifu_tpu.processor.manage import ManageProcessor
+
+        return ManageProcessor(cmd, getattr(args, "version", None)).run()
+    raise NotImplementedError(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""Columnar data ingest: header parsing, chunked CSV reads, row filtering."""
+
+from shifu_tpu.data.reader import ColumnarData, read_header, read_columnar  # noqa: F401

@@ -1,0 +1,3 @@
+"""Filesystem layer: canonical artifact path layout + IO helpers."""
+
+from shifu_tpu.fs.pathfinder import PathFinder  # noqa: F401

@@ -1,0 +1,5 @@
+"""Lifecycle step processors — one per CLI subcommand.
+
+Mirrors the reference's core/processor/* layer: every processor loads and
+validates the two configs, runs its step, and persists updated state
+(BasicModelProcessor.java:57 contract)."""

@@ -1,0 +1,23 @@
+"""Logging setup shared by the CLI and library."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(name)
+
+
+def configure(verbose: bool = False) -> None:
+    level = logging.DEBUG if verbose else logging.INFO
+    logging.basicConfig(
+        level=level,
+        stream=sys.stderr,
+        format="%(asctime)s %(levelname)-5s %(name)s - %(message)s",
+        datefmt="%Y-%m-%d %H:%M:%S",
+    )
+    # JAX compilation chatter stays at WARNING unless verbose.
+    if not verbose:
+        logging.getLogger("jax").setLevel(logging.WARNING)

@@ -1,0 +1,18 @@
+"""Test configuration: force a virtual 8-device CPU mesh BEFORE jax loads.
+
+Multi-chip sharding logic is exercised the way the reference exercises its
+BSP protocol without a cluster (core/dtrain/DTrainTest.java:44 simulates N
+workers in-process): same pure step functions, N virtual devices.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

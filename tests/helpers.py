@@ -1,0 +1,88 @@
+"""Synthetic dataset generators for tests — WDBC-shaped tabular data."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def make_binary_dataset(
+    n_rows: int = 600,
+    n_numeric: int = 10,
+    n_categorical: int = 2,
+    missing_rate: float = 0.02,
+    seed: int = 7,
+):
+    """Two-gaussian binary classification data with categorical columns and
+    missing tokens. Returns (header_names, rows_of_strings, y)."""
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n_rows) < 0.4).astype(int)
+    names = ["diagnosis"]
+    cols = []
+    for j in range(n_numeric):
+        shift = 1.5 * (j % 3 == 0)
+        x = rng.normal(loc=y * shift + j * 0.1, scale=1.0 + 0.05 * j)
+        cols.append(x)
+        names.append(f"num_{j}")
+    cat_values = ["red", "green", "blue", "violet"]
+    cat_cols = []
+    for j in range(n_categorical):
+        probs_pos = np.array([0.5, 0.25, 0.15, 0.10])
+        probs_neg = np.array([0.10, 0.15, 0.25, 0.5])
+        choice = np.where(
+            y == 1,
+            rng.choice(4, size=n_rows, p=probs_pos),
+            rng.choice(4, size=n_rows, p=probs_neg),
+        )
+        cat_cols.append(np.array(cat_values)[choice])
+        names.append(f"cat_{j}")
+
+    rows = []
+    for i in range(n_rows):
+        fields = ["M" if y[i] else "B"]
+        for x in cols:
+            if rng.random() < missing_rate:
+                fields.append("")
+            else:
+                fields.append(f"{x[i]:.6g}")
+        for c in cat_cols:
+            if rng.random() < missing_rate:
+                fields.append("?")
+            else:
+                fields.append(str(c[i]))
+        rows.append(fields)
+    return names, rows, y
+
+
+def write_dataset(dirpath: str, names, rows, delimiter: str = "|"):
+    os.makedirs(dirpath, exist_ok=True)
+    header = os.path.join(dirpath, "header.txt")
+    with open(header, "w") as fh:
+        fh.write(delimiter.join(names) + "\n")
+    data = os.path.join(dirpath, "data.txt")
+    with open(data, "w") as fh:
+        for r in rows:
+            fh.write(delimiter.join(r) + "\n")
+    return data, header
+
+
+def make_model_set(root: str, n_rows: int = 600, seed: int = 7, algorithm: str = "NN"):
+    """Create a ready-to-init model set dir with synthetic data. Returns root."""
+    from shifu_tpu.config.model_config import Algorithm, new_model_config
+
+    names, rows, _ = make_binary_dataset(n_rows=n_rows, seed=seed)
+    data_dir = os.path.join(root, "data")
+    data_path, header_path = write_dataset(data_dir, names, rows)
+
+    mc = new_model_config("TestModel", Algorithm.parse(algorithm))
+    mc.data_set.data_path = data_path
+    mc.data_set.header_path = header_path
+    mc.data_set.data_delimiter = "|"
+    mc.data_set.header_delimiter = "|"
+    mc.data_set.target_column_name = "diagnosis"
+    mc.data_set.pos_tags = ["M"]
+    mc.data_set.neg_tags = ["B"]
+    os.makedirs(root, exist_ok=True)
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    return root

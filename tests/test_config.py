@@ -1,0 +1,187 @@
+"""ModelConfig / ColumnConfig JSON round-trip and validation tests."""
+
+import json
+import math
+import os
+
+from shifu_tpu.config import (
+    Algorithm,
+    ColumnConfig,
+    ColumnFlag,
+    ColumnType,
+    ModelConfig,
+    NormType,
+    RunMode,
+    load_column_config_list,
+    save_column_config_list,
+)
+from shifu_tpu.config.inspector import ModelStep, probe
+from shifu_tpu.config.model_config import new_model_config
+
+# A reference-format ModelConfig.json (shape per container/obj/ModelConfig.java)
+REFERENCE_STYLE_JSON = {
+    "basic": {
+        "name": "TestWoeZscale",
+        "author": "someone",
+        "description": "x",
+        "version": "0.2.0",
+        "runMode": "LOCAL",
+        "postTrainOn": False,
+        "customPaths": {},
+    },
+    "dataSet": {
+        "source": "LOCAL",
+        "dataPath": "./data",
+        "dataDelimiter": "|",
+        "headerPath": "./data/.pig_header",
+        "headerDelimiter": "|",
+        "filterExpressions": "",
+        "weightColumnName": "",
+        "targetColumnName": "diagnosis",
+        "posTags": ["M"],
+        "negTags": ["B"],
+        "missingOrInvalidValues": ["", "*", "#", "?", "null", "~"],
+        "metaColumnNameFile": "columns/meta.column.names",
+        "categoricalColumnNameFile": "columns/categorical.column.names",
+    },
+    "stats": {
+        "maxNumBin": 10,
+        "binningMethod": "EqualPositive",
+        "sampleRate": 0.8,
+        "sampleNegOnly": False,
+        "binningAlgorithm": "SPDTI",
+        "psiColumnName": "",
+    },
+    "varSelect": {
+        "forceEnable": True,
+        "filterEnable": True,
+        "filterNum": 200,
+        "filterBy": "KS",
+        "wrapperEnabled": False,
+        "missingRateThreshold": 0.5,
+        "filterBySE": True,
+        "params": None,
+    },
+    "normalize": {
+        "stdDevCutOff": 4.0,
+        "sampleRate": 1.0,
+        "sampleNegOnly": False,
+        "normType": "WOE_ZSCORE",
+    },
+    "train": {
+        "baggingNum": 5,
+        "baggingWithReplacement": True,
+        "baggingSampleRate": 1.0,
+        "validSetRate": 0.2,
+        "numTrainEpochs": 100,
+        "epochsPerIteration": 1,
+        "isContinuous": False,
+        "workerThreadCount": 4,
+        "algorithm": "NN",
+        "params": {
+            "NumHiddenLayers": 1,
+            "ActivationFunc": ["tanh"],
+            "NumHiddenNodes": [50],
+            "LearningRate": 0.1,
+            "Propagation": "Q",
+        },
+        "customPaths": {},
+    },
+    "evals": [
+        {
+            "name": "Eval1",
+            "dataSet": {
+                "source": "LOCAL",
+                "dataPath": "./evaldata",
+                "dataDelimiter": "|",
+                "headerPath": "",
+                "headerDelimiter": "|",
+                "filterExpressions": "",
+                "weightColumnName": "",
+            },
+            "performanceBucketNum": 10,
+            "performanceScoreSelector": "mean",
+            "scoreMetaColumnNameFile": "",
+            "customPaths": {},
+        }
+    ],
+}
+
+
+def test_model_config_reference_format_roundtrip(tmp_path):
+    path = tmp_path / "ModelConfig.json"
+    path.write_text(json.dumps(REFERENCE_STYLE_JSON))
+    mc = ModelConfig.load(str(path))
+    assert mc.basic.name == "TestWoeZscale"
+    assert mc.basic.run_mode == RunMode.LOCAL
+    assert mc.data_set.target_column_name == "diagnosis"
+    assert mc.data_set.pos_tags == ["M"]
+    assert mc.stats.max_num_bin == 10
+    assert mc.normalize.norm_type == NormType.WOE_ZSCORE
+    assert mc.train.algorithm == Algorithm.NN
+    assert mc.train.get_param("NumHiddenNodes") == [50]
+    assert mc.train.get_param("numhiddennodes") == [50]  # case-insensitive
+    assert len(mc.evals) == 1 and mc.evals[0].name == "Eval1"
+
+    out = tmp_path / "out.json"
+    mc.save(str(out))
+    data = json.loads(out.read_text())
+    assert data["basic"]["runMode"] == "LOCAL"
+    assert data["normalize"]["normType"] == "WOE_ZSCORE"
+    assert data["train"]["params"]["NumHiddenNodes"] == [50]
+    # reload of our own output is stable
+    mc2 = ModelConfig.load(str(out))
+    assert mc2.to_json() == mc.to_json()
+
+
+def test_run_mode_case_insensitive():
+    assert RunMode.parse("local") == RunMode.LOCAL
+    assert RunMode.parse("DIST") == RunMode.DIST
+    assert RunMode.parse("tpu") == RunMode.TPU
+    assert NormType.parse("woe_zscale") == NormType.WOE_ZSCALE
+
+
+def test_column_config_roundtrip(tmp_path):
+    cc = ColumnConfig(column_num=2, column_name="col4", column_type=ColumnType.N)
+    cc.column_stats.mean = 18.89
+    cc.column_stats.std_dev = 4.17
+    cc.column_binning.length = 3
+    cc.column_binning.bin_boundary = [-math.inf, 17.0, 18.8]
+    cc.column_binning.bin_count_pos = [12, 12, 13, 0]
+    cc.column_binning.bin_count_neg = [111, 52, 19, 1]
+    cc.final_select = True
+
+    path = str(tmp_path / "ColumnConfig.json")
+    save_column_config_list(path, [cc])
+    raw = json.load(open(path))
+    assert raw[0]["columnBinning"]["binBoundary"][0] == "-Infinity"
+    assert raw[0]["columnType"] == "N"
+
+    loaded = load_column_config_list(path)
+    assert loaded[0].column_binning.bin_boundary[0] == -math.inf
+    assert loaded[0].column_binning.bin_count_pos == [12, 12, 13, 0]
+    assert loaded[0].final_select is True
+    assert loaded[0].is_numerical()
+
+
+def test_column_flags():
+    cc = ColumnConfig(column_name="t", column_flag=ColumnFlag.TARGET)
+    assert cc.is_target() and not cc.is_feature()
+    cc2 = ColumnConfig(column_name="x")
+    assert cc2.is_feature()
+
+
+def test_inspector_catches_bad_train():
+    mc = new_model_config("m", Algorithm.NN)
+    mc.train.valid_set_rate = 1.5
+    result = probe(mc, ModelStep.TRAIN)
+    assert not result.status
+    assert any("validSetRate" in c for c in result.causes)
+
+
+def test_inspector_data_path(tmp_path):
+    mc = new_model_config("m", Algorithm.NN)
+    mc.data_set.data_path = str(tmp_path / "nope.csv")
+    mc.data_set.target_column_name = "y"
+    result = probe(mc, ModelStep.INIT, base_dir=str(tmp_path))
+    assert not result.status

@@ -1,0 +1,36 @@
+"""`shifu new` / `shifu init` behavior tests."""
+
+import json
+import os
+
+from tests.helpers import make_model_set
+
+from shifu_tpu.config import ColumnFlag, ColumnType, load_column_config_list
+from shifu_tpu.processor.create import run_new
+from shifu_tpu.processor.init import InitProcessor
+
+
+def test_new_scaffolds_model_set(tmp_path):
+    rc = run_new("MyModel", "GBT", root=str(tmp_path))
+    assert rc == 0
+    root = tmp_path / "MyModel"
+    mc = json.loads((root / "ModelConfig.json").read_text())
+    assert mc["basic"]["name"] == "MyModel"
+    assert mc["train"]["algorithm"] == "GBT"
+    assert mc["train"]["params"]["TreeNum"] == 100
+    assert (root / "columns" / "meta.column.names").exists()
+    # creating again fails gracefully
+    assert run_new("MyModel", "GBT", root=str(tmp_path)) == 1
+
+
+def test_init_builds_column_config(tmp_path):
+    root = make_model_set(str(tmp_path / "ms"))
+    proc = InitProcessor(root)
+    assert proc.run() == 0
+    cols = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+    by_name = {c.column_name: c for c in cols}
+    assert by_name["diagnosis"].column_flag == ColumnFlag.TARGET
+    assert by_name["num_0"].column_type == ColumnType.N
+    assert by_name["cat_0"].column_type == ColumnType.C  # auto-typed
+    assert by_name["cat_0"].column_stats.distinct_count == 4
+    assert all(c.column_num == i for i, c in enumerate(cols))

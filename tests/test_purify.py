@@ -1,0 +1,65 @@
+"""Vectorized filter-expression tests (DataPurifier parity)."""
+
+import numpy as np
+import pytest
+
+from shifu_tpu.data.purify import DataPurifier, combined_mask
+from shifu_tpu.utils.errors import ShifuError
+
+COLS = {
+    "a": np.array(["1", "20", "3", ""], dtype=object),
+    "b": np.array(["0.5", "1.5", "2.5", "3.5"], dtype=object),
+    "tag": np.array(["M", "B", "M", "B"], dtype=object),
+}
+
+
+def test_numeric_comparison_on_string_columns():
+    mask = DataPurifier("a > 2").mask(COLS, 4)
+    assert mask.tolist() == [False, True, True, False]  # '' -> NaN -> False
+
+
+def test_jexl_and_or_rewrite():
+    mask = DataPurifier("a > 1 && b < 2").mask(COLS, 4)
+    assert mask.tolist() == [False, True, False, False]
+    mask = DataPurifier("a > 10 || tag == 'M'").mask(COLS, 4)
+    assert mask.tolist() == [True, True, True, False]
+
+
+def test_not_and_in():
+    mask = DataPurifier("not (tag == 'M')").mask(COLS, 4)
+    assert mask.tolist() == [False, True, False, True]
+    mask = DataPurifier("tag in ['M', 'X']").mask(COLS, 4)
+    assert mask.tolist() == [True, False, True, False]
+    mask = DataPurifier("a in [1, 3]").mask(COLS, 4)
+    assert mask.tolist() == [True, False, True, False]
+
+
+def test_arithmetic_and_chained_compare():
+    mask = DataPurifier("a + b > 21").mask(COLS, 4)
+    assert mask.tolist() == [False, True, False, False]
+    mask = DataPurifier("1 < a < 4").mask(COLS, 4)
+    assert mask.tolist() == [False, False, True, False]
+
+
+def test_string_equality():
+    mask = DataPurifier("tag == 'B'").mask(COLS, 4)
+    assert mask.tolist() == [False, True, False, True]
+
+
+def test_combined_mask_semicolon_and_list():
+    mask = combined_mask("a > 1; tag == 'M'", COLS, 4)
+    assert mask.tolist() == [False, False, True, False]
+    mask = combined_mask(["a > 1", "tag == 'M'"], COLS, 4)
+    assert mask.tolist() == [False, False, True, False]
+
+
+def test_disallowed_constructs_rejected():
+    with pytest.raises(ShifuError):
+        DataPurifier("__import__('os')")
+    with pytest.raises(ShifuError):
+        DataPurifier("a > (lambda: 1)()")
+
+
+def test_noop():
+    assert DataPurifier("").is_noop()
+    assert combined_mask(None, COLS, 4).all()
