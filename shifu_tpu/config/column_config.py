@@ -185,24 +185,6 @@ def _encode_boundary(values: Optional[List[float]]) -> Optional[List[Any]]:
     return out
 
 
-def _decode_boundary(values: Optional[List[Any]]) -> Optional[List[float]]:
-    if values is None:
-        return None
-    out: List[float] = []
-    for v in values:
-        if isinstance(v, str):
-            low = v.strip().lower()
-            if low in ("-infinity", "-inf"):
-                out.append(-math.inf)
-            elif low in ("infinity", "inf", "+infinity"):
-                out.append(math.inf)
-            else:
-                out.append(float(v))
-        else:
-            out.append(float(v))
-    return out
-
-
 def column_config_to_json(cc: ColumnConfig) -> dict:
     raw = encode_dataclass(cc)
     raw["columnBinning"]["binBoundary"] = _encode_boundary(cc.column_binning.bin_boundary)
@@ -210,10 +192,9 @@ def column_config_to_json(cc: ColumnConfig) -> dict:
 
 
 def column_config_from_json(data: dict) -> ColumnConfig:
-    cc = decode_dataclass(ColumnConfig, data)
-    binning = (data or {}).get("columnBinning") or {}
-    cc.column_binning.bin_boundary = _decode_boundary(binning.get("binBoundary"))
-    return cc
+    # jsonbase._decode's float path already parses "-Infinity"/"Infinity"
+    # boundary strings for List[float] fields.
+    return decode_dataclass(ColumnConfig, data)
 
 
 def save_column_config_list(path: str, columns: List[ColumnConfig]) -> None:

@@ -117,7 +117,14 @@ def _decode(ftype: Any, raw: Any) -> Any:
             return float(raw)
         if ftype is int and not isinstance(raw, bool):
             return int(raw)
-        if ftype is bool and isinstance(raw, bool):
+        if ftype is bool:
+            if isinstance(raw, bool):
+                return raw
+            # Jackson-style coercion: "true"/"false"/0/1 are valid booleans
+            if isinstance(raw, str):
+                return raw.strip().lower() in ("true", "1", "yes", "on")
+            if isinstance(raw, (int, float)):
+                return bool(raw)
             return raw
         if ftype is str:
             return str(raw)

@@ -14,7 +14,7 @@ Supported: comparisons, and/or/not (rewritten to &, |, ~), arithmetic, and
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -31,14 +31,42 @@ _ALLOWED_NODES = (
 # calls, &/|/~); user input is validated against the stricter set above first.
 
 
+def _split_quoted(expr: str) -> List[Tuple[bool, str]]:
+    """Split into (is_literal, text) segments so operator rewriting never
+    touches the inside of quoted string literals."""
+    out: List[Tuple[bool, str]] = []
+    i, start = 0, 0
+    while i < len(expr):
+        ch = expr[i]
+        if ch in ("'", '"'):
+            if i > start:
+                out.append((False, expr[start:i]))
+            j = i + 1
+            while j < len(expr) and expr[j] != ch:
+                j += 1
+            out.append((True, expr[i : min(j + 1, len(expr))]))
+            i = j + 1
+            start = i
+        else:
+            i += 1
+    if start < len(expr):
+        out.append((False, expr[start:]))
+    return out
+
+
 def _normalize_expr(expr: str) -> str:
-    # JEXL-isms -> Python operators.
-    return (
-        expr.replace("&&", " and ")
-        .replace("||", " or ")
-        .replace(" eq ", " == ")
-        .replace(" ne ", " != ")
-    )
+    # JEXL-isms -> Python operators, outside string literals only.
+    parts = []
+    for is_lit, seg in _split_quoted(expr):
+        if not is_lit:
+            seg = (
+                seg.replace("&&", " and ")
+                .replace("||", " or ")
+                .replace(" eq ", " == ")
+                .replace(" ne ", " != ")
+            )
+        parts.append(seg)
+    return "".join(parts)
 
 
 class _Rewrite(ast.NodeTransformer):
@@ -218,7 +246,19 @@ def combined_mask(
     if not expressions:
         return np.ones(n_rows, dtype=bool)
     if isinstance(expressions, str):
-        expr_list: List[str] = expressions.split(";")
+        # split on ';' outside quoted literals only
+        expr_list: List[str] = []
+        buf = ""
+        for is_lit, seg in _split_quoted(expressions):
+            if is_lit:
+                buf += seg
+            else:
+                chunks = seg.split(";")
+                buf += chunks[0]
+                for extra in chunks[1:]:
+                    expr_list.append(buf)
+                    buf = extra
+        expr_list.append(buf)
     else:
         expr_list = list(expressions)
     mask = np.ones(n_rows, dtype=bool)

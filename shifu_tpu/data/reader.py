@@ -19,10 +19,11 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from shifu_tpu.config.model_config import DEFAULT_MISSING_VALUES
 from shifu_tpu.utils.errors import ErrorCode, ShifuError
 
 # Default tokens treated as missing (ModelSourceDataConf.missingOrInvalidValues).
-DEFAULT_MISSING = ("", "*", "#", "?", "null", "~")
+DEFAULT_MISSING = tuple(DEFAULT_MISSING_VALUES)
 
 
 def strip_namespace(name: str) -> str:
@@ -34,7 +35,8 @@ def strip_namespace(name: str) -> str:
 def read_header(header_path: str, delimiter: str = "|") -> List[str]:
     if not os.path.isfile(header_path):
         raise ShifuError(ErrorCode.HEADER_NOT_FOUND, header_path)
-    with open(header_path) as fh:
+    opener = gzip.open if header_path.endswith(".gz") else open
+    with opener(header_path, "rt") as fh:
         line = fh.readline().rstrip("\n\r")
     names = [strip_namespace(c) for c in line.split(delimiter)]
     if len(names) != len(set(names)):
@@ -52,21 +54,27 @@ def read_header(header_path: str, delimiter: str = "|") -> List[str]:
     return names
 
 
+def _is_data_file(path: str) -> bool:
+    """Skip Hadoop markers (_SUCCESS, _temporary), dot-files, empty files."""
+    base = os.path.basename(path)
+    if base.startswith(".") or base.startswith("_"):
+        return False
+    return os.path.isfile(path) and os.path.getsize(path) > 0
+
+
 def _expand_paths(data_path: str) -> List[str]:
     if os.path.isdir(data_path):
         parts = sorted(
-            p
-            for p in glob.glob(os.path.join(data_path, "*"))
-            if os.path.isfile(p) and not os.path.basename(p).startswith(".")
+            p for p in glob.glob(os.path.join(data_path, "*")) if _is_data_file(p)
         )
         if not parts:
             raise ShifuError(ErrorCode.DATA_NOT_FOUND, f"empty directory {data_path}")
         return parts
     if os.path.isfile(data_path):
         return [data_path]
-    parts = sorted(glob.glob(data_path))
+    parts = sorted(p for p in glob.glob(data_path) if _is_data_file(p))
     if parts:
-        return [p for p in parts if os.path.isfile(p)]
+        return parts
     raise ShifuError(ErrorCode.DATA_NOT_FOUND, data_path)
 
 

@@ -1,0 +1,1 @@
+"""Compute kernels: jit-compiled aggregations shared by stats/train/eval."""

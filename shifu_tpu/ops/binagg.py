@@ -1,0 +1,115 @@
+"""Bin aggregation: the TPU-native replacement for the UpdateBinningInfo MR
+job (core/binning/UpdateBinningInfoMapper.java:71 / Reducer.java:57).
+
+One scatter-add over a flat (column, bin) index space produces every
+per-column per-bin count in a single fused XLA program; the multi-chip path
+wraps the same function in shard_map over the row axis and psums the
+aggregates — the analog of the reference's mapper-side partial sums merged in
+one reducer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+class BinAggregates(NamedTuple):
+    """Flat (column-offset + bin) histograms + per-numeric-column moments."""
+
+    pos: jax.Array  # [total_slots] positive counts
+    neg: jax.Array  # [total_slots] negative counts
+    wpos: jax.Array  # [total_slots] weighted positive
+    wneg: jax.Array  # [total_slots] weighted negative
+    vsum: jax.Array  # [n_numeric] sum of non-missing values
+    vsumsq: jax.Array  # [n_numeric] sum of squares
+    vmin: jax.Array  # [n_numeric]
+    vmax: jax.Array  # [n_numeric]
+    vcount: jax.Array  # [n_numeric] non-missing count
+    vmissing: jax.Array  # [n_numeric] missing count (valid-tag rows)
+
+
+def bin_aggregate(
+    codes: jax.Array,  # [n, C] int32, per-column bin index (missing = last slot)
+    col_offsets: jax.Array,  # [C] int32 prefix offsets into the flat slot space
+    total_slots: int,
+    tags: jax.Array,  # [n] int32 {1 pos, 0 neg, -1 invalid}
+    weights: jax.Array,  # [n] float32
+    values: jax.Array,  # [n, Cn] float32 numeric matrix, NaN = missing
+) -> BinAggregates:
+    valid = tags >= 0
+    posm = (tags == 1) & valid
+    negm = (tags == 0) & valid
+
+    flat = (codes + col_offsets[None, :]).reshape(-1)  # [n*C]
+    n, c = codes.shape
+
+    def scatter(row_mask, row_weight):
+        contrib = jnp.where(row_mask, row_weight, 0.0).astype(jnp.float32)
+        tiled = jnp.repeat(contrib, c)  # row value for every column slot
+        return jnp.zeros(total_slots, jnp.float32).at[flat].add(tiled)
+
+    ones = jnp.ones_like(weights)
+    pos = scatter(posm, ones)
+    neg = scatter(negm, ones)
+    wpos = scatter(posm, weights)
+    wneg = scatter(negm, weights)
+
+    missing = jnp.isnan(values)
+    vvalid = (~missing) & valid[:, None]
+    v0 = jnp.where(vvalid, values, 0.0)
+    vsum = v0.sum(axis=0)
+    vsumsq = (v0 * v0).sum(axis=0)
+    vmin = jnp.where(vvalid, values, jnp.inf).min(axis=0)
+    vmax = jnp.where(vvalid, values, -jnp.inf).max(axis=0)
+    vcount = vvalid.sum(axis=0).astype(jnp.float32)
+    vmissing = (missing & valid[:, None]).sum(axis=0).astype(jnp.float32)
+    return BinAggregates(pos, neg, wpos, wneg, vsum, vsumsq, vmin, vmax, vcount, vmissing)
+
+
+bin_aggregate_jit = jax.jit(bin_aggregate, static_argnames=("total_slots",))
+
+
+def bin_aggregate_sharded(
+    mesh: Mesh,
+    codes: jax.Array,
+    col_offsets: jax.Array,
+    total_slots: int,
+    tags: jax.Array,
+    weights: jax.Array,
+    values: jax.Array,
+    axis: str = "data",
+) -> BinAggregates:
+    """Row-sharded SPMD variant: each device aggregates its row shard, then a
+    single psum merges — gradients-of-histograms over ICI instead of
+    ZooKeeper-merged Bytables."""
+
+    def local(codes, tags, weights, values):
+        agg = bin_aggregate(codes, col_offsets, total_slots, tags, weights, values)
+        psum = lambda x: jax.lax.psum(x, axis)  # noqa: E731
+        return BinAggregates(
+            pos=psum(agg.pos),
+            neg=psum(agg.neg),
+            wpos=psum(agg.wpos),
+            wneg=psum(agg.wneg),
+            vsum=psum(agg.vsum),
+            vsumsq=psum(agg.vsumsq),
+            vmin=jax.lax.pmin(agg.vmin, axis),
+            vmax=jax.lax.pmax(agg.vmax, axis),
+            vcount=psum(agg.vcount),
+            vmissing=psum(agg.vmissing),
+        )
+
+    from jax import shard_map
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(axis), P(axis, None)),
+        out_specs=BinAggregates(*([P()] * 10)),
+    )
+    return fn(codes, tags, weights, values)

@@ -1,0 +1,84 @@
+"""`shifu stats` — compute per-column statistics and binning.
+
+Parity: core/processor/StatsModelProcessor.java:116 (SPDTI executor path) +
+optional -correlation / -psi / -rebin flags.
+"""
+
+from __future__ import annotations
+
+import os
+
+from shifu_tpu.data.reader import read_columnar, read_header
+from shifu_tpu.processor.basic import BasicProcessor
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+class StatsProcessor(BasicProcessor):
+    step = "stats"
+
+    def __init__(
+        self,
+        root: str = ".",
+        correlation: bool = False,
+        psi: bool = False,
+        rebin: bool = False,
+    ):
+        super().__init__(root)
+        self.correlation = correlation
+        self.psi = psi
+        self.rebin = rebin
+
+    def _load_data(self):
+        mc = self.model_config
+        assert mc is not None
+        ds = mc.data_set
+        if ds.header_path:
+            names = read_header(self.resolve(ds.header_path), ds.header_delimiter)
+        else:
+            names = [c.column_name for c in self.column_configs]
+        return read_columnar(
+            self.resolve(ds.data_path),
+            names,
+            delimiter=ds.data_delimiter,
+            missing_values=tuple(ds.missing_or_invalid_values),
+        )
+
+    def run_step(self) -> None:
+        self.setup()
+        mc = self.model_config
+        assert mc is not None
+        data = self._load_data()
+
+        from shifu_tpu.stats.engine import compute_stats
+
+        compute_stats(mc, self.column_configs, data)
+
+        if self.correlation or self.psi:
+            self.paths.ensure(self.paths.tmp_dir("stats"))
+        if self.correlation:
+            from shifu_tpu.stats.correlation import (
+                column_correlation,
+                save_correlation_csv,
+            )
+
+            corr, names = column_correlation(data, self.column_configs)
+            save_correlation_csv(self.paths.correlation_path(), corr, names)
+            log.info(
+                "correlation matrix (%d x %d) -> %s",
+                len(names), len(names), self.paths.correlation_path(),
+            )
+
+        psi_col = (mc.stats.psi_column_name or "").strip()
+        if self.psi and psi_col:
+            from shifu_tpu.stats.psi import compute_psi
+
+            compute_psi(data, self.column_configs, psi_col)
+            log.info("PSI computed against unit column %s", psi_col)
+        elif self.psi:
+            log.warning("-psi requested but stats.psiColumnName is empty; skipped")
+
+        self.save_column_configs()
+        n_binned = sum(1 for c in self.column_configs if c.column_binning.length)
+        log.info("stats written for %d columns.", n_binned)

@@ -1,0 +1,8 @@
+"""Column statistics engine: binning, KS/IV/WOE, correlation, PSI.
+
+The reference computes these with two Hadoop jobs (Pig SPDT histogram pass +
+UpdateBinningInfo MR pass, core/processor/stats/MapReducerStatsWorker.java:105).
+Here: bin boundaries from exact columnar quantiles, then ONE jit-compiled
+aggregation over a dense [rows, cols] bin-code matrix — shardable over the
+device mesh with psum for the multi-chip path.
+"""

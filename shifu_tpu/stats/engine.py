@@ -1,0 +1,218 @@
+"""Stats engine: orchestrates binning + one-pass jit aggregation, then writes
+results back into the ColumnConfig list.
+
+Pipeline parity with MapReducerStatsWorker.doStats
+(core/processor/stats/MapReducerStatsWorker.java:105): purify -> sample ->
+per-column bins -> bin-hit aggregation -> KS/IV/WOE -> ColumnConfig update.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.config import ColumnConfig, ColumnType
+from shifu_tpu.config.model_config import ModelConfig
+from shifu_tpu.data.purify import combined_mask
+from shifu_tpu.data.reader import ColumnarData, make_tags, make_weights
+from shifu_tpu.ops.binagg import bin_aggregate_jit
+from shifu_tpu.stats.binning import (
+    categorical_bin_index,
+    categorical_bins,
+    numeric_bin_index,
+    numeric_boundaries,
+)
+from shifu_tpu.stats.metrics import column_metrics
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+# Reference caps categorical cardinality at 10k (shifuconfig:107-108).
+MAX_CATEGORY_SIZE = 10_000
+
+
+def build_codes(
+    data: ColumnarData,
+    stats_cols: List[ColumnConfig],
+) -> Tuple[np.ndarray, np.ndarray, List[int], np.ndarray, List[ColumnConfig]]:
+    """Assign each row a bin code for every stats column.
+
+    Returns (codes [n, C] int32, col_offsets [C], slots_per_col, values
+    [n, Cn] float32 numeric matrix, numeric_cols)."""
+    n = data.n_rows
+    codes = np.zeros((n, len(stats_cols)), dtype=np.int32)
+    slots: List[int] = []
+    numeric_cols: List[ColumnConfig] = []
+    numeric_mat: List[np.ndarray] = []
+    for j, cc in enumerate(stats_cols):
+        if cc.is_categorical():
+            cats = cc.column_binning.bin_category or []
+            miss = data.missing_mask(cc.column_name)
+            codes[:, j] = categorical_bin_index(
+                data.column(cc.column_name), cats, miss
+            )
+            slots.append(len(cats) + 1)
+        else:
+            bounds = cc.column_binning.bin_boundary or [float("-inf")]
+            vals = data.numeric(cc.column_name)
+            codes[:, j] = numeric_bin_index(vals, bounds)
+            slots.append(len(bounds) + 1)
+            numeric_cols.append(cc)
+            numeric_mat.append(vals.astype(np.float32))
+    col_offsets = np.zeros(len(stats_cols), dtype=np.int32)
+    if slots:
+        col_offsets[1:] = np.cumsum(slots[:-1])
+    values = (
+        np.stack(numeric_mat, axis=1)
+        if numeric_mat
+        else np.zeros((n, 0), dtype=np.float32)
+    )
+    return codes, col_offsets, slots, values, numeric_cols
+
+
+def compute_stats(
+    mc: ModelConfig,
+    columns: List[ColumnConfig],
+    data: ColumnarData,
+    seed: int = 0,
+) -> None:
+    """Fill stats + binning for every non-target/meta/weight column, in place."""
+    ds = mc.data_set
+
+    # purify + invalid-tag drop + sampling (reference samples in the Pig job)
+    mask = combined_mask(ds.filter_expressions, data.raw, data.n_rows)
+    tags_all = make_tags(data.column(ds.target_column_name), ds.pos_tags, ds.neg_tags)
+    mask &= tags_all >= 0
+    if mc.stats.sample_rate < 1.0:
+        rng = np.random.default_rng(seed)
+        keep = rng.random(data.n_rows) < mc.stats.sample_rate
+        if mc.stats.sample_neg_only:
+            keep |= tags_all == 1
+        mask &= keep
+    data = data.select_rows(mask)
+    tags = tags_all[mask]
+    weights = make_weights(data, ds.weight_column_name)
+    log.info("stats over %d rows (%d pos / %d neg)", data.n_rows,
+             int((tags == 1).sum()), int((tags == 0).sum()))
+
+    stats_cols = [
+        c for c in columns if not (c.is_target() or c.is_meta() or c.is_weight())
+    ]
+
+    # ---- pass 1: bin construction (host, exact quantiles) ----
+    max_bins = mc.stats.max_num_bin
+    cate_max = mc.stats.cate_max_num_bin or MAX_CATEGORY_SIZE
+    for cc in stats_cols:
+        if cc.is_categorical():
+            miss = data.missing_mask(cc.column_name)
+            cats = categorical_bins(data.column(cc.column_name), miss, cate_max)
+            cc.column_binning.bin_category = cats
+            cc.column_binning.bin_boundary = None
+            cc.column_binning.length = len(cats)
+        else:
+            vals = data.numeric(cc.column_name)
+            bounds = numeric_boundaries(
+                vals, tags, weights, mc.stats.binning_method, max_bins
+            )
+            cc.column_binning.bin_boundary = bounds
+            cc.column_binning.bin_category = None
+            cc.column_binning.length = len(bounds)
+
+    # ---- pass 2: one jit aggregation over the code matrix ----
+    codes, col_offsets, slots, values, numeric_cols = build_codes(data, stats_cols)
+    total_slots = int(sum(slots))
+    import jax.numpy as jnp
+
+    agg = bin_aggregate_jit(
+        jnp.asarray(codes),
+        jnp.asarray(col_offsets),
+        total_slots,
+        jnp.asarray(tags),
+        jnp.asarray(weights, dtype=jnp.float32),
+        jnp.asarray(values),
+    )
+    pos = np.asarray(agg.pos)
+    neg = np.asarray(agg.neg)
+    wpos = np.asarray(agg.wpos)
+    wneg = np.asarray(agg.wneg)
+
+    # ---- metrics: vectorized KS/IV/WOE over padded [C, max_slots] ----
+    max_slots = max(slots) if slots else 1
+    C = len(stats_cols)
+    pos_pad = np.zeros((C, max_slots), dtype=np.float64)
+    neg_pad = np.zeros_like(pos_pad)
+    wpos_pad = np.zeros_like(pos_pad)
+    wneg_pad = np.zeros_like(pos_pad)
+    bin_mask = np.zeros_like(pos_pad)
+    for j, cc in enumerate(stats_cols):
+        o, s = col_offsets[j], slots[j]
+        pos_pad[j, :s] = pos[o : o + s]
+        neg_pad[j, :s] = neg[o : o + s]
+        wpos_pad[j, :s] = wpos[o : o + s]
+        wneg_pad[j, :s] = wneg[o : o + s]
+        bin_mask[j, :s] = 1.0
+    cm = column_metrics(pos_pad, neg_pad, bin_mask)
+    wcm = column_metrics(wpos_pad, wneg_pad, bin_mask)
+
+    ks, iv, woe, bin_woe, cvalid = cm.ks, cm.iv, cm.woe, cm.bin_woe, cm.valid
+    wks, wiv, wwoe, wbin_woe = wcm.ks, wcm.iv, wcm.woe, wcm.bin_woe
+
+    vsum = np.asarray(agg.vsum)
+    vsumsq = np.asarray(agg.vsumsq)
+    vmin = np.asarray(agg.vmin)
+    vmax = np.asarray(agg.vmax)
+    vcount = np.asarray(agg.vcount)
+    vmissing = np.asarray(agg.vmissing)
+    num_index = {id(cc): k for k, cc in enumerate(numeric_cols)}
+
+    n_valid_rows = int((tags >= 0).sum())
+    for j, cc in enumerate(stats_cols):
+        s = slots[j]
+        st = cc.column_stats
+        bn = cc.column_binning
+        bn.bin_count_pos = [int(x) for x in pos_pad[j, :s]]
+        bn.bin_count_neg = [int(x) for x in neg_pad[j, :s]]
+        bn.bin_weighted_pos = [float(x) for x in wpos_pad[j, :s]]
+        bn.bin_weighted_neg = [float(x) for x in wneg_pad[j, :s]]
+        tot = pos_pad[j, :s] + neg_pad[j, :s]
+        with np.errstate(invalid="ignore", divide="ignore"):
+            rate = np.where(tot > 0, pos_pad[j, :s] / np.maximum(tot, 1e-12), 0.0)
+        bn.bin_pos_rate = [float(x) for x in rate]
+        if bool(cvalid[j]):
+            bn.bin_count_woe = [float(x) for x in bin_woe[j, :s]]
+            bn.bin_weighted_woe = [float(x) for x in wbin_woe[j, :s]]
+            st.ks = float(ks[j])
+            st.iv = float(iv[j])
+            st.woe = float(woe[j])
+            st.weighted_ks = float(wks[j])
+            st.weighted_iv = float(wiv[j])
+            st.weighted_woe = float(wwoe[j])
+        st.total_count = n_valid_rows
+
+        k = num_index.get(id(cc))
+        if k is not None:
+            cnt = float(vcount[k])
+            st.missing_count = int(vmissing[k])
+            st.missing_percentage = (
+                float(vmissing[k]) / max(n_valid_rows, 1) if n_valid_rows else 0.0
+            )
+            if cnt > 0:
+                mean = float(vsum[k]) / cnt
+                st.mean = mean
+                var = max(float(vsumsq[k]) / cnt - mean * mean, 0.0)
+                # sample std like the reference (BasicStatsCalculator)
+                st.std_dev = math.sqrt(var * cnt / max(cnt - 1, 1.0))
+                st.min = float(vmin[k])
+                st.max = float(vmax[k])
+                vals = data.numeric(cc.column_name)
+                finite = vals[np.isfinite(vals)]
+                st.median = float(np.median(finite)) if finite.size else None
+        else:
+            miss = data.missing_mask(cc.column_name)
+            st.missing_count = int(miss.sum())
+            st.missing_percentage = float(miss.mean()) if data.n_rows else 0.0
+            # categorical "mean" = overall pos rate (used by norm missing fill)
+            tot_all = pos_pad[j, :s].sum() + neg_pad[j, :s].sum()
+            st.mean = float(pos_pad[j, :s].sum() / tot_all) if tot_all else None

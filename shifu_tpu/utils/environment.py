@@ -37,9 +37,11 @@ def _ensure_loaded() -> None:
     if home:
         _load_file(os.path.join(home, "conf", "shifuconfig"))
     _load_file("/etc/shifuconfig")
+    # tier 2: SHIFU_* env vars override config files (tier 3, -D, overrides both
+    # via set_property)
     for k, v in os.environ.items():
-        if k.startswith("SHIFU_"):
-            _props.setdefault(k[len("SHIFU_"):].lower().replace("_", "."), v)
+        if k.startswith("SHIFU_") and k != "SHIFU_TPU_HOME":
+            _props[k[len("SHIFU_"):].lower().replace("_", ".")] = v
     _loaded = True
 
 

@@ -63,3 +63,12 @@ def test_disallowed_constructs_rejected():
 def test_noop():
     assert DataPurifier("").is_noop()
     assert combined_mask(None, COLS, 4).all()
+
+
+def test_quoted_literals_survive_rewrites():
+    cols = {"note": np.array(["a;b", "M eq F", "A&&B", "x"], dtype=object)}
+    assert DataPurifier("note == 'a;b'").mask(cols, 4).tolist() == [True, False, False, False]
+    assert DataPurifier('note == "M eq F"').mask(cols, 4).tolist() == [False, True, False, False]
+    assert DataPurifier('note == "A&&B"').mask(cols, 4).tolist() == [False, False, True, False]
+    assert combined_mask("note == 'a;b'; note != 'zzz'", cols, 4).tolist() == [
+        True, False, False, False]

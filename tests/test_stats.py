@@ -1,0 +1,177 @@
+"""Stats engine tests: metrics parity, binning, end-to-end stats step."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_model_set
+
+from shifu_tpu.config import load_column_config_list
+from shifu_tpu.processor.init import InitProcessor
+from shifu_tpu.processor.stats import StatsProcessor
+from shifu_tpu.stats.binning import (
+    numeric_bin_index,
+    numeric_boundaries,
+    weighted_quantile_boundaries,
+)
+from shifu_tpu.stats.metrics import column_metrics, psi_metric
+
+
+def test_metrics_match_reference_fixture_numbers():
+    """Numbers from the reference's cancer-judgement ColumnConfig.json for
+    column_4 (binCountNeg/binCountPos -> ks/iv/woe/binCountWoe)."""
+    neg = [111, 52, 19, 11, 5, 6, 7, 5, 8, 11]
+    pos = [12, 12, 13, 12, 12, 12, 12, 12, 12, 11]
+    cm = column_metrics(
+        np.asarray([pos], dtype=np.float64),
+        np.asarray([neg], dtype=np.float64),
+        np.ones((1, 10)),
+    )
+    assert bool(cm.valid[0])
+    assert float(cm.ks[0]) == pytest.approx(49.361702127659576, rel=1e-6)
+    assert float(cm.iv[0]) == pytest.approx(1.254393655186373, rel=1e-6)
+    assert float(cm.woe[0]) == pytest.approx(-0.6720937713617051, rel=1e-6)
+    assert float(cm.bin_woe[0, 0]) == pytest.approx(-1.5525297793739326, rel=1e-6)
+    assert float(cm.bin_woe[0, 9]) == pytest.approx(0.6720937703166583, rel=1e-6)
+
+
+def test_metrics_invalid_when_one_class_empty():
+    cm = column_metrics(
+        np.zeros((1, 4), dtype=np.float64),
+        np.ones((1, 4), dtype=np.float64),
+        np.ones((1, 4)),
+    )
+    assert not bool(cm.valid[0])
+
+
+def test_quantile_boundaries_equal_mass():
+    v = np.arange(1000, dtype=np.float64)
+    b = weighted_quantile_boundaries(v, None, 10)
+    assert b[0] == -math.inf
+    assert len(b) == 10
+    idx = numeric_bin_index(v, b)
+    counts = np.bincount(idx, minlength=11)[:10]
+    assert counts.min() >= 90 and counts.max() <= 110
+
+
+def test_bin_index_missing_goes_last():
+    b = [-math.inf, 1.0, 2.0]
+    v = np.array([0.5, 1.0, 1.5, 2.5, np.nan])
+    idx = numeric_bin_index(v, b)
+    assert idx.tolist() == [0, 1, 1, 2, 3]
+
+
+def test_equal_positive_binning_uses_positive_rows():
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([rng.normal(0, 1, 900), rng.normal(5, 1, 100)])
+    tags = np.concatenate([np.zeros(900, np.int32), np.ones(100, np.int32)])
+    w = np.ones(1000)
+    from shifu_tpu.config.model_config import BinningMethod
+
+    b = numeric_boundaries(vals, tags, w, BinningMethod.EQUAL_POSITIVE, 5)
+    # positives center on 5: all interior boundaries should sit near there
+    assert all(x > 2.0 for x in b[1:])
+
+
+def test_psi_metric_zero_for_identical():
+    assert psi_metric([10, 20, 30], [100, 200, 300]) == pytest.approx(0.0, abs=1e-9)
+    assert psi_metric([10, 20, 30], [30, 20, 10]) > 0.1
+
+
+@pytest.fixture(scope="module")
+def stats_model_set(tmp_path_factory):
+    root = make_model_set(str(tmp_path_factory.mktemp("ms")))
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root, correlation=True).run() == 0
+    return root
+
+
+def test_stats_end_to_end(stats_model_set):
+    root = stats_model_set
+    cols = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+    by_name = {c.column_name: c for c in cols}
+
+    num0 = by_name["num_0"]  # informative numeric column
+    assert num0.column_stats.mean is not None
+    assert num0.column_stats.std_dev is not None
+    assert num0.column_stats.ks is not None and num0.column_stats.ks > 5
+    assert num0.column_stats.iv is not None and num0.column_stats.iv > 0.05
+    assert num0.column_binning.bin_boundary[0] == -math.inf
+    # missing bin included: counts length == boundaries + 1
+    assert len(num0.column_binning.bin_count_pos) == len(
+        num0.column_binning.bin_boundary
+    ) + 1
+    assert num0.column_stats.missing_count > 0  # helper injects ~2% missing
+
+    cat0 = by_name["cat_0"]  # informative categorical column
+    assert cat0.column_binning.bin_category is not None
+    assert len(cat0.column_binning.bin_count_pos) == len(
+        cat0.column_binning.bin_category
+    ) + 1
+    assert cat0.column_stats.ks is not None and cat0.column_stats.ks > 5
+    # pos rate ordering encodes the signal: 'red' is the most positive color
+    cats = cat0.column_binning.bin_category
+    rates = cat0.column_binning.bin_pos_rate
+    assert rates[cats.index("red")] > rates[cats.index("green")]
+
+    # uninformative column has low KS
+    num1 = by_name["num_1"]
+    assert num1.column_stats.ks < num0.column_stats.ks
+
+    # totals conserved: pos+neg counts sum to total rows
+    tot = sum(num0.column_binning.bin_count_pos) + sum(
+        num0.column_binning.bin_count_neg
+    )
+    assert tot == num0.column_stats.total_count
+
+
+def test_correlation_artifact(stats_model_set):
+    root = stats_model_set
+    from shifu_tpu.stats.correlation import load_correlation_csv
+
+    corr, names = load_correlation_csv(
+        os.path.join(root, "tmp", "stats", "correlation.csv")
+    )
+    assert len(names) == corr.shape[0] == corr.shape[1]
+    assert np.allclose(np.diag(corr), 1.0, atol=1e-2)  # f32 matmul precision
+    assert np.all(np.abs(corr) <= 1.0 + 1e-2)
+
+
+def test_sharded_binagg_matches_single_device():
+    """8-virtual-device row-sharded aggregation == single-device result
+    (the multi-chip stats path)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from shifu_tpu.ops.binagg import bin_aggregate_jit, bin_aggregate_sharded
+
+    rng = np.random.default_rng(1)
+    n, c = 512, 6
+    slots = [5, 5, 5, 5, 5, 5]
+    codes = rng.integers(0, 5, size=(n, c)).astype(np.int32)
+    offsets = np.array([0, 5, 10, 15, 20, 25], dtype=np.int32)
+    tags = rng.integers(0, 2, size=n).astype(np.int32)
+    weights = rng.random(n).astype(np.float32)
+    values = rng.normal(size=(n, 3)).astype(np.float32)
+    values[rng.random((n, 3)) < 0.1] = np.nan
+
+    single = bin_aggregate_jit(
+        jnp.asarray(codes), jnp.asarray(offsets), 30, jnp.asarray(tags),
+        jnp.asarray(weights), jnp.asarray(values),
+    )
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest must force 8 virtual devices"
+    mesh = Mesh(np.array(devices), ("data",))
+    sharded = bin_aggregate_sharded(
+        mesh, jnp.asarray(codes), jnp.asarray(offsets), 30,
+        jnp.asarray(tags), jnp.asarray(weights), jnp.asarray(values),
+    )
+    np.testing.assert_allclose(np.asarray(single.pos), np.asarray(sharded.pos), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(single.wpos), np.asarray(sharded.wpos), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.vsum), np.asarray(sharded.vsum), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(single.vmin), np.asarray(sharded.vmin), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(single.vmissing), np.asarray(sharded.vmissing), rtol=1e-5)
