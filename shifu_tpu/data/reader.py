@@ -119,10 +119,13 @@ class ColumnarData:
         return ser.isin(list(self.missing_values)).to_numpy()
 
     def select_rows(self, mask: np.ndarray) -> "ColumnarData":
+        """Row subset (boolean mask) or reorder (integer index array)."""
+        raw = {k: v[mask] for k, v in self.raw.items()}
+        n = len(next(iter(raw.values()))) if raw else 0
         return ColumnarData(
             names=self.names,
-            raw={k: v[mask] for k, v in self.raw.items()},
-            n_rows=int(mask.sum()),
+            raw=raw,
+            n_rows=n,
             missing_values=self.missing_values,
         )
 

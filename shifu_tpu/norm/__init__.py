@@ -1,0 +1,6 @@
+from shifu_tpu.norm.normalizer import (  # noqa: F401
+    NormPlan,
+    build_norm_plan,
+    norm_columns,
+    normalize_dataset,
+)

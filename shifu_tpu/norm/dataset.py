@@ -1,0 +1,167 @@
+"""Sharded on-disk layout for normalized training data.
+
+Replaces the reference's Pig-written text NormalizedData
+(core/processor/NormalizeModelProcessor.java:183-252 + Normalize.pig): rows
+become float32 .npy shards that memory-map straight into host RAM and feed
+`jax.device_put` per mesh shard — no text re-parsing between norm and train.
+
+Layout under PathFinder.normalized_data_dir():
+    meta.json                 columns, n_rows, shard row counts, norm type
+    features-SSSSS.npy        [rows_s, n_cols] float32
+    tags-SSSSS.npy            [rows_s] int8   (1 pos / 0 neg)
+    weights-SSSSS.npy         [rows_s] float32
+and under cleaned_data_dir() (tree-model input, bin codes not z-scores):
+    codes-SSSSS.npy           [rows_s, n_feat] int16
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class NormMeta:
+    columns: List[str]
+    n_rows: int
+    shard_rows: List[int]
+    norm_type: str = "ZSCALE"
+    extra: Optional[dict] = None
+
+    def to_json(self) -> dict:
+        return {
+            "columns": self.columns,
+            "nRows": self.n_rows,
+            "shardRows": self.shard_rows,
+            "normType": self.norm_type,
+            "extra": self.extra or {},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "NormMeta":
+        return cls(
+            columns=list(d["columns"]),
+            n_rows=int(d["nRows"]),
+            shard_rows=[int(x) for x in d["shardRows"]],
+            norm_type=d.get("normType", "ZSCALE"),
+            extra=d.get("extra") or {},
+        )
+
+
+def _shard_slices(n_rows: int, n_shards: int) -> List[Tuple[int, int]]:
+    base, rem = divmod(n_rows, n_shards)
+    out, start = [], 0
+    for s in range(n_shards):
+        size = base + (1 if s < rem else 0)
+        out.append((start, start + size))
+        start += size
+    return out
+
+
+def write_normalized(
+    out_dir: str,
+    features: np.ndarray,
+    tags: np.ndarray,
+    weights: np.ndarray,
+    columns: List[str],
+    norm_type: str = "ZSCALE",
+    n_shards: int = 1,
+    extra: Optional[dict] = None,
+) -> NormMeta:
+    os.makedirs(out_dir, exist_ok=True)
+    n = features.shape[0]
+    n_shards = max(1, min(n_shards, max(n, 1)))
+    slices = _shard_slices(n, n_shards)
+    shard_rows = []
+    for s, (a, b) in enumerate(slices):
+        np.save(os.path.join(out_dir, f"features-{s:05d}.npy"),
+                features[a:b].astype(np.float32, copy=False))
+        np.save(os.path.join(out_dir, f"tags-{s:05d}.npy"),
+                tags[a:b].astype(np.int8, copy=False))
+        np.save(os.path.join(out_dir, f"weights-{s:05d}.npy"),
+                weights[a:b].astype(np.float32, copy=False))
+        shard_rows.append(b - a)
+    meta = NormMeta(columns=columns, n_rows=n, shard_rows=shard_rows,
+                    norm_type=norm_type, extra=extra)
+    with open(os.path.join(out_dir, "meta.json"), "w") as fh:
+        json.dump(meta.to_json(), fh, indent=2)
+    return meta
+
+
+def write_codes(
+    out_dir: str,
+    codes: np.ndarray,
+    tags: np.ndarray,
+    weights: np.ndarray,
+    columns: List[str],
+    slots: List[int],
+    n_shards: int = 1,
+) -> NormMeta:
+    """Tree-model input: int16 bin codes per feature + per-column slot counts."""
+    os.makedirs(out_dir, exist_ok=True)
+    n = codes.shape[0]
+    n_shards = max(1, min(n_shards, max(n, 1)))
+    slices = _shard_slices(n, n_shards)
+    # int16 covers the reference's 10k category cap; fall back for wider slots
+    code_dtype = np.int16 if (not slots or max(slots) < 2**15) else np.int32
+    shard_rows = []
+    for s, (a, b) in enumerate(slices):
+        np.save(os.path.join(out_dir, f"codes-{s:05d}.npy"),
+                codes[a:b].astype(code_dtype, copy=False))
+        np.save(os.path.join(out_dir, f"tags-{s:05d}.npy"),
+                tags[a:b].astype(np.int8, copy=False))
+        np.save(os.path.join(out_dir, f"weights-{s:05d}.npy"),
+                weights[a:b].astype(np.float32, copy=False))
+        shard_rows.append(b - a)
+    meta = NormMeta(columns=columns, n_rows=n, shard_rows=shard_rows,
+                    norm_type="CODES", extra={"slots": slots})
+    with open(os.path.join(out_dir, "meta.json"), "w") as fh:
+        json.dump(meta.to_json(), fh, indent=2)
+    return meta
+
+
+def read_meta(data_dir: str) -> NormMeta:
+    with open(os.path.join(data_dir, "meta.json")) as fh:
+        return NormMeta.from_json(json.load(fh))
+
+
+def _load_stack(data_dir: str, prefix: str, n_shards: int) -> np.ndarray:
+    parts = [
+        np.load(os.path.join(data_dir, f"{prefix}-{s:05d}.npy"), mmap_mode="r")
+        for s in range(n_shards)
+    ]
+    return np.concatenate(parts, axis=0) if len(parts) > 1 else np.asarray(parts[0])
+
+
+def load_normalized(
+    data_dir: str,
+) -> Tuple[NormMeta, np.ndarray, np.ndarray, np.ndarray]:
+    """(meta, features[n, C] f32, tags[n] i8, weights[n] f32)."""
+    meta = read_meta(data_dir)
+    k = len(meta.shard_rows)
+    feats = _load_stack(data_dir, "features", k)
+    tags = _load_stack(data_dir, "tags", k)
+    weights = _load_stack(data_dir, "weights", k)
+    return meta, feats, tags, weights
+
+
+def load_codes(
+    data_dir: str,
+) -> Tuple[NormMeta, np.ndarray, np.ndarray, np.ndarray]:
+    """(meta, codes[n, C] i16, tags[n] i8, weights[n] f32)."""
+    meta = read_meta(data_dir)
+    k = len(meta.shard_rows)
+    codes = _load_stack(data_dir, "codes", k)
+    tags = _load_stack(data_dir, "tags", k)
+    weights = _load_stack(data_dir, "weights", k)
+    return meta, codes, tags, weights
+
+
+def iter_shards(data_dir: str, prefix: str = "features") -> Iterator[np.ndarray]:
+    meta = read_meta(data_dir)
+    for s in range(len(meta.shard_rows)):
+        yield np.load(os.path.join(data_dir, f"{prefix}-{s:05d}.npy"), mmap_mode="r")

@@ -1,0 +1,121 @@
+"""`shifu norm` — produce the dense normalized training matrix.
+
+Parity: core/processor/NormalizeModelProcessor.java:67 (Normalize.pig +
+udf/NormalizeUDF) and the optional MR shuffle (core/shuffle/MapReduceShuffle).
+TPU-first shape: one pass builds BOTH artifacts every trainer needs —
+  NormalizedData/   float32 feature shards (NN/LR/WDL input)
+  CleanedData/      int16 bin-code shards (GBT/RF input; replaces the
+                    reference's raw-column CleanedData, the tree engine bins
+                    at the source instead of per-iteration)
+Shuffle is a host-side permutation before sharding (the MR shuffle's only
+purpose is balanced random shards — reference NormalizeModelProcessor.java:87).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from shifu_tpu.data.purify import combined_mask
+from shifu_tpu.data.reader import make_tags, make_weights, read_columnar, read_header
+from shifu_tpu.norm.dataset import write_codes, write_normalized
+from shifu_tpu.norm.normalizer import (
+    _slots,
+    apply_norm_plan,
+    bin_code_matrix,
+    build_norm_plan,
+    norm_columns,
+)
+from shifu_tpu.processor.basic import BasicProcessor
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+def default_shards() -> int:
+    try:
+        import jax
+
+        return max(1, len(jax.devices()))
+    except Exception:  # pragma: no cover - jax always present in CI
+        return 1
+
+
+class NormProcessor(BasicProcessor):
+    step = "norm"
+
+    def __init__(self, root: str = ".", shuffle: bool = False, seed: int = 0):
+        super().__init__(root)
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def run_step(self) -> None:
+        self.setup()
+        mc = self.model_config
+        assert mc is not None
+        ds = mc.data_set
+
+        if ds.header_path:
+            names = read_header(self.resolve(ds.header_path), ds.header_delimiter)
+        else:
+            names = [c.column_name for c in self.column_configs]
+        data = read_columnar(
+            self.resolve(ds.data_path),
+            names,
+            delimiter=ds.data_delimiter,
+            missing_values=tuple(ds.missing_or_invalid_values),
+        )
+
+        # purify + invalid-tag drop + norm sampling (NormalizeUDF filters rows
+        # through DataPurifier and sampler before emitting)
+        mask = combined_mask(ds.filter_expressions, data.raw, data.n_rows)
+        tags_all = make_tags(data.column(ds.target_column_name), ds.pos_tags, ds.neg_tags)
+        mask &= tags_all >= 0
+        if mc.normalize.sample_rate < 1.0:
+            rng = np.random.default_rng(self.seed)
+            keep = rng.random(data.n_rows) < mc.normalize.sample_rate
+            if mc.normalize.sample_neg_only:
+                keep |= tags_all == 1
+            mask &= keep
+        data = data.select_rows(mask)
+        tags = tags_all[mask]
+        weights = make_weights(data, ds.weight_column_name)
+
+        if self.shuffle:
+            perm = np.random.default_rng(self.seed).permutation(data.n_rows)
+            data = data.select_rows(perm)
+            tags = tags[perm]
+            weights = weights[perm]
+
+        plan = build_norm_plan(mc, self.column_configs)
+        code_cache: dict = {}
+        feats = apply_norm_plan(plan, data, code_cache=code_cache)
+        n_shards = default_shards()
+        out_dir = self.paths.normalized_data_dir()
+        write_normalized(
+            out_dir,
+            feats,
+            tags,
+            weights,
+            plan.out_names,
+            norm_type=mc.normalize.norm_type.value,
+            n_shards=n_shards,
+        )
+        log.info(
+            "normalized %d rows x %d cols (%s) -> %s [%d shards]",
+            feats.shape[0], feats.shape[1], mc.normalize.norm_type.value,
+            out_dir, n_shards,
+        )
+
+        # tree-model bin codes
+        tree_cols = norm_columns(self.column_configs)
+        codes = bin_code_matrix(tree_cols, data, cache=code_cache)
+        write_codes(
+            self.paths.cleaned_data_dir(),
+            codes,
+            tags,
+            weights,
+            [c.column_name for c in tree_cols],
+            [_slots(c) for c in tree_cols],
+            n_shards=n_shards,
+        )
+        log.info("bin codes -> %s", self.paths.cleaned_data_dir())

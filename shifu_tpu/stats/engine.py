@@ -213,6 +213,18 @@ def compute_stats(
             miss = data.missing_mask(cc.column_name)
             st.missing_count = int(miss.sum())
             st.missing_percentage = float(miss.mean()) if data.n_rows else 0.0
-            # categorical "mean" = overall pos rate (used by norm missing fill)
-            tot_all = pos_pad[j, :s].sum() + neg_pad[j, :s].sum()
-            st.mean = float(pos_pad[j, :s].sum() / tot_all) if tot_all else None
+            # Categorical stats are over the posrate-encoded variable (the
+            # reference's CategoricalVarStats maps value -> binPosRate then
+            # runs BasicStats) — closed form from the bin counts, incl. the
+            # missing bin. Norm's categorical z-scale depends on these.
+            tot_all = float(tot.sum())
+            if tot_all > 0:
+                mean = float((tot * rate).sum() / tot_all)
+                e2 = float((tot * rate * rate).sum() / tot_all)
+                var = max(e2 - mean * mean, 0.0)
+                st.mean = mean
+                st.std_dev = math.sqrt(var * tot_all / max(tot_all - 1.0, 1.0))
+                st.min = float(rate.min()) if s else None
+                st.max = float(rate.max()) if s else None
+            else:
+                st.mean = None

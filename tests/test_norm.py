@@ -1,0 +1,255 @@
+"""Normalize engine tests — NormType semantics parity with core/Normalizer.java
+(zscore clamp, woe lookup w/ missing bin, onehot expansion, index variants) and
+the end-to-end NormProcessor artifact layout."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import ColumnConfig, ColumnType
+from shifu_tpu.config.model_config import (
+    MissingValueFillType,
+    ModelConfig,
+    NormType,
+)
+from shifu_tpu.data.reader import ColumnarData
+from shifu_tpu.norm.dataset import load_codes, load_normalized
+from shifu_tpu.norm.normalizer import (
+    apply_norm_plan,
+    build_norm_plan,
+    woe_mean_std,
+)
+
+from tests.helpers import make_model_set
+
+
+def _num_col(name="x", mean=10.0, std=2.0, bounds=None, woe=None):
+    cc = ColumnConfig(column_num=1, column_name=name, column_type=ColumnType.N)
+    cc.final_select = True
+    cc.column_stats.mean = mean
+    cc.column_stats.std_dev = std
+    cc.column_stats.min = 4.0
+    cc.column_stats.max = 16.0
+    cc.column_binning.bin_boundary = bounds or [-math.inf, 8.0, 12.0]
+    nbins = len(cc.column_binning.bin_boundary) + 1
+    cc.column_binning.bin_count_woe = woe or [0.1 * i for i in range(nbins)]
+    cc.column_binning.bin_weighted_woe = cc.column_binning.bin_count_woe
+    cc.column_binning.bin_count_pos = [10] * nbins
+    cc.column_binning.bin_count_neg = [10] * nbins
+    cc.column_binning.bin_pos_rate = [0.5] * nbins
+    return cc
+
+
+def _cat_col(name="c", cats=("a", "b"), posrate=(0.8, 0.2, 0.5), woe=(1.0, -1.0, 0.0)):
+    cc = ColumnConfig(column_num=2, column_name=name, column_type=ColumnType.C)
+    cc.final_select = True
+    cc.column_binning.bin_category = list(cats)
+    cc.column_binning.bin_pos_rate = list(posrate)
+    cc.column_binning.bin_count_woe = list(woe)
+    cc.column_binning.bin_weighted_woe = list(woe)
+    cc.column_binning.bin_count_pos = [8, 2, 5]
+    cc.column_binning.bin_count_neg = [2, 8, 5]
+    # posrate-encoded mean/std as the stats engine computes them
+    cc.column_stats.mean = 0.5
+    cc.column_stats.std_dev = 0.3
+    return cc
+
+
+def _data(num_vals, cat_vals):
+    n = len(num_vals)
+    raw = {
+        "x": np.array([str(v) if v is not None else "" for v in num_vals], dtype=object),
+        "c": np.array([v if v is not None else "?" for v in cat_vals], dtype=object),
+    }
+    return ColumnarData(names=["x", "c"], raw=raw, n_rows=n)
+
+
+def _mc(norm_type, cutoff=4.0, fill=MissingValueFillType.POSRATE):
+    mc = ModelConfig()
+    mc.normalize.norm_type = norm_type
+    mc.normalize.std_dev_cut_off = cutoff
+    mc.normalize.category_missing_norm_type = fill
+    return mc
+
+
+class TestZScale:
+    def test_numeric_zscore_and_clamp(self):
+        cols = [_num_col()]
+        data = _data([10.0, 12.0, 100.0, -100.0], [])
+        data.names = ["x"]
+        data.raw.pop("c")
+        plan = build_norm_plan(_mc(NormType.ZSCALE), cols)
+        out = apply_norm_plan(plan, data)
+        # (v-10)/2 clamped at ±4 std
+        assert out[:, 0] == pytest.approx([0.0, 1.0, 4.0, -4.0], abs=1e-5)
+
+    def test_numeric_missing_goes_to_mean(self):
+        cols = [_num_col()]
+        data = _data([None, "bad"], [])
+        data.names = ["x"]
+        data.raw.pop("c")
+        plan = build_norm_plan(_mc(NormType.ZSCALE), cols)
+        out = apply_norm_plan(plan, data)
+        assert out[:, 0] == pytest.approx([0.0, 0.0], abs=1e-6)
+
+    def test_categorical_posrate_zscored(self):
+        cols = [_cat_col()]
+        data = _data([], ["a", "b", "zzz", None])
+        data.names = ["c"]
+        data.raw.pop("x")
+        plan = build_norm_plan(_mc(NormType.ZSCALE), cols)
+        out = apply_norm_plan(plan, data)
+        # posrate a=0.8, b=0.2; unseen/missing -> missing-bin posrate 0.5
+        exp = [(0.8 - 0.5) / 0.3, (0.2 - 0.5) / 0.3, 0.0, 0.0]
+        assert out[:, 0] == pytest.approx(exp, abs=1e-5)
+
+    def test_old_zscale_categorical_raw_posrate(self):
+        cols = [_cat_col()]
+        data = _data([], ["a", "b", None])
+        data.names = ["c"]
+        data.raw.pop("x")
+        plan = build_norm_plan(_mc(NormType.OLD_ZSCALE), cols)
+        out = apply_norm_plan(plan, data)
+        assert out[:, 0] == pytest.approx([0.8, 0.2, 0.5], abs=1e-6)
+
+    def test_zero_std_outputs_zero(self):
+        cols = [_num_col(std=0.0)]
+        data = _data([10.0, 99.0], [])
+        data.names = ["x"]
+        data.raw.pop("c")
+        out = apply_norm_plan(build_norm_plan(_mc(NormType.ZSCALE), cols), data)
+        assert out[:, 0] == pytest.approx([0.0, 0.0])
+
+
+class TestWoe:
+    def test_woe_lookup_and_missing_bin(self):
+        cols = [_num_col(woe=[0.5, -0.5, 0.2, 0.9]), _cat_col()]
+        data = _data([5.0, 9.0, 13.0, None], ["a", "b", "zzz", None])
+        plan = build_norm_plan(_mc(NormType.WOE), cols)
+        out = apply_norm_plan(plan, data)
+        # numeric: bins (-inf,8),(8,12),(12,inf); missing -> slot 3
+        assert out[:, 0] == pytest.approx([0.5, -0.5, 0.2, 0.9], abs=1e-6)
+        # categorical: woe a=1, b=-1; unseen+missing -> missing bin 0.0
+        assert out[:, 1] == pytest.approx([1.0, -1.0, 0.0, 0.0], abs=1e-6)
+
+    def test_woe_zscale_matches_reference_formula(self):
+        cc = _cat_col()
+        data = _data([], ["a", "b", None])
+        data.names = ["c"]
+        data.raw.pop("x")
+        plan = build_norm_plan(_mc(NormType.WOE_ZSCALE), [cc])
+        out = apply_norm_plan(plan, data)
+        m, s = woe_mean_std(cc, False)
+        exp = [(1.0 - m) / s, (-1.0 - m) / s, (0.0 - m) / s]
+        assert out[:, 0] == pytest.approx(exp, abs=1e-5)
+
+    def test_woe_mean_std_formula(self):
+        cc = _cat_col()
+        # counts: (10, 10, 10), woe (1, -1, 0) -> mean 0
+        m, s = woe_mean_std(cc, False)
+        assert m == pytest.approx(0.0)
+        # squaredSum=20, n=30 -> sqrt(20/29)
+        assert s == pytest.approx(math.sqrt(20.0 / 29.0))
+
+    def test_hybrid(self):
+        cols = [_num_col(), _cat_col()]
+        data = _data([12.0, 8.0], ["a", "b"])
+        out = apply_norm_plan(build_norm_plan(_mc(NormType.HYBRID), cols), data)
+        assert out[:, 0] == pytest.approx([1.0, -1.0], abs=1e-5)  # zscore
+        assert out[:, 1] == pytest.approx([1.0, -1.0], abs=1e-6)  # woe
+
+
+class TestOneHotIndex:
+    def test_onehot_expands_all_slots(self):
+        cols = [_num_col(), _cat_col()]
+        data = _data([5.0, None], ["b", "zzz"])
+        plan = build_norm_plan(_mc(NormType.ONEHOT), cols)
+        out = apply_norm_plan(plan, data)
+        # numeric 4 slots + cat 3 slots
+        assert out.shape == (2, 7)
+        assert out[0, :4].tolist() == [1, 0, 0, 0]
+        assert out[1, :4].tolist() == [0, 0, 0, 1]  # missing -> last
+        assert out[0, 4:].tolist() == [0, 1, 0]
+        assert out[1, 4:].tolist() == [0, 0, 1]  # unseen -> last
+        assert plan.out_names[0] == "x_0"
+
+    def test_zscale_onehot(self):
+        cols = [_num_col(), _cat_col()]
+        data = _data([12.0], ["a"])
+        plan = build_norm_plan(_mc(NormType.ZSCALE_ONEHOT), cols)
+        out = apply_norm_plan(plan, data)
+        assert out.shape == (1, 4)  # 1 zscore + 3 onehot
+        assert out[0, 0] == pytest.approx(1.0, abs=1e-5)
+        assert out[0, 1:].tolist() == [1, 0, 0]
+
+    def test_index_variants(self):
+        cols = [_num_col(), _cat_col()]
+        data = _data([12.0], ["b"])
+        plan = build_norm_plan(_mc(NormType.ZSCALE_INDEX), cols)
+        out = apply_norm_plan(plan, data)
+        assert out[0, 0] == pytest.approx(1.0, abs=1e-5)
+        assert out[0, 1] == pytest.approx(1.0)  # index of "b"
+
+        plan = build_norm_plan(_mc(NormType.WOE_INDEX), cols)
+        out = apply_norm_plan(plan, data)
+        assert out[0, 0] == pytest.approx(0.2, abs=1e-6)  # numeric woe bin 2
+        assert out[0, 1] == pytest.approx(1.0)
+
+    def test_discrete_zscale_snaps_to_boundary(self):
+        cols = [_num_col()]
+        data = _data([5.0, 9.0, 13.0, None], [])
+        data.names = ["x"]
+        data.raw.pop("c")
+        plan = build_norm_plan(_mc(NormType.DISCRETE_ZSCALE), cols)
+        out = apply_norm_plan(plan, data)
+        # bin0 -> min 4.0, bin1 -> 8.0, bin2 -> 12.0, missing -> mean 10
+        exp = [(4 - 10) / 2, (8 - 10) / 2, (12 - 10) / 2, 0.0]
+        assert out[:, 0] == pytest.approx(exp, abs=1e-5)
+
+    def test_asis(self):
+        cols = [_num_col(), _cat_col()]
+        data = _data([7.5, "bad"], ["a", "b"])
+        out = apply_norm_plan(build_norm_plan(_mc(NormType.ASIS_PR), cols), data)
+        assert out[0, 0] == pytest.approx(7.5)
+        assert out[1, 0] == pytest.approx(10.0)  # invalid -> mean
+        assert out[:, 1] == pytest.approx([0.8, 0.2])
+
+
+class TestNormProcessor:
+    def test_end_to_end_artifacts(self, tmp_path):
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=300)
+        cwd = os.getcwd()
+        os.chdir(root)
+        try:
+            from shifu_tpu.processor.init import InitProcessor
+            from shifu_tpu.processor.norm import NormProcessor
+            from shifu_tpu.processor.stats import StatsProcessor
+
+            assert InitProcessor(root).run() == 0
+            assert StatsProcessor(root).run() == 0
+            assert NormProcessor(root, shuffle=True).run() == 0
+        finally:
+            os.chdir(cwd)
+
+        from shifu_tpu.fs.pathfinder import PathFinder
+
+        paths = PathFinder(root)
+        meta, feats, tags, weights = load_normalized(paths.normalized_data_dir())
+        assert meta.n_rows == feats.shape[0] > 0
+        assert feats.shape[1] == len(meta.columns) == 12  # 10 num + 2 cat
+        assert feats.dtype == np.float32
+        assert set(np.unique(tags)).issubset({0, 1})
+        assert np.isfinite(feats).all()
+        # z-scaled numerics should be roughly centered
+        assert abs(float(feats[:, 0].mean())) < 1.0
+
+        cmeta, codes, ctags, cweights = load_codes(paths.cleaned_data_dir())
+        assert codes.shape == (meta.n_rows, 12)
+        assert codes.dtype == np.int16
+        slots = cmeta.extra["slots"]
+        assert len(slots) == 12
+        assert (codes < np.asarray(slots)[None, :]).all()
+        np.testing.assert_array_equal(np.asarray(ctags), np.asarray(tags))
