@@ -1,0 +1,221 @@
+"""MLP model: functional forward pass, weight init, and the .nn model spec.
+
+Replaces the reference's Encog network stack (core/dtrain/dataset/
+BasicFloatNetwork + FloatFlatNetwork flat-weight forward,
+DTrainUtils.generateNetwork:? network builder) and its two serializers
+(PersistBasicFloatNetwork EGB, nn/BinaryNNSerializer.java:44). Model math is
+pure jax over a {W_i, b_i} pytree; the on-disk spec is a self-describing
+binary (JSON header + raw float32 weights) loadable by IndependentNNModel
+with zero pipeline dependencies (parity target: nn/IndependentNNModel.java:58).
+
+Supported activations (nn/Activation*.java + wdl/activation/*): sigmoid,
+tanh, relu, leakyrelu, swish, ptanh (LeCun scaled tanh), linear, log,
+gaussian.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"STNN"
+FORMAT_VERSION = 1
+
+
+def activation_fn(name: str) -> Callable:
+    import jax.numpy as jnp
+
+    name = (name or "sigmoid").lower()
+    if name in ("sigmoid", "logistic"):
+        return lambda x: 1.0 / (1.0 + jnp.exp(-x))
+    if name == "tanh":
+        return jnp.tanh
+    if name == "relu":
+        return lambda x: jnp.maximum(x, 0.0)
+    if name in ("leakyrelu", "leaky_relu"):
+        return lambda x: jnp.where(x > 0, x, 0.01 * x)
+    if name == "swish":
+        return lambda x: x / (1.0 + jnp.exp(-x))
+    if name == "ptanh":  # LeCun scaled tanh (ActivationPTANH)
+        return lambda x: 1.7159 * jnp.tanh(x * 2.0 / 3.0)
+    if name == "linear":
+        return lambda x: x
+    if name == "log":
+        return lambda x: jnp.sign(x) * jnp.log1p(jnp.abs(x))
+    if name == "gaussian":
+        return lambda x: jnp.exp(-(x * x))
+    raise ValueError(f"unknown activation: {name}")
+
+
+def init_params(
+    layer_sizes: Sequence[int],
+    seed: int = 0,
+    init: str = "xavier",
+) -> List[Dict[str, np.ndarray]]:
+    """[{W: [in, out], b: [out]}] — Xavier/He/Lecun/Gaussian randomizers
+    (core/dtrain/random/*, 9 files)."""
+    rng = np.random.default_rng(seed)
+    params = []
+    for fan_in, fan_out in zip(layer_sizes[:-1], layer_sizes[1:]):
+        if init == "xavier":
+            limit = np.sqrt(6.0 / (fan_in + fan_out))
+            w = rng.uniform(-limit, limit, size=(fan_in, fan_out))
+        elif init == "he":
+            w = rng.normal(0.0, np.sqrt(2.0 / fan_in), size=(fan_in, fan_out))
+        elif init == "lecun":
+            w = rng.normal(0.0, np.sqrt(1.0 / fan_in), size=(fan_in, fan_out))
+        else:  # gaussian
+            w = rng.normal(0.0, 1.0, size=(fan_in, fan_out))
+        params.append(
+            {"W": w.astype(np.float32), "b": np.zeros(fan_out, dtype=np.float32)}
+        )
+    return params
+
+
+def forward(params, x, activations: Sequence[str], out_activation: str = "sigmoid"):
+    """x: [..., n_in] -> [..., n_out]. Hidden activations per layer; output
+    layer sigmoid for binary regression-mode scoring (reference networks end
+    in sigmoid — DTrainUtils.generateNetwork output ActivationSigmoid)."""
+    h = x
+    n_hidden = len(params) - 1
+    for i in range(n_hidden):
+        h = activation_fn(activations[i % len(activations)] if activations else "tanh")(
+            h @ params[i]["W"] + params[i]["b"]
+        )
+    out = h @ params[-1]["W"] + params[-1]["b"]
+    return activation_fn(out_activation)(out)
+
+
+def flatten_params(params) -> Tuple[np.ndarray, List[Tuple[int, int]]]:
+    """Pytree -> flat vector + layer shapes (Weight.java operates flat)."""
+    chunks, shapes = [], []
+    for layer in params:
+        shapes.append(layer["W"].shape)
+        chunks.append(np.asarray(layer["W"]).ravel())
+        chunks.append(np.asarray(layer["b"]).ravel())
+    return np.concatenate(chunks), shapes
+
+
+def unflatten_params(flat: np.ndarray, shapes: List[Tuple[int, int]]):
+    params, off = [], 0
+    for (fi, fo) in shapes:
+        w = flat[off : off + fi * fo].reshape(fi, fo)
+        off += fi * fo
+        b = flat[off : off + fo]
+        off += fo
+        params.append({"W": np.asarray(w), "b": np.asarray(b)})
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Model spec (.nn)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NNModelSpec:
+    """Self-contained scoring spec: columns + norm info + weights.
+
+    The reference's BinaryNNSerializer embeds per-column stats (NNColumnStats)
+    so IndependentNNModel can normalize raw input itself; we do the same via
+    a JSON header carrying the per-column norm plan summary."""
+
+    layer_sizes: List[int]
+    activations: List[str]
+    out_activation: str = "sigmoid"
+    input_columns: List[str] = field(default_factory=list)
+    norm_type: str = "ZSCALE"
+    algorithm: str = "NN"
+    loss: str = "squared"
+    # per-input-column normalization tables, mirrored from the NormPlan so the
+    # independent model can score RAW records: list of dicts
+    #   {name, kind: value|table|onehot, fill, mean, std, cutoff, table,
+    #    boundaries | categories}
+    norm_specs: List[Dict[str, Any]] = field(default_factory=list)
+    params: Optional[List[Dict[str, np.ndarray]]] = None
+    train_error: Optional[float] = None
+    valid_error: Optional[float] = None
+
+    def header(self) -> dict:
+        return {
+            "formatVersion": FORMAT_VERSION,
+            "algorithm": self.algorithm,
+            "layerSizes": self.layer_sizes,
+            "activations": self.activations,
+            "outActivation": self.out_activation,
+            "inputColumns": self.input_columns,
+            "normType": self.norm_type,
+            "loss": self.loss,
+            "normSpecs": self.norm_specs,
+            "trainError": self.train_error,
+            "validError": self.valid_error,
+        }
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        flat, shapes = flatten_params(self.params)
+        head = self.header()
+        head["layerShapes"] = [list(s) for s in shapes]
+        head_bytes = json.dumps(head).encode("utf-8")
+        with open(path, "wb") as fh:
+            fh.write(MAGIC)
+            fh.write(struct.pack("<I", len(head_bytes)))
+            fh.write(head_bytes)
+            fh.write(flat.astype("<f4").tobytes())
+
+    @classmethod
+    def load(cls, path: str) -> "NNModelSpec":
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if data[:4] != MAGIC:
+            raise ValueError(f"{path}: not a shifu-tpu .nn model")
+        (hlen,) = struct.unpack("<I", data[4:8])
+        head = json.loads(data[8 : 8 + hlen].decode("utf-8"))
+        flat = np.frombuffer(data[8 + hlen :], dtype="<f4")
+        shapes = [tuple(s) for s in head["layerShapes"]]
+        spec = cls(
+            layer_sizes=head["layerSizes"],
+            activations=head["activations"],
+            out_activation=head.get("outActivation", "sigmoid"),
+            input_columns=head.get("inputColumns", []),
+            norm_type=head.get("normType", "ZSCALE"),
+            algorithm=head.get("algorithm", "NN"),
+            loss=head.get("loss", "squared"),
+            norm_specs=head.get("normSpecs", []),
+            train_error=head.get("trainError"),
+            valid_error=head.get("validError"),
+        )
+        spec.params = unflatten_params(flat.copy(), shapes)
+        return spec
+
+
+class IndependentNNModel:
+    """Zero-dependency scorer over NORMALIZED input vectors; raw-record
+    scoring happens through shifu_tpu.eval.scorer which owns the norm plan.
+    Parity anchor: nn/IndependentNNModel.java:58."""
+
+    def __init__(self, spec: NNModelSpec):
+        self.spec = spec
+
+    @classmethod
+    def load(cls, path: str) -> "IndependentNNModel":
+        return cls(NNModelSpec.load(path))
+
+    def compute(self, x: np.ndarray) -> np.ndarray:
+        """x: [n, n_in] normalized features -> [n] score (first output)."""
+        h = np.asarray(x, dtype=np.float32)
+        import jax
+
+        out = jax.jit(
+            lambda inp: forward(
+                self.spec.params, inp, self.spec.activations, self.spec.out_activation
+            )
+        )(h)
+        out = np.asarray(out)
+        return out[:, 0] if out.ndim == 2 else out

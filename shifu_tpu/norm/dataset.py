@@ -62,24 +62,25 @@ def _shard_slices(n_rows: int, n_shards: int) -> List[Tuple[int, int]]:
     return out
 
 
-def write_normalized(
+def _write_sharded(
     out_dir: str,
-    features: np.ndarray,
+    primary_prefix: str,
+    primary: np.ndarray,
+    primary_dtype,
     tags: np.ndarray,
     weights: np.ndarray,
     columns: List[str],
-    norm_type: str = "ZSCALE",
-    n_shards: int = 1,
-    extra: Optional[dict] = None,
+    norm_type: str,
+    n_shards: int,
+    extra: Optional[dict],
 ) -> NormMeta:
     os.makedirs(out_dir, exist_ok=True)
-    n = features.shape[0]
+    n = primary.shape[0]
     n_shards = max(1, min(n_shards, max(n, 1)))
-    slices = _shard_slices(n, n_shards)
     shard_rows = []
-    for s, (a, b) in enumerate(slices):
-        np.save(os.path.join(out_dir, f"features-{s:05d}.npy"),
-                features[a:b].astype(np.float32, copy=False))
+    for s, (a, b) in enumerate(_shard_slices(n, n_shards)):
+        np.save(os.path.join(out_dir, f"{primary_prefix}-{s:05d}.npy"),
+                primary[a:b].astype(primary_dtype, copy=False))
         np.save(os.path.join(out_dir, f"tags-{s:05d}.npy"),
                 tags[a:b].astype(np.int8, copy=False))
         np.save(os.path.join(out_dir, f"weights-{s:05d}.npy"),
@@ -92,6 +93,20 @@ def write_normalized(
     return meta
 
 
+def write_normalized(
+    out_dir: str,
+    features: np.ndarray,
+    tags: np.ndarray,
+    weights: np.ndarray,
+    columns: List[str],
+    norm_type: str = "ZSCALE",
+    n_shards: int = 1,
+    extra: Optional[dict] = None,
+) -> NormMeta:
+    return _write_sharded(out_dir, "features", features, np.float32, tags,
+                          weights, columns, norm_type, n_shards, extra)
+
+
 def write_codes(
     out_dir: str,
     codes: np.ndarray,
@@ -101,27 +116,11 @@ def write_codes(
     slots: List[int],
     n_shards: int = 1,
 ) -> NormMeta:
-    """Tree-model input: int16 bin codes per feature + per-column slot counts."""
-    os.makedirs(out_dir, exist_ok=True)
-    n = codes.shape[0]
-    n_shards = max(1, min(n_shards, max(n, 1)))
-    slices = _shard_slices(n, n_shards)
-    # int16 covers the reference's 10k category cap; fall back for wider slots
+    """Tree-model input: int16 bin codes per feature + per-column slot counts.
+    int16 covers the reference's 10k category cap; wider slots use int32."""
     code_dtype = np.int16 if (not slots or max(slots) < 2**15) else np.int32
-    shard_rows = []
-    for s, (a, b) in enumerate(slices):
-        np.save(os.path.join(out_dir, f"codes-{s:05d}.npy"),
-                codes[a:b].astype(code_dtype, copy=False))
-        np.save(os.path.join(out_dir, f"tags-{s:05d}.npy"),
-                tags[a:b].astype(np.int8, copy=False))
-        np.save(os.path.join(out_dir, f"weights-{s:05d}.npy"),
-                weights[a:b].astype(np.float32, copy=False))
-        shard_rows.append(b - a)
-    meta = NormMeta(columns=columns, n_rows=n, shard_rows=shard_rows,
-                    norm_type="CODES", extra={"slots": slots})
-    with open(os.path.join(out_dir, "meta.json"), "w") as fh:
-        json.dump(meta.to_json(), fh, indent=2)
-    return meta
+    return _write_sharded(out_dir, "codes", codes, code_dtype, tags, weights,
+                          columns, "CODES", n_shards, {"slots": slots})
 
 
 def read_meta(data_dir: str) -> NormMeta:

@@ -326,8 +326,10 @@ def build_norm_plan(
     mc: ModelConfig, columns: List[ColumnConfig]
 ) -> NormPlan:
     nt = mc.normalize.norm_type
-    cutoff = mc.normalize.std_dev_cut_off or STD_DEV_CUTOFF
-    if not math.isfinite(cutoff):
+    cutoff = mc.normalize.std_dev_cut_off
+    # reference checkCutOff (Normalizer.java:708) rejects only null/NaN/Inf —
+    # an explicit 0.0 is legal (clamps everything to the mean)
+    if cutoff is None or not math.isfinite(cutoff):
         cutoff = STD_DEV_CUTOFF
     fill = mc.normalize.category_missing_norm_type
     specs = [
@@ -376,6 +378,47 @@ def bin_code_matrix(
     return out
 
 
+def _make_kernels():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def value_kernel(v, mean, std, zs, cutoff):
+        lo = mean - cutoff * std
+        hi = mean + cutoff * std
+        clamped = jnp.clip(v, lo[None, :], hi[None, :])
+        safe = jnp.where(std > MIN_STD, std, 1.0)
+        z = jnp.where(
+            std[None, :] > MIN_STD, (clamped - mean[None, :]) / safe[None, :], 0.0
+        )
+        return jnp.where(zs[None, :] > 0, z, clamped)
+
+    @jax.jit
+    def table_kernel(codes, tables):
+        return jnp.take_along_axis(
+            tables.T, jnp.clip(codes, 0, tables.shape[1] - 1), axis=0
+        )
+
+    return value_kernel, table_kernel
+
+
+def _value_kernel_jit(*args):
+    global _KERNELS
+    if _KERNELS is None:
+        _KERNELS = _make_kernels()
+    return _KERNELS[0](*args)
+
+
+def _table_kernel_jit(*args):
+    global _KERNELS
+    if _KERNELS is None:
+        _KERNELS = _make_kernels()
+    return _KERNELS[1](*args)
+
+
+_KERNELS = None
+
+
 def apply_norm_plan(
     plan: NormPlan,
     data: ColumnarData,
@@ -401,39 +444,31 @@ def apply_norm_plan(
 
     # ---- value columns: one [n, Cv] matrix, jit affine+clamp ----
     if value_specs:
-        vals = np.stack(
+        # missing-fill happens in float64 BEFORE the float32 cast so huge
+        # finite raw values overflow to inf and get CLAMPED (reference
+        # computeZScore clamps), not mistaken for missing and mean-filled
+        vals64 = np.stack(
             [data.numeric(s.cc.column_name) for s in value_specs], axis=1
-        ).astype(np.float32)
+        )
         fill = np.asarray([s.fill for s in value_specs], dtype=np.float32)
+        vals = np.where(
+            np.isfinite(vals64), vals64, fill.astype(np.float64)[None, :]
+        ).astype(np.float32)
         mean = np.asarray([s.mean for s in value_specs], dtype=np.float32)
         std = np.asarray([s.std for s in value_specs], dtype=np.float32)
         zs = np.asarray([1.0 if s.zscore else 0.0 for s in value_specs], np.float32)
         cutoff = np.float32(plan.cutoff)
 
         if use_jax:
-            import jax
-            import jax.numpy as jnp
-
-            @jax.jit
-            def value_kernel(v, fill, mean, std, zs):
-                v = jnp.where(jnp.isfinite(v), v, fill[None, :])
-                lo = mean - cutoff * std
-                hi = mean + cutoff * std
-                clamped = jnp.clip(v, lo[None, :], hi[None, :])
-                safe = jnp.where(std > MIN_STD, std, 1.0)
-                z = jnp.where(
-                    std[None, :] > MIN_STD, (clamped - mean[None, :]) / safe[None, :], 0.0
-                )
-                return jnp.where(zs[None, :] > 0, z, v)
-
-            out_vals = np.asarray(value_kernel(vals, fill, mean, std, zs))
+            out_vals = np.asarray(
+                _value_kernel_jit(vals, mean, std, zs, cutoff)
+            )
         else:
-            v = np.where(np.isfinite(vals), vals, fill[None, :])
             lo, hi = mean - cutoff * std, mean + cutoff * std
-            clamped = np.clip(v, lo[None, :], hi[None, :])
+            clamped = np.clip(vals, lo[None, :], hi[None, :])
             safe = np.where(std > MIN_STD, std, 1.0)
             z = np.where(std[None, :] > MIN_STD, (clamped - mean[None, :]) / safe, 0.0)
-            out_vals = np.where(zs[None, :] > 0, z, v).astype(np.float32)
+            out_vals = np.where(zs[None, :] > 0, z, vals).astype(np.float32)
         for k, s in enumerate(value_specs):
             pieces[id(s)] = out_vals[:, k : k + 1]
 
@@ -447,16 +482,7 @@ def apply_norm_plan(
         for k, s in enumerate(table_specs):
             tables[k, : s.table.size] = s.table
         if use_jax:
-            import jax
-            import jax.numpy as jnp
-
-            @jax.jit
-            def table_kernel(codes, tables):
-                return jnp.take_along_axis(
-                    tables.T, jnp.clip(codes, 0, tables.shape[1] - 1), axis=0
-                )
-
-            out_tab = np.asarray(table_kernel(codes, tables))
+            out_tab = np.asarray(_table_kernel_jit(codes, tables))
         else:
             out_tab = np.take_along_axis(
                 tables.T, np.clip(codes, 0, tables.shape[1] - 1), axis=0
@@ -474,6 +500,30 @@ def apply_norm_plan(
         pieces[id(s)] = oh
 
     return np.concatenate([pieces[id(s)] for s in plan.specs], axis=1)
+
+
+def spec_to_json(s: ColumnNormSpec) -> dict:
+    """Serializable summary of one column's norm mapping — embedded in model
+    specs so independent scorers can normalize raw records (the reference
+    embeds NNColumnStats in BinaryNNSerializer for the same reason)."""
+    d: dict = {"name": s.cc.column_name, "kind": s.kind, "outNames": s.out_names}
+    if s.kind == "value":
+        d.update(fill=s.fill, mean=s.mean, std=s.std, zscore=s.zscore)
+    elif s.kind == "table":
+        d["table"] = [float(x) for x in s.table]
+    if s.cc.is_categorical():
+        d["categories"] = list(s.cc.column_binning.bin_category or [])
+    else:
+        d["boundaries"] = [float(b) for b in (s.cc.column_binning.bin_boundary or [])]
+    return d
+
+
+def plan_to_json(plan: NormPlan) -> dict:
+    return {
+        "normType": plan.norm_type.value,
+        "cutoff": plan.cutoff,
+        "columns": [spec_to_json(s) for s in plan.specs],
+    }
 
 
 def normalize_dataset(

@@ -1,0 +1,5 @@
+from shifu_tpu.parallel.mesh import (  # noqa: F401
+    data_mesh,
+    pad_rows,
+    shard_rows,
+)

@@ -1,0 +1,68 @@
+"""Device-mesh helpers — the TPU-native replacement for the Guagua BSP layer.
+
+The reference runs master+workers as Hadoop mappers synchronized through
+ZooKeeper (SURVEY §5: guagua-mapreduce, NNParams Bytable exchange). Here the
+whole "cluster" is one SPMD program: rows are sharded over the mesh's `data`
+axis, weights are replicated, and XLA inserts the gradient all-reduce (the
+`psum` that replaces NNMaster.accumulateGradients) when the jitted train step
+consumes row-sharded inputs and produces replicated outputs.
+
+Axis names:
+    data   — row (batch) parallelism; every trainer uses it
+    model  — reserved for tensor-parallel WDL embedding shards
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def data_mesh(n_devices: Optional[int] = None, model_axis: int = 1):
+    """1-or-2-axis mesh over available devices: (data, model)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if model_axis > 1:
+        assert n % model_axis == 0, (n, model_axis)
+        dev = np.array(devices).reshape(n // model_axis, model_axis)
+        return Mesh(dev, ("data", "model"))
+    return Mesh(np.array(devices), ("data",))
+
+
+def pad_rows(
+    arrays: Sequence[np.ndarray], multiple: int
+) -> Tuple[list, int]:
+    """Pad row dimension to a multiple (sharding needs even splits). Padded
+    rows must carry zero significance — callers pad weights with 0."""
+    n = arrays[0].shape[0]
+    target = ((n + multiple - 1) // multiple) * multiple
+    if target == n:
+        return list(arrays), n
+    out = []
+    for a in arrays:
+        pad_shape = (target - n,) + a.shape[1:]
+        out.append(np.concatenate([a, np.zeros(pad_shape, dtype=a.dtype)], axis=0))
+    return out, n
+
+
+def shard_rows(array, mesh):
+    """Place an array on the mesh sharded along its leading (row) axis."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P("data", *([None] * (array.ndim - 1)))
+    return jax.device_put(array, NamedSharding(mesh, spec))
+
+
+def replicate(tree, mesh):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)

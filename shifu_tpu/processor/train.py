@@ -1,0 +1,243 @@
+"""`shifu train` — train model(s) on the normalized matrix.
+
+Parity: core/processor/TrainModelProcessor.java:105 — bagging fan-out,
+k-fold, grid search, continuous training, per-algorithm param wiring
+(prepareNNParams :1338 / prepareLRParams :1325), progress + val-error files.
+The Guagua job fan-out (runDistributedTrain:661) becomes: one jitted SPMD
+training run per bag member on the full device mesh; bagging members run
+sequentially but each reuses the compiled step (same shapes = jit cache hit).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from shifu_tpu.config.model_config import Algorithm
+from shifu_tpu.norm.dataset import load_normalized
+from shifu_tpu.norm.normalizer import build_norm_plan, plan_to_json
+from shifu_tpu.processor.basic import BasicProcessor
+from shifu_tpu.utils.errors import ErrorCode, ShifuError
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+class TrainProcessor(BasicProcessor):
+    step = "train"
+
+    def __init__(self, root: str = ".", dry: bool = False):
+        super().__init__(root)
+        self.dry = dry
+
+    # ---- helpers ----
+    def _model_suffix(self, alg: Algorithm) -> str:
+        return {
+            Algorithm.NN: "nn",
+            Algorithm.LR: "lr",
+            Algorithm.GBT: "gbt",
+            Algorithm.RF: "rf",
+            Algorithm.DT: "rf",
+            Algorithm.WDL: "wdl",
+        }.get(alg, "nn")
+
+    def run_step(self) -> None:
+        self.setup()
+        mc = self.model_config
+        assert mc is not None
+        alg = mc.train.algorithm
+
+        if self.dry:
+            log.info("dry run: config validated, algorithm=%s", alg.value)
+            return
+
+        if alg in (Algorithm.NN, Algorithm.LR, Algorithm.SVM):
+            self._train_nn_family(alg)
+        elif alg in (Algorithm.GBT, Algorithm.RF, Algorithm.DT):
+            self._train_tree_family(alg)
+        elif alg == Algorithm.WDL:
+            self._train_wdl()
+        else:
+            raise ShifuError(
+                ErrorCode.INVALID_MODEL_CONFIG, f"algorithm {alg.value} not supported"
+            )
+
+    # ---- NN / LR ----
+    def _train_nn_family(self, alg: Algorithm) -> None:
+        from shifu_tpu.train.grid_search import flatten_params
+        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
+
+        mc = self.model_config
+        norm_dir = self.paths.normalized_data_dir()
+        if not os.path.isdir(norm_dir):
+            raise ShifuError(
+                ErrorCode.DATA_NOT_FOUND, f"{norm_dir} — run `shifu norm` first"
+            )
+        meta, feats, tags, weights = load_normalized(norm_dir)
+        feats = np.asarray(feats, dtype=np.float32)
+        tags = np.asarray(tags, dtype=np.float32)
+        weights = np.asarray(weights, dtype=np.float32)
+        log.info("training on %d rows x %d features (%s)",
+                 feats.shape[0], feats.shape[1], alg.value)
+
+        mesh = self._mesh()
+        plan = build_norm_plan(mc, self.column_configs)
+        norm_json = plan_to_json(plan)
+        suffix = self._model_suffix(alg)
+        self.paths.ensure(self.paths.models_dir())
+        self.paths.ensure(self.paths.train_dir())
+
+        composites = flatten_params(
+            mc.train.params or {},
+            self.resolve(mc.train.grid_config_file)
+            if mc.train.grid_config_file
+            else None,
+        )
+        is_grid = len(composites) > 1
+        num_kfold = mc.train.num_k_fold or -1
+        bagging = max(1, int(mc.train.bagging_num or 1))
+
+        if is_grid:
+            best = self._grid_search(alg, composites, feats, tags, weights, mesh)
+            log.info("grid search best params: %s", best)
+            mc.train.params = best
+            composites = [best]
+
+        if num_kfold > 0:
+            self._k_fold(alg, num_kfold, feats, tags, weights, mesh, norm_json, suffix)
+            return
+
+        val_errors: List[float] = []
+        for i in range(bagging):
+            cfg = NNTrainConfig.from_model_config(mc, trainer_id=i)
+            init_flat = self._continuous_init(i, suffix) if mc.train.is_continuous else None
+            cfg.checkpoint_every = 10
+            cfg.checkpoint_path = os.path.join(
+                self.paths.ensure(self.paths.checkpoint_dir(i)), "weights.npy"
+            )
+            progress_path = self.paths.progress_path(i)
+
+            def progress(it, tr, va, _p=progress_path, _i=i):
+                with open(_p, "a") as fh:
+                    fh.write(
+                        f"Trainer {_i} Epoch #{it} Train Error:{tr:.8f} "
+                        f"Validation Error:{va:.8f}\n"
+                    )
+                log.info("trainer %d epoch %d train %.6f valid %.6f", _i, it, tr, va)
+
+            cfg.progress_cb = progress
+            result = train_nn(feats, tags, weights, cfg, mesh=mesh,
+                              init_flat=init_flat)
+            spec = self._make_spec(alg, cfg, result, meta.columns, norm_json)
+            path = self.paths.model_path(i, suffix)
+            spec.save(path)
+            with open(self.paths.val_error_path(i), "w") as fh:
+                fh.write(f"{result.valid_error}\n")
+            val_errors.append(result.valid_error)
+            log.info("model %d -> %s (valid err %.6f)", i, path, result.valid_error)
+
+        if len(val_errors) > 1:
+            log.info("bagging avg valid error: %.6f", float(np.mean(val_errors)))
+
+    def _grid_search(self, alg, composites, feats, tags, weights, mesh) -> dict:
+        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
+
+        mc = self.model_config
+        results = []
+        orig_params = mc.train.params
+        for gi, params in enumerate(composites):
+            mc.train.params = params
+            try:
+                cfg = NNTrainConfig.from_model_config(mc, trainer_id=gi)
+            finally:
+                mc.train.params = orig_params
+            res = train_nn(feats, tags, weights, cfg, mesh=mesh)
+            results.append((res.valid_error, gi, params))
+            log.info("grid trial %d/%d valid err %.6f params=%s",
+                     gi + 1, len(composites), res.valid_error, params)
+        results.sort(key=lambda r: r[0])
+        return results[0][2]
+
+    def _k_fold(self, alg, k, feats, tags, weights, mesh, norm_json, suffix) -> None:
+        """k models, fold i held out as validation; avg val error reported
+        (TrainModelProcessor.java:947-969)."""
+        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
+
+        mc = self.model_config
+        n = feats.shape[0]
+        fold = np.arange(n) % k
+        errors = []
+        for i in range(k):
+            cfg = NNTrainConfig.from_model_config(mc, trainer_id=i)
+            cfg.valid_set_rate = 0.0  # folds drive the split instead
+            val_mask = fold == i
+            w_train = np.where(val_mask, 0.0, weights).astype(np.float32)
+            res = train_nn(feats, tags, w_train, cfg, mesh=mesh)
+            # validation error on the held-out fold
+            from shifu_tpu.models.nn import IndependentNNModel, NNModelSpec
+
+            spec = self._make_spec(alg, cfg, res, [], norm_json)
+            scores = IndependentNNModel(spec).compute(feats[val_mask])
+            t = tags[val_mask]
+            err = float(np.mean((t - scores) ** 2)) if t.size else 0.0
+            errors.append(err)
+            spec.save(self.paths.model_path(i, suffix))
+            log.info("fold %d/%d holdout err %.6f", i + 1, k, err)
+        log.info("k-fold avg validation error: %.6f", float(np.mean(errors)))
+
+    def _continuous_init(self, i: int, suffix: str) -> Optional[np.ndarray]:
+        """Continuous training resumes from the existing model's weights
+        (checkContinuousTraining TrainModelProcessor.java:1149)."""
+        from shifu_tpu.models.nn import NNModelSpec, flatten_params
+
+        path = self.paths.model_path(i, suffix)
+        if not os.path.isfile(path):
+            return None
+        try:
+            spec = NNModelSpec.load(path)
+            flat, _ = flatten_params(spec.params)
+            log.info("continuous training: resuming model %d from %s", i, path)
+            return flat
+        except Exception as e:
+            log.warning("cannot resume from %s (%s); fresh start", path, e)
+            return None
+
+    def _make_spec(self, alg, cfg, result, columns, norm_json):
+        from shifu_tpu.models.nn import NNModelSpec
+
+        return NNModelSpec(
+            layer_sizes=[len(columns) if columns else result.params[0]["W"].shape[0]]
+            + list(cfg.hidden_nodes)
+            + [1],
+            activations=list(cfg.activations),
+            input_columns=list(columns),
+            norm_type=norm_json.get("normType", "ZSCALE"),
+            algorithm=alg.value,
+            loss=cfg.loss,
+            norm_specs=norm_json.get("columns", []),
+            params=result.params,
+            train_error=result.train_error,
+            valid_error=result.valid_error,
+        )
+
+    def _mesh(self):
+        try:
+            from shifu_tpu.parallel.mesh import data_mesh
+
+            return data_mesh()
+        except Exception:  # pragma: no cover
+            return None
+
+    # ---- trees / WDL: wired in by their engines ----
+    def _train_tree_family(self, alg: Algorithm) -> None:
+        from shifu_tpu.processor.train_tree import train_tree_models
+
+        train_tree_models(self, alg)
+
+    def _train_wdl(self) -> None:
+        from shifu_tpu.processor.train_wdl import train_wdl_models
+
+        train_wdl_models(self)

@@ -224,7 +224,8 @@ def compute_stats(
                 var = max(e2 - mean * mean, 0.0)
                 st.mean = mean
                 st.std_dev = math.sqrt(var * tot_all / max(tot_all - 1.0, 1.0))
-                st.min = float(rate.min()) if s else None
-                st.max = float(rate.max()) if s else None
+                occupied = rate[tot > 0]
+                st.min = float(occupied.min()) if occupied.size else None
+                st.max = float(occupied.max()) if occupied.size else None
             else:
                 st.mean = None

@@ -1,0 +1,388 @@
+"""Distributed NN/LR trainer — one jit-compiled SPMD program per training run.
+
+What the reference spreads across NNMaster/NNWorker/Guagua/ZooKeeper
+(SURVEY §3.1: per-iteration Bytable exchange, master gradient sum, Weight
+update, early-stop halt flag) collapses here into a single
+`lax.while_loop` inside jit:
+
+    worker shard gradients  -> row-sharded jnp.dot; XLA all-reduces (psum)
+                               when producing the replicated gradient
+    master Weight update    -> updaters.make_updater pure function
+    ZK halt flag            -> replicated bool in the loop carry
+    NNOutput checkpoints    -> host callback every `checkpoint_every` iters
+
+The gradient convention is Encog's: g = -dE/dw SUMMED over records (NNMaster
+sums worker gradients, NNMaster.java:240-249), error reported as the
+significance-weighted mean. LR decay per iteration (NNMaster.java:267),
+window early stop (earlystop/WindowEarlyStop.java:23), convergence threshold
+(ConvergeAndValidToleranceEarlyStop.java:22). Mini-batching via rotating
+contiguous chunks (MiniBatchs param, AbstractNNWorker). Bagging/validation
+sampling parity: AbstractNNWorker.sampleWeights:668 — Poisson counts when
+baggingWithReplacement else Bernoulli keep-mask.
+
+LR (algorithm=LR) is the same trainer with zero hidden layers and log loss
+(lr/LogisticRegressionWorker.java:302 computes the same sigmoid gradient).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.models.nn import (
+    NNModelSpec,
+    activation_fn,
+    flatten_params,
+    init_params,
+    unflatten_params,
+)
+from shifu_tpu.train.updaters import make_updater
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass
+class NNTrainConfig:
+    hidden_nodes: List[int] = field(default_factory=lambda: [50])
+    activations: List[str] = field(default_factory=lambda: ["tanh"])
+    learning_rate: float = 0.1
+    propagation: str = "Q"
+    momentum: float = 0.5
+    learning_decay: float = 0.0
+    regularized_constant: float = 0.0
+    reg_level: str = "NONE"  # NONE | L1 | L2 (RegulationLevel.java)
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    num_epochs: int = 100
+    mini_batchs: int = 1  # epoch split count; 1 = full batch
+    dropout_rate: float = 0.0
+    loss: str = "squared"  # squared | log | absolute (nn/*ErrorCalculation)
+    valid_set_rate: float = 0.2
+    bagging_sample_rate: float = 1.0
+    bagging_with_replacement: bool = False
+    early_stop_window: int = 0  # 0 = disabled
+    convergence_threshold: float = 0.0
+    weight_init: str = "xavier"
+    seed: int = 0
+    is_continuous: bool = False
+    checkpoint_every: int = 0
+    checkpoint_path: Optional[str] = None
+    progress_cb: Optional[Callable[[int, float, float], None]] = None
+
+    @classmethod
+    def from_model_config(cls, mc, trainer_id: int = 0) -> "NNTrainConfig":
+        """Wire train.params the way TrainModelProcessor.prepareNNParams
+        (TrainModelProcessor.java:1338) feeds NNMaster/Workers."""
+        t = mc.train
+        p = t.params or {}
+
+        def g(key, default):
+            v = t.get_param(key, default)
+            return default if v is None else v
+
+        alg = t.algorithm.value if hasattr(t.algorithm, "value") else str(t.algorithm)
+        hidden = list(g("NumHiddenNodes", [50]))
+        acts = [str(a) for a in g("ActivationFunc", ["tanh"])]
+        if alg == "LR":
+            hidden, acts = [], []
+        return cls(
+            hidden_nodes=hidden,
+            activations=acts,
+            learning_rate=float(g("LearningRate", 0.1)),
+            propagation=str(g("Propagation", "Q")),
+            momentum=float(g("Momentum", 0.5)),
+            learning_decay=float(g("LearningDecay", 0.0)),
+            regularized_constant=float(g("RegularizedConstant", 0.0)),
+            reg_level=str(g("L1orL2", "NONE")).upper(),
+            adam_beta1=float(g("AdamBeta1", 0.9)),
+            adam_beta2=float(g("AdamBeta2", 0.999)),
+            num_epochs=int(t.num_train_epochs or 100),
+            mini_batchs=max(1, int(g("MiniBatchs", 1))),
+            dropout_rate=float(g("DropoutRate", 0.0)),
+            loss=str(g("Loss", "log" if alg == "LR" else "squared")).lower(),
+            valid_set_rate=float(t.valid_set_rate or 0.0),
+            bagging_sample_rate=float(t.bagging_sample_rate or 1.0),
+            bagging_with_replacement=bool(t.bagging_with_replacement),
+            early_stop_window=int(g("EarlyStopWindowSize", 0)),
+            convergence_threshold=float(t.convergence_threshold or 0.0),
+            weight_init=str(g("WeightInitializer", "xavier")).lower(),
+            seed=trainer_id * 1000 + 7,
+        )
+
+
+@dataclass
+class TrainResult:
+    params: List[Dict[str, np.ndarray]]
+    train_error: float
+    valid_error: float
+    iterations: int
+    history: List[Tuple[int, float, float]] = field(default_factory=list)
+
+
+def split_and_sample(
+    n: int, cfg: NNTrainConfig
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(train significance multiplier [n], valid mask [n]) — bagging sampling
+    parity with AbstractNNWorker.sampleWeights:668."""
+    rng = np.random.default_rng(cfg.seed)
+    valid = rng.random(n) < cfg.valid_set_rate
+    if cfg.bagging_with_replacement:
+        sig = rng.poisson(cfg.bagging_sample_rate, size=n).astype(np.float32)
+    else:
+        sig = (rng.random(n) < cfg.bagging_sample_rate).astype(np.float32)
+    sig[valid] = 0.0
+    return sig, valid
+
+
+def _loss_and_errors(cfg: NNTrainConfig, shapes, n_flat: int):
+    """Build the jit-able (flat_w, x, t, sig_train, sig_valid, key) ->
+    (descent_grad, train_err, valid_err) function."""
+    import jax
+    import jax.numpy as jnp
+
+    acts = cfg.activations
+    n_hidden = len(cfg.hidden_nodes)
+    dropout = cfg.dropout_rate
+
+    def unflatten(flat):
+        params, off = [], 0
+        for (fi, fo) in shapes:
+            w = flat[off : off + fi * fo].reshape(fi, fo)
+            off += fi * fo
+            b = flat[off : off + fo]
+            off += fo
+            params.append({"W": w, "b": b})
+        return params
+
+    def fwd(params, x, key):
+        h = x
+        for i in range(n_hidden):
+            h = activation_fn(acts[i % len(acts)] if acts else "tanh")(
+                h @ params[i]["W"] + params[i]["b"]
+            )
+            if dropout > 0.0:
+                key, sub = jax.random.split(key)
+                keep = jax.random.bernoulli(sub, 1.0 - dropout, h.shape)
+                h = jnp.where(keep, h / (1.0 - dropout), 0.0)
+        out = h @ params[-1]["W"] + params[-1]["b"]
+        return activation_fn("sigmoid")(out)[:, 0]
+
+    def fwd_eval(params, x):
+        h = x
+        for i in range(n_hidden):
+            h = activation_fn(acts[i % len(acts)] if acts else "tanh")(
+                h @ params[i]["W"] + params[i]["b"]
+            )
+        out = h @ params[-1]["W"] + params[-1]["b"]
+        return activation_fn("sigmoid")(out)[:, 0]
+
+    def record_loss(p, t):
+        if cfg.loss == "log":
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return -(t * jnp.log(pc) + (1 - t) * jnp.log(1 - pc))
+        if cfg.loss == "absolute":
+            return jnp.abs(t - p)
+        return 0.5 * (t - p) ** 2
+
+    def total_loss(flat, x, t, sig, key):
+        params = unflatten(flat)
+        p = fwd(params, x, key)
+        return jnp.sum(sig * record_loss(p, t))
+
+    grad_fn = jax.grad(total_loss)
+
+    def step_metrics(flat, x, t, sig_train, sig_valid, key):
+        g = -grad_fn(flat, x, t, sig_train, key)  # descent direction, summed
+        params = unflatten(flat)
+        p = fwd_eval(params, x)
+        # reported errors are squared-error means like Encog calculateError
+        sq = (t - p) ** 2
+        train_err = jnp.sum(sig_train * sq) / jnp.maximum(jnp.sum(sig_train), 1.0)
+        valid_err = jnp.sum(sig_valid * sq) / jnp.maximum(jnp.sum(sig_valid), 1.0)
+        return g, train_err, valid_err
+
+    return step_metrics
+
+
+def train_nn(
+    features: np.ndarray,
+    tags: np.ndarray,
+    weights: np.ndarray,
+    cfg: NNTrainConfig,
+    mesh=None,
+    init_flat: Optional[np.ndarray] = None,
+) -> TrainResult:
+    """Train one model. features [n, d] float32 (normalized), tags [n] {0,1},
+    weights [n] significance. `mesh` shards rows over its `data` axis;
+    None = single device."""
+    import jax
+    import jax.numpy as jnp
+
+    n, d = features.shape
+    layer_sizes = [d] + list(cfg.hidden_nodes) + [1]
+    params0 = init_params(layer_sizes, seed=cfg.seed, init=cfg.weight_init)
+    flat0, shapes = flatten_params(params0)
+    if init_flat is not None and init_flat.size == flat0.size:
+        flat0 = init_flat.astype(np.float32)  # continuous training resume
+    n_flat = flat0.size
+
+    sig, valid_mask = split_and_sample(n, cfg)
+    sig_train = (sig * weights).astype(np.float32)
+    sig_valid = (valid_mask.astype(np.float32) * weights).astype(np.float32)
+    n_train_size = float(max(sig.sum(), 1.0))
+
+    init_state, apply_update = make_updater(
+        cfg.propagation,
+        cfg.learning_rate,
+        momentum=cfg.momentum,
+        reg=cfg.regularized_constant,
+        reg_level=cfg.reg_level,
+        num_train_size=n_train_size,
+        adam_beta1=cfg.adam_beta1,
+        adam_beta2=cfg.adam_beta2,
+    )
+
+    # ---- shard rows over the mesh; pad to even splits with zero significance
+    x = features.astype(np.float32)
+    t = tags.astype(np.float32)
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import pad_rows, replicate, shard_rows
+
+        n_dev = mesh.devices.size
+        (x, t, sig_train, sig_valid), _ = pad_rows(
+            [x, t, sig_train, sig_valid], n_dev
+        )
+        x = shard_rows(x, mesh)
+        t = shard_rows(t, mesh)
+        sig_train = shard_rows(sig_train, mesh)
+        sig_valid = shard_rows(sig_valid, mesh)
+
+    step_metrics = _loss_and_errors(cfg, shapes, n_flat)
+    opt0 = init_state(n_flat)
+
+    n_batches = cfg.mini_batchs
+    rows = x.shape[0]
+    batch = rows // n_batches if n_batches > 1 else rows
+
+    max_iters = cfg.num_epochs
+    window = cfg.early_stop_window
+    conv = cfg.convergence_threshold
+    decay = cfg.learning_decay
+    key0 = jax.random.PRNGKey(cfg.seed)
+
+    def one_iter(carry):
+        (flat, opt, it, lr, best_val, best_flat, bad, halt, tr_e, va_e) = carry
+        key = jax.random.fold_in(key0, it)
+        if n_batches > 1:
+            start = (it % n_batches) * batch
+            xs = jax.lax.dynamic_slice_in_dim(x, start, batch, 0)
+            ts = jax.lax.dynamic_slice_in_dim(t, start, batch, 0)
+            ss = jax.lax.dynamic_slice_in_dim(sig_train, start, batch, 0)
+            g, tr, _ = step_metrics(flat, xs, ts, ss, ss, key)
+            _, tr_full, va = step_metrics(flat, x, t, sig_train, sig_valid, key)
+            tr = tr_full
+        else:
+            g, tr, va = step_metrics(flat, x, t, sig_train, sig_valid, key)
+        new_flat, new_opt = apply_update(opt, flat, g, lr, it + 1)
+        improved = va < best_val
+        best_val2 = jnp.where(improved, va, best_val)
+        best_flat2 = jnp.where(improved, new_flat, best_flat)
+        bad2 = jnp.where(improved, 0, bad + 1)
+        halt2 = jnp.zeros((), dtype=bool)
+        if window > 0:
+            halt2 = halt2 | (bad2 >= window)
+        if conv > 0.0:
+            halt2 = halt2 | ((tr + va) / 2.0 <= conv)
+        lr2 = lr * (1.0 - decay)
+        return (new_flat, new_opt, it + 1, lr2, best_val2, best_flat2, bad2,
+                halt2, tr, va)
+
+    def cond(carry):
+        it, halt = carry[2], carry[7]
+        return (it < max_iters) & (~halt)
+
+    @jax.jit
+    def run(flat, opt):
+        carry = (
+            flat, opt, jnp.int32(0), jnp.float32(cfg.learning_rate),
+            jnp.float32(np.inf), flat, jnp.int32(0),
+            jnp.zeros((), dtype=bool), jnp.float32(0.0), jnp.float32(0.0),
+        )
+        return jax.lax.while_loop(cond, one_iter, carry)
+
+    flat_j = jnp.asarray(flat0)
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import replicate
+
+        flat_j = replicate(flat_j, mesh)
+        opt0 = replicate(opt0, mesh)
+
+    if cfg.checkpoint_every and cfg.checkpoint_every > 0:
+        result = _run_with_checkpoints(run, one_iter, cond, flat_j, opt0, cfg,
+                                       shapes, max_iters)
+    else:
+        result = run(flat_j, opt0)
+
+    (flat_f, _, it_f, _, best_val, best_flat, _, _, tr_e, va_e) = result
+    it_n = int(it_f)
+    best = np.asarray(best_flat)
+    final_valid = float(best_val) if math.isfinite(float(best_val)) else float(va_e)
+    use_best = cfg.valid_set_rate > 0 and math.isfinite(float(best_val))
+    chosen = best if use_best else np.asarray(flat_f)
+    params = unflatten_params(chosen, shapes)
+    log.info(
+        "train done: %d iterations, train_err %.6f valid_err %.6f",
+        it_n, float(tr_e), final_valid,
+    )
+    return TrainResult(
+        params=params,
+        train_error=float(tr_e),
+        valid_error=final_valid,
+        iterations=it_n,
+    )
+
+
+def _run_with_checkpoints(run, one_iter, cond, flat, opt, cfg, shapes, max_iters):
+    """Chunked run: jit loop in segments, checkpoint + progress between them
+    (NNOutput.postIteration:158 writes tmp models each epoch)."""
+    import jax
+    import jax.numpy as jnp
+
+    every = cfg.checkpoint_every
+
+    def seg_cond_factory(limit):
+        def c(carry):
+            return cond(carry) & (carry[2] < limit)
+
+        return c
+
+    @jax.jit
+    def run_until(carry, limit):
+        return jax.lax.while_loop(
+            lambda c: cond(c) & (c[2] < limit), one_iter, carry
+        )
+
+    carry = (
+        flat, opt, jnp.int32(0), jnp.float32(cfg.learning_rate),
+        jnp.float32(np.inf), flat, jnp.int32(0),
+        jnp.zeros((), dtype=bool), jnp.float32(0.0), jnp.float32(0.0),
+    )
+    it = 0
+    while it < max_iters:
+        limit = min(it + every, max_iters)
+        carry = run_until(carry, jnp.int32(limit))
+        it = int(carry[2])
+        tr, va = float(carry[8]), float(carry[9])
+        if cfg.progress_cb:
+            cfg.progress_cb(it, tr, va)
+        if cfg.checkpoint_path:
+            np.save(cfg.checkpoint_path, np.asarray(carry[0]))
+        if bool(carry[7]) or it >= max_iters:
+            break
+    return carry

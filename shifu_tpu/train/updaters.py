@@ -1,0 +1,226 @@
+"""Weight-update rules as pure jax functions over flat parameter vectors.
+
+Parity with core/dtrain/Weight.java (the master-side update machinery copied
+from Encog) and core/dtrain/nn/update/* — but expressed as (state, w, g) ->
+(w', state') pure functions so the whole training loop stays inside one jit.
+
+Convention inherited from Encog/the reference: `g` is the DESCENT direction
+(accumulated -dE/dw summed over records, NOT averaged), so every rule does
+`w += step(g)`. Propagation codes (train params "Propagation"):
+    B  back propagation w/ momentum     Weight.updateWeightBP:246
+    Q  quick propagation                Weight.updateWeightQBP:252
+    M  manhattan                        Weight.updateWeightMHP:300
+    R  resilient (RPROP+)               Weight.updateWeightRLP:313
+Optimizer names (train params "Propagation" again, reference overloads it):
+    ADAM / ADAGRAD / RMSPROP / MOMENTUM / NESTEROV   nn/update/*.java
+Regularization (non-optimizer path, Weight.calculateWeights:194-221): L2
+subtracts reg*w/numTrainSize from the step; L1 soft-thresholds the updated
+weight by reg/numTrainSize (the reference's L1 branch replaces the weight
+with the shrunk delta — an evident bug we do not reproduce).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+# RPROP constants (DTrainUtils.java:74-85, Weight.java:72-74)
+POSITIVE_ETA = 1.2
+NEGATIVE_ETA = 0.5
+DELTA_MIN = 1e-6
+DEFAULT_INITIAL_UPDATE = 0.1
+DEFAULT_MAX_STEP = 50.0
+ZERO_TOLERANCE = 1e-17
+QPROP_DECAY = 1e-4
+QPROP_OUTPUT_EPSILON = 0.35
+
+UpdateFn = Callable[..., Tuple[Any, Dict[str, Any]]]
+
+
+def _zeros_like(n, jnp):
+    return jnp.zeros((n,), dtype=jnp.float32)
+
+
+def make_updater(
+    propagation: str,
+    learning_rate: float,
+    momentum: float = 0.5,
+    reg: float = 0.0,
+    reg_level: str = "NONE",
+    num_train_size: float = 1.0,
+    adam_beta1: float = 0.9,
+    adam_beta2: float = 0.999,
+):
+    """Returns (init_state(n_weights) -> state,
+                apply(state, w, g, lr, iteration) -> (w', state')).
+
+    lr is threaded per-iteration so NNMaster's learning decay
+    (NNMaster.java:267 lr *= 1-learningDecay) composes outside."""
+    import jax.numpy as jnp
+
+    prop = (propagation or "Q").upper()
+
+    def regularize(w, step):
+        """Apply the step plus L1/L2 regularization (Weight.java:199-218)."""
+        if reg_level == "L2" and reg != 0.0:
+            return w + step - reg * w / num_train_size
+        if reg_level == "L1" and reg != 0.0:
+            shrink = reg / num_train_size
+            updated = w + step
+            return jnp.sign(updated) * jnp.maximum(0.0, jnp.abs(updated) - shrink)
+        return w + step
+
+    if prop == "B":
+
+        def init(n):
+            return {"last_delta": _zeros_like(n, jnp)}
+
+        def apply(state, w, g, lr, it):
+            delta = g * lr + state["last_delta"] * momentum
+            return regularize(w, delta), {"last_delta": delta}
+
+        return init, apply
+
+    if prop == "M":
+
+        def init(n):
+            return {}
+
+        def apply(state, w, g, lr, it):
+            step = jnp.where(
+                jnp.abs(g) < ZERO_TOLERANCE, 0.0, jnp.sign(g) * lr
+            )
+            return regularize(w, step), state
+
+        return init, apply
+
+    if prop == "Q":
+        # Quickprop (Weight.updateWeightQBP:252-297). eps/shrink derive from
+        # the CONSTRUCTION-time lr and train size (Weight.java:146-147).
+        eps = QPROP_OUTPUT_EPSILON / max(num_train_size, 1.0)
+
+        def init(n):
+            return {
+                "last_delta": _zeros_like(n, jnp),
+                "last_gradient": _zeros_like(n, jnp),
+            }
+
+        def apply(state, w, g, lr, it):
+            shrink = lr / (1.0 + lr)
+            d = state["last_delta"]
+            s = -g + QPROP_DECAY * w
+            p = -state["last_gradient"]
+            quad = d * s / (p - s)
+            lin = -eps * s
+            step_neg = jnp.where(s > 0.0, lin, 0.0) + jnp.where(
+                s >= shrink * p, lr * d, quad
+            )
+            step_pos = jnp.where(s < 0.0, lin, 0.0) + jnp.where(
+                s <= shrink * p, lr * d, quad
+            )
+            next_step = jnp.where(
+                d < 0.0, step_neg, jnp.where(d > 0.0, step_pos, lin)
+            )
+            return regularize(w, next_step), {
+                "last_delta": next_step,
+                "last_gradient": g,
+            }
+
+        return init, apply
+
+    if prop == "R":
+        # RPROP+ (Weight.updateWeightRLP:313-343): per-weight adaptive step,
+        # sign-change backtracking, last gradient zeroed after a reversal.
+        def init(n):
+            return {
+                "update_values": jnp.full((n,), DEFAULT_INITIAL_UPDATE, jnp.float32),
+                "last_gradient": _zeros_like(n, jnp),
+                "last_delta": _zeros_like(n, jnp),
+            }
+
+        def apply(state, w, g, lr, it):
+            change = jnp.sign(g * state["last_gradient"])
+            upd = state["update_values"]
+            delta_pos = jnp.minimum(upd * POSITIVE_ETA, DEFAULT_MAX_STEP)
+            delta_neg = jnp.maximum(upd * NEGATIVE_ETA, DELTA_MIN)
+            new_upd = jnp.where(
+                change > 0, delta_pos, jnp.where(change < 0, delta_neg, upd)
+            )
+            wchange = jnp.where(
+                change > 0,
+                jnp.sign(g) * delta_pos,
+                jnp.where(change < 0, -state["last_delta"], jnp.sign(g) * upd),
+            )
+            new_last_g = jnp.where(change < 0, 0.0, g)
+            return regularize(w, wchange), {
+                "update_values": new_upd,
+                "last_gradient": new_last_g,
+                "last_delta": wchange,
+            }
+
+        return init, apply
+
+    if prop == "ADAM":
+
+        def init(n):
+            return {"m": _zeros_like(n, jnp), "v": _zeros_like(n, jnp)}
+
+        def apply(state, w, g, lr, it):
+            m = adam_beta1 * state["m"] + (1 - adam_beta1) * g
+            v = adam_beta2 * state["v"] + (1 - adam_beta2) * g * g
+            it_f = jnp.maximum(it.astype(jnp.float32), 1.0)
+            m_hat = m / (1 - adam_beta1**it_f)
+            v_hat = v / (1 - adam_beta2**it_f)
+            step = lr * m_hat / (jnp.sqrt(v_hat) + 1e-8)
+            return w + step, {"m": m, "v": v}
+
+        return init, apply
+
+    if prop == "ADAGRAD":
+
+        def init(n):
+            return {"sum_sq": _zeros_like(n, jnp)}
+
+        def apply(state, w, g, lr, it):
+            s = state["sum_sq"] + g * g
+            step = lr * g / (jnp.sqrt(s) + 1e-8)
+            return w + step, {"sum_sq": s}
+
+        return init, apply
+
+    if prop == "RMSPROP":
+
+        def init(n):
+            return {"cache": _zeros_like(n, jnp)}
+
+        def apply(state, w, g, lr, it):
+            cache = 0.9 * state["cache"] + 0.1 * g * g
+            step = lr * g / (jnp.sqrt(cache) + 1e-8)
+            return w + step, {"cache": cache}
+
+        return init, apply
+
+    if prop == "MOMENTUM":
+
+        def init(n):
+            return {"v": _zeros_like(n, jnp)}
+
+        def apply(state, w, g, lr, it):
+            v = momentum * state["v"] + lr * g
+            return w + v, {"v": v}
+
+        return init, apply
+
+    if prop == "NESTEROV":
+
+        def init(n):
+            return {"v": _zeros_like(n, jnp)}
+
+        def apply(state, w, g, lr, it):
+            v_prev = state["v"]
+            v = momentum * v_prev - lr * (-g)  # g is descent dir: v = mom*v + lr*g
+            w_new = w - momentum * v_prev + (1 + momentum) * v
+            return w_new, {"v": v}
+
+        return init, apply
+
+    raise ValueError(f"unknown propagation/optimizer: {propagation}")

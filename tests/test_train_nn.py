@@ -1,0 +1,205 @@
+"""NN/LR trainer tests.
+
+Mirrors the reference's cluster-free strategy (core/dtrain/DTrainTest.java:44
+simulates 24 workers in-process and asserts error decreases): here the same
+pure train step runs on an 8-virtual-device mesh, and sharded vs single-device
+gradients must agree.
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.train.nn_trainer import NNTrainConfig, TrainResult, train_nn
+from shifu_tpu.train.updaters import make_updater
+
+
+def make_xor_like(n=512, d=6, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    logits = 1.5 * x[:, 0] - 2.0 * x[:, 1] + 0.8 * x[:, 2] * x[:, 3]
+    t = (logits + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
+    w = np.ones(n, dtype=np.float32)
+    return x, t, w
+
+
+class TestUpdaters:
+    def _roundtrip(self, prop, **kw):
+        import jax.numpy as jnp
+
+        init, apply = make_updater(prop, 0.1, num_train_size=100.0, **kw)
+        w = jnp.ones(5)
+        g = jnp.asarray([0.5, -0.5, 0.0, 1.0, -1.0])
+        state = init(5)
+        w2, state2 = apply(state, w, g, jnp.float32(0.1), jnp.int32(1))
+        return np.asarray(w), np.asarray(w2)
+
+    def test_backprop_step(self):
+        w, w2 = self._roundtrip("B")
+        # delta = g*lr (no momentum history)
+        np.testing.assert_allclose(w2 - w, [0.05, -0.05, 0.0, 0.1, -0.1], atol=1e-6)
+
+    def test_manhattan_step(self):
+        w, w2 = self._roundtrip("M")
+        np.testing.assert_allclose(w2 - w, [0.1, -0.1, 0.0, 0.1, -0.1], atol=1e-6)
+
+    def test_rprop_first_step_uses_initial_update(self):
+        w, w2 = self._roundtrip("R")
+        # change == 0 on first iter -> sign(g) * 0.1 initial update
+        np.testing.assert_allclose(w2 - w, [0.1, -0.1, 0.0, 0.1, -0.1], atol=1e-6)
+
+    def test_adam_first_step_is_lr_sized(self):
+        w, w2 = self._roundtrip("ADAM")
+        # bias-corrected first adam step = lr * sign(g)
+        np.testing.assert_allclose(
+            w2 - w, [0.1, -0.1, 0.0, 0.1, -0.1], atol=1e-3
+        )
+
+    def test_l2_regularization_shrinks(self):
+        import jax.numpy as jnp
+
+        init, apply = make_updater(
+            "B", 0.1, reg=10.0, reg_level="L2", num_train_size=100.0
+        )
+        w = jnp.ones(3)
+        g = jnp.zeros(3)
+        w2, _ = apply(init(3), w, g, jnp.float32(0.1), jnp.int32(1))
+        np.testing.assert_allclose(np.asarray(w2), [0.9, 0.9, 0.9], atol=1e-6)
+
+    def test_all_rules_run(self):
+        for prop in ["B", "Q", "M", "R", "ADAM", "ADAGRAD", "RMSPROP",
+                     "MOMENTUM", "NESTEROV"]:
+            w, w2 = self._roundtrip(prop)
+            assert np.isfinite(w2).all(), prop
+
+
+class TestTrainNN:
+    def test_error_decreases_and_converges(self):
+        x, t, w = make_xor_like()
+        cfg = NNTrainConfig(
+            hidden_nodes=[16], activations=["tanh"], learning_rate=0.1,
+            propagation="R", num_epochs=60, valid_set_rate=0.2, seed=1,
+        )
+        res = train_nn(x, t, w, cfg)
+        assert res.iterations == 60
+        assert res.valid_error < 0.15  # vs ~0.24 baseline variance of labels
+
+    def test_lr_zero_hidden_layers(self):
+        x, t, w = make_xor_like()
+        cfg = NNTrainConfig(
+            hidden_nodes=[], activations=[], learning_rate=0.5,
+            propagation="ADAM", loss="log", num_epochs=80, valid_set_rate=0.2,
+        )
+        res = train_nn(x, t, w, cfg)
+        assert len(res.params) == 1  # single linear layer
+        assert res.valid_error < 0.2
+
+    def test_early_stop_window_halts(self):
+        x, t, w = make_xor_like(n=256)
+        cfg = NNTrainConfig(
+            hidden_nodes=[8], num_epochs=500, valid_set_rate=0.3,
+            early_stop_window=5, propagation="R", seed=2,
+        )
+        res = train_nn(x, t, w, cfg)
+        assert res.iterations < 500
+
+    def test_mesh_sharded_matches_single_device(self):
+        """DP sharding must not change the math: same seed, same result."""
+        from shifu_tpu.parallel.mesh import data_mesh
+
+        x, t, w = make_xor_like(n=264)  # not divisible by 8 -> exercises padding
+        cfg = NNTrainConfig(hidden_nodes=[8], num_epochs=10, propagation="B",
+                            valid_set_rate=0.25, seed=5)
+        res_single = train_nn(x, t, w, cfg)
+        mesh = data_mesh()
+        assert mesh.devices.size == 8
+        res_mesh = train_nn(x, t, w, cfg, mesh=mesh)
+        f1, _ = _flat(res_single)
+        f2, _ = _flat(res_mesh)
+        np.testing.assert_allclose(f1, f2, rtol=2e-3, atol=2e-4)
+
+    def test_bagging_sampling_with_replacement(self):
+        from shifu_tpu.train.nn_trainer import split_and_sample
+
+        cfg = NNTrainConfig(valid_set_rate=0.2, bagging_sample_rate=1.0,
+                            bagging_with_replacement=True, seed=11)
+        sig, valid = split_and_sample(10_000, cfg)
+        assert (sig[valid] == 0).all()
+        nonval = sig[~valid]
+        assert nonval.max() > 1  # poisson produces counts > 1
+        assert abs(nonval.mean() - 1.0) < 0.05
+
+    def test_minibatch_runs(self):
+        x, t, w = make_xor_like(n=512)
+        cfg = NNTrainConfig(hidden_nodes=[8], num_epochs=30, mini_batchs=4,
+                            propagation="ADAM", learning_rate=0.05,
+                            valid_set_rate=0.2)
+        res = train_nn(x, t, w, cfg)
+        assert res.valid_error < 0.25
+
+    def test_continuous_init_resumes(self):
+        x, t, w = make_xor_like(n=256)
+        cfg = NNTrainConfig(hidden_nodes=[8], num_epochs=20, propagation="R",
+                            valid_set_rate=0.2, seed=9)
+        res1 = train_nn(x, t, w, cfg)
+        flat1, shapes = _flat(res1)
+        res2 = train_nn(x, t, w, cfg, init_flat=flat1)
+        assert res2.valid_error <= res1.valid_error + 0.02
+
+
+def _flat(res: TrainResult):
+    from shifu_tpu.models.nn import flatten_params
+
+    return flatten_params(res.params)
+
+
+class TestModelSpec:
+    def test_save_load_roundtrip(self, tmp_path):
+        from shifu_tpu.models.nn import IndependentNNModel, NNModelSpec
+
+        x, t, w = make_xor_like(n=128)
+        cfg = NNTrainConfig(hidden_nodes=[8], num_epochs=15, valid_set_rate=0.2)
+        res = train_nn(x, t, w, cfg)
+        spec = NNModelSpec(
+            layer_sizes=[x.shape[1], 8, 1],
+            activations=["tanh"],
+            input_columns=[f"f{i}" for i in range(x.shape[1])],
+            params=res.params,
+            train_error=res.train_error,
+            valid_error=res.valid_error,
+        )
+        path = str(tmp_path / "model0.nn")
+        spec.save(path)
+        loaded = NNModelSpec.load(path)
+        assert loaded.layer_sizes == spec.layer_sizes
+        s1 = IndependentNNModel(spec).compute(x[:10])
+        s2 = IndependentNNModel(loaded).compute(x[:10])
+        np.testing.assert_allclose(s1, s2, atol=1e-6)
+        assert ((s1 >= 0) & (s1 <= 1)).all()
+
+
+class TestGridSearch:
+    def test_flatten_cartesian(self):
+        from shifu_tpu.train.grid_search import flatten_params
+
+        out = flatten_params(
+            {"LearningRate": [0.1, 0.2], "NumHiddenNodes": [[10], [20]],
+             "Propagation": "R"}
+        )
+        assert len(out) == 4
+        assert all(o["Propagation"] == "R" for o in out)
+        assert {o["LearningRate"] for o in out} == {0.1, 0.2}
+
+    def test_plain_params_single(self):
+        from shifu_tpu.train.grid_search import flatten_params
+
+        out = flatten_params({"LearningRate": 0.1, "NumHiddenNodes": [10]})
+        assert len(out) == 1
+
+    def test_threshold_caps(self):
+        from shifu_tpu.train.grid_search import flatten_params
+
+        out = flatten_params({"A": list(range(10)), "B": list(range(10))})
+        assert len(out) == 30  # default shifu.gridsearch.threshold

@@ -1,0 +1,97 @@
+"""End-to-end `shifu train` on a synthetic model set (NN + LR paths),
+mirroring ShifuCLITest.java:102-210's init->stats->norm->train drive."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_model_set
+
+
+@pytest.fixture()
+def trained_root(tmp_path):
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=500)
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    return root
+
+
+def _set_train(root, **kw):
+    from shifu_tpu.config.model_config import ModelConfig
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    for k, v in kw.items():
+        setattr(mc.train, k, v)
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    return mc
+
+
+def test_train_nn_end_to_end(trained_root):
+    root = trained_root
+    _set_train(root, num_train_epochs=40)
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert TrainProcessor(root).run() == 0
+    model_path = os.path.join(root, "models", "model0.nn")
+    assert os.path.isfile(model_path)
+
+    from shifu_tpu.models.nn import IndependentNNModel, NNModelSpec
+    from shifu_tpu.norm.dataset import load_normalized
+
+    spec = NNModelSpec.load(model_path)
+    assert spec.algorithm == "NN"
+    assert spec.norm_specs  # embedded norm plan for independent scoring
+    assert spec.valid_error is not None and spec.valid_error < 0.15
+
+    _, feats, tags, _ = load_normalized(
+        os.path.join(root, "tmp", "norm", "NormalizedData")
+    )
+    scores = IndependentNNModel(spec).compute(np.asarray(feats))
+    # model separates the classes: mean score of pos >> neg
+    pos = scores[np.asarray(tags) == 1].mean()
+    neg = scores[np.asarray(tags) == 0].mean()
+    assert pos - neg > 0.4
+
+    # progress + val error artifacts (NNOutput parity)
+    assert os.path.isfile(os.path.join(root, "tmp", "train", "progress_0.log"))
+    assert os.path.isfile(os.path.join(root, "tmp", "train", "val_error_0.txt"))
+
+
+def test_train_lr_and_bagging(trained_root):
+    root = trained_root
+    mc = _set_train(root, num_train_epochs=30, bagging_num=2)
+    mc.train.algorithm = type(mc.train.algorithm).LR
+    mc.train.params = {"LearningRate": 0.3, "Propagation": "ADAM"}
+    mc.save(os.path.join(root, "ModelConfig.json"))
+
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert TrainProcessor(root).run() == 0
+    assert os.path.isfile(os.path.join(root, "models", "model0.lr"))
+    assert os.path.isfile(os.path.join(root, "models", "model1.lr"))
+
+    from shifu_tpu.models.nn import NNModelSpec
+
+    spec = NNModelSpec.load(os.path.join(root, "models", "model0.lr"))
+    assert spec.layer_sizes[1] == 1  # no hidden layer
+    assert spec.loss == "log"
+
+
+def test_train_continuous_resume(trained_root):
+    root = trained_root
+    _set_train(root, num_train_epochs=15)
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert TrainProcessor(root).run() == 0
+    first = os.path.getmtime(os.path.join(root, "models", "model0.nn"))
+    _set_train(root, num_train_epochs=15, is_continuous=True)
+    assert TrainProcessor(root).run() == 0
+    assert os.path.getmtime(os.path.join(root, "models", "model0.nn")) >= first
