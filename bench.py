@@ -1,0 +1,101 @@
+"""Benchmark: NN training throughput vs a measured Encog-style CPU baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so the baseline is MEASURED
+here: the same full-batch MLP train step (fwd + backprop + RPROP update,
+double precision like Encog's FloatFlatNetwork path) implemented in numpy on
+one core — what one reference Hadoop worker does per iteration — scaled by
+the reference's nominal 100-worker cluster. vs_baseline > 1.0 means one TPU
+chip out-trains the modeled 100-node Hadoop deployment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+# single-core baseline: pin BLAS threads BEFORE numpy loads
+os.environ.setdefault("OPENBLAS_NUM_THREADS", "1")
+os.environ.setdefault("OMP_NUM_THREADS", "1")
+os.environ.setdefault("MKL_NUM_THREADS", "1")
+
+import numpy as np
+
+N_REFERENCE_WORKERS = 100  # north-star cluster size (BASELINE.md)
+
+
+def numpy_worker_row_epochs_per_s(d: int = 30, h: int = 50, n: int = 20_000) -> float:
+    """One Encog-worker-equivalent: full-batch fwd+backprop in float64."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d))
+    t = (rng.random(n) < 0.5).astype(np.float64)
+    w1 = rng.normal(size=(d, h)) * 0.1
+    b1 = np.zeros(h)
+    w2 = rng.normal(size=(h, 1)) * 0.1
+    b2 = np.zeros(1)
+
+    def step():
+        z1 = x @ w1 + b1
+        a1 = np.tanh(z1)
+        z2 = a1 @ w2 + b2
+        p = 1.0 / (1.0 + np.exp(-z2[:, 0]))
+        delta2 = ((t - p) * p * (1 - p))[:, None]
+        g_w2 = a1.T @ delta2
+        delta1 = (delta2 @ w2.T) * (1 - a1 * a1)
+        g_w1 = x.T @ delta1
+        return g_w1.sum() + g_w2.sum()
+
+    step()  # warm caches
+    reps, t0 = 3, time.perf_counter()
+    for _ in range(reps):
+        step()
+    dt = (time.perf_counter() - t0) / reps
+    return n / dt
+
+
+def main() -> None:
+    from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
+
+    rng = np.random.default_rng(0)
+    n, d = 1_000_000, 30
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    logits = x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
+    t = (logits + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    w = np.ones(n, dtype=np.float32)
+
+    epochs = 50
+    cfg = NNTrainConfig(
+        hidden_nodes=[50], activations=["tanh"], propagation="R",
+        num_epochs=epochs, valid_set_rate=0.1, seed=1, mixed_precision=True,
+    )
+
+    # resident dataset: upload once, train from HBM (the reference's workers
+    # likewise hold their shard in memory across iterations)
+    import jax
+
+    x_dev = jax.device_put(x)
+    t_dev = jax.device_put(t)
+
+    # warmup: compiles the program (epoch count is a traced arg, so the
+    # 2-epoch warmup warms the full run)
+    warm = NNTrainConfig(**{**cfg.__dict__, "num_epochs": 2})
+    train_nn(x_dev, t_dev, w, warm)
+
+    t0 = time.perf_counter()
+    res = train_nn(x_dev, t_dev, w, cfg)
+    dt = time.perf_counter() - t0
+
+    throughput = n * res.iterations / dt
+    baseline = numpy_worker_row_epochs_per_s(d=d) * N_REFERENCE_WORKERS
+    print(json.dumps({
+        "metric": "nn_train_row_epochs_per_s",
+        "value": round(throughput, 1),
+        "unit": "row-epochs/s",
+        "vs_baseline": round(throughput / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,220 @@
+"""Eval metrics: confusion sweep, PR/ROC/gain bucketing, AUC.
+
+The reference streams sorted scores through a buffered confusion matrix
+(core/ConfusionMatrix.java:248 bufferedComputeConfusionMatrixAndPerformance,
+core/PerformanceEvaluator.java:252 bucketing, core/eval/AreaUnderCurve.java:31
+trapezoid). Vectorized here: sort scores descending once, cumulative sums give
+every threshold's (tp, fp, tn, fn) in one pass — the whole sweep is O(n log n)
+on device-friendly dense arrays instead of a streaming loop.
+
+PerformanceObject field parity (container/PerformanceObject.java): binNum,
+binLowestScore, tp/fp/tn/fn (+weighted), precision/recall/fpr (+weighted),
+actionRate, liftUnit. Bucket selection parity with
+PerformanceEvaluator.bucketing: FPR list keyed on fpr crossings, catch-rate
+list on recall crossings, gain list on action-rate crossings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class ConfusionSweep:
+    """Cumulative confusion state at each score threshold (descending)."""
+
+    scores: np.ndarray  # sorted descending
+    tp: np.ndarray
+    fp: np.ndarray
+    fn: np.ndarray
+    tn: np.ndarray
+    wtp: np.ndarray
+    wfp: np.ndarray
+    wfn: np.ndarray
+    wtn: np.ndarray
+    total: int
+    pos_total: float
+    neg_total: float
+    wpos_total: float
+    wneg_total: float
+
+
+def confusion_sweep(
+    scores: np.ndarray, tags: np.ndarray, weights: Optional[np.ndarray] = None
+) -> ConfusionSweep:
+    scores = np.asarray(scores, dtype=np.float64)
+    tags = np.asarray(tags, dtype=np.float64)
+    w = (
+        np.ones_like(scores)
+        if weights is None
+        else np.asarray(weights, dtype=np.float64)
+    )
+    order = np.argsort(-scores, kind="stable")
+    s, t, w = scores[order], tags[order], w[order]
+    tp = np.cumsum(t)
+    fp = np.cumsum(1.0 - t)
+    wtp = np.cumsum(t * w)
+    wfp = np.cumsum((1.0 - t) * w)
+    pos_total, neg_total = float(tp[-1]) if t.size else 0.0, float(fp[-1]) if t.size else 0.0
+    wpos_total = float(wtp[-1]) if t.size else 0.0
+    wneg_total = float(wfp[-1]) if t.size else 0.0
+    return ConfusionSweep(
+        scores=s,
+        tp=tp,
+        fp=fp,
+        fn=pos_total - tp,
+        tn=neg_total - fp,
+        wtp=wtp,
+        wfp=wfp,
+        wfn=wpos_total - wtp,
+        wtn=wneg_total - wfp,
+        total=int(t.size),
+        pos_total=pos_total,
+        neg_total=neg_total,
+        wpos_total=wpos_total,
+        wneg_total=wneg_total,
+    )
+
+
+def area_under_curve(fpr: np.ndarray, recall: np.ndarray) -> float:
+    """Trapezoid AUC over the ROC polyline incl. (0,0) and (1,1) endpoints
+    (AreaUnderCurve.java:31)."""
+    x = np.concatenate([[0.0], fpr, [1.0]])
+    y = np.concatenate([[0.0], recall, [1.0]])
+    return float(np.trapezoid(y, x))
+
+
+def auc_from_sweep(cs: ConfusionSweep, weighted: bool = False) -> float:
+    if weighted:
+        fpr = cs.wfp / max(cs.wneg_total, 1e-12)
+        rec = cs.wtp / max(cs.wpos_total, 1e-12)
+    else:
+        fpr = cs.fp / max(cs.neg_total, 1e-12)
+        rec = cs.tp / max(cs.pos_total, 1e-12)
+    return area_under_curve(fpr, rec)
+
+
+def _perf_object(cs: ConfusionSweep, i: int, bin_num: int) -> Dict:
+    tp, fp = float(cs.tp[i]), float(cs.fp[i])
+    fn, tn = float(cs.fn[i]), float(cs.tn[i])
+    wtp, wfp = float(cs.wtp[i]), float(cs.wfp[i])
+    wfn, wtn = float(cs.wfn[i]), float(cs.wtn[i])
+    pos, neg = cs.pos_total, cs.neg_total
+    wpos, wneg = cs.wpos_total, cs.wneg_total
+    action = (tp + fp) / max(cs.total, 1)
+    waction = (wtp + wfp) / max(wpos + wneg, 1e-12)
+    recall = tp / max(pos, 1e-12)
+    wrecall = wtp / max(wpos, 1e-12)
+    precision = tp / max(tp + fp, 1e-12)
+    wprecision = wtp / max(wtp + wfp, 1e-12)
+    return {
+        "binNum": bin_num,
+        "binLowestScore": float(cs.scores[i]),
+        "tp": tp, "fp": fp, "fn": fn, "tn": tn,
+        "weightedTp": wtp, "weightedFp": wfp,
+        "weightedFn": wfn, "weightedTn": wtn,
+        "precision": precision,
+        "weightedPrecision": wprecision,
+        "recall": recall,
+        "weightedRecall": wrecall,
+        "fpr": fp / max(neg, 1e-12),
+        "weightedFpr": wfp / max(wneg, 1e-12),
+        "actionRate": action,
+        "weightedActionRate": waction,
+        "liftUnit": recall / action if action > 0 else 0.0,
+        "weightLiftUnit": wrecall / waction if waction > 0 else 0.0,
+    }
+
+
+@dataclass
+class PerformanceResult:
+    pr: List[Dict] = field(default_factory=list)
+    weighted_pr: List[Dict] = field(default_factory=list)
+    roc: List[Dict] = field(default_factory=list)
+    weighted_roc: List[Dict] = field(default_factory=list)
+    gains: List[Dict] = field(default_factory=list)
+    weighted_gains: List[Dict] = field(default_factory=list)
+    area_under_roc: float = 0.0
+    weighted_area_under_roc: float = 0.0
+
+    def to_json(self) -> dict:
+        return {
+            "version": "1.0",
+            "pr": self.pr,
+            "weightedPr": self.weighted_pr,
+            "roc": self.roc,
+            "weightedRoc": self.weighted_roc,
+            "gains": self.gains,
+            "weightedGains": self.weighted_gains,
+            "areaUnderRoc": self.area_under_roc,
+            "weightedAreaUnderRoc": self.weighted_area_under_roc,
+        }
+
+
+def evaluate_performance(
+    scores: np.ndarray,
+    tags: np.ndarray,
+    weights: Optional[np.ndarray] = None,
+    n_buckets: int = 10,
+) -> PerformanceResult:
+    """Bucketed PR/ROC/gain lists + AUC (PerformanceEvaluator.bucketing
+    crossing rules: emit a row the first time the tracked rate crosses each
+    1/numBucket boundary)."""
+    cs = confusion_sweep(scores, tags, weights)
+    res = PerformanceResult()
+    if cs.total == 0:
+        return res
+    cap = 1.0 / n_buckets
+
+    fpr = cs.fp / max(cs.neg_total, 1e-12)
+    rec = cs.tp / max(cs.pos_total, 1e-12)
+    act = (cs.tp + cs.fp) / max(cs.total, 1)
+    wfpr = cs.wfp / max(cs.wneg_total, 1e-12)
+    wrec = cs.wtp / max(cs.wpos_total, 1e-12)
+    wact = (cs.wtp + cs.wfp) / max(cs.wpos_total + cs.wneg_total, 1e-12)
+
+    def pick(series) -> List[Dict]:
+        out = [_first_po(cs)]
+        nxt = 1
+        for i in range(1, cs.total):
+            if series[i] >= nxt * cap:
+                out.append(_perf_object(cs, i, nxt))
+                nxt += 1
+        return out
+
+    res.roc = pick(fpr)
+    res.pr = pick(rec)
+    res.gains = pick(act)
+    res.weighted_roc = pick(wfpr)
+    res.weighted_pr = pick(wrec)
+    res.weighted_gains = pick(wact)
+    res.area_under_roc = auc_from_sweep(cs)
+    res.weighted_area_under_roc = auc_from_sweep(cs, weighted=True)
+    return res
+
+
+def _first_po(cs: ConfusionSweep) -> Dict:
+    po = _perf_object(cs, 0, 0)
+    # reference pins the first row's NaN-prone fields (bucketing :272-282)
+    po["precision"] = 1.0
+    po["weightedPrecision"] = 1.0
+    po["liftUnit"] = 0.0
+    po["weightLiftUnit"] = 0.0
+    return po
+
+
+def confusion_matrix_rows(
+    cs: ConfusionSweep, step: int = 0
+) -> List[Dict]:
+    """Per-threshold confusion rows for EvalConfusionMatrix.csv; `step`
+    subsamples to at most ~1000 rows for wide datasets."""
+    n = cs.total
+    if step <= 0:
+        step = max(1, n // 1000)
+    rows = []
+    for i in range(0, n, step):
+        rows.append(_perf_object(cs, i, i // step))
+    return rows

@@ -138,6 +138,7 @@ class NNModelSpec:
     #   {name, kind: value|table|onehot, fill, mean, std, cutoff, table,
     #    boundaries | categories}
     norm_specs: List[Dict[str, Any]] = field(default_factory=list)
+    norm_cutoff: float = 4.0
     params: Optional[List[Dict[str, np.ndarray]]] = None
     train_error: Optional[float] = None
     valid_error: Optional[float] = None
@@ -153,6 +154,7 @@ class NNModelSpec:
             "normType": self.norm_type,
             "loss": self.loss,
             "normSpecs": self.norm_specs,
+            "normCutoff": self.norm_cutoff,
             "trainError": self.train_error,
             "validError": self.valid_error,
         }
@@ -188,6 +190,7 @@ class NNModelSpec:
             algorithm=head.get("algorithm", "NN"),
             loss=head.get("loss", "squared"),
             norm_specs=head.get("normSpecs", []),
+            norm_cutoff=float(head.get("normCutoff", 4.0)),
             train_error=head.get("trainError"),
             valid_error=head.get("validError"),
         )
@@ -202,6 +205,7 @@ class IndependentNNModel:
 
     def __init__(self, spec: NNModelSpec):
         self.spec = spec
+        self._fwd = None  # jitted forward, created once per model
 
     @classmethod
     def load(cls, path: str) -> "IndependentNNModel":
@@ -210,12 +214,14 @@ class IndependentNNModel:
     def compute(self, x: np.ndarray) -> np.ndarray:
         """x: [n, n_in] normalized features -> [n] score (first output)."""
         h = np.asarray(x, dtype=np.float32)
-        import jax
+        if self._fwd is None:
+            import jax
 
-        out = jax.jit(
-            lambda inp: forward(
-                self.spec.params, inp, self.spec.activations, self.spec.out_activation
+            self._fwd = jax.jit(
+                lambda inp: forward(
+                    self.spec.params, inp, self.spec.activations,
+                    self.spec.out_activation,
+                )
             )
-        )(h)
-        out = np.asarray(out)
+        out = np.asarray(self._fwd(h))
         return out[:, 0] if out.ndim == 2 else out

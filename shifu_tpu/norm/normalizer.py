@@ -391,7 +391,9 @@ def _make_kernels():
         z = jnp.where(
             std[None, :] > MIN_STD, (clamped - mean[None, :]) / safe[None, :], 0.0
         )
-        return jnp.where(zs[None, :] > 0, z, clamped)
+        # non-zscore (ASIS) columns pass through UNclamped (asIsNormalize
+        # parity: only invalid values are touched, never clamped)
+        return jnp.where(zs[None, :] > 0, z, v)
 
     @jax.jit
     def table_kernel(codes, tables):
@@ -524,6 +526,38 @@ def plan_to_json(plan: NormPlan) -> dict:
         "cutoff": plan.cutoff,
         "columns": [spec_to_json(s) for s in plan.specs],
     }
+
+
+def plan_from_json(d: dict) -> NormPlan:
+    """Rebuild an applicable NormPlan from a model-embedded norm summary, so
+    independent scorers normalize raw eval records without ColumnConfig."""
+    from shifu_tpu.config.column_config import ColumnType
+
+    specs = []
+    for cd in d.get("columns", []):
+        cc = ColumnConfig(column_name=cd["name"])
+        if "categories" in cd:
+            cc.column_type = ColumnType.C
+            cc.column_binning.bin_category = list(cd["categories"])
+        else:
+            cc.column_type = ColumnType.N
+            cc.column_binning.bin_boundary = [float(b) for b in cd.get("boundaries", [])]
+        kind = cd["kind"]
+        spec = ColumnNormSpec(
+            cc=cc,
+            kind=kind,
+            out_names=list(cd["outNames"]),
+            fill=float(cd.get("fill", 0.0)),
+            mean=float(cd.get("mean", 0.0)),
+            std=float(cd.get("std", 0.0)),
+            zscore=bool(cd.get("zscore", True)),
+            table=np.asarray(cd["table"], dtype=np.float64)
+            if cd.get("table") is not None
+            else None,
+        )
+        specs.append(spec)
+    nt = NormType.parse(d.get("normType", "ZSCALE"))
+    return NormPlan(specs=specs, norm_type=nt, cutoff=float(d.get("cutoff", 4.0)))
 
 
 def normalize_dataset(

@@ -1,0 +1,253 @@
+"""`shifu eval` — score eval sets, confusion matrix, performance, gain chart.
+
+Parity: core/processor/EvalModelProcessor.java:138 — steps NEW/LIST/DELETE/
+RUN/NORM/SCORE/CONFMAT/PERF (:155-170). RUN = score + confusion + perf +
+gain chart. Score output column order parity with EvalScoreUDF:
+tag|weight|mean|max|min|median|model0..modelN (+ scoreMetaColumns echo).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import List, Optional
+
+import numpy as np
+
+from shifu_tpu.config.model_config import EvalConfig, RawSourceData
+from shifu_tpu.data.purify import combined_mask
+from shifu_tpu.data.reader import (
+    make_tags,
+    make_weights,
+    read_columnar,
+    read_header,
+)
+from shifu_tpu.processor.basic import BasicProcessor
+from shifu_tpu.utils.errors import ErrorCode, ShifuError
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+class EvalProcessor(BasicProcessor):
+    step = "eval"
+
+    def __init__(
+        self,
+        root: str = ".",
+        new_name: Optional[str] = None,
+        list_sets: bool = False,
+        delete_name: Optional[str] = None,
+        run_name: Optional[str] = None,
+        score_name: Optional[str] = None,
+        norm_name: Optional[str] = None,
+        confmat_name: Optional[str] = None,
+        perf_name: Optional[str] = None,
+    ):
+        super().__init__(root)
+        self.new_name = new_name
+        self.list_sets = list_sets
+        self.delete_name = delete_name
+        self.run_name = run_name
+        self.score_name = score_name
+        self.norm_name = norm_name
+        self.confmat_name = confmat_name
+        self.perf_name = perf_name
+
+    @classmethod
+    def from_args(cls, args) -> "EvalProcessor":
+        return cls(
+            new_name=args.new_name,
+            list_sets=args.list_sets,
+            delete_name=args.delete_name,
+            run_name=args.run_name,
+            score_name=args.score_name,
+            norm_name=args.norm_name,
+            confmat_name=args.confmat_name,
+            perf_name=args.perf_name,
+        )
+
+    # ---- eval-set management ----
+    def _evals(self, name: str) -> List[EvalConfig]:
+        mc = self.model_config
+        assert mc is not None
+        if name:
+            e = mc.get_eval(name)
+            if e is None:
+                raise ShifuError(ErrorCode.INVALID_MODEL_CONFIG,
+                                 f"eval set {name} not found")
+            return [e]
+        return list(mc.evals)
+
+    def run_step(self) -> None:
+        self.setup()
+        mc = self.model_config
+        assert mc is not None
+
+        if self.new_name is not None:
+            ec = EvalConfig(name=self.new_name, data_set=RawSourceData())
+            ec.data_set.data_path = mc.data_set.data_path
+            ec.data_set.header_path = mc.data_set.header_path
+            ec.data_set.data_delimiter = mc.data_set.data_delimiter
+            ec.data_set.header_delimiter = mc.data_set.header_delimiter
+            mc.evals.append(ec)
+            self.save_model_config()
+            log.info("eval set %s created; edit ModelConfig.json evals section.",
+                     self.new_name)
+            return
+        if self.list_sets:
+            for e in mc.evals:
+                log.info("eval set: %s (%s)", e.name, e.data_set.data_path)
+            return
+        if self.delete_name is not None:
+            mc.evals = [e for e in mc.evals if e.name != self.delete_name]
+            self.save_model_config()
+            shutil.rmtree(self.paths.eval_dir(self.delete_name), ignore_errors=True)
+            log.info("eval set %s deleted.", self.delete_name)
+            return
+
+        if self.score_name is not None:
+            for e in self._evals(self.score_name):
+                self._score(e)
+            return
+        if self.confmat_name is not None or self.perf_name is not None:
+            name = self.confmat_name if self.confmat_name is not None else self.perf_name
+            for e in self._evals(name):
+                self._perf_from_scores(e)
+            return
+        if self.norm_name is not None:
+            for e in self._evals(self.norm_name):
+                self._norm(e)
+            return
+
+        # default / -run: full evaluation
+        for e in self._evals(self.run_name or ""):
+            self._score(e)
+            self._perf_from_scores(e)
+
+    # ---- data loading ----
+    def _load_eval_data(self, ec: EvalConfig):
+        mc = self.model_config
+        ds = ec.data_set
+        header = ds.header_path or mc.data_set.header_path
+        if header:
+            names = read_header(self.resolve(header),
+                                ds.header_delimiter or mc.data_set.header_delimiter)
+        else:
+            names = [c.column_name for c in self.column_configs]
+        data = read_columnar(
+            self.resolve(ds.data_path or mc.data_set.data_path),
+            names,
+            delimiter=ds.data_delimiter or mc.data_set.data_delimiter,
+            missing_values=tuple(mc.data_set.missing_or_invalid_values),
+        )
+        mask = combined_mask(ds.filter_expressions, data.raw, data.n_rows)
+        data = data.select_rows(mask)
+        pos = ec.pos_tags if ec.pos_tags is not None else mc.data_set.pos_tags
+        neg = ec.neg_tags if ec.neg_tags is not None else mc.data_set.neg_tags
+        target = mc.data_set.target_column_name
+        tags = make_tags(data.column(target), pos, neg)
+        weights = make_weights(data, ds.weight_column_name
+                               or mc.data_set.weight_column_name)
+        return data, tags, weights
+
+    # ---- steps ----
+    def _score(self, ec: EvalConfig) -> None:
+        from shifu_tpu.eval.scorer import ModelRunner, find_model_paths
+
+        paths = find_model_paths(self.paths.models_dir())
+        if not paths:
+            raise ShifuError(ErrorCode.MODEL_NOT_FOUND,
+                             f"no models under {self.paths.models_dir()}")
+        data, tags, weights = self._load_eval_data(ec)
+        runner = ModelRunner(paths)
+        result = runner.score_raw(data)
+        out = self.paths.eval_score_path(ec.name)
+        self.paths.ensure(os.path.dirname(out))
+        sep = "|"
+        with open(out, "w") as fh:
+            header = ["tag", "weight", "mean", "max", "min", "median"] + [
+                f"model{i}" for i in range(result.model_scores.shape[1])
+            ]
+            fh.write(sep.join(header) + "\n")
+            for i in range(result.model_scores.shape[0]):
+                row = [
+                    str(int(tags[i])), f"{weights[i]:g}",
+                    f"{result.mean[i]:.3f}", f"{result.max[i]:.3f}",
+                    f"{result.min[i]:.3f}", f"{result.median[i]:.3f}",
+                ] + [f"{s:.3f}" for s in result.model_scores[i]]
+                fh.write(sep.join(row) + "\n")
+        n_pos = int((tags == 1).sum())
+        n_neg = int((tags == 0).sum())
+        log.info("eval %s scored %d records (%d pos / %d neg) with %d models -> %s",
+                 ec.name, data.n_rows, n_pos, n_neg, len(paths), out)
+
+    def _read_scores(self, ec: EvalConfig):
+        path = self.paths.eval_score_path(ec.name)
+        if not os.path.isfile(path):
+            self._score(ec)
+        import pandas as pd
+
+        df = pd.read_csv(path, sep="|")
+        return df
+
+    def _perf_from_scores(self, ec: EvalConfig) -> None:
+        from shifu_tpu.eval.gainchart import render_gain_chart
+        from shifu_tpu.eval.metrics import (
+            confusion_matrix_rows,
+            confusion_sweep,
+            evaluate_performance,
+        )
+
+        mc = self.model_config
+        df = self._read_scores(ec)
+        valid = df["tag"] >= 0
+        df = df[valid]
+        selector = (ec.performance_score_selector or "mean").lower()
+        score_col = selector if selector in df.columns else "mean"
+        scores = df[score_col].to_numpy(dtype=np.float64)
+        tags = df["tag"].to_numpy(dtype=np.float64)
+        weights = df["weight"].to_numpy(dtype=np.float64)
+
+        perf = evaluate_performance(
+            scores, tags, weights, n_buckets=ec.performance_bucket_num or 10
+        )
+        perf_path = self.paths.eval_performance_path(ec.name)
+        self.paths.ensure(os.path.dirname(perf_path))
+        with open(perf_path, "w") as fh:
+            json.dump(perf.to_json(), fh, indent=2)
+
+        cs = confusion_sweep(scores, tags, weights)
+        rows = confusion_matrix_rows(cs)
+        cm_path = self.paths.eval_confusion_path(ec.name)
+        with open(cm_path, "w") as fh:
+            if rows:
+                cols = list(rows[0].keys())
+                fh.write(",".join(cols) + "\n")
+                for r in rows:
+                    fh.write(",".join(f"{r[c]:.6g}" for c in cols) + "\n")
+
+        chart = render_gain_chart(ec.name, mc.basic.name, perf)
+        with open(self.paths.gain_chart_path(ec.name), "w") as fh:
+            fh.write(chart)
+        log.info(
+            "eval %s: AUC %.6f (weighted %.6f); perf -> %s, chart -> %s",
+            ec.name, perf.area_under_roc, perf.weighted_area_under_roc,
+            perf_path, self.paths.gain_chart_path(ec.name),
+        )
+
+    def _norm(self, ec: EvalConfig) -> None:
+        """eval -norm: write the normalized eval matrix
+        (EvalModelProcessor NORM step)."""
+        from shifu_tpu.norm.dataset import write_normalized
+        from shifu_tpu.norm.normalizer import apply_norm_plan, build_norm_plan
+
+        mc = self.model_config
+        data, tags, weights = self._load_eval_data(ec)
+        plan = build_norm_plan(mc, self.column_configs)
+        feats = apply_norm_plan(plan, data)
+        out_dir = os.path.join(self.paths.eval_dir(ec.name), "NormalizedData")
+        write_normalized(out_dir, feats, np.maximum(tags, 0), weights,
+                         plan.out_names, norm_type=mc.normalize.norm_type.value)
+        log.info("eval %s normalized -> %s", ec.name, out_dir)

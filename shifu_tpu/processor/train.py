@@ -218,6 +218,7 @@ class TrainProcessor(BasicProcessor):
             algorithm=alg.value,
             loss=cfg.loss,
             norm_specs=norm_json.get("columns", []),
+            norm_cutoff=float(norm_json.get("cutoff", 4.0)),
             params=result.params,
             train_error=result.train_error,
             valid_error=result.valid_error,

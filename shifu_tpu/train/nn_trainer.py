@@ -70,6 +70,7 @@ class NNTrainConfig:
     weight_init: str = "xavier"
     seed: int = 0
     is_continuous: bool = False
+    mixed_precision: bool = False  # bf16 matmuls (MXU), f32 accumulation
     checkpoint_every: int = 0
     checkpoint_path: Optional[str] = None
     progress_cb: Optional[Callable[[int, float, float], None]] = None
@@ -139,7 +140,7 @@ def split_and_sample(
     return sig, valid
 
 
-def _loss_and_errors(cfg: NNTrainConfig, shapes, n_flat: int):
+def _loss_and_errors(cfg: NNTrainConfig, shapes):
     """Build the jit-able (flat_w, x, t, sig_train, sig_valid, key) ->
     (descent_grad, train_err, valid_err) function."""
     import jax
@@ -148,6 +149,7 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes, n_flat: int):
     acts = cfg.activations
     n_hidden = len(cfg.hidden_nodes)
     dropout = cfg.dropout_rate
+    bf16 = cfg.mixed_precision
 
     def unflatten(flat):
         params, off = [], 0
@@ -159,26 +161,24 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes, n_flat: int):
             params.append({"W": w, "b": b})
         return params
 
-    def fwd(params, x, key):
+    def matmul(h, w):
+        if bf16:  # MXU-friendly: bf16 operands, f32 result
+            return (h.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16)).astype(
+                jnp.float32
+            )
+        return h @ w
+
+    def fwd(params, x, key, train: bool):
         h = x
         for i in range(n_hidden):
             h = activation_fn(acts[i % len(acts)] if acts else "tanh")(
-                h @ params[i]["W"] + params[i]["b"]
+                matmul(h, params[i]["W"]) + params[i]["b"]
             )
-            if dropout > 0.0:
+            if train and dropout > 0.0:
                 key, sub = jax.random.split(key)
                 keep = jax.random.bernoulli(sub, 1.0 - dropout, h.shape)
                 h = jnp.where(keep, h / (1.0 - dropout), 0.0)
-        out = h @ params[-1]["W"] + params[-1]["b"]
-        return activation_fn("sigmoid")(out)[:, 0]
-
-    def fwd_eval(params, x):
-        h = x
-        for i in range(n_hidden):
-            h = activation_fn(acts[i % len(acts)] if acts else "tanh")(
-                h @ params[i]["W"] + params[i]["b"]
-            )
-        out = h @ params[-1]["W"] + params[-1]["b"]
+        out = matmul(h, params[-1]["W"]) + params[-1]["b"]
         return activation_fn("sigmoid")(out)[:, 0]
 
     def record_loss(p, t):
@@ -192,15 +192,19 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes, n_flat: int):
 
     def total_loss(flat, x, t, sig, key):
         params = unflatten(flat)
-        p = fwd(params, x, key)
-        return jnp.sum(sig * record_loss(p, t))
+        p = fwd(params, x, key, train=True)
+        return jnp.sum(sig * record_loss(p, t)), p
 
-    grad_fn = jax.grad(total_loss)
+    grad_fn = jax.grad(total_loss, has_aux=True)
 
     def step_metrics(flat, x, t, sig_train, sig_valid, key):
-        g = -grad_fn(flat, x, t, sig_train, key)  # descent direction, summed
-        params = unflatten(flat)
-        p = fwd_eval(params, x)
+        g_neg, p_train = grad_fn(flat, x, t, sig_train, key)
+        g = -g_neg  # descent direction, summed over records
+        if dropout > 0.0:
+            # dropout-free predictions for error reporting
+            p = fwd(unflatten(flat), x, key, train=False)
+        else:
+            p = p_train
         # reported errors are squared-error means like Encog calculateError
         sq = (t - p) ** 2
         train_err = jnp.sum(sig_train * sq) / jnp.maximum(jnp.sum(sig_train), 1.0)
@@ -208,6 +212,89 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes, n_flat: int):
         return g, train_err, valid_err
 
     return step_metrics
+
+
+# Compiled-program cache: one XLA program per (architecture, hyperparams)
+# signature; data, seed, epoch limit and sample size are traced arguments so
+# bagging members, grid trials with same arch, and bench warmups all reuse it.
+_PROGRAMS: dict = {}
+
+
+def _get_program(cfg: NNTrainConfig, shapes, rows: int):
+    import jax
+    import jax.numpy as jnp
+
+    n_batches = cfg.mini_batchs
+    cache_key = (
+        tuple(shapes), tuple(cfg.activations), cfg.loss, cfg.dropout_rate,
+        cfg.mixed_precision, n_batches, rows if n_batches > 1 else -1,
+        cfg.early_stop_window, cfg.convergence_threshold, cfg.learning_decay,
+        (cfg.propagation or "Q").upper(), cfg.momentum,
+        cfg.regularized_constant, cfg.reg_level, cfg.adam_beta1, cfg.adam_beta2,
+    )
+    cached = _PROGRAMS.get(cache_key)
+    if cached is not None:
+        return cached
+
+    step_metrics = _loss_and_errors(cfg, shapes)
+    init_state, apply_update = make_updater(
+        cfg.propagation,
+        momentum=cfg.momentum,
+        reg=cfg.regularized_constant,
+        reg_level=cfg.reg_level,
+        adam_beta1=cfg.adam_beta1,
+        adam_beta2=cfg.adam_beta2,
+    )
+    window = cfg.early_stop_window
+    conv = cfg.convergence_threshold
+    decay = cfg.learning_decay
+    # ceil so rotating slices cover every row (last slice overlaps the tail
+    # instead of dropping rows % n_batches records from all gradients)
+    batch = -(-rows // n_batches) if n_batches > 1 else rows
+
+    def one_iter(carry, x, t, sig_train, sig_valid, key0, nts):
+        (flat, opt, it, lr, best_val, best_flat, bad, halt, tr_e, va_e) = carry
+        key = jax.random.fold_in(key0, it)
+        if n_batches > 1:
+            start = jnp.minimum((it % n_batches) * batch, rows - batch)
+            xs = jax.lax.dynamic_slice_in_dim(x, start, batch, 0)
+            ts = jax.lax.dynamic_slice_in_dim(t, start, batch, 0)
+            ss = jax.lax.dynamic_slice_in_dim(sig_train, start, batch, 0)
+            g, _, _ = step_metrics(flat, xs, ts, ss, ss, key)
+            _, tr, va = step_metrics(flat, x, t, sig_train, sig_valid, key)
+        else:
+            g, tr, va = step_metrics(flat, x, t, sig_train, sig_valid, key)
+        new_flat, new_opt = apply_update(opt, flat, g, lr, it + 1, nts)
+        improved = va < best_val
+        best_val2 = jnp.where(improved, va, best_val)
+        # va was measured on the PRE-update weights; keep those as "best"
+        best_flat2 = jnp.where(improved, flat, best_flat)
+        bad2 = jnp.where(improved, 0, bad + 1)
+        halt2 = jnp.zeros((), dtype=bool)
+        if window > 0:
+            halt2 = halt2 | (bad2 >= window)
+        if conv > 0.0:
+            halt2 = halt2 | ((tr + va) / 2.0 <= conv)
+        lr2 = lr * (1.0 - decay)
+        return (new_flat, new_opt, it + 1, lr2, best_val2, best_flat2, bad2,
+                halt2, tr, va)
+
+    @jax.jit
+    def program(carry, limit, x, t, sig_train, sig_valid, key0, nts):
+        """Iterate until `limit` or halt. limit/seed/data/sample-size are
+        traced operands so the same program serves any epoch count,
+        checkpoint cadence, bag member, and dataset of the same shape."""
+
+        def cond(c):
+            return (c[2] < limit) & (~c[7])
+
+        def body(c):
+            return one_iter(c, x, t, sig_train, sig_valid, key0, nts)
+
+        return jax.lax.while_loop(cond, body, carry)
+
+    _PROGRAMS[cache_key] = (program, init_state)
+    return program, init_state
 
 
 def train_nn(
@@ -237,22 +324,13 @@ def train_nn(
     sig_valid = (valid_mask.astype(np.float32) * weights).astype(np.float32)
     n_train_size = float(max(sig.sum(), 1.0))
 
-    init_state, apply_update = make_updater(
-        cfg.propagation,
-        cfg.learning_rate,
-        momentum=cfg.momentum,
-        reg=cfg.regularized_constant,
-        reg_level=cfg.reg_level,
-        num_train_size=n_train_size,
-        adam_beta1=cfg.adam_beta1,
-        adam_beta2=cfg.adam_beta2,
-    )
-
     # ---- shard rows over the mesh; pad to even splits with zero significance
-    x = features.astype(np.float32)
-    t = tags.astype(np.float32)
+    # features may already live on device (bench / repeated runs): don't pull
+    # it back to host, HBM residency is the point
+    x = features if isinstance(features, jax.Array) else features.astype(np.float32)
+    t = tags if isinstance(tags, jax.Array) else tags.astype(np.float32)
     if mesh is not None:
-        from shifu_tpu.parallel.mesh import pad_rows, replicate, shard_rows
+        from shifu_tpu.parallel.mesh import pad_rows, shard_rows
 
         n_dev = mesh.devices.size
         (x, t, sig_train, sig_valid), _ = pad_rows(
@@ -263,58 +341,10 @@ def train_nn(
         sig_train = shard_rows(sig_train, mesh)
         sig_valid = shard_rows(sig_valid, mesh)
 
-    step_metrics = _loss_and_errors(cfg, shapes, n_flat)
-    opt0 = init_state(n_flat)
-
-    n_batches = cfg.mini_batchs
     rows = x.shape[0]
-    batch = rows // n_batches if n_batches > 1 else rows
-
     max_iters = cfg.num_epochs
-    window = cfg.early_stop_window
-    conv = cfg.convergence_threshold
-    decay = cfg.learning_decay
-    key0 = jax.random.PRNGKey(cfg.seed)
-
-    def one_iter(carry):
-        (flat, opt, it, lr, best_val, best_flat, bad, halt, tr_e, va_e) = carry
-        key = jax.random.fold_in(key0, it)
-        if n_batches > 1:
-            start = (it % n_batches) * batch
-            xs = jax.lax.dynamic_slice_in_dim(x, start, batch, 0)
-            ts = jax.lax.dynamic_slice_in_dim(t, start, batch, 0)
-            ss = jax.lax.dynamic_slice_in_dim(sig_train, start, batch, 0)
-            g, tr, _ = step_metrics(flat, xs, ts, ss, ss, key)
-            _, tr_full, va = step_metrics(flat, x, t, sig_train, sig_valid, key)
-            tr = tr_full
-        else:
-            g, tr, va = step_metrics(flat, x, t, sig_train, sig_valid, key)
-        new_flat, new_opt = apply_update(opt, flat, g, lr, it + 1)
-        improved = va < best_val
-        best_val2 = jnp.where(improved, va, best_val)
-        best_flat2 = jnp.where(improved, new_flat, best_flat)
-        bad2 = jnp.where(improved, 0, bad + 1)
-        halt2 = jnp.zeros((), dtype=bool)
-        if window > 0:
-            halt2 = halt2 | (bad2 >= window)
-        if conv > 0.0:
-            halt2 = halt2 | ((tr + va) / 2.0 <= conv)
-        lr2 = lr * (1.0 - decay)
-        return (new_flat, new_opt, it + 1, lr2, best_val2, best_flat2, bad2,
-                halt2, tr, va)
-
-    def cond(carry):
-        it, halt = carry[2], carry[7]
-        return (it < max_iters) & (~halt)
-
-    @jax.jit
-    def run(flat, opt):
-        carry = (
-            flat, opt, jnp.int32(0), jnp.float32(cfg.learning_rate),
-            jnp.float32(np.inf), flat, jnp.int32(0),
-            jnp.zeros((), dtype=bool), jnp.float32(0.0), jnp.float32(0.0),
-        )
-        return jax.lax.while_loop(cond, one_iter, carry)
+    program, init_state = _get_program(cfg, shapes, rows)
+    opt0 = init_state(n_flat)
 
     flat_j = jnp.asarray(flat0)
     if mesh is not None:
@@ -323,11 +353,22 @@ def train_nn(
         flat_j = replicate(flat_j, mesh)
         opt0 = replicate(opt0, mesh)
 
+    carry0 = (
+        flat_j, opt0, jnp.int32(0), jnp.float32(cfg.learning_rate),
+        jnp.float32(np.inf), flat_j, jnp.int32(0),
+        jnp.zeros((), dtype=bool), jnp.float32(0.0), jnp.float32(0.0),
+    )
+    key0 = jax.random.PRNGKey(cfg.seed)
+    nts = jnp.float32(n_train_size)
+
+    def run_until(carry, limit):
+        return program(carry, jnp.int32(limit), x, t, sig_train, sig_valid,
+                       key0, nts)
+
     if cfg.checkpoint_every and cfg.checkpoint_every > 0:
-        result = _run_with_checkpoints(run, one_iter, cond, flat_j, opt0, cfg,
-                                       shapes, max_iters)
+        result = _run_with_checkpoints(run_until, carry0, cfg, max_iters)
     else:
-        result = run(flat_j, opt0)
+        result = run_until(carry0, max_iters)
 
     (flat_f, _, it_f, _, best_val, best_flat, _, _, tr_e, va_e) = result
     it_n = int(it_f)
@@ -348,31 +389,12 @@ def train_nn(
     )
 
 
-def _run_with_checkpoints(run, one_iter, cond, flat, opt, cfg, shapes, max_iters):
+def _run_with_checkpoints(run_until, carry, cfg, max_iters):
     """Chunked run: jit loop in segments, checkpoint + progress between them
     (NNOutput.postIteration:158 writes tmp models each epoch)."""
-    import jax
     import jax.numpy as jnp
 
     every = cfg.checkpoint_every
-
-    def seg_cond_factory(limit):
-        def c(carry):
-            return cond(carry) & (carry[2] < limit)
-
-        return c
-
-    @jax.jit
-    def run_until(carry, limit):
-        return jax.lax.while_loop(
-            lambda c: cond(c) & (c[2] < limit), one_iter, carry
-        )
-
-    carry = (
-        flat, opt, jnp.int32(0), jnp.float32(cfg.learning_rate),
-        jnp.float32(np.inf), flat, jnp.int32(0),
-        jnp.zeros((), dtype=bool), jnp.float32(0.0), jnp.float32(0.0),
-    )
     it = 0
     while it < max_iters:
         limit = min(it + every, max_iters)

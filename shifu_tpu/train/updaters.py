@@ -42,29 +42,28 @@ def _zeros_like(n, jnp):
 
 def make_updater(
     propagation: str,
-    learning_rate: float,
     momentum: float = 0.5,
     reg: float = 0.0,
     reg_level: str = "NONE",
-    num_train_size: float = 1.0,
     adam_beta1: float = 0.9,
     adam_beta2: float = 0.999,
 ):
     """Returns (init_state(n_weights) -> state,
-                apply(state, w, g, lr, iteration) -> (w', state')).
+                apply(state, w, g, lr, iteration, num_train_size) -> (w', state')).
 
-    lr is threaded per-iteration so NNMaster's learning decay
-    (NNMaster.java:267 lr *= 1-learningDecay) composes outside."""
+    lr and num_train_size are threaded per-call as traced values so one
+    compiled program serves every learning-decay step and bagging-sample
+    size (NNMaster.java:267 lr *= 1-learningDecay composes outside)."""
     import jax.numpy as jnp
 
     prop = (propagation or "Q").upper()
 
-    def regularize(w, step):
+    def regularize(w, step, nts):
         """Apply the step plus L1/L2 regularization (Weight.java:199-218)."""
         if reg_level == "L2" and reg != 0.0:
-            return w + step - reg * w / num_train_size
+            return w + step - reg * w / nts
         if reg_level == "L1" and reg != 0.0:
-            shrink = reg / num_train_size
+            shrink = reg / nts
             updated = w + step
             return jnp.sign(updated) * jnp.maximum(0.0, jnp.abs(updated) - shrink)
         return w + step
@@ -74,9 +73,9 @@ def make_updater(
         def init(n):
             return {"last_delta": _zeros_like(n, jnp)}
 
-        def apply(state, w, g, lr, it):
+        def apply(state, w, g, lr, it, nts):
             delta = g * lr + state["last_delta"] * momentum
-            return regularize(w, delta), {"last_delta": delta}
+            return regularize(w, delta, nts), {"last_delta": delta}
 
         return init, apply
 
@@ -85,18 +84,18 @@ def make_updater(
         def init(n):
             return {}
 
-        def apply(state, w, g, lr, it):
+        def apply(state, w, g, lr, it, nts):
             step = jnp.where(
                 jnp.abs(g) < ZERO_TOLERANCE, 0.0, jnp.sign(g) * lr
             )
-            return regularize(w, step), state
+            return regularize(w, step, nts), state
 
         return init, apply
 
     if prop == "Q":
         # Quickprop (Weight.updateWeightQBP:252-297). eps/shrink derive from
-        # the CONSTRUCTION-time lr and train size (Weight.java:146-147).
-        eps = QPROP_OUTPUT_EPSILON / max(num_train_size, 1.0)
+        # the construction-time lr and train size (Weight.java:146-147);
+        # nts is traced so eps follows the actual sample size.
 
         def init(n):
             return {
@@ -104,7 +103,8 @@ def make_updater(
                 "last_gradient": _zeros_like(n, jnp),
             }
 
-        def apply(state, w, g, lr, it):
+        def apply(state, w, g, lr, it, nts):
+            eps = QPROP_OUTPUT_EPSILON / jnp.maximum(nts, 1.0)
             shrink = lr / (1.0 + lr)
             d = state["last_delta"]
             s = -g + QPROP_DECAY * w
@@ -120,7 +120,7 @@ def make_updater(
             next_step = jnp.where(
                 d < 0.0, step_neg, jnp.where(d > 0.0, step_pos, lin)
             )
-            return regularize(w, next_step), {
+            return regularize(w, next_step, nts), {
                 "last_delta": next_step,
                 "last_gradient": g,
             }
@@ -137,7 +137,7 @@ def make_updater(
                 "last_delta": _zeros_like(n, jnp),
             }
 
-        def apply(state, w, g, lr, it):
+        def apply(state, w, g, lr, it, nts):
             change = jnp.sign(g * state["last_gradient"])
             upd = state["update_values"]
             delta_pos = jnp.minimum(upd * POSITIVE_ETA, DEFAULT_MAX_STEP)
@@ -151,7 +151,7 @@ def make_updater(
                 jnp.where(change < 0, -state["last_delta"], jnp.sign(g) * upd),
             )
             new_last_g = jnp.where(change < 0, 0.0, g)
-            return regularize(w, wchange), {
+            return regularize(w, wchange, nts), {
                 "update_values": new_upd,
                 "last_gradient": new_last_g,
                 "last_delta": wchange,
@@ -164,7 +164,7 @@ def make_updater(
         def init(n):
             return {"m": _zeros_like(n, jnp), "v": _zeros_like(n, jnp)}
 
-        def apply(state, w, g, lr, it):
+        def apply(state, w, g, lr, it, nts):
             m = adam_beta1 * state["m"] + (1 - adam_beta1) * g
             v = adam_beta2 * state["v"] + (1 - adam_beta2) * g * g
             it_f = jnp.maximum(it.astype(jnp.float32), 1.0)
@@ -180,7 +180,7 @@ def make_updater(
         def init(n):
             return {"sum_sq": _zeros_like(n, jnp)}
 
-        def apply(state, w, g, lr, it):
+        def apply(state, w, g, lr, it, nts):
             s = state["sum_sq"] + g * g
             step = lr * g / (jnp.sqrt(s) + 1e-8)
             return w + step, {"sum_sq": s}
@@ -192,7 +192,7 @@ def make_updater(
         def init(n):
             return {"cache": _zeros_like(n, jnp)}
 
-        def apply(state, w, g, lr, it):
+        def apply(state, w, g, lr, it, nts):
             cache = 0.9 * state["cache"] + 0.1 * g * g
             step = lr * g / (jnp.sqrt(cache) + 1e-8)
             return w + step, {"cache": cache}
@@ -204,7 +204,7 @@ def make_updater(
         def init(n):
             return {"v": _zeros_like(n, jnp)}
 
-        def apply(state, w, g, lr, it):
+        def apply(state, w, g, lr, it, nts):
             v = momentum * state["v"] + lr * g
             return w + v, {"v": v}
 
@@ -215,7 +215,7 @@ def make_updater(
         def init(n):
             return {"v": _zeros_like(n, jnp)}
 
-        def apply(state, w, g, lr, it):
+        def apply(state, w, g, lr, it, nts):
             v_prev = state["v"]
             v = momentum * v_prev - lr * (-g)  # g is descent dir: v = mom*v + lr*g
             w_new = w - momentum * v_prev + (1 + momentum) * v

@@ -1,0 +1,149 @@
+"""Eval subsystem tests: metrics math (AUC, bucketing parity) and the
+end-to-end eval processor (score -> confusion -> perf -> gain chart)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.eval.metrics import (
+    area_under_curve,
+    auc_from_sweep,
+    confusion_sweep,
+    evaluate_performance,
+)
+
+
+class TestConfusionSweep:
+    def test_basic_counts(self):
+        scores = np.array([0.9, 0.8, 0.3, 0.1])
+        tags = np.array([1, 0, 1, 0])
+        cs = confusion_sweep(scores, tags)
+        np.testing.assert_array_equal(cs.tp, [1, 1, 2, 2])
+        np.testing.assert_array_equal(cs.fp, [0, 1, 1, 2])
+        assert cs.pos_total == 2 and cs.neg_total == 2
+
+    def test_perfect_separation_auc(self):
+        scores = np.array([0.9, 0.8, 0.2, 0.1])
+        tags = np.array([1, 1, 0, 0])
+        cs = confusion_sweep(scores, tags)
+        assert auc_from_sweep(cs) == pytest.approx(1.0)
+
+    def test_random_scores_auc_half(self):
+        rng = np.random.default_rng(0)
+        scores = rng.random(20_000)
+        tags = (rng.random(20_000) < 0.3).astype(float)
+        cs = confusion_sweep(scores, tags)
+        assert auc_from_sweep(cs) == pytest.approx(0.5, abs=0.02)
+
+    def test_weighted_auc_differs(self):
+        scores = np.array([0.9, 0.8, 0.7, 0.1])
+        tags = np.array([1, 0, 1, 0])
+        w = np.array([1.0, 10.0, 1.0, 1.0])
+        cs = confusion_sweep(scores, tags, w)
+        assert auc_from_sweep(cs) != pytest.approx(auc_from_sweep(cs, weighted=True))
+
+    def test_auc_known_value(self):
+        # manual: ranks -> AUC = P(score_pos > score_neg)
+        scores = np.array([0.9, 0.7, 0.6, 0.4, 0.2])
+        tags = np.array([1, 0, 1, 0, 0])
+        # pairs: (0.9 beats all 3 negs), (0.6 beats 0.4, 0.2) -> 5/6
+        cs = confusion_sweep(scores, tags)
+        assert auc_from_sweep(cs) == pytest.approx(5 / 6, abs=1e-6)
+
+
+class TestPerformance:
+    def test_bucket_lists_monotone(self):
+        rng = np.random.default_rng(1)
+        n = 5000
+        tags = (rng.random(n) < 0.3).astype(float)
+        scores = tags * 0.5 + rng.random(n) * 0.5
+        perf = evaluate_performance(scores, tags, n_buckets=10)
+        assert perf.area_under_roc > 0.7
+        gains = perf.gains
+        assert len(gains) >= 10
+        # action rate and recall both increase down the gain table
+        ar = [g["actionRate"] for g in gains]
+        rc = [g["recall"] for g in gains]
+        assert all(a2 >= a1 for a1, a2 in zip(ar, ar[1:]))
+        assert all(r2 >= r1 for r1, r2 in zip(rc, rc[1:]))
+        # first row parity: precision pinned to 1.0
+        assert gains[0]["precision"] == 1.0
+
+    def test_empty_input(self):
+        perf = evaluate_performance(np.array([]), np.array([]))
+        assert perf.area_under_roc == 0.0
+
+
+class TestEvalProcessor:
+    @pytest.fixture()
+    def ready_root(self, tmp_path):
+        from tests.helpers import make_model_set
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=500)
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+        from shifu_tpu.processor.train import TrainProcessor
+
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        mc.train.num_train_epochs = 30
+        # point the default eval set at the training data
+        mc.evals[0].data_set.data_path = mc.data_set.data_path
+        mc.evals[0].data_set.header_path = mc.data_set.header_path
+        mc.save(os.path.join(root, "ModelConfig.json"))
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root).run() == 0
+        assert NormProcessor(root).run() == 0
+        assert TrainProcessor(root).run() == 0
+        return root
+
+    def test_eval_run_full(self, ready_root):
+        from shifu_tpu.processor.evaluate import EvalProcessor
+
+        root = ready_root
+        assert EvalProcessor(root, run_name="").run() == 0
+        eval_dir = os.path.join(root, "evals", "Eval1")
+        score_path = os.path.join(eval_dir, "EvalScore.csv")
+        perf_path = os.path.join(eval_dir, "EvalPerformance.json")
+        chart_path = os.path.join(eval_dir, "gainchart.html")
+        assert os.path.isfile(score_path)
+        assert os.path.isfile(perf_path)
+        assert os.path.isfile(chart_path)
+        assert os.path.isfile(os.path.join(eval_dir, "EvalConfusionMatrix.csv"))
+
+        with open(perf_path) as fh:
+            perf = json.load(fh)
+        assert perf["areaUnderRoc"] > 0.9  # strongly separable synthetic data
+        assert perf["gains"]
+
+        import pandas as pd
+
+        df = pd.read_csv(score_path, sep="|")
+        assert {"tag", "weight", "mean", "model0"} <= set(df.columns)
+        assert df["mean"].between(0, 1000).all()
+        html = open(chart_path).read()
+        assert "AUC" in html and "<svg" in html
+
+    def test_eval_set_management(self, ready_root):
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.processor.evaluate import EvalProcessor
+
+        root = ready_root
+        assert EvalProcessor(root, new_name="EvalX").run() == 0
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        assert mc.get_eval("EvalX") is not None
+        assert EvalProcessor(root, delete_name="EvalX").run() == 0
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        assert mc.get_eval("EvalX") is None
+
+    def test_eval_norm(self, ready_root):
+        from shifu_tpu.processor.evaluate import EvalProcessor
+
+        root = ready_root
+        assert EvalProcessor(root, norm_name="").run() == 0
+        out = os.path.join(root, "evals", "Eval1", "NormalizedData")
+        assert os.path.isfile(os.path.join(out, "meta.json"))

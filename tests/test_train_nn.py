@@ -29,11 +29,13 @@ class TestUpdaters:
     def _roundtrip(self, prop, **kw):
         import jax.numpy as jnp
 
-        init, apply = make_updater(prop, 0.1, num_train_size=100.0, **kw)
+        init, apply = make_updater(prop, **kw)
         w = jnp.ones(5)
         g = jnp.asarray([0.5, -0.5, 0.0, 1.0, -1.0])
         state = init(5)
-        w2, state2 = apply(state, w, g, jnp.float32(0.1), jnp.int32(1))
+        w2, state2 = apply(
+            state, w, g, jnp.float32(0.1), jnp.int32(1), jnp.float32(100.0)
+        )
         return np.asarray(w), np.asarray(w2)
 
     def test_backprop_step(self):
@@ -60,12 +62,12 @@ class TestUpdaters:
     def test_l2_regularization_shrinks(self):
         import jax.numpy as jnp
 
-        init, apply = make_updater(
-            "B", 0.1, reg=10.0, reg_level="L2", num_train_size=100.0
-        )
+        init, apply = make_updater("B", reg=10.0, reg_level="L2")
         w = jnp.ones(3)
         g = jnp.zeros(3)
-        w2, _ = apply(init(3), w, g, jnp.float32(0.1), jnp.int32(1))
+        w2, _ = apply(
+            init(3), w, g, jnp.float32(0.1), jnp.int32(1), jnp.float32(100.0)
+        )
         np.testing.assert_allclose(np.asarray(w2), [0.9, 0.9, 0.9], atol=1e-6)
 
     def test_all_rules_run(self):
