@@ -24,7 +24,10 @@ import numpy as np
 
 @dataclass
 class ConfusionSweep:
-    """Cumulative confusion state at each score threshold (descending)."""
+    """Cumulative confusion state at each score threshold (descending).
+    `block_end[i]` is True on the LAST row of each tied-score block; curves
+    and AUC evaluate only there, so tied records move through the sweep as
+    one unit and the result is independent of input row order."""
 
     scores: np.ndarray  # sorted descending
     tp: np.ndarray
@@ -35,6 +38,7 @@ class ConfusionSweep:
     wfp: np.ndarray
     wfn: np.ndarray
     wtn: np.ndarray
+    block_end: np.ndarray
     total: int
     pos_total: float
     neg_total: float
@@ -61,6 +65,10 @@ def confusion_sweep(
     pos_total, neg_total = float(tp[-1]) if t.size else 0.0, float(fp[-1]) if t.size else 0.0
     wpos_total = float(wtp[-1]) if t.size else 0.0
     wneg_total = float(wfp[-1]) if t.size else 0.0
+    block_end = (
+        np.concatenate([s[:-1] != s[1:], [True]]) if t.size
+        else np.zeros(0, dtype=bool)
+    )
     return ConfusionSweep(
         scores=s,
         tp=tp,
@@ -71,6 +79,7 @@ def confusion_sweep(
         wfp=wfp,
         wfn=wpos_total - wtp,
         wtn=wneg_total - wfp,
+        block_end=block_end,
         total=int(t.size),
         pos_total=pos_total,
         neg_total=neg_total,
@@ -88,12 +97,13 @@ def area_under_curve(fpr: np.ndarray, recall: np.ndarray) -> float:
 
 
 def auc_from_sweep(cs: ConfusionSweep, weighted: bool = False) -> float:
+    be = cs.block_end
     if weighted:
-        fpr = cs.wfp / max(cs.wneg_total, 1e-12)
-        rec = cs.wtp / max(cs.wpos_total, 1e-12)
+        fpr = cs.wfp[be] / max(cs.wneg_total, 1e-12)
+        rec = cs.wtp[be] / max(cs.wpos_total, 1e-12)
     else:
-        fpr = cs.fp / max(cs.neg_total, 1e-12)
-        rec = cs.tp / max(cs.pos_total, 1e-12)
+        fpr = cs.fp[be] / max(cs.neg_total, 1e-12)
+        rec = cs.tp[be] / max(cs.pos_total, 1e-12)
     return area_under_curve(fpr, rec)
 
 
@@ -176,11 +186,13 @@ def evaluate_performance(
     wrec = cs.wtp / max(cs.wpos_total, 1e-12)
     wact = (cs.wtp + cs.wfp) / max(cs.wpos_total + cs.wneg_total, 1e-12)
 
+    ends = np.nonzero(cs.block_end)[0]
+
     def pick(series) -> List[Dict]:
         out = [_first_po(cs)]
         nxt = 1
-        for i in range(1, cs.total):
-            if series[i] >= nxt * cap:
+        for i in ends:
+            while nxt <= n_buckets and series[i] >= nxt * cap:
                 out.append(_perf_object(cs, i, nxt))
                 nxt += 1
         return out

@@ -10,6 +10,7 @@ a dense matrix, and scoring is one batched forward per model.
 from __future__ import annotations
 
 import glob
+import json
 import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -71,49 +72,70 @@ class ModelRunner:
             raise ValueError("no models to score with")
         self.paths = model_paths
         self.specs = [load_model(p) for p in model_paths]
+        # independent scorers are created once so their jitted forwards cache
+        self.models = [self._independent(spec) for spec in self.specs]
         self.scale = scale
-        self._norm_cache: Dict[int, np.ndarray] = {}
+        self._norm_cache: Dict[str, np.ndarray] = {}
+        self._codes_cache: Dict[str, np.ndarray] = {}
+
+    @staticmethod
+    def _independent(spec):
+        from shifu_tpu.models.nn import IndependentNNModel, NNModelSpec
+
+        if isinstance(spec, NNModelSpec):
+            return IndependentNNModel(spec)
+        return spec.independent()
 
     def _normalized_input(self, spec, data: ColumnarData) -> np.ndarray:
         """Normalize raw records with the model's embedded norm plan; plans
-        are usually identical across bagged models, so cache by plan shape."""
+        are usually identical across bagged models, so cache by the FULL
+        plan signature (type + cutoff + every column table)."""
         from shifu_tpu.norm.normalizer import apply_norm_plan, plan_from_json
 
-        key = hash(str(spec.norm_specs)[:4096])
+        plan_json = {
+            "normType": spec.norm_type,
+            "cutoff": getattr(spec, "norm_cutoff", 4.0),
+            "columns": spec.norm_specs,
+        }
+        key = json.dumps(plan_json, sort_keys=True)
         if key in self._norm_cache:
             return self._norm_cache[key]
-        plan = plan_from_json(
-            {
-                "normType": spec.norm_type,
-                "cutoff": getattr(spec, "norm_cutoff", 4.0),
-                "columns": spec.norm_specs,
-            }
-        )
-        mat = apply_norm_plan(plan, data)
+        mat = apply_norm_plan(plan_from_json(plan_json), data)
         self._norm_cache[key] = mat
         return mat
 
+    def _tree_codes(self, spec, model, data: ColumnarData) -> np.ndarray:
+        """Bin codes per tree model, cached by the model's own binning
+        signature (different models may embed different columns/bins)."""
+        key = json.dumps(
+            [spec.input_columns, spec.boundaries, spec.categories],
+            sort_keys=True,
+        )
+        if key in self._codes_cache:
+            return self._codes_cache[key]
+        codes = model.codes_from_raw(data)
+        self._codes_cache[key] = codes
+        return codes
+
     def score_raw(self, data: ColumnarData) -> ScoreResult:
-        """Score raw records (normalizes per embedded plan)."""
+        """Score raw records. NN/LR/WDL models normalize via their embedded
+        plan; tree models bin via their embedded boundaries/categories
+        (EvalScoreUDF loads models once, then scores row batches)."""
+        from shifu_tpu.models.tree import TreeModelSpec
+
         cols = []
-        for spec in self.specs:
-            x = self._normalized_input(spec, data)
-            cols.append(self._compute(spec, x))
+        for spec, model in zip(self.specs, self.models):
+            if isinstance(spec, TreeModelSpec):
+                codes = self._tree_codes(spec, model, data)
+                cols.append(model.compute(codes) * self.scale)
+            else:
+                x = self._normalized_input(spec, data)
+                cols.append(model.compute(x) * self.scale)
         return self._aggregate(cols)
 
     def score_normalized(self, feats: np.ndarray) -> ScoreResult:
-        cols = [self._compute(spec, feats) for spec in self.specs]
+        cols = [m.compute(feats) * self.scale for m in self.models]
         return self._aggregate(cols)
-
-    def _compute(self, spec, x: np.ndarray) -> np.ndarray:
-        from shifu_tpu.models.nn import NNModelSpec
-
-        if isinstance(spec, NNModelSpec):
-            from shifu_tpu.models.nn import IndependentNNModel
-
-            return IndependentNNModel(spec).compute(x) * self.scale
-        # tree / wdl specs implement .compute themselves
-        return spec.independent().compute(x) * self.scale
 
     def _aggregate(self, cols: List[np.ndarray]) -> ScoreResult:
         m = np.stack(cols, axis=1)

@@ -245,9 +245,12 @@ class EvalProcessor(BasicProcessor):
 
         mc = self.model_config
         data, tags, weights = self._load_eval_data(ec)
+        keep = tags >= 0  # invalid-tag rows are dropped, as in `shifu norm`
+        data = data.select_rows(keep)
+        tags, weights = tags[keep], weights[keep]
         plan = build_norm_plan(mc, self.column_configs)
         feats = apply_norm_plan(plan, data)
         out_dir = os.path.join(self.paths.eval_dir(ec.name), "NormalizedData")
-        write_normalized(out_dir, feats, np.maximum(tags, 0), weights,
+        write_normalized(out_dir, feats, tags, weights,
                          plan.out_names, norm_type=mc.normalize.norm_type.value)
         log.info("eval %s normalized -> %s", ec.name, out_dir)

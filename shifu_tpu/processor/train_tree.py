@@ -1,0 +1,74 @@
+"""`shifu train` for GBT/RF — consumes the CleanedData bin codes.
+
+Parity: TrainModelProcessor tree path (input = CleanedDataPath, not norm —
+TrainModelProcessor.java:1366-1372) + DT param wiring (prepareDTParams:1312).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from shifu_tpu.norm.dataset import load_codes
+from shifu_tpu.utils.errors import ErrorCode, ShifuError
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+def train_tree_models(proc, alg) -> None:
+    """proc: TrainProcessor (already set up)."""
+    from shifu_tpu.norm.normalizer import norm_columns
+    from shifu_tpu.train.tree_trainer import TreeTrainConfig, train_trees
+
+    mc = proc.model_config
+    codes_dir = proc.paths.cleaned_data_dir()
+    if not os.path.isdir(codes_dir):
+        raise ShifuError(
+            ErrorCode.DATA_NOT_FOUND, f"{codes_dir} — run `shifu norm` first"
+        )
+    meta, codes, tags, weights = load_codes(codes_dir)
+    codes = np.asarray(codes, dtype=np.int32)
+    tags = np.asarray(tags, dtype=np.float32)
+    weights = np.asarray(weights, dtype=np.float32)
+    slots = [int(s) for s in meta.extra["slots"]]
+
+    cols = norm_columns(proc.column_configs)
+    by_name = {c.column_name: c for c in cols}
+    is_cat, boundaries, categories = [], [], []
+    for name in meta.columns:
+        cc = by_name.get(name)
+        cat = bool(cc and cc.is_categorical())
+        is_cat.append(cat)
+        boundaries.append(None if cat else list(cc.column_binning.bin_boundary or []))
+        categories.append(list(cc.column_binning.bin_category or []) if cat else None)
+
+    suffix = proc._model_suffix(alg)
+    proc.paths.ensure(proc.paths.models_dir())
+    proc.paths.ensure(proc.paths.train_dir())
+    bagging = max(1, int(mc.train.bagging_num or 1))
+
+    for i in range(bagging):
+        cfg = TreeTrainConfig.from_model_config(mc, trainer_id=i)
+        progress_path = proc.paths.progress_path(i)
+
+        def progress(k, tr, va, _p=progress_path, _i=i):
+            if k % 10 == 0 or k == 1:
+                with open(_p, "a") as fh:
+                    fh.write(f"Trainer {_i} Tree #{k} Train Error:{tr:.8f} "
+                             f"Validation Error:{va:.8f}\n")
+                log.info("trainer %d tree %d train %.6f valid %.6f",
+                         _i, k, tr, va)
+
+        result = train_trees(
+            codes, tags, weights, slots, is_cat, meta.columns, cfg,
+            boundaries=boundaries, categories=categories, progress_cb=progress,
+        )
+        path = proc.paths.model_path(i, suffix)
+        result.spec.save(path)
+        with open(proc.paths.val_error_path(i), "w") as fh:
+            fh.write(f"{result.valid_error}\n")
+        log.info("model %d (%s, %d trees) -> %s (valid err %.6f)",
+                 i, cfg.algorithm, len(result.spec.trees), path,
+                 result.valid_error)

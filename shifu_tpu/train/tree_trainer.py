@@ -1,0 +1,434 @@
+"""GBT/RF histogram tree builder — level-wise, one fused scatter-add per level.
+
+What DTMaster/DTWorker do across a Hadoop cluster (SURVEY §3.2: workers
+accumulate per-node per-feature bin histograms via Impurity.featureUpdate
+dt/DTWorker.java:851, master merges + picks best split per node
+dt/DTMaster.java:274-360) happens here as one jit program per tree level:
+
+    histogram    [L, F, S, 3] (cnt, sum, sqsum) built by ONE scatter-add over
+                 the [n, F] code matrix — the Pallas-able hot op; XLA's TPU
+                 scatter handles it. Row-sharded inputs all-reduce (psum) the
+                 histogram when run on a mesh.
+    split scan   ordered prefix sums per (node, feature): numeric bins keep
+                 code order, categorical bins are sorted by label mean per
+                 node (the reference sorts categories by mean response,
+                 DTMaster split search); gain by impurity
+                 (variance/friedmanmse: dt/Impurity.java:106,255;
+                 entropy/gini via binary counts :368,553).
+    node update  rows re-position via the chosen feature's goes-left bin mask.
+
+GBT parity (dt/DTWorker.java:1470-1486): tree 0 weight 1.0, later trees
+weight=learningRate; per-tree labels are -loss gradient (squared -> residual,
+log -> y - sigmoid(pred)). RF: per-tree Poisson bagging + feature subset
+(FeatureSubsetStrategy.java).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.models.tree import DenseTree, TreeModelSpec
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass
+class TreeTrainConfig:
+    algorithm: str = "GBT"  # GBT | RF
+    tree_num: int = 100
+    max_depth: int = 6
+    impurity: str = "variance"  # variance | friedmanmse | entropy | gini
+    loss: str = "squared"  # squared | log (GBT label relabeling)
+    learning_rate: float = 0.05
+    min_instances_per_node: int = 5
+    min_info_gain: float = 0.0
+    feature_subset_strategy: str = "ALL"  # ALL/HALF/ONETHIRD/TWOTHIRDS/SQRT/LOG2/AUTO
+    bagging_sample_rate: float = 1.0
+    bagging_with_replacement: bool = True
+    valid_set_rate: float = 0.1
+    early_stop_rounds: int = 0  # GBT: stop when valid error worsens N rounds
+    seed: int = 0
+    max_batch_nodes: int = 1024  # node-budget analog of maxStatsMemory
+
+    @classmethod
+    def from_model_config(cls, mc, trainer_id: int = 0) -> "TreeTrainConfig":
+        t = mc.train
+        alg = t.algorithm.value if hasattr(t.algorithm, "value") else str(t.algorithm)
+
+        def g(key, default):
+            v = t.get_param(key, default)
+            return default if v is None else v
+
+        alg = "RF" if alg in ("RF", "DT") else "GBT"
+        return cls(
+            algorithm=alg,
+            tree_num=int(g("TreeNum", 100 if alg == "GBT" else 10)),
+            max_depth=int(g("MaxDepth", 6 if alg == "GBT" else 10)),
+            impurity=str(g("Impurity", "variance")).lower(),
+            loss=str(g("Loss", "squared")).lower(),
+            learning_rate=float(g("LearningRate", 0.05)),
+            min_instances_per_node=int(g("MinInstancesPerNode", 5)),
+            min_info_gain=float(g("MinInfoGain", 0.0)),
+            feature_subset_strategy=str(
+                g("FeatureSubsetStrategy", "ALL")
+            ).upper(),
+            bagging_sample_rate=float(t.bagging_sample_rate or 1.0),
+            bagging_with_replacement=bool(t.bagging_with_replacement or alg == "RF"),
+            valid_set_rate=float(t.valid_set_rate or 0.1),
+            seed=trainer_id * 977 + 13,
+        )
+
+
+def subset_count(strategy: str, n_features: int) -> int:
+    s = strategy.upper()
+    if s in ("ALL", ""):
+        return n_features
+    if s == "HALF":
+        return max(1, n_features // 2)
+    if s == "ONETHIRD":
+        return max(1, n_features // 3)
+    if s == "TWOTHIRDS":
+        return max(1, (2 * n_features) // 3)
+    if s == "QUARTER":
+        return max(1, n_features // 4)
+    if s in ("SQRT", "AUTO"):
+        return max(1, int(math.sqrt(n_features)))
+    if s == "LOG2":
+        return max(1, int(math.log2(max(n_features, 2))))
+    return n_features
+
+
+# Cached per-level compiled programs keyed by static shape/hyperparams.
+_LEVEL_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _get_level_program(L: int, F: int, S: int, impurity: str,
+                       min_inst: int, min_gain: float):
+    key = (L, F, S, impurity, min_inst, float(min_gain))
+    prog = _LEVEL_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def level_step(codes, labels, weights, node_local, active, is_cat, feat_ok):
+        """One tree level over L nodes.
+
+        codes [n, F] int32; labels/weights [n] f32; node_local [n] int32
+        (0..L-1, position within level); active [n] bool; is_cat [F] bool;
+        feat_ok [F] bool (feature-subset mask).
+
+        Returns (feature [L], cut_rank [L], order [L, F, S], leaf_value [L],
+        is_split [L]).
+        """
+        n = codes.shape[0]
+        w = jnp.where(active, weights, 0.0)
+        nl = jnp.where(active, node_local, 0)
+
+        # ---- fused histogram: one scatter-add of (w, w*y, w*y^2) ----
+        flat = (nl[:, None] * F + jnp.arange(F)[None, :]) * S + codes
+        vals = jnp.stack(
+            [w, w * labels, w * labels * labels], axis=-1
+        )[:, None, :] * jnp.ones((1, F, 1), jnp.float32)
+        hist = jnp.zeros((L * F * S, 3), jnp.float32).at[flat].add(vals)
+        hist = hist.reshape(L, F, S, 3)
+        cnt, s1, s2 = hist[..., 0], hist[..., 1], hist[..., 2]
+
+        # ---- bin ordering: numeric keeps code order, categorical sorts by
+        # mean label (empty bins pushed right) ----
+        mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, 1e-12), jnp.inf)
+        cat_order = jnp.argsort(mean, axis=-1)  # [L, F, S]
+        num_order = jnp.broadcast_to(jnp.arange(S), (L, F, S))
+        order = jnp.where(is_cat[None, :, None], cat_order, num_order)
+
+        cnt_o = jnp.take_along_axis(cnt, order, axis=-1)
+        s1_o = jnp.take_along_axis(s1, order, axis=-1)
+        s2_o = jnp.take_along_axis(s2, order, axis=-1)
+        lcnt = jnp.cumsum(cnt_o, axis=-1)
+        ls1 = jnp.cumsum(s1_o, axis=-1)
+        ls2 = jnp.cumsum(s2_o, axis=-1)
+        tcnt, ts1, ts2 = lcnt[..., -1:], ls1[..., -1:], ls2[..., -1:]
+        rcnt, rs1, rs2 = tcnt - lcnt, ts1 - ls1, ts2 - ls2
+
+        def sse(c, s, q):  # sum squared error = impurity mass (variance)
+            return q - s * s / jnp.maximum(c, 1e-12)
+
+        def gini_mass(c, pos):
+            neg = c - pos
+            return c - (pos * pos + neg * neg) / jnp.maximum(c, 1e-12)
+
+        def entropy_mass(c, pos):
+            p = pos / jnp.maximum(c, 1e-12)
+            q = 1.0 - p
+            h = -(p * jnp.log2(jnp.maximum(p, 1e-12))
+                  + q * jnp.log2(jnp.maximum(q, 1e-12)))
+            return c * h
+
+        if impurity in ("entropy",):
+            gain = (entropy_mass(tcnt, ts1) - entropy_mass(lcnt, ls1)
+                    - entropy_mass(rcnt, rs1))
+        elif impurity in ("gini",):
+            gain = gini_mass(tcnt, ts1) - gini_mass(lcnt, ls1) - gini_mass(rcnt, rs1)
+        elif impurity == "friedmanmse":
+            # FriedmanMSE (Impurity.java:255): (nl*nr)/(nl+nr) * (ml - mr)^2
+            ml = ls1 / jnp.maximum(lcnt, 1e-12)
+            mr = rs1 / jnp.maximum(rcnt, 1e-12)
+            gain = lcnt * rcnt / jnp.maximum(tcnt, 1e-12) * (ml - mr) ** 2
+        else:  # variance
+            gain = sse(tcnt, ts1, ts2) - sse(lcnt, ls1, ls2) - sse(rcnt, rs1, rs2)
+
+        valid = (
+            (lcnt >= min_inst)
+            & (rcnt >= min_inst)
+            & (gain > min_gain)
+            & feat_ok[None, :, None]
+        )
+        gain = jnp.where(valid, gain, -jnp.inf)
+
+        # best cut per node over (F, S) — cut at ordered rank k means ordered
+        # bins [0..k] go left (k = S-1 would send all left: invalid via rcnt)
+        flat_gain = gain.reshape(L, F * S)
+        best = jnp.argmax(flat_gain, axis=-1)
+        best_gain = jnp.take_along_axis(flat_gain, best[:, None], axis=-1)[:, 0]
+        best_feat = (best // S).astype(jnp.int32)
+        best_rank = (best % S).astype(jnp.int32)
+        is_split = jnp.isfinite(best_gain)
+
+        node_cnt = tcnt[:, 0, 0]
+        node_sum = ts1[:, 0, 0]
+        leaf_value = node_sum / jnp.maximum(node_cnt, 1e-12)
+        return best_feat, best_rank, order, leaf_value, is_split
+
+    @jax.jit
+    def finalize_level(bf, br, order, is_split, node_local, active, resting,
+                       codes, base):
+        """Build the level's goes-left masks, settle non-split rows, and
+        reposition the rest — all on device, so the per-level Python loop
+        never blocks on a host transfer (one sync per TREE, not per level;
+        matters enormously over a tunneled TPU link)."""
+        # inverse permutation of each node's best-feature bin order -> rank
+        order_best = order[jnp.arange(L), bf]  # [L, S]
+        rank = jnp.zeros((L, S), jnp.int32).at[
+            jnp.arange(L)[:, None], order_best
+        ].set(jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (L, S)))
+        lm = (rank <= br[:, None]) & is_split[:, None]
+
+        settled = active & ~is_split[node_local]
+        resting2 = jnp.where(settled, base + node_local, resting)
+
+        f = jnp.where(is_split, bf, 0)[node_local]
+        code = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
+        goes_left = lm[node_local, jnp.clip(code, 0, S - 1)]
+        new_local = jnp.where(goes_left, 2 * node_local, 2 * node_local + 1)
+        still = is_split[node_local] & active
+        node_local2 = jnp.where(still, new_local, 0)
+        feature_level = jnp.where(is_split, bf, -1)
+        return lm, feature_level, resting2, node_local2, still
+
+    _LEVEL_PROGRAMS[key] = (level_step, finalize_level)
+    return _LEVEL_PROGRAMS[key]
+
+
+def build_tree(
+    codes,
+    labels,
+    weights,
+    slots: np.ndarray,
+    is_cat: np.ndarray,
+    cfg: TreeTrainConfig,
+    feat_ok: np.ndarray,
+) -> Tuple[DenseTree, np.ndarray]:
+    """One tree, level-wise. codes [n, F] int32 on device; labels/weights
+    [n] f32 on device (weights already carry bagging significance).
+
+    Returns (tree, resting [n] int32) — resting is the global node index each
+    row ends at, so callers get per-row predictions without re-traversal
+    (leaf_value[resting])."""
+    import jax.numpy as jnp
+
+    n, F = codes.shape
+    S = int(slots.max())
+    D = cfg.max_depth
+
+    is_cat_j = jnp.asarray(is_cat)
+    feat_ok_j = jnp.asarray(feat_ok)
+    node_local = jnp.zeros(n, dtype=jnp.int32)
+    active = jnp.ones(n, dtype=bool)
+    resting = jnp.zeros(n, dtype=jnp.int32)
+
+    feat_levels, mask_levels, leaf_levels = [], [], []
+    for depth in range(D):
+        L = 2**depth
+        base = 2**depth - 1
+        level_step, finalize_level = _get_level_program(
+            L, F, S, cfg.impurity, cfg.min_instances_per_node, cfg.min_info_gain
+        )
+        bf, br, order, lv, is_split = level_step(
+            codes, labels, weights, node_local, active, is_cat_j, feat_ok_j
+        )
+        lm, feature_level, resting, node_local, active = finalize_level(
+            bf, br, order, is_split, node_local, active, resting, codes,
+            jnp.int32(base),
+        )
+        feat_levels.append(feature_level)
+        mask_levels.append(lm)
+        leaf_levels.append(lv)
+
+    # final level: leaf values for the deepest children + settle leftovers
+    L2 = 2**D
+    base2 = L2 - 1
+    level_step2, _ = _get_level_program(
+        L2, F, S, cfg.impurity, cfg.min_instances_per_node, cfg.min_info_gain
+    )
+    _, _, _, lv2, _ = level_step2(
+        codes, labels, weights, node_local, active, is_cat_j, feat_ok_j
+    )
+    leaf_levels.append(lv2)
+    feat_levels.append(jnp.full(L2, -1, jnp.int32))
+    mask_levels.append(jnp.zeros((L2, S), bool))
+    resting = jnp.where(active, base2 + node_local, resting)
+
+    # ONE host sync for the whole tree
+    import jax
+
+    feature, left_mask, leaf_value = jax.device_get(
+        (jnp.concatenate(feat_levels), jnp.concatenate(mask_levels, axis=0),
+         jnp.concatenate(leaf_levels))
+    )
+    tree = DenseTree(
+        feature=np.asarray(feature, np.int32),
+        left_mask=np.asarray(left_mask, bool),
+        leaf_value=np.asarray(leaf_value, np.float32),
+        weight=1.0,
+    )
+    return tree, resting
+
+
+@dataclass
+class TreeTrainResult:
+    spec: TreeModelSpec
+    train_error: float
+    valid_error: float
+
+
+def train_trees(
+    codes: np.ndarray,
+    tags: np.ndarray,
+    weights: np.ndarray,
+    slots: List[int],
+    is_cat: List[bool],
+    columns: List[str],
+    cfg: TreeTrainConfig,
+    boundaries: Optional[List] = None,
+    categories: Optional[List] = None,
+    progress_cb=None,
+) -> TreeTrainResult:
+    """Full GBT/RF training run."""
+    import jax
+    import jax.numpy as jnp
+
+    n, F = codes.shape
+    rng = np.random.default_rng(cfg.seed)
+    valid_mask = rng.random(n) < cfg.valid_set_rate
+    codes_j = jnp.asarray(codes.astype(np.int32))
+    y = tags.astype(np.float32)
+    y_j = jnp.asarray(y)
+    vm_j = jnp.asarray(valid_mask)
+    base_w_j = jnp.asarray(np.where(valid_mask, 0.0, weights).astype(np.float32))
+    slots_np = np.asarray(slots, dtype=np.int32)
+    is_cat_np = np.asarray(is_cat, dtype=bool)
+
+    k_sub = subset_count(cfg.feature_subset_strategy, F)
+    trees: List[DenseTree] = []
+    lr = cfg.learning_rate
+    is_gbt = cfg.algorithm == "GBT"
+    log_loss = cfg.loss == "log"
+
+    @jax.jit
+    def errors_of(score):
+        sq = (y_j - score) ** 2
+        v = jnp.sum(jnp.where(vm_j, sq, 0.0)) / jnp.maximum(jnp.sum(vm_j), 1.0)
+        t = jnp.sum(jnp.where(vm_j, 0.0, sq)) / jnp.maximum(jnp.sum(~vm_j), 1.0)
+        return t, v
+
+    pred = jnp.zeros(n, dtype=jnp.float32)  # GBT raw prediction F(x)
+    valid_errors: List[float] = []
+    bad_rounds = 0
+    terr = verr = 0.0
+
+    for k in range(cfg.tree_num):
+        if cfg.algorithm == "RF":
+            if cfg.bagging_with_replacement:
+                bag = rng.poisson(cfg.bagging_sample_rate, size=n)
+            else:
+                bag = rng.random(n) < cfg.bagging_sample_rate
+            w_k = base_w_j * jnp.asarray(bag.astype(np.float32))
+            labels_k = y_j
+        else:  # GBT: fit the negative loss gradient
+            w_k = base_w_j
+            if log_loss:
+                labels_k = y_j - 1.0 / (1.0 + jnp.exp(-pred))
+            else:
+                labels_k = y_j - pred
+
+        feat_ok = np.zeros(F, dtype=bool)
+        if k_sub >= F:
+            feat_ok[:] = True
+        else:
+            feat_ok[rng.choice(F, size=k_sub, replace=False)] = True
+
+        tree, resting = build_tree(
+            codes_j, labels_k, w_k, slots_np, is_cat_np, cfg, feat_ok,
+        )
+        tree.weight = 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0)
+        trees.append(tree)
+
+        # per-row prediction straight from the build (no re-traversal)
+        tree_pred = jnp.asarray(tree.leaf_value)[resting]
+        if is_gbt:
+            pred = pred + tree.weight * tree_pred
+            score = (
+                1.0 / (1.0 + jnp.exp(-pred)) if log_loss
+                else jnp.clip(pred, 0.0, 1.0)
+            )
+        else:
+            pred = tree_pred if k == 0 else (pred * k + tree_pred) / (k + 1)
+            score = jnp.clip(pred, 0.0, 1.0)
+
+        t_e, v_e = errors_of(score)
+        terr, verr = float(t_e), float(v_e)  # one sync per tree
+        valid_errors.append(verr)
+        if progress_cb:
+            progress_cb(k + 1, terr, verr)
+        if cfg.early_stop_rounds and len(valid_errors) > 1:
+            if verr > min(valid_errors):
+                bad_rounds += 1
+                if bad_rounds >= cfg.early_stop_rounds:
+                    log.info("early stop after %d trees", k + 1)
+                    break
+            else:
+                bad_rounds = 0
+
+    spec = TreeModelSpec(
+        algorithm=cfg.algorithm,
+        trees=trees,
+        input_columns=list(columns),
+        slots=[int(s) for s in slots],
+        boundaries=boundaries or [None] * F,
+        categories=categories or [None] * F,
+        loss=cfg.loss,
+        learning_rate=lr,
+        init_pred=0.0,
+        convert_to_prob="SIGMOID" if cfg.loss == "log" else "RAW",
+        train_error=terr,
+        valid_error=valid_errors[-1] if valid_errors else None,
+    )
+    return TreeTrainResult(spec=spec, train_error=terr,
+                           valid_error=valid_errors[-1] if valid_errors else 0.0)

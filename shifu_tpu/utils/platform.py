@@ -1,0 +1,32 @@
+"""JAX platform selection shared by the CLI, tests, and driver entry points.
+
+Some TPU plugins (axon) ignore the JAX_PLATFORMS env var; the config API
+wins either way, so force the platform through jax.config BEFORE the backend
+initializes. Safe to call multiple times; a no-op once a backend exists.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def force_platform(platform: Optional[str] = None, n_devices: Optional[int] = None) -> None:
+    """Force `platform` (default: the JAX_PLATFORMS env var, if set) and
+    optionally request n virtual host devices (CPU mesh testing)."""
+    platform = platform or os.environ.get("JAX_PLATFORMS")
+    if n_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+    if not platform:
+        return
+    os.environ.setdefault("JAX_PLATFORMS", platform)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    except Exception:
+        pass

@@ -8,16 +8,8 @@ workers in-process): same pure step functions, N virtual devices.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# The axon TPU plugin ignores the env var; the config API wins either way.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from shifu_tpu.utils.platform import force_platform  # noqa: E402
+
+force_platform("cpu", n_devices=8)

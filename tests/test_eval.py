@@ -44,6 +44,25 @@ class TestConfusionSweep:
         cs = confusion_sweep(scores, tags, w)
         assert auc_from_sweep(cs) != pytest.approx(auc_from_sweep(cs, weighted=True))
 
+    def test_tied_scores_order_independent(self):
+        """All-tied scores must give AUC 0.5 regardless of row order
+        (tie blocks move through the sweep as a unit)."""
+        scores = np.full(100, 0.5)
+        tags = np.concatenate([np.ones(40), np.zeros(60)])
+        for t in (tags, tags[::-1]):
+            cs = confusion_sweep(scores, t)
+            assert auc_from_sweep(cs) == pytest.approx(0.5, abs=1e-9)
+
+    def test_multi_bucket_crossing_emits_all(self):
+        """A dominant-weight record crossing several bucket boundaries at
+        once must still emit every bucket row."""
+        scores = np.array([0.9, 0.8, 0.7, 0.6])
+        tags = np.array([1.0, 0, 0, 0])
+        w = np.array([100.0, 1, 1, 1])
+        perf = evaluate_performance(scores, tags, w, n_buckets=10)
+        bins = [p["binNum"] for p in perf.weighted_pr]
+        assert bins == list(range(11))  # 0 + all ten crossings
+
     def test_auc_known_value(self):
         # manual: ranks -> AUC = P(score_pos > score_neg)
         scores = np.array([0.9, 0.7, 0.6, 0.4, 0.2])
