@@ -77,6 +77,15 @@ class ModelRunner:
         self.scale = scale
         self._norm_cache: Dict[str, np.ndarray] = {}
         self._codes_cache: Dict[str, np.ndarray] = {}
+        self._cached_data_id: Optional[int] = None
+
+    def _check_batch(self, data: ColumnarData) -> None:
+        """Feature caches are per input batch — a new ColumnarData object
+        invalidates them (model signatures alone don't identify the rows)."""
+        if self._cached_data_id != id(data):
+            self._norm_cache.clear()
+            self._codes_cache.clear()
+            self._cached_data_id = id(data)
 
     @staticmethod
     def _independent(spec):
@@ -123,6 +132,7 @@ class ModelRunner:
         (EvalScoreUDF loads models once, then scores row batches)."""
         from shifu_tpu.models.tree import TreeModelSpec
 
+        self._check_batch(data)
         cols = []
         for spec, model in zip(self.specs, self.models):
             if isinstance(spec, TreeModelSpec):

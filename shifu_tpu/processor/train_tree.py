@@ -39,7 +39,13 @@ def train_tree_models(proc, alg) -> None:
     is_cat, boundaries, categories = [], [], []
     for name in meta.columns:
         cc = by_name.get(name)
-        cat = bool(cc and cc.is_categorical())
+        if cc is None:
+            raise ShifuError(
+                ErrorCode.DATA_NOT_FOUND,
+                f"CleanedData column {name} is no longer selected in "
+                f"ColumnConfig.json — re-run `shifu norm`",
+            )
+        cat = cc.is_categorical()
         is_cat.append(cat)
         boundaries.append(None if cat else list(cc.column_binning.bin_boundary or []))
         categories.append(list(cc.column_binning.bin_category or []) if cat else None)

@@ -53,7 +53,6 @@ class TreeTrainConfig:
     valid_set_rate: float = 0.1
     early_stop_rounds: int = 0  # GBT: stop when valid error worsens N rounds
     seed: int = 0
-    max_batch_nodes: int = 1024  # node-budget analog of maxStatsMemory
 
     @classmethod
     def from_model_config(cls, mc, trainer_id: int = 0) -> "TreeTrainConfig":
@@ -78,8 +77,9 @@ class TreeTrainConfig:
                 g("FeatureSubsetStrategy", "ALL")
             ).upper(),
             bagging_sample_rate=float(t.bagging_sample_rate or 1.0),
-            bagging_with_replacement=bool(t.bagging_with_replacement or alg == "RF"),
+            bagging_with_replacement=bool(t.bagging_with_replacement),
             valid_set_rate=float(t.valid_set_rate or 0.1),
+            early_stop_rounds=int(g("EarlyStopRounds", 0)),
             seed=trainer_id * 977 + 13,
         )
 

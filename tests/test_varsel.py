@@ -1,0 +1,175 @@
+"""Varsel tests: filter orders, pareto front, auto-filter, SE sensitivity,
+and the end-to-end processor including norm re-run shrinking the matrix."""
+
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.config import ColumnConfig, ColumnType
+from shifu_tpu.config.column_config import ColumnFlag
+from shifu_tpu.varsel.selector import (
+    auto_filter,
+    pareto_front_order,
+    select_by_filter,
+    sensitivity_scores,
+)
+
+
+def _col(name, ks, iv, flag=None, missing=0.0):
+    c = ColumnConfig(column_name=name, column_type=ColumnType.N)
+    c.column_stats.ks = ks
+    c.column_stats.iv = iv
+    c.column_stats.missing_percentage = missing
+    c.column_flag = flag
+    return c
+
+
+class TestFilter:
+    def test_ks_order(self):
+        cols = [_col("a", 10, 1), _col("b", 30, 2), _col("c", 20, 3)]
+        sel = select_by_filter(cols, "KS", 2)
+        assert sel == ["b", "c"]
+        assert [c.final_select for c in cols] == [False, True, True]
+
+    def test_iv_order(self):
+        cols = [_col("a", 10, 1), _col("b", 30, 2), _col("c", 20, 3)]
+        sel = select_by_filter(cols, "IV", 2)
+        assert sel == ["c", "b"]
+
+    def test_mix_alternates(self):
+        cols = [_col("a", 40, 1), _col("b", 30, 9), _col("c", 20, 8),
+                _col("d", 10, 2)]
+        sel = select_by_filter(cols, "MIX", 3)
+        # ks best = a, iv best = b, then ks#2 = b (dup) -> c by iv
+        assert sel[0] == "a" and "b" in sel[:2]
+
+    def test_force_select_counts_toward_budget(self):
+        cols = [_col("a", 1, 1, flag=ColumnFlag.FORCE_SELECT),
+                _col("b", 30, 2), _col("c", 20, 3)]
+        sel = select_by_filter(cols, "KS", 2)
+        assert "a" in sel and "b" in sel and "c" not in sel
+
+    def test_force_remove_excluded(self):
+        cols = [_col("a", 99, 9, flag=ColumnFlag.FORCE_REMOVE), _col("b", 1, 1)]
+        sel = select_by_filter(cols, "KS", 5)
+        assert sel == ["b"]
+
+    def test_filter_disabled_only_force(self):
+        cols = [_col("a", 9, 9, flag=ColumnFlag.FORCE_SELECT), _col("b", 99, 9)]
+        sel = select_by_filter(cols, "KS", 10, filter_enable=False)
+        assert sel == ["a"]
+
+    def test_pareto_front(self):
+        pts = [(1, 1), (3, 3), (2, 4), (0, 0)]
+        order = pareto_front_order(pts)
+        # (3,3) and (2,4) are front 1; (1,1) front 2; (0,0) front 3
+        assert set(order[:2]) == {1, 2}
+        assert order[2] == 0 and order[3] == 3
+
+
+class TestAutoFilter:
+    def test_missing_and_thresholds(self):
+        cols = [_col("a", 30, 3, missing=0.99), _col("b", 0.001, 3),
+                _col("c", 30, 0.0001), _col("d", 30, 3)]
+        res = auto_filter(cols, missing_rate_threshold=0.98, min_ks=0.01,
+                          min_iv=0.001)
+        assert set(res.removed) == {"a", "b", "c"}
+        assert cols[0].is_force_remove()
+        assert not cols[3].is_force_remove()
+
+    def test_correlation_drops_lower_iv(self):
+        cols = [_col("a", 30, 3), _col("b", 30, 1)]
+        corr = np.asarray([[1.0, 0.95], [0.95, 1.0]])
+        res = auto_filter(cols, correlation=corr, correlation_names=["a", "b"],
+                          correlation_threshold=0.9)
+        assert set(res.removed) == {"b"}
+
+
+class TestSensitivity:
+    def test_knockout_finds_informative_column(self):
+        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
+
+        rng = np.random.default_rng(0)
+        n = 600
+        x = rng.normal(size=(n, 4)).astype(np.float32)
+        t = (x[:, 1] > 0).astype(np.float32)  # only column 1 matters
+        w = np.ones(n, np.float32)
+        cfg = NNTrainConfig(hidden_nodes=[8], num_epochs=40, propagation="R",
+                            valid_set_rate=0.2)
+        res = train_nn(x, t, w, cfg)
+        scores = sensitivity_scores(res.params, ["tanh"], x, t, "SE")
+        assert scores.argmax() == 1
+        scores_st = sensitivity_scores(res.params, ["tanh"], x, t, "ST")
+        assert scores_st.argmax() == 1
+
+
+class TestVarSelProcessor:
+    @pytest.fixture()
+    def root(self, tmp_path):
+        from tests.helpers import make_model_set
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=400)
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root, correlation=True).run() == 0
+        return root
+
+    def test_filter_and_recover(self, root):
+        from shifu_tpu.config import load_column_config_list
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.processor.varsel import VarSelProcessor
+
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        mc.var_select.filter_num = 5
+        mc.var_select.filter_by = "KS"
+        mc.save(os.path.join(root, "ModelConfig.json"))
+
+        assert VarSelProcessor(root).run() == 0
+        cols = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+        assert sum(1 for c in cols if c.final_select) == 5
+
+        # -list and -reset
+        assert VarSelProcessor(root, list_vars=True).run() == 0
+        assert VarSelProcessor(root, reset=True).run() == 0
+        cols = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+        assert sum(1 for c in cols if c.final_select) == 0
+
+        # -recover restores the pre-varsel state (no selection)
+        assert VarSelProcessor(root, recover=True).run() == 0
+
+    def test_varsel_then_norm_shrinks_matrix(self, root):
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.norm.dataset import load_normalized
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.varsel import VarSelProcessor
+
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        mc.var_select.filter_num = 4
+        mc.save(os.path.join(root, "ModelConfig.json"))
+        assert VarSelProcessor(root).run() == 0
+        assert NormProcessor(root).run() == 0
+        meta, feats, _, _ = load_normalized(
+            os.path.join(root, "tmp", "norm", "NormalizedData")
+        )
+        assert feats.shape[1] == 4
+
+    def test_se_filter(self, root):
+        from shifu_tpu.config import load_column_config_list
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.varsel import VarSelProcessor
+
+        assert NormProcessor(root).run() == 0
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        mc.var_select.filter_num = 6
+        mc.var_select.filter_by = "SE"
+        mc.train.num_train_epochs = 20
+        mc.save(os.path.join(root, "ModelConfig.json"))
+        assert VarSelProcessor(root).run() == 0
+        cols = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+        assert sum(1 for c in cols if c.final_select) == 6
+        assert os.path.isfile(os.path.join(root, "tmp", "varsel", "se.csv"))
