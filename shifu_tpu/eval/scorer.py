@@ -113,6 +113,24 @@ class ModelRunner:
         self._norm_cache[key] = mat
         return mat
 
+    def _wdl_codes(self, spec, data: ColumnarData) -> np.ndarray:
+        """Categorical index matrix for a WDL model, cached per batch like
+        tree codes."""
+        from shifu_tpu.stats.binning import categorical_bin_index
+
+        key = json.dumps(["wdl", spec.cat_columns, spec.categories],
+                         sort_keys=True)
+        if key in self._codes_cache:
+            return self._codes_cache[key]
+        codes = np.zeros((data.n_rows, len(spec.cat_columns)), np.int32)
+        for f, name in enumerate(spec.cat_columns):
+            miss = data.missing_mask(name)
+            codes[:, f] = categorical_bin_index(
+                data.column(name), spec.categories[f], miss
+            )
+        self._codes_cache[key] = codes
+        return codes
+
     def _tree_codes(self, spec, model, data: ColumnarData) -> np.ndarray:
         """Bin codes per tree model, cached by the model's own binning
         signature (different models may embed different columns/bins)."""
@@ -131,6 +149,7 @@ class ModelRunner:
         plan; tree models bin via their embedded boundaries/categories
         (EvalScoreUDF loads models once, then scores row batches)."""
         from shifu_tpu.models.tree import TreeModelSpec
+        from shifu_tpu.models.wdl import WDLModelSpec
 
         self._check_batch(data)
         cols = []
@@ -138,6 +157,10 @@ class ModelRunner:
             if isinstance(spec, TreeModelSpec):
                 codes = self._tree_codes(spec, model, data)
                 cols.append(model.compute(codes) * self.scale)
+            elif isinstance(spec, WDLModelSpec):
+                dense = self._normalized_input(spec, data)
+                wcodes = self._wdl_codes(spec, data)
+                cols.append(model.compute_parts(dense, wcodes) * self.scale)
             else:
                 x = self._normalized_input(spec, data)
                 cols.append(model.compute(x) * self.scale)

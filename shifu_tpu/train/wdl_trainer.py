@@ -1,0 +1,235 @@
+"""WDL trainer — same jit while_loop harness as the NN trainer, over the
+flattened wide&deep parameter vector.
+
+Parity: wdl/WDLMaster.java:65 (master merges gradients + optimizer step) and
+wdl/WDLWorker.java (per-record fwd/bwd) collapse into one SPMD program; the
+optimizer set (wdl/optimization/*: GradientDescent, AdaGrad + the shared
+Propagation/ADAM family) reuses shifu_tpu.train.updaters. Loss is weighted
+log loss (the reference's WDL trains sigmoid + cross-entropy).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.models.wdl import (
+    WDLParams,
+    flatten_wdl,
+    init_wdl_params,
+    unflatten_wdl,
+    unflatten_wdl_from_shapes,
+    wdl_forward,
+    wdl_shapes,
+)
+from shifu_tpu.train.updaters import make_updater
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass
+class WDLTrainConfig:
+    hidden: List[int] = field(default_factory=lambda: [100, 50])
+    activations: List[str] = field(default_factory=lambda: ["relu", "relu"])
+    embed_dim: int = 8
+    learning_rate: float = 0.005
+    optimizer: str = "ADAM"
+    l2_reg: float = 0.0
+    num_epochs: int = 100
+    valid_set_rate: float = 0.2
+    bagging_sample_rate: float = 1.0
+    bagging_with_replacement: bool = False
+    early_stop_window: int = 0
+    seed: int = 0
+
+    @classmethod
+    def from_model_config(cls, mc, trainer_id: int = 0) -> "WDLTrainConfig":
+        t = mc.train
+
+        def g(key, default):
+            v = t.get_param(key, default)
+            return default if v is None else v
+
+        return cls(
+            hidden=[int(x) for x in g("NumHiddenNodes", [100, 50])],
+            activations=[str(a) for a in g("ActivationFunc", ["relu", "relu"])],
+            embed_dim=int(g("EmbedOutputs", 8)),
+            learning_rate=float(g("LearningRate", 0.005)),
+            optimizer=str(g("Optimizer", "ADAM")).upper(),
+            l2_reg=float(g("L2Reg", 0.0) or g("RegularizedConstant", 0.0)),
+            num_epochs=int(t.num_train_epochs or 100),
+            valid_set_rate=float(t.valid_set_rate or 0.0),
+            bagging_sample_rate=float(t.bagging_sample_rate or 1.0),
+            bagging_with_replacement=bool(t.bagging_with_replacement),
+            early_stop_window=int(g("EarlyStopWindowSize", 0)),
+            seed=trainer_id * 1000 + 23,
+        )
+
+
+@dataclass
+class WDLTrainResult:
+    params: WDLParams
+    train_error: float
+    valid_error: float
+    iterations: int
+
+
+_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _get_program(cfg: WDLTrainConfig, template: WDLParams, mesh=None):
+    import jax
+    import jax.numpy as jnp
+
+    # tensor parallelism: when the mesh has a `model` axis, embedding tables
+    # are constrained to shard their embed dim across it — XLA inserts the
+    # all-gathers/reduce-scatters (SURVEY §2.8: TP for wide WDL vocab tables)
+    embed_sharding = None
+    if mesh is not None and "model" in mesh.axis_names:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        embed_sharding = NamedSharding(mesh, P(None, "model"))
+
+    # close over shapes only — retaining `template`'s arrays in the cached
+    # closure would pin every initial 10k-vocab embedding table forever
+    shapes = wdl_shapes(template)
+    n_cat = len(template.embed)
+    key = (tuple(shapes), n_cat, tuple(cfg.activations), cfg.optimizer,
+           cfg.l2_reg, cfg.early_stop_window, embed_sharding)
+    if key in _PROGRAMS:
+        return _PROGRAMS[key]
+
+    init_state, apply_update = make_updater(
+        cfg.optimizer if cfg.optimizer != "GD" else "B",
+        momentum=0.0,
+        reg=cfg.l2_reg,
+        reg_level="L2" if cfg.l2_reg else "NONE",
+    )
+    window = cfg.early_stop_window
+
+    def loss_fn(flat, dense, codes, t, sig):
+        p = unflatten_wdl_from_shapes(flat, shapes, n_cat)
+        if embed_sharding is not None:
+            p.embed = [
+                jax.lax.with_sharding_constraint(e, embed_sharding)
+                for e in p.embed
+            ]
+        prob = wdl_forward(p, dense, codes, cfg.activations)
+        eps = 1e-7
+        pc = jnp.clip(prob, eps, 1 - eps)
+        ll = -(t * jnp.log(pc) + (1 - t) * jnp.log(1 - pc))
+        return jnp.sum(sig * ll), prob
+
+    grad_fn = jax.grad(loss_fn, has_aux=True)
+
+    def one_iter(carry, dense, codes, t, sig_tr, sig_va, nts, lr):
+        (flat, opt, it, best_val, best_flat, bad, halt, tr_e, va_e) = carry
+        g_neg, prob = grad_fn(flat, dense, codes, t, sig_tr)
+        g = -g_neg
+        sq = (t - prob) ** 2
+        tr = jnp.sum(sig_tr * sq) / jnp.maximum(jnp.sum(sig_tr), 1.0)
+        va = jnp.sum(sig_va * sq) / jnp.maximum(jnp.sum(sig_va), 1.0)
+        new_flat, new_opt = apply_update(opt, flat, g, lr, it + 1, nts)
+        improved = va < best_val
+        best_val2 = jnp.where(improved, va, best_val)
+        best_flat2 = jnp.where(improved, flat, best_flat)
+        bad2 = jnp.where(improved, 0, bad + 1)
+        halt2 = (bad2 >= window) if window > 0 else jnp.zeros((), bool)
+        return (new_flat, new_opt, it + 1, best_val2, best_flat2, bad2,
+                halt2, tr, va)
+
+    @jax.jit
+    def program(carry, limit, dense, codes, t, sig_tr, sig_va, nts, lr):
+        def cond(c):
+            return (c[2] < limit) & (~c[6])
+
+        def body(c):
+            return one_iter(c, dense, codes, t, sig_tr, sig_va, nts, lr)
+
+        return jax.lax.while_loop(cond, body, carry)
+
+    _PROGRAMS[key] = (program, init_state)
+    return _PROGRAMS[key]
+
+
+def train_wdl(
+    dense: np.ndarray,
+    codes: np.ndarray,
+    tags: np.ndarray,
+    weights: np.ndarray,
+    vocab_sizes: List[int],
+    cfg: WDLTrainConfig,
+    mesh=None,
+) -> WDLTrainResult:
+    import jax
+    import jax.numpy as jnp
+
+    n = dense.shape[0]
+    template = init_wdl_params(
+        dense.shape[1], vocab_sizes, cfg.embed_dim, cfg.hidden, seed=cfg.seed
+    )
+    flat0 = flatten_wdl(template)
+
+    from shifu_tpu.train.nn_trainer import split_and_sample
+
+    sig, valid = split_and_sample(n, cfg)
+    sig_tr = (sig * weights).astype(np.float32)
+    sig_va = (valid.astype(np.float32) * weights).astype(np.float32)
+    nts = float(max(sig.sum(), 1.0))
+
+    d = dense.astype(np.float32)
+    c = codes.astype(np.int32)
+    t = tags.astype(np.float32)
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import pad_rows, shard_rows
+
+        n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+            "data", mesh.devices.size
+        )
+        (d, c, t, sig_tr, sig_va), _ = pad_rows([d, c, t, sig_tr, sig_va], n_data)
+        d = shard_rows(d, mesh)
+        c = shard_rows(c, mesh)
+        t = shard_rows(t, mesh)
+        sig_tr = shard_rows(sig_tr, mesh)
+        sig_va = shard_rows(sig_va, mesh)
+
+    program, init_state = _get_program(cfg, template, mesh=mesh)
+    opt0 = init_state(flat0.size)
+    flat_j = jnp.asarray(flat0)
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import replicate
+
+        flat_j = replicate(flat_j, mesh)
+        opt0 = replicate(opt0, mesh)
+
+    carry0 = (
+        flat_j, opt0, jnp.int32(0), jnp.float32(np.inf), flat_j,
+        jnp.int32(0), jnp.zeros((), bool), jnp.float32(0.0), jnp.float32(0.0),
+    )
+    result = program(carry0, jnp.int32(cfg.num_epochs), d, c, t,
+                     sig_tr, sig_va, jnp.float32(nts),
+                     jnp.float32(cfg.learning_rate))
+    (flat_f, _, it_f, best_val, best_flat, _, _, tr_e, va_e) = result
+    import math as _math
+
+    use_best = cfg.valid_set_rate > 0 and _math.isfinite(float(best_val))
+    chosen = np.asarray(best_flat if use_best else flat_f)
+    params = unflatten_wdl(chosen, template)
+    params = WDLParams(
+        embed=[np.asarray(a) for a in params.embed],
+        wide=[np.asarray(a) for a in params.wide],
+        wide_dense=np.asarray(params.wide_dense),
+        dense_layers=[{k: np.asarray(v) for k, v in l.items()}
+                      for l in params.dense_layers],
+        bias=np.asarray(params.bias),
+    )
+    final_valid = float(best_val) if use_best else float(va_e)
+    log.info("wdl train done: %d iterations, train_err %.6f valid_err %.6f",
+             int(it_f), float(tr_e), final_valid)
+    return WDLTrainResult(
+        params=params, train_error=float(tr_e), valid_error=final_valid,
+        iterations=int(it_f),
+    )

@@ -1,0 +1,150 @@
+"""WDL tests: forward math, training convergence, TP-sharded embeddings on
+the virtual mesh, spec roundtrip, and end-to-end processor + eval."""
+
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.models.wdl import (
+    WDLModelSpec,
+    flatten_wdl,
+    init_wdl_params,
+    unflatten_wdl,
+    wdl_forward,
+)
+from shifu_tpu.train.wdl_trainer import WDLTrainConfig, train_wdl
+
+
+def _make_data(n=1500, dn=4, seed=0):
+    """Signal in dense col 0 and categorical field 0 (vocab 5)."""
+    rng = np.random.default_rng(seed)
+    dense = rng.normal(size=(n, dn)).astype(np.float32)
+    codes = np.stack([
+        rng.integers(0, 5, n), rng.integers(0, 3, n)
+    ], axis=1).astype(np.int32)
+    logits = dense[:, 0] * 1.5 + (codes[:, 0] >= 3) * 2.0 - 1.5
+    t = (logits + rng.normal(scale=0.4, size=n) > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    return dense, codes, t, w, [5, 3]
+
+
+class TestForward:
+    def test_flatten_roundtrip(self):
+        p = init_wdl_params(3, [5, 4], 2, [8], seed=1)
+        flat = flatten_wdl(p)
+        p2 = unflatten_wdl(flat, p)
+        np.testing.assert_allclose(p.embed[0], p2.embed[0])
+        np.testing.assert_allclose(p.dense_layers[0]["W"], p2.dense_layers[0]["W"])
+        np.testing.assert_allclose(p.bias, p2.bias)
+
+    def test_forward_shape_and_range(self):
+        import jax.numpy as jnp
+
+        p = init_wdl_params(3, [5, 4], 2, [8], seed=1)
+        dense = jnp.zeros((7, 3))
+        codes = jnp.zeros((7, 2), jnp.int32)
+        out = wdl_forward(p, dense, codes, ["relu"])
+        assert out.shape == (7,)
+        assert ((out >= 0) & (out <= 1)).all()
+
+    def test_wide_tower_contributes(self):
+        import jax.numpy as jnp
+
+        p = init_wdl_params(1, [3], 2, [4], seed=1)
+        p.wide[0] = np.asarray([0.0, 5.0, -5.0], np.float32)
+        dense = jnp.zeros((3, 1))
+        codes = jnp.asarray([[0], [1], [2]], jnp.int32)
+        out = np.asarray(wdl_forward(p, dense, codes, ["relu"]))
+        assert out[1] > out[0] > out[2]
+
+
+class TestTrain:
+    def test_learns_both_towers(self):
+        dense, codes, t, w, vocab = _make_data()
+        cfg = WDLTrainConfig(hidden=[16], activations=["relu"], embed_dim=4,
+                             learning_rate=0.02, num_epochs=150,
+                             valid_set_rate=0.2, seed=1)
+        res = train_wdl(dense, codes, t, w, vocab, cfg)
+        assert res.valid_error < 0.12
+
+    def test_mesh_matches_single(self):
+        from shifu_tpu.parallel.mesh import data_mesh
+
+        dense, codes, t, w, vocab = _make_data(n=260)
+        cfg = WDLTrainConfig(hidden=[8], embed_dim=2, optimizer="ADAM",
+                             learning_rate=0.05, num_epochs=15,
+                             valid_set_rate=0.25, seed=3)
+        r1 = train_wdl(dense, codes, t, w, vocab, cfg)
+        r2 = train_wdl(dense, codes, t, w, vocab, cfg, mesh=data_mesh())
+        np.testing.assert_allclose(
+            flatten_wdl(r1.params), flatten_wdl(r2.params), rtol=3e-3, atol=3e-4
+        )
+
+    def test_early_stop(self):
+        dense, codes, t, w, vocab = _make_data(n=300)
+        cfg = WDLTrainConfig(hidden=[8], embed_dim=2, learning_rate=0.1,
+                             num_epochs=400, valid_set_rate=0.3,
+                             early_stop_window=8, seed=5)
+        res = train_wdl(dense, codes, t, w, vocab, cfg)
+        assert res.iterations < 400
+
+
+class TestSpec:
+    def test_roundtrip_and_score(self, tmp_path):
+        dense, codes, t, w, vocab = _make_data(n=400)
+        cfg = WDLTrainConfig(hidden=[8], embed_dim=2, num_epochs=30, seed=7)
+        res = train_wdl(dense, codes, t, w, vocab, cfg)
+        spec = WDLModelSpec(
+            hidden=[8], activations=["relu", "relu"], embed_dim=2,
+            dense_columns=[f"n{i}" for i in range(4)],
+            cat_columns=["c0", "c1"], vocab_sizes=vocab,
+            categories=[["a", "b", "c", "d"], ["x", "y"]],
+            params=res.params,
+        )
+        path = str(tmp_path / "model0.wdl")
+        spec.save(path)
+        loaded = WDLModelSpec.load(path)
+        s1 = spec.independent().compute_parts(dense[:20], codes[:20])
+        s2 = loaded.independent().compute_parts(dense[:20], codes[:20])
+        np.testing.assert_allclose(s1, s2, atol=1e-6)
+
+
+class TestProcessor:
+    def test_end_to_end_wdl(self, tmp_path):
+        from tests.helpers import make_model_set
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=500, algorithm="WDL")
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.processor.evaluate import EvalProcessor
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+        from shifu_tpu.processor.train import TrainProcessor
+
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        mc.train.num_train_epochs = 60
+        mc.train.params["NumHiddenNodes"] = [16]
+        mc.train.params["ActivationFunc"] = ["relu"]
+        mc.train.params["LearningRate"] = 0.02
+        mc.evals[0].data_set.data_path = mc.data_set.data_path
+        mc.evals[0].data_set.header_path = mc.data_set.header_path
+        mc.save(os.path.join(root, "ModelConfig.json"))
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root).run() == 0
+        assert NormProcessor(root).run() == 0
+        assert TrainProcessor(root).run() == 0
+        model_path = os.path.join(root, "models", "model0.wdl")
+        assert os.path.isfile(model_path)
+        spec = WDLModelSpec.load(model_path)
+        assert spec.cat_columns == ["cat_0", "cat_1"]
+        assert len(spec.dense_columns) == 10
+
+        assert EvalProcessor(root, run_name="").run() == 0
+        import json
+
+        with open(os.path.join(root, "evals", "Eval1",
+                               "EvalPerformance.json")) as fh:
+            perf = json.load(fh)
+        assert perf["areaUnderRoc"] > 0.9
