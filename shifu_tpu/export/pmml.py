@@ -1,0 +1,144 @@
+"""PMML 4.2 export for NN/LR models.
+
+Parity: core/pmml/PMMLTranslator.java:47 + builder/impl/* (DataDictionary,
+MiningSchema, NeuralNetwork, Zscore/Woe LocalTransformations creators).
+The generated document embeds the normalization as LocalTransformations:
+  value kind  -> z-score as a DerivedField with NormContinuous (two
+                 LinearNorm anchor points encode (x-mean)/std with outlier
+                 clamp semantics)
+  table kind  -> MapValues over an InlineTable (bin -> woe/posrate value)
+so any PMML consumer (jpmml etc.) reproduces shifu-tpu scores from RAW data.
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from typing import List
+
+import numpy as np
+
+from shifu_tpu.models.nn import NNModelSpec
+
+PMML_NS = "http://www.dmg.org/PMML-4_2"
+
+
+def _el(parent, tag, **attrs):
+    e = ET.SubElement(parent, tag)
+    for k, v in attrs.items():
+        e.set(k, str(v))
+    return e
+
+
+def _derived_name(col: str) -> str:
+    return f"norm_{col}"
+
+
+def _add_local_transformations(parent, spec: NNModelSpec):
+    lt = _el(parent, "LocalTransformations")
+    for cd in spec.norm_specs:
+        name = cd["name"]
+        df = _el(lt, "DerivedField", name=_derived_name(name),
+                 dataType="double", optype="continuous")
+        if cd["kind"] == "value":
+            mean, std = cd.get("mean", 0.0), cd.get("std", 1.0)
+            std = std if abs(std) > 1e-5 else 1.0
+            cutoff = spec.norm_cutoff
+            nc = _el(df, "NormContinuous", field=name, outliers="asExtremeValues",
+                     mapMissingTo=f"{0.0 if cd.get('zscore', True) else cd.get('fill', 0.0)}")
+            # two anchors encode the affine map: x=mean -> 0, x=mean+std -> 1,
+            # extreme values clamp at ±cutoff
+            lo, hi = mean - cutoff * std, mean + cutoff * std
+            _el(nc, "LinearNorm", orig=lo, norm=-cutoff)
+            _el(nc, "LinearNorm", orig=hi, norm=cutoff)
+        else:  # table
+            table = cd.get("table") or []
+            mv = _el(df, "MapValues", outputColumn="out",
+                     dataType="double",
+                     mapMissingTo=f"{table[-1] if table else 0.0}",
+                     defaultValue=f"{table[-1] if table else 0.0}")
+            _el(mv, "FieldColumnPair", field=name, column="in")
+            inline = _el(mv, "InlineTable")
+            cats = cd.get("categories")
+            if cats:
+                for cat, val in zip(cats, table):
+                    row = _el(inline, "row")
+                    ET.SubElement(row, "in").text = str(cat)
+                    ET.SubElement(row, "out").text = f"{val}"
+            else:
+                # numeric binned table: discretize first via intervals
+                bounds = cd.get("boundaries") or []
+                df.remove(mv)
+                disc = _el(df, "Discretize", field=name,
+                           mapMissingTo=f"{table[-1] if table else 0.0}",
+                           defaultValue=f"{table[-1] if table else 0.0}")
+                for i in range(len(bounds)):
+                    left = bounds[i]
+                    right = bounds[i + 1] if i + 1 < len(bounds) else None
+                    bin_el = _el(disc, "DiscretizeBin",
+                                 binValue=f"{table[i] if i < len(table) else 0.0}")
+                    iv = _el(bin_el, "Interval", closure="closedOpen")
+                    if np.isfinite(left):
+                        iv.set("leftMargin", str(left))
+                    if right is not None and np.isfinite(right):
+                        iv.set("rightMargin", str(right))
+    return lt
+
+
+def nn_to_pmml(spec: NNModelSpec, model_name: str = "shifu_tpu_model") -> str:
+    root = ET.Element("PMML", version="4.2", xmlns=PMML_NS)
+    header = _el(root, "Header", description="shifu-tpu exported model")
+    _el(header, "Application", name="shifu-tpu", version="0.1")
+
+    dd = _el(root, "DataDictionary")
+    for cd in spec.norm_specs:
+        optype = "categorical" if cd.get("categories") else "continuous"
+        dtype = "string" if cd.get("categories") else "double"
+        _el(dd, "DataField", name=cd["name"], optype=optype, dataType=dtype)
+    _el(dd, "DataField", name="TARGET", optype="categorical", dataType="string")
+    dd.set("numberOfFields", str(len(spec.norm_specs) + 1))
+
+    act = (spec.activations[0] if spec.activations else "tanh").lower()
+    pmml_act = {"tanh": "tanh", "sigmoid": "logistic", "relu": "rectifier",
+                "linear": "identity"}.get(act, "tanh")
+    nn = _el(root, "NeuralNetwork", modelName=model_name,
+             functionName="regression", activationFunction=pmml_act)
+
+    ms = _el(nn, "MiningSchema")
+    for cd in spec.norm_specs:
+        _el(ms, "MiningField", name=cd["name"], usageType="active")
+    _el(ms, "MiningField", name="TARGET", usageType="target")
+
+    out = _el(nn, "Output")
+    of = _el(out, "OutputField", name="shifu_score", feature="predictedValue")
+
+    _add_local_transformations(nn, spec)
+
+    inputs = _el(nn, "NeuralInputs",
+                 numberOfInputs=str(len(spec.norm_specs)))
+    for i, cd in enumerate(spec.norm_specs):
+        ni = _el(inputs, "NeuralInput", id=f"0,{i}")
+        df = _el(ni, "DerivedField", dataType="double", optype="continuous")
+        _el(df, "FieldRef", field=_derived_name(cd["name"]))
+
+    params = spec.params
+    prev_ids = [f"0,{i}" for i in range(len(spec.norm_specs))]
+    for li, layer in enumerate(params):
+        W, b = np.asarray(layer["W"]), np.asarray(layer["b"])
+        is_output = li == len(params) - 1
+        lay = _el(nn, "NeuralLayer",
+                  activationFunction="logistic" if is_output else pmml_act)
+        ids = []
+        for j in range(W.shape[1]):
+            neuron = _el(lay, "Neuron", id=f"{li + 1},{j}", bias=f"{b[j]}")
+            for i, pid in enumerate(prev_ids):
+                _el(neuron, "Con", **{"from": pid, "weight": f"{W[i, j]}"})
+            ids.append(f"{li + 1},{j}")
+        prev_ids = ids
+
+    outputs = _el(nn, "NeuralOutputs", numberOfOutputs="1")
+    no = _el(outputs, "NeuralOutput", outputNeuron=prev_ids[0])
+    df = _el(no, "DerivedField", dataType="double", optype="continuous")
+    _el(df, "FieldRef", field="TARGET")
+
+    ET.indent(root)
+    return ET.tostring(root, encoding="unicode", xml_declaration=True)

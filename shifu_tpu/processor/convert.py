@@ -1,0 +1,169 @@
+"""`shifu convert` — model spec format conversion.
+
+Parity: util/IndependentTreeModelUtils.java:138 (`shifu convert` zip<->binary
+spec). Our binary specs convert to/from a readable JSON form:
+    -tozip  binary (.nn/.lr/.gbt/.rf/.wdl) -> .json (inspectable/portable)
+    -tobin  .json -> binary spec
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from shifu_tpu.processor.basic import BasicProcessor
+from shifu_tpu.utils.errors import ErrorCode, ShifuError
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+class ConvertProcessor(BasicProcessor):
+    step = "convert"
+
+    def __init__(self, root: str = ".", to_json: bool = True,
+                 input_path: str = None, output_path: str = None):
+        super().__init__(root)
+        self.to_json = to_json
+        self.input_path = input_path
+        self.output_path = output_path
+
+    @classmethod
+    def from_args(cls, args) -> "ConvertProcessor":
+        return cls(to_json=not args.tobin, input_path=args.input,
+                   output_path=args.output)
+
+    def run_step(self) -> None:
+        if not self.input_path:
+            raise ShifuError(ErrorCode.INVALID_MODEL_CONFIG,
+                             "convert needs an input model path")
+        if self.to_json:
+            self._to_json()
+        else:
+            self._to_binary()
+
+    def _to_json(self) -> None:
+        from shifu_tpu.eval.scorer import load_model
+        from shifu_tpu.models.nn import NNModelSpec, flatten_params
+        from shifu_tpu.models.tree import TreeModelSpec
+        from shifu_tpu.models.wdl import WDLModelSpec, flatten_wdl
+
+        spec = load_model(self.input_path)
+        out = self.output_path or self.input_path + ".json"
+        if isinstance(spec, NNModelSpec):
+            head = spec.header()
+            flat, shapes = flatten_params(spec.params)
+            head["layerShapes"] = [list(s) for s in shapes]
+            head["weights"] = [float(x) for x in flat]
+        elif isinstance(spec, TreeModelSpec):
+            head = {
+                "algorithm": spec.algorithm,
+                "inputColumns": spec.input_columns,
+                "slots": spec.slots,
+                "boundaries": spec.boundaries,
+                "categories": spec.categories,
+                "loss": spec.loss,
+                "learningRate": spec.learning_rate,
+                "convertToProb": spec.convert_to_prob,
+                "trees": [
+                    {
+                        "weight": t.weight,
+                        "feature": t.feature.tolist(),
+                        "leftMask": t.left_mask.astype(int).tolist(),
+                        "leafValue": [float(v) for v in t.leaf_value],
+                    }
+                    for t in spec.trees
+                ],
+            }
+        elif isinstance(spec, WDLModelSpec):
+            head = {
+                "algorithm": "WDL", "hidden": spec.hidden,
+                "activations": spec.activations, "embedDim": spec.embed_dim,
+                "denseColumns": spec.dense_columns,
+                "catColumns": spec.cat_columns,
+                "vocabSizes": spec.vocab_sizes,
+                "normSpecs": spec.norm_specs,
+                "categories": spec.categories,
+                "weights": [float(x) for x in flatten_wdl(spec.params)],
+            }
+        else:  # pragma: no cover
+            raise ShifuError(ErrorCode.MODEL_NOT_FOUND, str(self.input_path))
+        head["sourceFormat"] = os.path.splitext(self.input_path)[1]
+        with open(out, "w") as fh:
+            json.dump(head, fh)
+        log.info("converted %s -> %s", self.input_path, out)
+
+    def _to_binary(self) -> None:
+        with open(self.input_path) as fh:
+            head = json.load(fh)
+        alg = head.get("algorithm", "NN")
+        out = self.output_path
+        if alg in ("GBT", "RF"):
+            from shifu_tpu.models.tree import DenseTree, TreeModelSpec
+
+            trees = [
+                DenseTree(
+                    feature=np.asarray(t["feature"], np.int32),
+                    left_mask=np.asarray(t["leftMask"], bool),
+                    leaf_value=np.asarray(t["leafValue"], np.float32),
+                    weight=float(t["weight"]),
+                )
+                for t in head["trees"]
+            ]
+            spec = TreeModelSpec(
+                algorithm=alg, trees=trees,
+                input_columns=head.get("inputColumns", []),
+                slots=head.get("slots", []),
+                boundaries=head.get("boundaries", []),
+                categories=head.get("categories", []),
+                loss=head.get("loss", "squared"),
+                learning_rate=float(head.get("learningRate", 0.05)),
+                convert_to_prob=head.get("convertToProb", "SIGMOID"),
+            )
+            out = out or f"model_converted.{alg.lower()}"
+        elif alg == "WDL":
+            from shifu_tpu.models.wdl import (
+                WDLModelSpec,
+                init_wdl_params,
+                unflatten_wdl,
+            )
+
+            spec = WDLModelSpec(
+                hidden=head["hidden"], activations=head["activations"],
+                embed_dim=head["embedDim"],
+                dense_columns=head["denseColumns"],
+                cat_columns=head["catColumns"],
+                vocab_sizes=head["vocabSizes"],
+                norm_specs=head.get("normSpecs", []),
+                categories=head.get("categories", []),
+            )
+            template = init_wdl_params(
+                len(spec.dense_columns), spec.vocab_sizes, spec.embed_dim,
+                spec.hidden,
+            )
+            spec.params = unflatten_wdl(
+                np.asarray(head["weights"], np.float32), template
+            )
+            out = out or "model_converted.wdl"
+        else:
+            from shifu_tpu.models.nn import NNModelSpec, unflatten_params
+
+            spec = NNModelSpec(
+                layer_sizes=head["layerSizes"],
+                activations=head["activations"],
+                out_activation=head.get("outActivation", "sigmoid"),
+                input_columns=head.get("inputColumns", []),
+                norm_type=head.get("normType", "ZSCALE"),
+                algorithm=head.get("algorithm", "NN"),
+                loss=head.get("loss", "squared"),
+                norm_specs=head.get("normSpecs", []),
+            )
+            spec.params = unflatten_params(
+                np.asarray(head["weights"], np.float32),
+                [tuple(s) for s in head["layerShapes"]],
+            )
+            out = out or f"model_converted{head.get('sourceFormat', '.nn')}"
+        spec.save(out)
+        log.info("converted %s -> %s", self.input_path, out)

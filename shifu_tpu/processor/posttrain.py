@@ -1,0 +1,100 @@
+"""`shifu posttrain` — bin-average scores + feature importance.
+
+Parity: core/processor/PostTrainModelProcessor.java — per selected column,
+the average model score of the records falling in each bin (binAvgScore
+written back into ColumnConfig, :187-192), plus a feature-importance report
+(FeatureImportanceMapper/Reducer). FI here: tree models use split-based
+importance; NN/LR use SE knockout sensitivity.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from shifu_tpu.norm.dataset import load_codes, load_normalized
+from shifu_tpu.processor.basic import BasicProcessor
+from shifu_tpu.utils.errors import ErrorCode, ShifuError
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+class PostTrainProcessor(BasicProcessor):
+    step = "posttrain"
+
+    def run_step(self) -> None:
+        self.setup()
+        from shifu_tpu.eval.scorer import ModelRunner, find_model_paths
+
+        model_paths = find_model_paths(self.paths.models_dir())
+        if not model_paths:
+            raise ShifuError(ErrorCode.MODEL_NOT_FOUND,
+                             "run `shifu train` before posttrain")
+        codes_dir = self.paths.cleaned_data_dir()
+        norm_dir = self.paths.normalized_data_dir()
+        if not (os.path.isdir(codes_dir) and os.path.isdir(norm_dir)):
+            raise ShifuError(ErrorCode.DATA_NOT_FOUND,
+                             "run `shifu norm` before posttrain")
+
+        cmeta, codes, tags, weights = load_codes(codes_dir)
+        _, feats, _, _ = load_normalized(norm_dir)
+        codes = np.asarray(codes)
+        runner = ModelRunner(model_paths)
+        from shifu_tpu.models.tree import TreeModelSpec
+
+        if all(isinstance(s, TreeModelSpec) for s in runner.specs):
+            scores = np.stack(
+                [m.compute(codes) * runner.scale for m in runner.models], axis=1
+            ).mean(axis=1)
+        else:
+            scores = runner.score_normalized(np.asarray(feats, np.float32)).mean
+
+        # ---- bin average score per column (PostTrainMapper/Reducer) ----
+        by_name = {c.column_name: c for c in self.column_configs}
+        slots = cmeta.extra["slots"]
+        for j, name in enumerate(cmeta.columns):
+            cc = by_name.get(name)
+            if cc is None:
+                continue
+            s = int(slots[j])
+            sums = np.zeros(s)
+            cnts = np.zeros(s)
+            np.add.at(sums, codes[:, j], scores)
+            np.add.at(cnts, codes[:, j], 1.0)
+            avg = np.where(cnts > 0, sums / np.maximum(cnts, 1), 0.0)
+            cc.column_binning.bin_avg_score = [float(round(v, 2)) for v in avg]
+        self.save_column_configs()
+
+        # ---- feature importance report ----
+        fi = self._feature_importance(runner, feats, tags)
+        self.paths.ensure(self.paths.tmp_dir("posttrain"))
+        with open(self.paths.feature_importance_path(), "w") as fh:
+            fh.write("column,importance\n")
+            for name, v in sorted(fi.items(), key=lambda kv: -kv[1]):
+                fh.write(f"{name},{v:.8g}\n")
+        log.info("posttrain done: binAvgScore for %d columns, FI -> %s",
+                 len(cmeta.columns), self.paths.feature_importance_path())
+
+    def _feature_importance(self, runner, feats, tags) -> dict:
+        from shifu_tpu.models.nn import NNModelSpec
+        from shifu_tpu.models.tree import TreeModelSpec
+
+        spec = runner.specs[0]
+        if isinstance(spec, TreeModelSpec):
+            from shifu_tpu.varsel.importance import tree_feature_importance
+
+            return tree_feature_importance(spec)
+        if isinstance(spec, NNModelSpec):
+            from shifu_tpu.varsel.selector import sensitivity_scores
+
+            scores = sensitivity_scores(
+                spec.params, spec.activations, np.asarray(feats, np.float32),
+                np.asarray(tags, np.float32), "SE",
+            )
+            cols = spec.input_columns or [
+                f"col_{i}" for i in range(len(scores))
+            ]
+            return {n: float(s) for n, s in zip(cols, scores)}
+        return {}

@@ -49,6 +49,21 @@ class StatsProcessor(BasicProcessor):
         self.setup()
         mc = self.model_config
         assert mc is not None
+
+        if self.rebin:
+            # -rebin re-derives bins from the EXISTING stats (DIB path,
+            # StatsModelProcessor DynamicBinning) — no data re-read
+            from shifu_tpu.stats.rebin import rebin_columns
+            from shifu_tpu.utils import environment
+
+            target = environment.get_int("shifu.rebin.maxNumBin",
+                                         mc.stats.max_num_bin)
+            n = rebin_columns(self.column_configs, target)
+            self.save_column_configs()
+            log.info("rebin done: %d columns re-binned to <= %d bins.",
+                     n, target)
+            return
+
         data = self._load_data()
 
         from shifu_tpu.stats.engine import compute_stats

@@ -1,0 +1,201 @@
+"""Aux processor tests: posttrain, export (PMML/columnstats/woemapping),
+encode, manage (save/switch/show), test, convert, analysis, combo."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_model_set
+
+
+@pytest.fixture(scope="module")
+def trained_root(tmp_path_factory):
+    """One fully-trained NN model set shared across this module's tests."""
+    root = str(tmp_path_factory.mktemp("ms") / "set")
+    make_model_set(root, n_rows=400)
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 25
+    mc.evals[0].data_set.data_path = mc.data_set.data_path
+    mc.evals[0].data_set.header_path = mc.data_set.header_path
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root, correlation=True).run() == 0
+    assert NormProcessor(root).run() == 0
+    assert TrainProcessor(root).run() == 0
+    return root
+
+
+class TestPostTrain:
+    def test_bin_avg_score_and_fi(self, trained_root):
+        from shifu_tpu.config import load_column_config_list
+        from shifu_tpu.processor.posttrain import PostTrainProcessor
+
+        assert PostTrainProcessor(trained_root).run() == 0
+        cols = load_column_config_list(
+            os.path.join(trained_root, "ColumnConfig.json"))
+        with_avg = [c for c in cols if c.column_binning.bin_avg_score]
+        assert len(with_avg) >= 10
+        fi_path = os.path.join(trained_root, "tmp", "posttrain",
+                               "feature_importance.csv")
+        assert os.path.isfile(fi_path)
+        lines = open(fi_path).read().strip().splitlines()
+        assert len(lines) > 10  # header + columns
+
+
+class TestExport:
+    def test_pmml(self, trained_root):
+        from shifu_tpu.processor.export import ExportProcessor
+
+        assert ExportProcessor(trained_root, kind="pmml").run() == 0
+        pmml_path = os.path.join(trained_root, "export", "model0.pmml")
+        assert os.path.isfile(pmml_path)
+        content = open(pmml_path).read()
+        assert "NeuralNetwork" in content
+        assert "NormContinuous" in content  # z-scale transform embedded
+        assert "MapValues" in content or "Discretize" in content
+        import xml.etree.ElementTree as ET
+
+        ET.fromstring(content)  # well-formed
+
+    def test_columnstats_and_woemapping(self, trained_root):
+        from shifu_tpu.processor.export import ExportProcessor
+
+        assert ExportProcessor(trained_root, kind="columnstats").run() == 0
+        assert ExportProcessor(trained_root, kind="woemapping").run() == 0
+        assert ExportProcessor(trained_root, kind="correlation").run() == 0
+        stats = open(os.path.join(trained_root, "export", "columnstats.csv")).read()
+        assert "columnName" in stats and "ks" in stats
+        woe = json.load(open(os.path.join(trained_root, "export",
+                                          "woemapping.json")))
+        assert len(woe) >= 10
+        any_col = next(iter(woe.values()))
+        assert "woe" in any_col
+
+
+class TestEncodeManageTest:
+    def test_encode_woe(self, trained_root):
+        from shifu_tpu.processor.encode import EncodeProcessor
+
+        assert EncodeProcessor(trained_root).run() == 0
+        out = os.path.join(trained_root, "tmp", "encode", "EncodedData")
+        lines = open(out).read().strip().splitlines()
+        assert lines[0].startswith("tag|")
+        assert len(lines) > 300
+
+    def test_manage_save_switch_show(self, trained_root):
+        from shifu_tpu.processor.manage import ManageProcessor
+
+        assert ManageProcessor("save", "v1", root=trained_root).run() == 0
+        assert os.path.isdir(os.path.join(trained_root, ".shifu", "backup",
+                                          "v1", "models"))
+        # mutate then switch back
+        model = os.path.join(trained_root, "models", "model0.nn")
+        orig = open(model, "rb").read()
+        open(model, "wb").write(b"garbage")
+        assert ManageProcessor("switch", "v1", root=trained_root).run() == 0
+        assert open(model, "rb").read() == orig
+        assert ManageProcessor("show", root=trained_root).run() == 0
+
+    def test_testdata(self, trained_root):
+        from shifu_tpu.processor.testdata import TestDataProcessor
+
+        assert TestDataProcessor(trained_root, n=50).run() == 0
+
+
+class TestConvert:
+    def test_nn_roundtrip(self, trained_root, tmp_path):
+        from shifu_tpu.models.nn import NNModelSpec
+        from shifu_tpu.processor.convert import ConvertProcessor
+
+        src = os.path.join(trained_root, "models", "model0.nn")
+        js = str(tmp_path / "m.json")
+        back = str(tmp_path / "m2.nn")
+        assert ConvertProcessor(trained_root, to_json=True, input_path=src,
+                                output_path=js).run() == 0
+        assert ConvertProcessor(trained_root, to_json=False, input_path=js,
+                                output_path=back).run() == 0
+        a, b = NNModelSpec.load(src), NNModelSpec.load(back)
+        from shifu_tpu.models.nn import flatten_params
+
+        fa, _ = flatten_params(a.params)
+        fb, _ = flatten_params(b.params)
+        np.testing.assert_allclose(fa, fb, atol=1e-6)
+
+    def test_tree_roundtrip(self, tmp_path):
+        from shifu_tpu.models.tree import TreeModelSpec
+        from shifu_tpu.processor.convert import ConvertProcessor
+        from shifu_tpu.train.tree_trainer import TreeTrainConfig, train_trees
+
+        rng = np.random.default_rng(0)
+        codes = rng.integers(0, 6, size=(300, 4)).astype(np.int32)
+        y = (codes[:, 0] >= 3).astype(np.float32)
+        res = train_trees(codes, y, np.ones(300, np.float32), [6] * 4,
+                          [False] * 4, [f"c{i}" for i in range(4)],
+                          TreeTrainConfig(tree_num=3, max_depth=3, seed=1))
+        src = str(tmp_path / "model0.gbt")
+        res.spec.save(src)
+        js = str(tmp_path / "t.json")
+        back = str(tmp_path / "t2.gbt")
+        assert ConvertProcessor(".", to_json=True, input_path=src,
+                                output_path=js).run() == 0
+        assert ConvertProcessor(".", to_json=False, input_path=js,
+                                output_path=back).run() == 0
+        s1 = TreeModelSpec.load(src).independent().compute(codes[:20])
+        s2 = TreeModelSpec.load(back).independent().compute(codes[:20])
+        np.testing.assert_allclose(s1, s2, atol=1e-6)
+
+
+class TestAnalysis:
+    def test_report(self, trained_root, capsys):
+        from shifu_tpu.processor.analysis import AnalysisProcessor
+
+        assert AnalysisProcessor(trained_root).run() == 0
+        out = capsys.readouterr().out
+        assert "Top variables by KS" in out
+        assert "model0.nn" in out
+        assert os.path.isfile(os.path.join(trained_root, "tmp", "analysis",
+                                           "report.txt"))
+
+
+class TestCombo:
+    def test_combo_workflow(self, tmp_path):
+        root = str(tmp_path / "combo")
+        make_model_set(root, n_rows=300)
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.processor.combo import ComboProcessor
+
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        mc.train.num_train_epochs = 15
+        mc.save(os.path.join(root, "ModelConfig.json"))
+
+        assert ComboProcessor(root, new_algs="NN,GBT,LR").run() == 0
+        assert os.path.isfile(os.path.join(root, "ComboTrain.json"))
+        assert ComboProcessor(root, do_init=True).run() == 0
+        assert os.path.isdir(os.path.join(root, "sub_0_NN"))
+        assert os.path.isdir(os.path.join(root, "sub_1_GBT"))
+
+        # shrink sub-model workloads
+        for d in ("sub_0_NN", "sub_1_GBT"):
+            p = os.path.join(root, d, "ModelConfig.json")
+            smc = ModelConfig.load(p)
+            smc.train.num_train_epochs = 15
+            if "GBT" in d:
+                smc.train.params["TreeNum"] = 5
+                smc.train.params["MaxDepth"] = 3
+            smc.save(p)
+
+        assert ComboProcessor(root, do_run=True).run() == 0
+        assert os.path.isfile(os.path.join(root, "assembler_LR", "models",
+                                           "model0.lr"))
+        assert ComboProcessor(root, do_eval=True).run() == 0
+        perf = json.load(open(os.path.join(root, "evals", "Combo",
+                                           "EvalPerformance.json")))
+        assert perf["areaUnderRoc"] > 0.85

@@ -175,3 +175,35 @@ def test_sharded_binagg_matches_single_device():
     np.testing.assert_allclose(np.asarray(single.vsum), np.asarray(sharded.vsum), rtol=1e-4)
     np.testing.assert_allclose(np.asarray(single.vmin), np.asarray(sharded.vmin), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(single.vmissing), np.asarray(sharded.vmissing), rtol=1e-5)
+
+
+class TestRebin:
+    def test_rebin_reduces_bins_preserving_iv(self, tmp_path):
+        from tests.helpers import make_model_set
+        from shifu_tpu.config import load_column_config_list
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+        from shifu_tpu.utils import environment
+        import os
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=500)
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root).run() == 0
+        before = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+        iv_before = {c.column_name: c.column_stats.iv for c in before
+                     if c.column_stats.iv}
+
+        environment.set_property("shifu.rebin.maxNumBin", "4")
+        try:
+            assert StatsProcessor(root, rebin=True).run() == 0
+        finally:
+            environment.set_property("shifu.rebin.maxNumBin", "")
+        after = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+        rebinned = [c for c in after
+                    if not c.is_categorical() and c.column_binning.bin_boundary]
+        assert any(len(c.column_binning.bin_boundary) <= 4 for c in rebinned)
+        for c in after:
+            iv0 = iv_before.get(c.column_name)
+            if iv0 and c.column_stats.iv:
+                assert c.column_stats.iv >= iv0 * 0.5  # IV largely preserved
