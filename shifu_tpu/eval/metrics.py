@@ -223,10 +223,13 @@ def confusion_matrix_rows(
 ) -> List[Dict]:
     """Per-threshold confusion rows for EvalConfusionMatrix.csv; `step`
     subsamples to at most ~1000 rows for wide datasets."""
-    n = cs.total
+    # Only block-end indices are valid thresholds — a row inside a
+    # tied-score block would depend on input order among ties and disagree
+    # with the tie-aware sweep used for curves/AUC.
+    ends = np.nonzero(cs.block_end)[0]
     if step <= 0:
-        step = max(1, n // 1000)
+        step = max(1, len(ends) // 1000)
     rows = []
-    for i in range(0, n, step):
-        rows.append(_perf_object(cs, i, i // step))
+    for k, i in enumerate(ends[::step]):
+        rows.append(_perf_object(cs, int(i), k))
     return rows

@@ -182,7 +182,25 @@ class VarSelProcessor(BasicProcessor):
             [{k: np.asarray(v) for k, v in layer.items()} for layer in res.params],
             cfg.activations, feats, tags, se_type,
         )
-        out = {name: float(s) for name, s in zip(meta.columns, scores)}
+        # meta.columns are norm-plan OUTPUT names; under ONEHOT-style norms a
+        # source column expands to several outputs (col_0, col_1, ...) that
+        # never match ColumnConfig names. Map outputs back to their source
+        # column (mapping persisted at norm time — reconstructing the plan
+        # here would diverge if configs changed since norm) and keep the max
+        # knockout score per source.
+        src_of = (meta.extra or {}).get("sourceOf")
+        if not src_of:
+            from shifu_tpu.norm.normalizer import build_norm_plan
+
+            plan = build_norm_plan(self.model_config, self.column_configs)
+            src_of = {}
+            for spec in plan.specs:
+                for on in spec.out_names:
+                    src_of[on] = spec.cc.column_name
+        out: dict = {}
+        for name, s in zip(meta.columns, scores):
+            src = src_of.get(name, name)
+            out[src] = max(out.get(src, float("-inf")), float(s))
         with open(os.path.join(self.paths.varsel_dir(), "se.csv"), "w") as fh:
             fh.write("column,score\n")
             for name, s in sorted(out.items(), key=lambda kv: -kv[1]):

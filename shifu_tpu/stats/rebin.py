@@ -12,6 +12,8 @@ from __future__ import annotations
 import math
 from typing import List
 
+import numpy as np
+
 from shifu_tpu.config import ColumnConfig
 from shifu_tpu.utils.log import get_logger
 
@@ -72,21 +74,42 @@ def rebin_column(cc: ColumnConfig, target_bins: int, iv_keep_ratio: float = 0.95
         return False
     miss_pos = float(bn.bin_count_pos[-1])
     miss_neg = float(bn.bin_count_neg[-1])
+    miss_wpos = float((bn.bin_weighted_pos or [miss_pos])[-1])
+    miss_wneg = float((bn.bin_weighted_neg or [miss_neg])[-1])
     bn.bin_boundary = bounds
     bn.length = len(bounds)
     bn.bin_count_pos = [int(x) for x in pos] + [int(miss_pos)]
     bn.bin_count_neg = [int(x) for x in neg] + [int(miss_neg)]
-    bn.bin_weighted_pos = wpos + [float((bn.bin_weighted_pos or [0])[-1])]
-    bn.bin_weighted_neg = wneg + [float((bn.bin_weighted_neg or [0])[-1])]
+    bn.bin_weighted_pos = wpos + [miss_wpos]
+    bn.bin_weighted_neg = wneg + [miss_wneg]
     all_pos = pos + [miss_pos]
     all_neg = neg + [miss_neg]
-    bn.bin_count_woe = [
-        _woe(p, n, pos_total, neg_total) for p, n in zip(all_pos, all_neg)
-    ]
+    all_wpos = wpos + [miss_wpos]
+    all_wneg = wneg + [miss_wneg]
     bn.bin_pos_rate = [
         p / max(p + n, 1e-10) for p, n in zip(all_pos, all_neg)
     ]
-    cc.column_stats.iv = _iv(all_pos, all_neg, pos_total, neg_total)
+    # Recompute count AND weighted woe/iv/ks from the merged bins so
+    # downstream WEIGHT_WOE/WEIGHT_HYBRID norms read fresh tables
+    # (ColumnConfigDynamicBinning recomputes both in the reference).
+    from shifu_tpu.stats.metrics import column_metrics
+
+    mask = np.ones((1, len(all_pos)))
+    cm = column_metrics(np.asarray([all_pos]), np.asarray([all_neg]), mask)
+    wm = column_metrics(np.asarray([all_wpos]), np.asarray([all_wneg]), mask)
+    bn.bin_count_woe = [float(x) for x in cm.bin_woe[0]]
+    bn.bin_weighted_woe = [float(x) for x in wm.bin_woe[0]]
+    st = cc.column_stats
+    # same guard as the stats engine (engine.py writes metrics only for
+    # valid columns): a column with an empty class gets no ks/iv, not noise
+    if cm.valid[0]:
+        st.iv = float(cm.iv[0])
+        st.ks = float(cm.ks[0])
+        st.woe = float(cm.woe[0])
+    if wm.valid[0]:
+        st.weighted_iv = float(wm.iv[0])
+        st.weighted_ks = float(wm.ks[0])
+        st.weighted_woe = float(wm.woe[0])
     return True
 
 

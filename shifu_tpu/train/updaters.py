@@ -68,6 +68,17 @@ def make_updater(
             return jnp.sign(updated) * jnp.maximum(0.0, jnp.abs(updated) - shrink)
         return w + step
 
+    def reg_gradient(w, g, nts):
+        """Fold the penalty into the descent direction for the optimizer
+        branches, the way the reference regularizes inside each layer's
+        gradient (DenseLayer.java:193, WideDenseLayer.java:100,
+        WideFieldLayer.java:104) so L2 works under every optimizer."""
+        if reg_level == "L2" and reg != 0.0:
+            return g - reg * w / nts
+        if reg_level == "L1" and reg != 0.0:
+            return g - reg * jnp.sign(w) / nts
+        return g
+
     if prop == "B":
 
         def init(n):
@@ -165,6 +176,7 @@ def make_updater(
             return {"m": _zeros_like(n, jnp), "v": _zeros_like(n, jnp)}
 
         def apply(state, w, g, lr, it, nts):
+            g = reg_gradient(w, g, nts)
             m = adam_beta1 * state["m"] + (1 - adam_beta1) * g
             v = adam_beta2 * state["v"] + (1 - adam_beta2) * g * g
             it_f = jnp.maximum(it.astype(jnp.float32), 1.0)
@@ -181,6 +193,7 @@ def make_updater(
             return {"sum_sq": _zeros_like(n, jnp)}
 
         def apply(state, w, g, lr, it, nts):
+            g = reg_gradient(w, g, nts)
             s = state["sum_sq"] + g * g
             step = lr * g / (jnp.sqrt(s) + 1e-8)
             return w + step, {"sum_sq": s}
@@ -193,6 +206,7 @@ def make_updater(
             return {"cache": _zeros_like(n, jnp)}
 
         def apply(state, w, g, lr, it, nts):
+            g = reg_gradient(w, g, nts)
             cache = 0.9 * state["cache"] + 0.1 * g * g
             step = lr * g / (jnp.sqrt(cache) + 1e-8)
             return w + step, {"cache": cache}
@@ -205,6 +219,7 @@ def make_updater(
             return {"v": _zeros_like(n, jnp)}
 
         def apply(state, w, g, lr, it, nts):
+            g = reg_gradient(w, g, nts)
             v = momentum * state["v"] + lr * g
             return w + v, {"v": v}
 
@@ -216,6 +231,7 @@ def make_updater(
             return {"v": _zeros_like(n, jnp)}
 
         def apply(state, w, g, lr, it, nts):
+            g = reg_gradient(w, g, nts)
             v_prev = state["v"]
             v = momentum * v_prev - lr * (-g)  # g is descent dir: v = mom*v + lr*g
             w_new = w - momentum * v_prev + (1 + momentum) * v

@@ -207,3 +207,29 @@ class TestRebin:
             iv0 = iv_before.get(c.column_name)
             if iv0 and c.column_stats.iv:
                 assert c.column_stats.iv >= iv0 * 0.5  # IV largely preserved
+
+    def test_rebin_refreshes_weighted_woe(self):
+        # ADVICE r1: merged bins must get a consistent bin_weighted_woe (same
+        # length as the merged count arrays) and fresh ks/weighted stats.
+        from shifu_tpu.config.column_config import ColumnConfig, ColumnType
+        from shifu_tpu.stats.rebin import rebin_column
+
+        cc = ColumnConfig(column_num=1, column_name="x",
+                          column_type=ColumnType.N)
+        bn = cc.column_binning
+        bn.bin_boundary = [-np.inf, 0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+        bn.bin_count_pos = [5, 8, 12, 20, 30, 45, 60, 80, 3]
+        bn.bin_count_neg = [80, 60, 45, 30, 20, 12, 8, 5, 2]
+        bn.bin_weighted_pos = [2 * p for p in bn.bin_count_pos]
+        bn.bin_weighted_neg = [2 * n for n in bn.bin_count_neg]
+        bn.length = 8
+        assert rebin_column(cc, target_bins=4)
+        n_bins = len(bn.bin_boundary) + 1  # + missing slot
+        assert len(bn.bin_count_woe) == n_bins
+        assert len(bn.bin_weighted_woe) == n_bins
+        assert cc.column_stats.ks is not None and cc.column_stats.ks > 0
+        assert cc.column_stats.weighted_iv is not None
+        # weights are a uniform 2x scale, so weighted woe == count woe
+        np.testing.assert_allclose(
+            bn.bin_weighted_woe, bn.bin_count_woe, atol=1e-9
+        )

@@ -70,6 +70,21 @@ class TestUpdaters:
         )
         np.testing.assert_allclose(np.asarray(w2), [0.9, 0.9, 0.9], atol=1e-6)
 
+    def test_l2_regularization_applies_under_optimizers(self):
+        # ADVICE r1: optimizer branches silently dropped reg; with zero
+        # gradient an L2-regularized step must still shrink the weights.
+        import jax.numpy as jnp
+
+        for prop in ["ADAM", "ADAGRAD", "RMSPROP", "MOMENTUM", "NESTEROV"]:
+            init, apply = make_updater(prop, reg=10.0, reg_level="L2")
+            w = jnp.ones(3)
+            g = jnp.zeros(3)
+            w2, _ = apply(
+                init(3), w, g, jnp.float32(0.1), jnp.int32(1),
+                jnp.float32(100.0),
+            )
+            assert float(np.asarray(w2)[0]) < 1.0, prop
+
     def test_all_rules_run(self):
         for prop in ["B", "Q", "M", "R", "ADAM", "ADAGRAD", "RMSPROP",
                      "MOMENTUM", "NESTEROV"]:
