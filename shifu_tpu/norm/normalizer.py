@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -178,6 +178,16 @@ class NormPlan:
     @property
     def n_out(self) -> int:
         return sum(s.n_out for s in self.specs)
+
+    @property
+    def source_of(self) -> Dict[str, str]:
+        """output column name -> source ColumnConfig name (one-hot style
+        norms expand one source into several outputs)."""
+        out: Dict[str, str] = {}
+        for s in self.specs:
+            for on in s.out_names:
+                out[on] = s.cc.column_name
+        return out
 
 
 def _value_spec(

@@ -94,10 +94,6 @@ class NormProcessor(BasicProcessor):
         # persist the output-name -> source-column mapping so later steps
         # (SE/ST varsel under one-hot expansion) don't have to reconstruct
         # the plan against possibly-changed ColumnConfigs
-        source_of = {}
-        for spec in plan.specs:
-            for on in spec.out_names:
-                source_of[on] = spec.cc.column_name
         write_normalized(
             out_dir,
             feats,
@@ -106,7 +102,7 @@ class NormProcessor(BasicProcessor):
             plan.out_names,
             norm_type=mc.normalize.norm_type.value,
             n_shards=n_shards,
-            extra={"sourceOf": source_of},
+            extra={"sourceOf": plan.source_of},
         )
         log.info(
             "normalized %d rows x %d cols (%s) -> %s [%d shards]",

@@ -55,6 +55,14 @@ def train_tree_models(proc, alg) -> None:
     proc.paths.ensure(proc.paths.train_dir())
     bagging = max(1, int(mc.train.bagging_num or 1))
 
+    # row-shard the code matrix over every available chip (DTWorker shard
+    # equivalent); histogram merge is the jit-inserted all-reduce
+    import jax
+
+    from shifu_tpu.parallel.mesh import data_mesh
+
+    mesh = data_mesh() if len(jax.devices()) > 1 else None
+
     for i in range(bagging):
         cfg = TreeTrainConfig.from_model_config(mc, trainer_id=i)
         progress_path = proc.paths.progress_path(i)
@@ -70,6 +78,7 @@ def train_tree_models(proc, alg) -> None:
         result = train_trees(
             codes, tags, weights, slots, is_cat, meta.columns, cfg,
             boundaries=boundaries, categories=categories, progress_cb=progress,
+            mesh=mesh,
         )
         path = proc.paths.model_path(i, suffix)
         result.spec.save(path)

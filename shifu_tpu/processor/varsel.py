@@ -190,13 +190,16 @@ class VarSelProcessor(BasicProcessor):
         # knockout score per source.
         src_of = (meta.extra or {}).get("sourceOf")
         if not src_of:
+            log.warning(
+                "normalized data predates the persisted sourceOf mapping; "
+                "reconstructing from current configs — re-run `shifu norm` "
+                "if configs changed since, or scores may map to no column"
+            )
             from shifu_tpu.norm.normalizer import build_norm_plan
 
-            plan = build_norm_plan(self.model_config, self.column_configs)
-            src_of = {}
-            for spec in plan.specs:
-                for on in spec.out_names:
-                    src_of[on] = spec.cc.column_name
+            src_of = build_norm_plan(
+                self.model_config, self.column_configs
+            ).source_of
         out: dict = {}
         for name, s in zip(meta.columns, scores):
             src = src_of.get(name, name)

@@ -132,14 +132,21 @@ def _get_level_program(L: int, F: int, S: int, impurity: str,
         w = jnp.where(active, weights, 0.0)
         nl = jnp.where(active, node_local, 0)
 
-        # ---- fused histogram: one scatter-add of (w, w*y, w*y^2) ----
+        # ---- fused histogram: scatter-add of (w, w*y, w*y^2). One scatter
+        # per component keeps the peak intermediate at [n, F] instead of
+        # [n, F, 3]. Under a `data`-sharded mesh each device scatters its
+        # row shard and XLA all-reduces the replicated histogram — the psum
+        # that replaces DTMaster's NodeStats merge (DTMaster.java:297-310).
         flat = (nl[:, None] * F + jnp.arange(F)[None, :]) * S + codes
-        vals = jnp.stack(
-            [w, w * labels, w * labels * labels], axis=-1
-        )[:, None, :] * jnp.ones((1, F, 1), jnp.float32)
-        hist = jnp.zeros((L * F * S, 3), jnp.float32).at[flat].add(vals)
-        hist = hist.reshape(L, F, S, 3)
-        cnt, s1, s2 = hist[..., 0], hist[..., 1], hist[..., 2]
+        comps = (w, w * labels, w * labels * labels)
+        planes = [
+            jnp.zeros((L * F * S,), jnp.float32)
+            .at[flat]
+            .add(jnp.broadcast_to(c[:, None], (n, F)))
+            .reshape(L, F, S)
+            for c in comps
+        ]
+        cnt, s1, s2 = planes
 
         # ---- bin ordering: numeric keeps code order, categorical sorts by
         # mean label (empty bins pushed right) ----
@@ -244,9 +251,13 @@ def build_tree(
     is_cat: np.ndarray,
     cfg: TreeTrainConfig,
     feat_ok: np.ndarray,
+    mesh=None,
 ) -> Tuple[DenseTree, np.ndarray]:
     """One tree, level-wise. codes [n, F] int32 on device; labels/weights
-    [n] f32 on device (weights already carry bagging significance).
+    [n] f32 on device (weights already carry bagging significance). With a
+    `mesh`, the row arrays must already be sharded over its `data` axis —
+    per-level row state is created with the same sharding so every level
+    runs SPMD with one histogram all-reduce.
 
     Returns (tree, resting [n] int32) — resting is the global node index each
     row ends at, so callers get per-row predictions without re-traversal
@@ -262,6 +273,14 @@ def build_tree(
     node_local = jnp.zeros(n, dtype=jnp.int32)
     active = jnp.ones(n, dtype=bool)
     resting = jnp.zeros(n, dtype=jnp.int32)
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import replicate, shard_rows
+
+        node_local = shard_rows(node_local, mesh)
+        active = shard_rows(active, mesh)
+        resting = shard_rows(resting, mesh)
+        is_cat_j = replicate(is_cat_j, mesh)
+        feat_ok_j = replicate(feat_ok_j, mesh)
 
     feat_levels, mask_levels, leaf_levels = [], [], []
     for depth in range(D):
@@ -329,19 +348,43 @@ def train_trees(
     boundaries: Optional[List] = None,
     categories: Optional[List] = None,
     progress_cb=None,
+    mesh=None,
 ) -> TreeTrainResult:
-    """Full GBT/RF training run."""
+    """Full GBT/RF training run. `mesh` shards rows over its `data` axis
+    (the TPU equivalent of DTWorker row shards); None = single device."""
     import jax
     import jax.numpy as jnp
 
     n, F = codes.shape
+    n_orig = n  # rng draws always use the UNpadded count so the stream (and
+    # therefore every tree) is identical with and without a mesh
     rng = np.random.default_rng(cfg.seed)
     valid_mask = rng.random(n) < cfg.valid_set_rate
-    codes_j = jnp.asarray(codes.astype(np.int32))
-    y = tags.astype(np.float32)
-    y_j = jnp.asarray(y)
-    vm_j = jnp.asarray(valid_mask)
-    base_w_j = jnp.asarray(np.where(valid_mask, 0.0, weights).astype(np.float32))
+    codes_np = codes.astype(np.int32)
+    y_np = tags.astype(np.float32)
+    base_w_np = np.where(valid_mask, 0.0, weights).astype(np.float32)
+    real_np = np.ones(n, dtype=bool)
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import pad_rows, shard_rows
+
+        row_put = lambda a: shard_rows(a, mesh)  # noqa: E731
+        n_dev = mesh.devices.size
+        (codes_np, y_np, base_w_np, valid_mask, real_np), _ = pad_rows(
+            [codes_np, y_np, base_w_np, valid_mask, real_np], n_dev
+        )
+        n = codes_np.shape[0]
+        codes_j = shard_rows(codes_np, mesh)
+        y_j = shard_rows(y_np, mesh)
+        vm_j = shard_rows(valid_mask, mesh)
+        base_w_j = shard_rows(base_w_np, mesh)
+        real_j = shard_rows(real_np, mesh)
+    else:
+        row_put = jnp.asarray
+        codes_j = jnp.asarray(codes_np)
+        y_j = jnp.asarray(y_np)
+        vm_j = jnp.asarray(valid_mask)
+        base_w_j = jnp.asarray(base_w_np)
+        real_j = jnp.asarray(real_np)
     slots_np = np.asarray(slots, dtype=np.int32)
     is_cat_np = np.asarray(is_cat, dtype=bool)
 
@@ -354,11 +397,13 @@ def train_trees(
     @jax.jit
     def errors_of(score):
         sq = (y_j - score) ** 2
-        v = jnp.sum(jnp.where(vm_j, sq, 0.0)) / jnp.maximum(jnp.sum(vm_j), 1.0)
-        t = jnp.sum(jnp.where(vm_j, 0.0, sq)) / jnp.maximum(jnp.sum(~vm_j), 1.0)
+        vsel = vm_j & real_j
+        tsel = (~vm_j) & real_j
+        v = jnp.sum(jnp.where(vsel, sq, 0.0)) / jnp.maximum(jnp.sum(vsel), 1.0)
+        t = jnp.sum(jnp.where(tsel, sq, 0.0)) / jnp.maximum(jnp.sum(tsel), 1.0)
         return t, v
 
-    pred = jnp.zeros(n, dtype=jnp.float32)  # GBT raw prediction F(x)
+    pred = row_put(jnp.zeros(n, dtype=jnp.float32))  # GBT raw prediction F(x)
     valid_errors: List[float] = []
     bad_rounds = 0
     terr = verr = 0.0
@@ -366,10 +411,11 @@ def train_trees(
     for k in range(cfg.tree_num):
         if cfg.algorithm == "RF":
             if cfg.bagging_with_replacement:
-                bag = rng.poisson(cfg.bagging_sample_rate, size=n)
+                bag = rng.poisson(cfg.bagging_sample_rate, size=n_orig)
             else:
-                bag = rng.random(n) < cfg.bagging_sample_rate
-            w_k = base_w_j * jnp.asarray(bag.astype(np.float32))
+                bag = rng.random(n_orig) < cfg.bagging_sample_rate
+            bag = np.pad(bag.astype(np.float32), (0, n - n_orig))
+            w_k = base_w_j * row_put(bag)
             labels_k = y_j
         else:  # GBT: fit the negative loss gradient
             w_k = base_w_j
@@ -386,6 +432,7 @@ def train_trees(
 
         tree, resting = build_tree(
             codes_j, labels_k, w_k, slots_np, is_cat_np, cfg, feat_ok,
+            mesh=mesh,
         )
         tree.weight = 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0)
         trees.append(tree)

@@ -223,3 +223,36 @@ class TestTreeProcessor:
                                "EvalPerformance.json")) as fh:
             perf = json.load(fh)
         assert perf["areaUnderRoc"] > 0.85
+
+
+class TestMeshParallelTrees:
+    """The multi-chip contract (DTMaster.java:297-310 histogram merge →
+    psum): an 8-device row-sharded build must produce the SAME forest as
+    the single-device build."""
+
+    def test_8_device_tree_equals_1_device_tree(self):
+        from shifu_tpu.parallel.mesh import data_mesh
+
+        rng = np.random.default_rng(7)
+        n, F, S = 1003, 10, 12  # row count NOT divisible by 8 (pad path)
+        codes = rng.integers(0, S, size=(n, F)).astype(np.int32)
+        y = (codes[:, 0] + codes[:, 1]
+             + rng.normal(scale=2, size=n) > S).astype(np.float32)
+        w = np.ones(n, np.float32)
+        slots = [S] * F
+        is_cat = [False] * (F - 2) + [True, True]
+        cols = [f"c{i}" for i in range(F)]
+
+        for alg in ("GBT", "RF"):
+            cfg = TreeTrainConfig(algorithm=alg, tree_num=4, max_depth=4,
+                                  seed=3)
+            r1 = train_trees(codes, y, w, slots, is_cat, cols, cfg)
+            r8 = train_trees(codes, y, w, slots, is_cat, cols, cfg,
+                             mesh=data_mesh(8))
+            assert len(r1.spec.trees) == len(r8.spec.trees)
+            for t1, t8 in zip(r1.spec.trees, r8.spec.trees):
+                np.testing.assert_array_equal(t1.feature, t8.feature)
+                np.testing.assert_array_equal(t1.left_mask, t8.left_mask)
+                np.testing.assert_allclose(t1.leaf_value, t8.leaf_value,
+                                           atol=1e-4)
+            assert abs(r1.valid_error - r8.valid_error) < 1e-4, alg
