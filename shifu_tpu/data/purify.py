@@ -224,7 +224,15 @@ class DataPurifier:
         """Evaluate to a boolean keep-mask of length n_rows."""
         if self._code is None:
             return np.ones(n_rows, dtype=bool)
-        env = {name: ColumnVar(arr) for name, arr in columns.items()}
+        # bind ONLY the columns the expression references — `columns` may be
+        # a lazy frame-backed mapping where touching a column materializes
+        # it (data/reader.LazyColumns); iterating all of them would defeat
+        # the bounded-memory ingest
+        env = {
+            name: ColumnVar(columns[name])
+            for name in self._code.co_names
+            if name in columns
+        }
         try:
             out = eval(self._code, {"__builtins__": {}}, env)  # noqa: S307
         except Exception as e:

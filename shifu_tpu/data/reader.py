@@ -78,16 +78,68 @@ def _expand_paths(data_path: str) -> List[str]:
     raise ShifuError(ErrorCode.DATA_NOT_FOUND, data_path)
 
 
+class LazyColumns:
+    """Mapping facade over a pandas DataFrame that materializes object
+    arrays per column ON ACCESS. With pandas' arrow-backed string storage
+    this keeps unread columns (fat meta/padding fields) in compact arrow
+    buffers — the chunked ingest path's memory depends only on the columns
+    a stage actually touches."""
+
+    def __init__(self, frame):
+        self._frame = frame
+        self._cache: Dict[str, np.ndarray] = {}
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        arr = self._cache.get(name)
+        if arr is None:
+            arr = self._frame[name].to_numpy(dtype=object)
+            self._cache[name] = arr
+        return arr
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._frame.columns
+
+    def __iter__(self):
+        return iter(self._frame.columns)
+
+    def __len__(self) -> int:
+        return len(self._frame.columns)
+
+    def items(self):
+        return ((name, self[name]) for name in self._frame.columns)
+
+
 @dataclass
 class ColumnarData:
-    """All columns as parallel numpy arrays of raw strings, plus lazily-parsed
-    numeric views cached per column."""
+    """All columns as parallel numpy arrays of raw strings (or a lazy
+    frame-backed mapping), plus lazily-parsed numeric views cached per
+    column."""
 
     names: List[str]
     raw: Dict[str, np.ndarray]
     n_rows: int
     missing_values: Sequence[str] = DEFAULT_MISSING
     _numeric_cache: Dict[str, np.ndarray] = field(default_factory=dict, repr=False)
+
+    @classmethod
+    def from_frame(
+        cls, frame, names: List[str], missing_values: Sequence[str] = DEFAULT_MISSING
+    ) -> "ColumnarData":
+        return cls(
+            names=list(names),
+            raw=LazyColumns(frame),
+            n_rows=len(frame),
+            missing_values=missing_values,
+        )
+
+    def _series(self, name: str):
+        """pandas Series view of a column WITHOUT materializing an object
+        array (arrow-backed when frame-backed)."""
+        import pandas as pd
+
+        if isinstance(self.raw, LazyColumns):
+            return self.raw._frame[name]
+        return pd.Series(self.raw[name])
 
     def column(self, name: str) -> np.ndarray:
         return self.raw[name]
@@ -98,10 +150,9 @@ class ColumnarData:
         cached = self._numeric_cache.get(name)
         if cached is not None:
             return cached
-        col = self.raw[name]
         import pandas as pd
 
-        ser = pd.Series(col)
+        ser = self._series(name)
         vals = pd.to_numeric(ser, errors="coerce").to_numpy(dtype=np.float64)
         if len(self.missing_values):
             miss = ser.isin([m for m in self.missing_values if m != ""]).to_numpy()
@@ -112,14 +163,18 @@ class ColumnarData:
 
     def missing_mask(self, name: str) -> np.ndarray:
         """True where the raw token is in the configured missing set."""
-        col = self.raw[name]
-        import pandas as pd
-
-        ser = pd.Series(col).str.strip()
+        ser = self._series(name).str.strip()
         return ser.isin(list(self.missing_values)).to_numpy()
 
     def select_rows(self, mask: np.ndarray) -> "ColumnarData":
         """Row subset (boolean mask) or reorder (integer index array)."""
+        if isinstance(self.raw, LazyColumns):
+            mask = np.asarray(mask)
+            df = self.raw._frame
+            sub = df[mask] if mask.dtype == bool else df.iloc[mask]
+            return ColumnarData.from_frame(
+                sub.reset_index(drop=True), self.names, self.missing_values
+            )
         raw = {k: v[mask] for k, v in self.raw.items()}
         n = len(next(iter(raw.values()))) if raw else 0
         return ColumnarData(

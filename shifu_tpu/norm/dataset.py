@@ -52,6 +52,62 @@ class NormMeta:
         )
 
 
+class ShardWriter:
+    """Incremental shard-at-a-time writer — the streaming norm path emits
+    one shard per ingest chunk, so peak memory is one chunk regardless of
+    dataset size (MemoryDiskFloatMLDataSet's memory envelope, done the
+    streaming way)."""
+
+    def __init__(
+        self,
+        out_dir: str,
+        primary_prefix: str,
+        primary_dtype,
+        columns: List[str],
+        norm_type: str,
+        extra: Optional[dict] = None,
+    ):
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self.primary_prefix = primary_prefix
+        self.primary_dtype = primary_dtype
+        self.columns = columns
+        self.norm_type = norm_type
+        self.extra = extra
+        self.shard_rows: List[int] = []
+
+    def add(self, primary: np.ndarray, tags: np.ndarray, weights: np.ndarray):
+        s = len(self.shard_rows)
+        np.save(os.path.join(self.out_dir, f"{self.primary_prefix}-{s:05d}.npy"),
+                primary.astype(self.primary_dtype, copy=False))
+        np.save(os.path.join(self.out_dir, f"tags-{s:05d}.npy"),
+                tags.astype(np.int8, copy=False))
+        np.save(os.path.join(self.out_dir, f"weights-{s:05d}.npy"),
+                weights.astype(np.float32, copy=False))
+        self.shard_rows.append(primary.shape[0])
+
+    def close(self) -> NormMeta:
+        if not self.shard_rows:
+            # every chunk filtered empty: write one empty shard so loaders
+            # get a clear zero-row dataset, not a missing-file crash
+            n_cols = len(self.columns)
+            self.add(
+                np.zeros((0, n_cols), dtype=self.primary_dtype),
+                np.zeros(0, dtype=np.int8),
+                np.zeros(0, dtype=np.float32),
+            )
+        meta = NormMeta(
+            columns=self.columns,
+            n_rows=int(sum(self.shard_rows)),
+            shard_rows=self.shard_rows,
+            norm_type=self.norm_type,
+            extra=self.extra,
+        )
+        with open(os.path.join(self.out_dir, "meta.json"), "w") as fh:
+            json.dump(meta.to_json(), fh, indent=2)
+        return meta
+
+
 def _shard_slices(n_rows: int, n_shards: int) -> List[Tuple[int, int]]:
     base, rem = divmod(n_rows, n_shards)
     out, start = [], 0

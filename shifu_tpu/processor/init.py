@@ -19,7 +19,7 @@ from typing import List, Optional, Set
 import numpy as np
 
 from shifu_tpu.config import ColumnConfig, ColumnFlag, ColumnType
-from shifu_tpu.data.reader import read_columnar, read_header, strip_namespace
+from shifu_tpu.data.reader import read_header, strip_namespace
 from shifu_tpu.processor.basic import BasicProcessor
 from shifu_tpu.utils.errors import ErrorCode, ShifuError
 from shifu_tpu.utils.log import get_logger
@@ -113,32 +113,38 @@ class InitProcessor(BasicProcessor):
         mc = self.model_config
         assert mc is not None
         ds = mc.data_set
-        data = read_columnar(
+        # streaming distinct-count sketches: the TPU-build analog of the
+        # reference's HLL++ autotype MR job
+        # (core/autotype/AutoTypeDistinctCountMapper.java:45) — bounded
+        # memory regardless of dataset size or cardinality
+        from shifu_tpu.data.stream import iter_columnar_chunks
+        from shifu_tpu.stats.sketch import AutoTypeSketch
+
+        candidates = [
+            cc for cc in columns
+            if not (cc.is_target() or cc.is_meta() or cc.is_weight())
+        ]
+        missing = tuple(ds.missing_or_invalid_values)
+        sketches = {cc.column_name: AutoTypeSketch(missing) for cc in candidates}
+        for chunk in iter_columnar_chunks(
             self.resolve(ds.data_path),
             names,
             delimiter=ds.data_delimiter,
-            missing_values=tuple(ds.missing_or_invalid_values),
+            missing_values=missing,
             max_rows=AUTOTYPE_MAX_ROWS,
-        )
+        ):
+            for cc in candidates:
+                sketches[cc.column_name].update(chunk._series(cc.column_name))
+
         threshold = ds.auto_type_threshold
         count_info = {}
         for cc in columns:
             if cc.is_target() or cc.is_meta() or cc.is_weight():
                 continue
-            col = data.column(cc.column_name)
-            import pandas as pd
-
-            ser = pd.Series(col).str.strip()
-            non_missing = ser[~ser.isin(list(data.missing_values))]
-            distinct = non_missing.nunique()
+            sk = sketches[cc.column_name]
+            distinct = sk.distinct_count()
             cc.column_stats.distinct_count = int(distinct)
-            total = len(non_missing)
-            numeric_ok = (
-                pd.to_numeric(non_missing, errors="coerce").notna().sum()
-                if total
-                else 0
-            )
-            num_ratio = (numeric_ok / total) if total else 0.0
+            num_ratio = sk.numeric_ratio()
             count_info[cc.column_name] = {
                 "distinctCount": int(distinct),
                 "numericRatio": round(float(num_ratio), 6),

@@ -58,6 +58,13 @@ class NormProcessor(BasicProcessor):
             names = read_header(self.resolve(ds.header_path), ds.header_delimiter)
         else:
             names = [c.column_name for c in self.column_configs]
+
+        from shifu_tpu.data.stream import should_stream
+
+        if should_stream(self.resolve(ds.data_path)):
+            self._run_streaming(names)
+            return
+
         data = read_columnar(
             self.resolve(ds.data_path),
             names,
@@ -123,3 +130,65 @@ class NormProcessor(BasicProcessor):
             n_shards=n_shards,
         )
         log.info("bin codes -> %s", self.paths.cleaned_data_dir())
+
+    def _run_streaming(self, names) -> None:
+        """Bounded-memory norm: one chunked pass writes one shard per chunk
+        for BOTH artifacts (NormalizedData f32 + CleanedData bin codes).
+        Shuffle permutes within each chunk (the MR shuffle's goal — balanced
+        random shards — holds because chunks are contiguous file ranges)."""
+        from shifu_tpu.data.stream import chunk_source
+        from shifu_tpu.norm.dataset import ShardWriter
+        from shifu_tpu.stats.engine import _prepare_rows
+
+        mc = self.model_config
+        ds = mc.data_set
+        plan = build_norm_plan(mc, self.column_configs)
+        tree_cols = norm_columns(self.column_configs)
+        slots = [_slots(c) for c in tree_cols]
+        code_dtype = np.int16 if (not slots or max(slots) < 2**15) else np.int32
+
+        feat_writer = ShardWriter(
+            self.paths.normalized_data_dir(), "features", np.float32,
+            plan.out_names, mc.normalize.norm_type.value,
+            extra={"sourceOf": plan.source_of},
+        )
+        code_writer = ShardWriter(
+            self.paths.cleaned_data_dir(), "codes", code_dtype,
+            [c.column_name for c in tree_cols], "CODES",
+            extra={"slots": slots},
+        )
+        factory = chunk_source(
+            self.resolve(ds.data_path), names,
+            delimiter=ds.data_delimiter,
+            missing_values=tuple(ds.missing_or_invalid_values),
+        )
+        n_rows = 0
+        for ci, chunk in enumerate(factory()):
+            chunk, tags, weights = _prepare_rows(
+                mc, chunk, [self.seed, ci], mc.normalize.sample_rate,
+                mc.normalize.sample_neg_only,
+            )
+            if not chunk.n_rows:
+                continue
+            if self.shuffle:
+                perm = np.random.default_rng(
+                    [self.seed, ci]
+                ).permutation(chunk.n_rows)
+                chunk = chunk.select_rows(perm)
+                tags = tags[perm]
+                weights = weights[perm]
+            code_cache: dict = {}
+            feats = apply_norm_plan(plan, chunk, code_cache=code_cache)
+            feat_writer.add(feats, tags, weights)
+            codes = bin_code_matrix(tree_cols, chunk, cache=code_cache)
+            code_writer.add(codes, tags, weights)
+            n_rows += chunk.n_rows
+        feat_meta = feat_writer.close()
+        code_writer.close()
+        log.info(
+            "streaming norm: %d rows x %d cols (%s) -> %s [%d shards] "
+            "+ bin codes -> %s",
+            n_rows, len(feat_meta.columns), mc.normalize.norm_type.value,
+            self.paths.normalized_data_dir(), len(feat_meta.shard_rows),
+            self.paths.cleaned_data_dir(),
+        )

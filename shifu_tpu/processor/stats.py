@@ -64,35 +64,87 @@ class StatsProcessor(BasicProcessor):
                      n, target)
             return
 
-        data = self._load_data()
+        from shifu_tpu.data.stream import should_stream
 
-        from shifu_tpu.stats.engine import compute_stats
+        ds = mc.data_set
+        streaming = should_stream(self.resolve(ds.data_path))
+        if streaming:
+            # bounded-memory path: two chunked passes, sketch-based bins
+            from shifu_tpu.data.stream import chunk_source
+            from shifu_tpu.stats.engine import compute_stats_streaming
 
-        compute_stats(mc, self.column_configs, data)
+            if ds.header_path:
+                names = read_header(self.resolve(ds.header_path),
+                                    ds.header_delimiter)
+            else:
+                names = [c.column_name for c in self.column_configs]
+            factory = chunk_source(
+                self.resolve(ds.data_path),
+                names,
+                delimiter=ds.data_delimiter,
+                missing_values=tuple(ds.missing_or_invalid_values),
+            )
+            log.info("dataset exceeds the ingest memory budget; "
+                     "streaming stats in chunks")
+            compute_stats_streaming(mc, self.column_configs, factory)
+            data = None
+        else:
+            data = self._load_data()
+
+            from shifu_tpu.stats.engine import compute_stats
+
+            compute_stats(mc, self.column_configs, data)
 
         if self.correlation or self.psi:
             self.paths.ensure(self.paths.tmp_dir("stats"))
-        if self.correlation:
+        psi_col = (mc.stats.psi_column_name or "").strip()
+        if self.psi and not psi_col:
+            log.warning("-psi requested but stats.psiColumnName is empty; skipped")
+
+        if streaming and (self.correlation or (self.psi and psi_col)):
+            # one more chunked pass accumulating both artifacts
             from shifu_tpu.stats.correlation import (
-                column_correlation,
+                StreamingCorrelation,
                 save_correlation_csv,
             )
+            from shifu_tpu.stats.psi import PsiAccumulator
 
-            corr, names = column_correlation(data, self.column_configs)
-            save_correlation_csv(self.paths.correlation_path(), corr, names)
-            log.info(
-                "correlation matrix (%d x %d) -> %s",
-                len(names), len(names), self.paths.correlation_path(),
+            corr_acc = StreamingCorrelation() if self.correlation else None
+            psi_acc = (
+                PsiAccumulator(self.column_configs, psi_col)
+                if self.psi and psi_col else None
             )
+            for chunk in factory():
+                if corr_acc is not None:
+                    corr_acc.update(chunk, self.column_configs)
+                if psi_acc is not None:
+                    psi_acc.update(chunk)
+            if corr_acc is not None:
+                corr, names = corr_acc.finalize()
+                save_correlation_csv(self.paths.correlation_path(), corr, names)
+                log.info("correlation matrix (%d x %d) -> %s",
+                         len(names), len(names), self.paths.correlation_path())
+            if psi_acc is not None:
+                psi_acc.finalize()
+                log.info("PSI computed against unit column %s", psi_col)
+        else:
+            if self.correlation:
+                from shifu_tpu.stats.correlation import (
+                    column_correlation,
+                    save_correlation_csv,
+                )
 
-        psi_col = (mc.stats.psi_column_name or "").strip()
-        if self.psi and psi_col:
-            from shifu_tpu.stats.psi import compute_psi
+                corr, names = column_correlation(data, self.column_configs)
+                save_correlation_csv(self.paths.correlation_path(), corr, names)
+                log.info(
+                    "correlation matrix (%d x %d) -> %s",
+                    len(names), len(names), self.paths.correlation_path(),
+                )
+            if self.psi and psi_col:
+                from shifu_tpu.stats.psi import compute_psi
 
-            compute_psi(data, self.column_configs, psi_col)
-            log.info("PSI computed against unit column %s", psi_col)
-        elif self.psi:
-            log.warning("-psi requested but stats.psiColumnName is empty; skipped")
+                compute_psi(data, self.column_configs, psi_col)
+                log.info("PSI computed against unit column %s", psi_col)
 
         self.save_column_configs()
         n_binned = sum(1 for c in self.column_configs if c.column_binning.length)

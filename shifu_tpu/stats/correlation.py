@@ -35,11 +35,12 @@ def _corr_matrix(x: jax.Array) -> jax.Array:
     return (z.T @ z) / jnp.maximum(n - 1, 1)
 
 
-def column_correlation(
+def feature_matrix(
     data: ColumnarData, columns: List[ColumnConfig]
 ) -> tuple[np.ndarray, List[str]]:
-    """Correlation over feature columns; categorical columns enter via their
-    bin pos-rate encoding (same trick the norm step uses)."""
+    """[n, C] float32 matrix over feature columns (NaN = missing);
+    categorical columns enter via their bin pos-rate encoding (same trick
+    the norm step uses)."""
     mats = []
     names = []
     for cc in columns:
@@ -63,9 +64,70 @@ def column_correlation(
             mats.append(data.numeric(cc.column_name).astype(np.float32))
         names.append(cc.column_name)
     if not mats:
+        return np.zeros((0, 0), dtype=np.float32), []
+    return np.stack(mats, axis=1), names
+
+
+def column_correlation(
+    data: ColumnarData, columns: List[ColumnConfig]
+) -> tuple[np.ndarray, List[str]]:
+    x, names = feature_matrix(data, columns)
+    if not names:
         return np.zeros((0, 0)), []
-    x = jnp.asarray(np.stack(mats, axis=1))
-    return np.asarray(_corr_matrix(x)), names
+    return np.asarray(_corr_matrix(jnp.asarray(x))), names
+
+
+@jax.jit
+def _corr_moments(x: jax.Array):
+    """Pairwise-complete accumulators for one chunk — four MXU matmuls.
+    The streaming analog of CorrelationWritable's adjusted sums
+    (core/correlation/CorrelationMapper.java:50)."""
+    mask = (~jnp.isnan(x)).astype(jnp.float32)
+    x0 = jnp.where(jnp.isnan(x), 0.0, x)
+    n_pair = mask.T @ mask
+    s_x = x0.T @ mask  # sum of x_i over rows where BOTH i and j present
+    sq_x = (x0 * x0).T @ mask
+    cross = x0.T @ x0
+    return n_pair, s_x, sq_x, cross
+
+
+class StreamingCorrelation:
+    """Chunked all-pairs Pearson with pairwise-complete missing handling —
+    closer to the reference's adjusted-count accumulation than the in-RAM
+    mean-impute path, and O(C^2) state."""
+
+    def __init__(self):
+        self.names: List[str] = []
+        self._acc = None
+
+    def update(self, data: ColumnarData, columns: List[ColumnConfig]) -> None:
+        x, names = feature_matrix(data, columns)
+        if not names:
+            return
+        if not self.names:
+            self.names = names
+        part = [np.asarray(a, dtype=np.float64)
+                for a in _corr_moments(jnp.asarray(x))]
+        if self._acc is None:
+            self._acc = part
+        else:
+            for k in range(len(part)):
+                self._acc[k] += part[k]
+
+    def finalize(self) -> tuple[np.ndarray, List[str]]:
+        if self._acc is None:
+            return np.zeros((0, 0)), []
+        n, sx, sqx, cross = self._acc
+        sy, sqy = sx.T, sqx.T
+        n_safe = np.maximum(n, 1.0)
+        cov = cross - sx * sy / n_safe
+        var_x = np.maximum(sqx - sx * sx / n_safe, 0.0)
+        var_y = np.maximum(sqy - sy * sy / n_safe, 0.0)
+        denom = np.sqrt(var_x * var_y)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            corr = np.where(denom > 0, cov / np.maximum(denom, 1e-300), 0.0)
+        np.fill_diagonal(corr, 1.0)
+        return corr, self.names
 
 
 def save_correlation_csv(path: str, corr: np.ndarray, names: List[str]) -> None:

@@ -72,6 +72,29 @@ def build_codes(
     return codes, col_offsets, slots, values, numeric_cols
 
 
+def _prepare_rows(
+    mc: ModelConfig, data: ColumnarData, seed, sample_rate: float,
+    sample_neg_only: bool,
+) -> Tuple[ColumnarData, np.ndarray, np.ndarray]:
+    """purify + invalid-tag drop + sampling (reference samples in the Pig
+    job). `seed` may be a sequence (streaming passes [seed, chunk_idx] so
+    both passes sample identically)."""
+    ds = mc.data_set
+    mask = combined_mask(ds.filter_expressions, data.raw, data.n_rows)
+    tags_all = make_tags(data.column(ds.target_column_name), ds.pos_tags, ds.neg_tags)
+    mask &= tags_all >= 0
+    if sample_rate < 1.0:
+        rng = np.random.default_rng(seed)
+        keep = rng.random(data.n_rows) < sample_rate
+        if sample_neg_only:
+            keep |= tags_all == 1
+        mask &= keep
+    data = data.select_rows(mask)
+    tags = tags_all[mask]
+    weights = make_weights(data, ds.weight_column_name)
+    return data, tags, weights
+
+
 def compute_stats(
     mc: ModelConfig,
     columns: List[ColumnConfig],
@@ -79,21 +102,9 @@ def compute_stats(
     seed: int = 0,
 ) -> None:
     """Fill stats + binning for every non-target/meta/weight column, in place."""
-    ds = mc.data_set
-
-    # purify + invalid-tag drop + sampling (reference samples in the Pig job)
-    mask = combined_mask(ds.filter_expressions, data.raw, data.n_rows)
-    tags_all = make_tags(data.column(ds.target_column_name), ds.pos_tags, ds.neg_tags)
-    mask &= tags_all >= 0
-    if mc.stats.sample_rate < 1.0:
-        rng = np.random.default_rng(seed)
-        keep = rng.random(data.n_rows) < mc.stats.sample_rate
-        if mc.stats.sample_neg_only:
-            keep |= tags_all == 1
-        mask &= keep
-    data = data.select_rows(mask)
-    tags = tags_all[mask]
-    weights = make_weights(data, ds.weight_column_name)
+    data, tags, weights = _prepare_rows(
+        mc, data, seed, mc.stats.sample_rate, mc.stats.sample_neg_only
+    )
     log.info("stats over %d rows (%d pos / %d neg)", data.n_rows,
              int((tags == 1).sum()), int((tags == 0).sum()))
 
@@ -133,11 +144,63 @@ def compute_stats(
         jnp.asarray(weights, dtype=jnp.float32),
         jnp.asarray(values),
     )
-    pos = np.asarray(agg.pos)
-    neg = np.asarray(agg.neg)
-    wpos = np.asarray(agg.wpos)
-    wneg = np.asarray(agg.wneg)
 
+    medians = []
+    for cc in numeric_cols:
+        vals = data.numeric(cc.column_name)
+        finite = vals[np.isfinite(vals)]
+        medians.append(float(np.median(finite)) if finite.size else None)
+    cat_missing = {}
+    for cc in stats_cols:
+        if cc.is_categorical():
+            miss = data.missing_mask(cc.column_name)
+            cat_missing[cc.column_name] = (
+                int(miss.sum()),
+                float(miss.mean()) if data.n_rows else 0.0,
+            )
+
+    _write_back(
+        stats_cols,
+        slots,
+        col_offsets,
+        np.asarray(agg.pos),
+        np.asarray(agg.neg),
+        np.asarray(agg.wpos),
+        np.asarray(agg.wneg),
+        numeric_cols,
+        np.asarray(agg.vsum),
+        np.asarray(agg.vsumsq),
+        np.asarray(agg.vmin),
+        np.asarray(agg.vmax),
+        np.asarray(agg.vcount),
+        np.asarray(agg.vmissing),
+        medians,
+        cat_missing,
+        n_valid_rows=int((tags >= 0).sum()),
+    )
+
+
+def _write_back(
+    stats_cols: List[ColumnConfig],
+    slots: List[int],
+    col_offsets: np.ndarray,
+    pos: np.ndarray,
+    neg: np.ndarray,
+    wpos: np.ndarray,
+    wneg: np.ndarray,
+    numeric_cols: List[ColumnConfig],
+    vsum: np.ndarray,
+    vsumsq: np.ndarray,
+    vmin: np.ndarray,
+    vmax: np.ndarray,
+    vcount: np.ndarray,
+    vmissing: np.ndarray,
+    medians: List[Optional[float]],
+    cat_missing: Dict[str, Tuple[int, float]],
+    n_valid_rows: int,
+) -> None:
+    """Fill ColumnStats/ColumnBinning from flat bin aggregates (shared by the
+    in-RAM and streaming paths)."""
     # ---- metrics: vectorized KS/IV/WOE over padded [C, max_slots] ----
     max_slots = max(slots) if slots else 1
     C = len(stats_cols)
@@ -158,16 +221,8 @@ def compute_stats(
 
     ks, iv, woe, bin_woe, cvalid = cm.ks, cm.iv, cm.woe, cm.bin_woe, cm.valid
     wks, wiv, wwoe, wbin_woe = wcm.ks, wcm.iv, wcm.woe, wcm.bin_woe
-
-    vsum = np.asarray(agg.vsum)
-    vsumsq = np.asarray(agg.vsumsq)
-    vmin = np.asarray(agg.vmin)
-    vmax = np.asarray(agg.vmax)
-    vcount = np.asarray(agg.vcount)
-    vmissing = np.asarray(agg.vmissing)
     num_index = {id(cc): k for k, cc in enumerate(numeric_cols)}
 
-    n_valid_rows = int((tags >= 0).sum())
     for j, cc in enumerate(stats_cols):
         s = slots[j]
         st = cc.column_stats
@@ -206,13 +261,11 @@ def compute_stats(
                 st.std_dev = math.sqrt(var * cnt / max(cnt - 1, 1.0))
                 st.min = float(vmin[k])
                 st.max = float(vmax[k])
-                vals = data.numeric(cc.column_name)
-                finite = vals[np.isfinite(vals)]
-                st.median = float(np.median(finite)) if finite.size else None
+                st.median = medians[k]
         else:
-            miss = data.missing_mask(cc.column_name)
-            st.missing_count = int(miss.sum())
-            st.missing_percentage = float(miss.mean()) if data.n_rows else 0.0
+            miss_cnt, miss_pct = cat_missing.get(cc.column_name, (0, 0.0))
+            st.missing_count = miss_cnt
+            st.missing_percentage = miss_pct
             # Categorical stats are over the posrate-encoded variable (the
             # reference's CategoricalVarStats maps value -> binPosRate then
             # runs BasicStats) — closed form from the bin counts, incl. the
@@ -229,3 +282,168 @@ def compute_stats(
                 st.max = float(occupied.max()) if occupied.size else None
             else:
                 st.mean = None
+
+
+def compute_stats_streaming(
+    mc: ModelConfig,
+    columns: List[ColumnConfig],
+    chunk_factory,
+    seed: int = 0,
+) -> None:
+    """Bounded-memory stats: two passes over a re-iterable chunk stream.
+
+    Pass 1 folds every chunk into per-column streaming sketches (SPDT
+    histogram for numeric bins — the reference's EqualPopulationBinning
+    sketch, core/binning/EqualPopulationBinning.java:34 — plus moments and a
+    capped categorical counter). Pass 2 re-streams, bin-codes each chunk and
+    accumulates the same flat aggregates the in-RAM path produces in one
+    shot (UpdateBinningInfo MR parity, mapper partial sums summed on host).
+    Peak memory = one chunk + sketches; nothing scales with the dataset.
+    """
+    from shifu_tpu.config.model_config import BinningMethod
+    from shifu_tpu.stats.sketch import CategoricalSketch, NumericSketch
+
+    stats_cols = [
+        c for c in columns if not (c.is_target() or c.is_meta() or c.is_weight())
+    ]
+    method = mc.stats.binning_method
+    max_bins = mc.stats.max_num_bin
+    cate_max = mc.stats.cate_max_num_bin or MAX_CATEGORY_SIZE
+    use_weights = method in (
+        BinningMethod.WEIGHT_EQUAL_POSITIVE,
+        BinningMethod.WEIGHT_EQUAL_NEGATIVE,
+        BinningMethod.WEIGHT_EQUAL_TOTAL,
+    )
+
+    def bin_subset(tags: np.ndarray) -> np.ndarray:
+        if method in (BinningMethod.EQUAL_POSITIVE,
+                      BinningMethod.WEIGHT_EQUAL_POSITIVE):
+            return tags == 1
+        if method in (BinningMethod.EQUAL_NEGATIVE,
+                      BinningMethod.WEIGHT_EQUAL_NEGATIVE):
+            return tags == 0
+        return tags >= 0
+
+    sketches: Dict[str, object] = {}
+    for cc in stats_cols:
+        if cc.is_categorical():
+            sketches[cc.column_name] = CategoricalSketch()
+        else:
+            sketches[cc.column_name] = NumericSketch(max_bins=max_bins)
+
+    # ---- pass 1: sketches ----
+    n_valid_rows = 0
+    n_pos = n_neg = 0
+    for ci, chunk in enumerate(chunk_factory()):
+        chunk, tags, weights = _prepare_rows(
+            mc, chunk, [seed, ci], mc.stats.sample_rate,
+            mc.stats.sample_neg_only,
+        )
+        if not chunk.n_rows:
+            continue
+        n_valid_rows += chunk.n_rows
+        n_pos += int((tags == 1).sum())
+        n_neg += int((tags == 0).sum())
+        bm = bin_subset(tags)
+        for cc in stats_cols:
+            sk = sketches[cc.column_name]
+            if cc.is_categorical():
+                sk.update(chunk.column(cc.column_name),
+                          chunk.missing_mask(cc.column_name))
+            else:
+                sk.update(chunk.numeric(cc.column_name), bm,
+                          weights if use_weights else None)
+    log.info("streaming stats pass 1 done: %d rows (%d pos / %d neg)",
+             n_valid_rows, n_pos, n_neg)
+
+    # ---- finalize bins from the sketches ----
+    for cc in stats_cols:
+        sk = sketches[cc.column_name]
+        bn = cc.column_binning
+        if cc.is_categorical():
+            cats = sk.top_categories(cate_max)
+            bn.bin_category = cats
+            bn.bin_boundary = None
+            bn.length = len(cats)
+        else:
+            if method == BinningMethod.EQUAL_INTERVAL:
+                lo, hi = sk.min, sk.max
+                if np.isfinite(lo) and np.isfinite(hi) and hi > lo:
+                    step = (hi - lo) / max_bins
+                    bounds = [float("-inf")] + [
+                        lo + k * step for k in range(1, max_bins)
+                    ]
+                else:
+                    bounds = [float("-inf")]
+            else:
+                hist = sk.hist if sk.hist.total_weight > 0 else sk.hist_all
+                bounds = hist.boundaries(max_bins)
+            bn.bin_boundary = bounds
+            bn.bin_category = None
+            bn.length = len(bounds)
+
+    # ---- pass 2: chunked aggregation, padded to a fixed shape ----
+    import jax.numpy as jnp
+
+    acc = None
+    pad_n = 0
+    numeric_cols: List[ColumnConfig] = []
+    slots: List[int] = []
+    col_offsets = np.zeros(0, dtype=np.int32)
+    for ci, chunk in enumerate(chunk_factory()):
+        chunk, tags, weights = _prepare_rows(
+            mc, chunk, [seed, ci], mc.stats.sample_rate,
+            mc.stats.sample_neg_only,
+        )
+        if not chunk.n_rows:
+            continue
+        codes, col_offsets, slots, values, numeric_cols = build_codes(
+            chunk, stats_cols
+        )
+        total_slots = int(sum(slots))
+        pad_n = max(pad_n, codes.shape[0])
+        extra = pad_n - codes.shape[0]
+        if extra:
+            codes = np.pad(codes, ((0, extra), (0, 0)))
+            tags = np.pad(tags, (0, extra), constant_values=-1)
+            weights = np.pad(weights, (0, extra))
+            values = np.pad(values, ((0, extra), (0, 0)),
+                            constant_values=np.nan)
+        agg = bin_aggregate_jit(
+            jnp.asarray(codes),
+            jnp.asarray(col_offsets),
+            total_slots,
+            jnp.asarray(tags.astype(np.int32)),
+            jnp.asarray(weights, dtype=jnp.float32),
+            jnp.asarray(values),
+        )
+        part = [np.asarray(x, dtype=np.float64) for x in agg]
+        if acc is None:
+            acc = part
+        else:
+            for k in range(len(acc)):
+                if k == 6:  # vmin
+                    acc[k] = np.minimum(acc[k], part[k])
+                elif k == 7:  # vmax
+                    acc[k] = np.maximum(acc[k], part[k])
+                else:
+                    acc[k] = acc[k] + part[k]
+    if acc is None:
+        log.warning("streaming stats: no rows survived filtering")
+        return
+    pos, neg, wpos, wneg, vsum, vsumsq, vmin, vmax, vcount, vmissing = acc
+
+    medians = [sketches[cc.column_name].median for cc in numeric_cols]
+    cat_missing = {}
+    for cc in stats_cols:
+        if cc.is_categorical():
+            sk = sketches[cc.column_name]
+            cat_missing[cc.column_name] = (
+                int(sk.missing),
+                float(sk.missing) / max(n_valid_rows, 1),
+            )
+    _write_back(
+        stats_cols, slots, col_offsets, pos, neg, wpos, wneg,
+        numeric_cols, vsum, vsumsq, vmin, vmax, vcount, vmissing,
+        medians, cat_missing, n_valid_rows=n_valid_rows,
+    )
