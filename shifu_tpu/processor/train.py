@@ -3,9 +3,11 @@
 Parity: core/processor/TrainModelProcessor.java:105 — bagging fan-out,
 k-fold, grid search, continuous training, per-algorithm param wiring
 (prepareNNParams :1338 / prepareLRParams :1325), progress + val-error files.
-The Guagua job fan-out (runDistributedTrain:661) becomes: one jitted SPMD
-training run per bag member on the full device mesh; bagging members run
-sequentially but each reuses the compiled step (same shapes = jit cache hit).
+The Guagua job fan-out (runDistributedTrain:661) becomes: bagging members
+vmapped into ONE SPMD program over the full device mesh (train_nn_bagged) —
+the member axis rides the MXU batch dimension instead of parallel Hadoop
+jobs; grid-search trials reuse the compiled step (same shapes = jit cache
+hit).
 """
 
 from __future__ import annotations
@@ -110,37 +112,77 @@ class TrainProcessor(BasicProcessor):
             self._k_fold(alg, num_kfold, feats, tags, weights, mesh, norm_json, suffix)
             return
 
-        val_errors: List[float] = []
-        for i in range(bagging):
-            cfg = NNTrainConfig.from_model_config(mc, trainer_id=i)
-            init_flat = self._continuous_init(i, suffix) if mc.train.is_continuous else None
-            cfg.checkpoint_every = 10
-            cfg.checkpoint_path = os.path.join(
-                self.paths.ensure(self.paths.checkpoint_dir(i)), "weights.npy"
-            )
-            progress_path = self.paths.progress_path(i)
+        if bagging > 1:
+            # all members in ONE vmapped program (the reference's 5-parallel
+            # Guagua jobs, shifuconfig shifu.train.bagging.inparallel)
+            from shifu_tpu.train.nn_trainer import train_nn_bagged
 
-            def progress(it, tr, va, _p=progress_path, _i=i):
-                with open(_p, "a") as fh:
+            base_cfg = NNTrainConfig.from_model_config(mc, trainer_id=0)
+            init_flats = [
+                self._continuous_init(i, suffix) if mc.train.is_continuous
+                else None
+                for i in range(bagging)
+            ]
+            base_cfg.checkpoint_every = 10
+            checkpoint_paths = [
+                os.path.join(self.paths.ensure(self.paths.checkpoint_dir(i)),
+                             "weights.npy")
+                for i in range(bagging)
+            ]
+            progress_paths = [self.paths.progress_path(i) for i in range(bagging)]
+
+            def progress(member_it, tr, va):
+                i, it = member_it
+                with open(progress_paths[i], "a") as fh:
                     fh.write(
-                        f"Trainer {_i} Epoch #{it} Train Error:{tr:.8f} "
+                        f"Trainer {i} Epoch #{it} Train Error:{tr:.8f} "
                         f"Validation Error:{va:.8f}\n"
                     )
-                log.info("trainer %d epoch %d train %.6f valid %.6f", _i, it, tr, va)
 
-            cfg.progress_cb = progress
-            result = train_nn(feats, tags, weights, cfg, mesh=mesh,
-                              init_flat=init_flat)
-            spec = self._make_spec(alg, cfg, result, meta.columns, norm_json)
-            path = self.paths.model_path(i, suffix)
-            spec.save(path)
-            with open(self.paths.val_error_path(i), "w") as fh:
-                fh.write(f"{result.valid_error}\n")
-            val_errors.append(result.valid_error)
-            log.info("model %d -> %s (valid err %.6f)", i, path, result.valid_error)
-
-        if len(val_errors) > 1:
+            base_cfg.progress_cb = progress
+            results = train_nn_bagged(feats, tags, weights, base_cfg, bagging,
+                                      mesh=mesh, init_flats=init_flats,
+                                      checkpoint_paths=checkpoint_paths)
+            val_errors: List[float] = []
+            for i, result in enumerate(results):
+                cfg_i = NNTrainConfig.from_model_config(mc, trainer_id=i)
+                spec = self._make_spec(alg, cfg_i, result, meta.columns,
+                                       norm_json)
+                path = self.paths.model_path(i, suffix)
+                spec.save(path)
+                with open(self.paths.val_error_path(i), "w") as fh:
+                    fh.write(f"{result.valid_error}\n")
+                val_errors.append(result.valid_error)
+                log.info("model %d -> %s (valid err %.6f)", i, path,
+                         result.valid_error)
             log.info("bagging avg valid error: %.6f", float(np.mean(val_errors)))
+            return
+
+        cfg = NNTrainConfig.from_model_config(mc, trainer_id=0)
+        init_flat = self._continuous_init(0, suffix) if mc.train.is_continuous else None
+        cfg.checkpoint_every = 10
+        cfg.checkpoint_path = os.path.join(
+            self.paths.ensure(self.paths.checkpoint_dir(0)), "weights.npy"
+        )
+        progress_path = self.paths.progress_path(0)
+
+        def progress(it, tr, va, _p=progress_path):
+            with open(_p, "a") as fh:
+                fh.write(
+                    f"Trainer 0 Epoch #{it} Train Error:{tr:.8f} "
+                    f"Validation Error:{va:.8f}\n"
+                )
+            log.info("trainer 0 epoch %d train %.6f valid %.6f", it, tr, va)
+
+        cfg.progress_cb = progress
+        result = train_nn(feats, tags, weights, cfg, mesh=mesh,
+                          init_flat=init_flat)
+        spec = self._make_spec(alg, cfg, result, meta.columns, norm_json)
+        path = self.paths.model_path(0, suffix)
+        spec.save(path)
+        with open(self.paths.val_error_path(0), "w") as fh:
+            fh.write(f"{result.valid_error}\n")
+        log.info("model 0 -> %s (valid err %.6f)", path, result.valid_error)
 
     def _grid_search(self, alg, composites, feats, tags, weights, mesh) -> dict:
         from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn

@@ -389,6 +389,152 @@ def train_nn(
     )
 
 
+def train_nn_bagged(
+    features: np.ndarray,
+    tags: np.ndarray,
+    weights: np.ndarray,
+    base_cfg: NNTrainConfig,
+    n_members: int,
+    mesh=None,
+    init_flats: Optional[List[Optional[np.ndarray]]] = None,
+    member_seed: Callable[[int], int] = lambda i: i * 1000 + 7,
+    checkpoint_paths: Optional[List[str]] = None,
+) -> List[TrainResult]:
+    """Train all bagging members as ONE vmapped SPMD program.
+
+    The reference fans each bag member out as a separate Guagua job, five in
+    parallel (TrainModelProcessor.java:768-945, shifuconfig
+    shifu.train.bagging.inparallel); here the member axis is vmapped over the
+    shared row-sharded dataset, so the MXU sees [M, n, d] batched matmuls and
+    all members train in one XLA execution. jax's while_loop batching rule
+    masks members that early-stop, so per-member halting semantics match the
+    serial path exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    n, d = features.shape
+    layer_sizes = [d] + list(base_cfg.hidden_nodes) + [1]
+    shapes = None
+    flat0s, sig_ts, sig_vs, ntss, seeds = [], [], [], [], []
+    for i in range(n_members):
+        seed_i = member_seed(i)
+        seeds.append(seed_i)
+        params0 = init_params(layer_sizes, seed=seed_i, init=base_cfg.weight_init)
+        flat0, shapes = flatten_params(params0)
+        init_i = (init_flats or [None] * n_members)[i]
+        if init_i is not None and init_i.size == flat0.size:
+            flat0 = init_i.astype(np.float32)
+        cfg_i = NNTrainConfig(**{**base_cfg.__dict__, "seed": seed_i})
+        sig, valid_mask = split_and_sample(n, cfg_i)
+        sig_ts.append((sig * weights).astype(np.float32))
+        sig_vs.append((valid_mask.astype(np.float32) * weights).astype(np.float32))
+        ntss.append(float(max(sig.sum(), 1.0)))
+        flat0s.append(flat0)
+
+    x = features if isinstance(features, jax.Array) else features.astype(np.float32)
+    t = tags if isinstance(tags, jax.Array) else tags.astype(np.float32)
+    sig_t = np.stack(sig_ts)  # [M, n]
+    sig_v = np.stack(sig_vs)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from shifu_tpu.parallel.mesh import pad_rows, shard_rows
+
+        n_dev = mesh.devices.size
+        (x, t), _ = pad_rows([x, t], n_dev)
+        sig_t = np.pad(sig_t, ((0, 0), (0, x.shape[0] - n)))
+        sig_v = np.pad(sig_v, ((0, 0), (0, x.shape[0] - n)))
+        x = shard_rows(x, mesh)
+        t = shard_rows(t, mesh)
+        member_rows = NamedSharding(mesh, P(None, "data"))
+        sig_t = jax.device_put(sig_t, member_rows)
+        sig_v = jax.device_put(sig_v, member_rows)
+
+    rows = x.shape[0]
+    program, init_state = _get_program(base_cfg, shapes, rows)
+    bag_key = ("bagged", id(program), n_members)
+    program_b = _PROGRAMS.get(bag_key)
+    if program_b is None:
+        program_b = jax.jit(
+            jax.vmap(program, in_axes=(0, None, None, None, 0, 0, 0, 0)),
+            static_argnums=(),
+        )
+        _PROGRAMS[bag_key] = program_b
+
+    n_flat = flat0s[0].size
+    flat_j = jnp.asarray(np.stack(flat0s))  # [M, n_flat]
+    opt0 = jax.tree_util.tree_map(
+        lambda *a: jnp.stack(a), *[init_state(n_flat) for _ in range(n_members)]
+    )
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import replicate
+
+        flat_j = replicate(flat_j, mesh)
+        opt0 = replicate(opt0, mesh)
+    M = n_members
+    carry0 = (
+        flat_j, opt0, jnp.zeros(M, jnp.int32),
+        jnp.full(M, base_cfg.learning_rate, jnp.float32),
+        jnp.full(M, np.inf, jnp.float32), flat_j, jnp.zeros(M, jnp.int32),
+        jnp.zeros(M, dtype=bool), jnp.zeros(M, jnp.float32),
+        jnp.zeros(M, jnp.float32),
+    )
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+    nts_j = jnp.asarray(ntss, jnp.float32)
+    max_iters = base_cfg.num_epochs
+
+    def run_until(carry, limit):
+        return program_b(carry, jnp.int32(limit), x, t, sig_t, sig_v, keys,
+                         nts_j)
+
+    if base_cfg.checkpoint_every and base_cfg.checkpoint_every > 0:
+        # segmented run: per-member checkpoints + progress between segments
+        # (NNOutput.postIteration parity, one file per trainer)
+        carry = carry0
+        it = 0
+        last_reported = [-1] * M
+        while it < max_iters:
+            limit = min(it + base_cfg.checkpoint_every, max_iters)
+            carry = run_until(carry, limit)
+            it = int(np.asarray(carry[2]).max())
+            trs, vas = np.asarray(carry[8]), np.asarray(carry[9])
+            its = np.asarray(carry[2])
+            flats = np.asarray(carry[0])
+            for i in range(M):
+                it_i = int(its[i])
+                if it_i == last_reported[i]:
+                    continue  # member already halted; don't re-report
+                last_reported[i] = it_i
+                if base_cfg.progress_cb:
+                    base_cfg.progress_cb((i, it_i), float(trs[i]),
+                                         float(vas[i]))
+                if checkpoint_paths and checkpoint_paths[i]:
+                    np.save(checkpoint_paths[i], flats[i])
+            if bool(np.asarray(carry[7]).all()) or it >= max_iters:
+                break
+        out = carry
+    else:
+        out = run_until(carry0, max_iters)
+    (flat_f, _, it_f, _, best_val, best_flat, _, _, tr_e, va_e) = out
+
+    results = []
+    flat_f_np = np.asarray(flat_f)
+    best_flat_np = np.asarray(best_flat)
+    for i in range(n_members):
+        bv = float(np.asarray(best_val)[i])
+        use_best = base_cfg.valid_set_rate > 0 and math.isfinite(bv)
+        chosen = best_flat_np[i] if use_best else flat_f_np[i]
+        results.append(TrainResult(
+            params=unflatten_params(chosen, shapes),
+            train_error=float(np.asarray(tr_e)[i]),
+            valid_error=bv if math.isfinite(bv) else float(np.asarray(va_e)[i]),
+            iterations=int(np.asarray(it_f)[i]),
+        ))
+    log.info("bagged train done: %d members in one program, avg valid %.6f",
+             n_members, float(np.mean([r.valid_error for r in results])))
+    return results
+
+
 def _run_with_checkpoints(run_until, carry, cfg, max_iters):
     """Chunked run: jit loop in segments, checkpoint + progress between them
     (NNOutput.postIteration:158 writes tmp models each epoch)."""
