@@ -128,15 +128,27 @@ def iter_columnar_chunks(
         else:
             frames = _iter_csv_chunks(path, names, delimiter, chunk_rows)
         for df in frames:
+            if len(df) and names:
+                # stray header line inside data (part files re-concatenated):
+                # drop only rows where EVERY field equals its column name —
+                # a legitimate row whose first field happens to equal the
+                # first column's name must survive. Filter BEFORE the
+                # max_rows slice so dropped headers don't consume budget.
+                cand = (df[names[0]] == names[0]).to_numpy()
+                if cand.any():
+                    sub = df[cand]
+                    header_row = np.ones(len(sub), dtype=bool)
+                    for c in names[1:]:
+                        header_row &= (sub[c] == c).to_numpy()
+                    if header_row.any():
+                        drop = np.zeros(len(df), dtype=bool)
+                        drop[np.nonzero(cand)[0][header_row]] = True
+                        df = df[~drop]
             if remaining is not None:
                 if remaining <= 0:
                     return
                 df = df.iloc[:remaining]
                 remaining -= len(df)
-            if len(df) and names:
-                # stray header line inside data (part files re-concatenated)
-                first = names[0]
-                df = df[df[first] != first]
             if not len(df):
                 continue
             # frame-backed: columns stay in pandas' compact (arrow) string

@@ -52,6 +52,25 @@ class NormMeta:
         )
 
 
+def _write_meta(
+    out_dir: str,
+    columns: List[str],
+    shard_rows: List[int],
+    norm_type: str,
+    extra: Optional[dict],
+) -> NormMeta:
+    meta = NormMeta(
+        columns=columns,
+        n_rows=int(sum(shard_rows)),
+        shard_rows=shard_rows,
+        norm_type=norm_type,
+        extra=extra,
+    )
+    with open(os.path.join(out_dir, "meta.json"), "w") as fh:
+        json.dump(meta.to_json(), fh, indent=2)
+    return meta
+
+
 class ShardWriter:
     """Incremental shard-at-a-time writer — the streaming norm path emits
     one shard per ingest chunk, so peak memory is one chunk regardless of
@@ -96,16 +115,127 @@ class ShardWriter:
                 np.zeros(0, dtype=np.int8),
                 np.zeros(0, dtype=np.float32),
             )
-        meta = NormMeta(
-            columns=self.columns,
-            n_rows=int(sum(self.shard_rows)),
-            shard_rows=self.shard_rows,
-            norm_type=self.norm_type,
-            extra=self.extra,
-        )
-        with open(os.path.join(self.out_dir, "meta.json"), "w") as fh:
-            json.dump(meta.to_json(), fh, indent=2)
-        return meta
+        return _write_meta(self.out_dir, self.columns, self.shard_rows,
+                           self.norm_type, self.extra)
+
+
+class ShuffleShardWriter:
+    """External-shuffle shard writer — the streaming analog of the MR shuffle
+    (core/shuffle/MapReduceShuffle.java:47, random-key re-partition).
+
+    Pass 1 (add): each chunk's rows scatter to k bucket files under a
+    deterministic random assignment. Pass 2 (close): each bucket is loaded,
+    permuted, and written as a final .npy shard. Random bucket assignment +
+    within-bucket permutation is a TRUE uniform global permutation, so a
+    label- or time-sorted input is fully decorrelated across AND within
+    shards — within-chunk shuffling alone leaves chunks globally ordered.
+    Peak memory: one bucket (~n_rows/k rows).
+
+    Determinism contract: two writers built with the same (seed, n_buckets)
+    and fed add() calls in lockstep draw identical assignments and bucket
+    permutations, so the feature and bin-code artifacts stay row-aligned.
+
+    Bucket files are opened in append mode per write (no persistent handles,
+    so k is not bounded by the fd ulimit), and close() permutes each bucket
+    through block-wise memmap gathers, so peak anonymous memory stays at one
+    block regardless of bucket size.
+    """
+
+    _CLOSE_BLOCK_ROWS = 65536
+
+    def __init__(
+        self,
+        out_dir: str,
+        primary_prefix: str,
+        primary_dtype,
+        columns: List[str],
+        norm_type: str,
+        n_buckets: int,
+        seed: int = 0,
+        extra: Optional[dict] = None,
+    ):
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self.primary_prefix = primary_prefix
+        self.primary_dtype = np.dtype(primary_dtype)
+        self.columns = columns
+        self.norm_type = norm_type
+        self.extra = extra
+        self.seed = seed
+        self.k = max(1, n_buckets)
+        self._chunk_idx = 0
+        self._bucket_rows = [0] * self.k
+        for s in range(self.k):
+            base = self._bucket_base(s)
+            for suffix in (".primary.bin", ".tags.bin", ".weights.bin"):
+                open(base + suffix, "wb").close()  # truncate leftovers
+
+    def _bucket_base(self, s: int) -> str:
+        return os.path.join(self.out_dir, f".bucket-{s:05d}")
+
+    def add(self, primary: np.ndarray, tags: np.ndarray, weights: np.ndarray):
+        n = primary.shape[0]
+        # 5_555 domain-separates from _prepare_rows' sampling draws, which
+        # use [seed, chunk_idx] — replaying that exact stream here would
+        # re-interpret the words that decided row retention as bucket ids,
+        # biasing kept rows toward low buckets (close() tags with 7_777)
+        assign = np.random.default_rng(
+            [self.seed, 5_555, self._chunk_idx]
+        ).integers(self.k, size=n)
+        self._chunk_idx += 1
+        # single stable partition instead of one boolean scan per bucket
+        order = np.argsort(assign, kind="stable")
+        counts = np.bincount(assign, minlength=self.k)
+        bounds = np.concatenate([[0], np.cumsum(counts)])
+        p = np.ascontiguousarray(primary.astype(self.primary_dtype, copy=False)[order])
+        t = np.ascontiguousarray(tags.astype(np.int8, copy=False)[order])
+        w = np.ascontiguousarray(weights.astype(np.float32, copy=False)[order])
+        for s in np.nonzero(counts)[0]:
+            a, b = bounds[s], bounds[s + 1]
+            base = self._bucket_base(s)
+            with open(base + ".primary.bin", "ab") as fh:
+                fh.write(p[a:b].tobytes())
+            with open(base + ".tags.bin", "ab") as fh:
+                fh.write(t[a:b].tobytes())
+            with open(base + ".weights.bin", "ab") as fh:
+                fh.write(w[a:b].tobytes())
+            self._bucket_rows[s] += int(b - a)
+
+    def _permute_to_npy(self, src: str, dtype, shape, perm, dst: str) -> None:
+        if shape[0] == 0:
+            np.save(dst, np.zeros(shape, dtype=dtype))
+            return
+        src_mm = np.memmap(src, dtype=dtype, mode="r", shape=shape)
+        out = np.lib.format.open_memmap(dst, mode="w+", dtype=dtype, shape=shape)
+        for a in range(0, shape[0], self._CLOSE_BLOCK_ROWS):
+            b = min(a + self._CLOSE_BLOCK_ROWS, shape[0])
+            out[a:b] = src_mm[perm[a:b]]
+        out.flush()
+        del out, src_mm
+
+    def close(self) -> NormMeta:
+        n_cols = len(self.columns)
+        shard_rows: List[int] = []
+        for s in range(self.k):
+            base = self._bucket_base(s)
+            rows = self._bucket_rows[s]
+            perm = np.random.default_rng([self.seed, 7_777, s]).permutation(rows)
+            sid = len(shard_rows)
+            self._permute_to_npy(
+                base + ".primary.bin", self.primary_dtype, (rows, n_cols),
+                perm,
+                os.path.join(self.out_dir, f"{self.primary_prefix}-{sid:05d}.npy"))
+            self._permute_to_npy(
+                base + ".tags.bin", np.int8, (rows,), perm,
+                os.path.join(self.out_dir, f"tags-{sid:05d}.npy"))
+            self._permute_to_npy(
+                base + ".weights.bin", np.float32, (rows,), perm,
+                os.path.join(self.out_dir, f"weights-{sid:05d}.npy"))
+            shard_rows.append(rows)
+            for suffix in (".primary.bin", ".tags.bin", ".weights.bin"):
+                os.remove(base + suffix)
+        return _write_meta(self.out_dir, self.columns, shard_rows,
+                           self.norm_type, self.extra)
 
 
 def _shard_slices(n_rows: int, n_shards: int) -> List[Tuple[int, int]]:
@@ -142,11 +272,7 @@ def _write_sharded(
         np.save(os.path.join(out_dir, f"weights-{s:05d}.npy"),
                 weights[a:b].astype(np.float32, copy=False))
         shard_rows.append(b - a)
-    meta = NormMeta(columns=columns, n_rows=n, shard_rows=shard_rows,
-                    norm_type=norm_type, extra=extra)
-    with open(os.path.join(out_dir, "meta.json"), "w") as fh:
-        json.dump(meta.to_json(), fh, indent=2)
-    return meta
+    return _write_meta(out_dir, columns, shard_rows, norm_type, extra)
 
 
 def write_normalized(

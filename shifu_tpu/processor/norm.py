@@ -132,12 +132,18 @@ class NormProcessor(BasicProcessor):
         log.info("bin codes -> %s", self.paths.cleaned_data_dir())
 
     def _run_streaming(self, names) -> None:
-        """Bounded-memory norm: one chunked pass writes one shard per chunk
-        for BOTH artifacts (NormalizedData f32 + CleanedData bin codes).
-        Shuffle permutes within each chunk (the MR shuffle's goal — balanced
-        random shards — holds because chunks are contiguous file ranges)."""
-        from shifu_tpu.data.stream import chunk_source
-        from shifu_tpu.norm.dataset import ShardWriter
+        """Bounded-memory norm: one chunked pass writes BOTH artifacts
+        (NormalizedData f32 + CleanedData bin codes). Without shuffle, one
+        shard per ingest chunk; with shuffle, a two-pass external shuffle
+        (ShuffleShardWriter) produces a true uniform global permutation —
+        the MR shuffle's contract (core/shuffle/MapReduceShuffle.java:47) —
+        with peak memory of one bucket."""
+        from shifu_tpu.data.stream import (
+            chunk_source,
+            dataset_size_bytes,
+            memory_budget_bytes,
+        )
+        from shifu_tpu.norm.dataset import ShardWriter, ShuffleShardWriter
         from shifu_tpu.stats.engine import _prepare_rows
 
         mc = self.model_config
@@ -147,16 +153,42 @@ class NormProcessor(BasicProcessor):
         slots = [_slots(c) for c in tree_cols]
         code_dtype = np.int16 if (not slots or max(slots) < 2**15) else np.int32
 
-        feat_writer = ShardWriter(
-            self.paths.normalized_data_dir(), "features", np.float32,
-            plan.out_names, mc.normalize.norm_type.value,
-            extra={"sourceOf": plan.source_of},
-        )
-        code_writer = ShardWriter(
-            self.paths.cleaned_data_dir(), "codes", code_dtype,
-            [c.column_name for c in tree_cols], "CODES",
-            extra={"slots": slots},
-        )
+        if self.shuffle:
+            # bucket count so one bucket fits ~1/4 of the memory budget;
+            # gz-compressed text typically expands ~4x when materialized
+            from shifu_tpu.data.reader import _expand_paths
+
+            raw_bytes = dataset_size_bytes(self.resolve(ds.data_path))
+            if any(p.endswith(".gz")
+                   for p in _expand_paths(self.resolve(ds.data_path))):
+                raw_bytes *= 4
+            n_buckets = max(
+                default_shards(),
+                int(np.ceil(raw_bytes / max(memory_budget_bytes() // 4, 1))),
+            )
+            feat_writer = ShuffleShardWriter(
+                self.paths.normalized_data_dir(), "features", np.float32,
+                plan.out_names, mc.normalize.norm_type.value,
+                n_buckets=n_buckets, seed=self.seed,
+                extra={"sourceOf": plan.source_of},
+            )
+            code_writer = ShuffleShardWriter(
+                self.paths.cleaned_data_dir(), "codes", code_dtype,
+                [c.column_name for c in tree_cols], "CODES",
+                n_buckets=n_buckets, seed=self.seed,
+                extra={"slots": slots},
+            )
+        else:
+            feat_writer = ShardWriter(
+                self.paths.normalized_data_dir(), "features", np.float32,
+                plan.out_names, mc.normalize.norm_type.value,
+                extra={"sourceOf": plan.source_of},
+            )
+            code_writer = ShardWriter(
+                self.paths.cleaned_data_dir(), "codes", code_dtype,
+                [c.column_name for c in tree_cols], "CODES",
+                extra={"slots": slots},
+            )
         factory = chunk_source(
             self.resolve(ds.data_path), names,
             delimiter=ds.data_delimiter,
@@ -170,13 +202,6 @@ class NormProcessor(BasicProcessor):
             )
             if not chunk.n_rows:
                 continue
-            if self.shuffle:
-                perm = np.random.default_rng(
-                    [self.seed, ci]
-                ).permutation(chunk.n_rows)
-                chunk = chunk.select_rows(perm)
-                tags = tags[perm]
-                weights = weights[perm]
             code_cache: dict = {}
             feats = apply_norm_plan(plan, chunk, code_cache=code_cache)
             feat_writer.add(feats, tags, weights)

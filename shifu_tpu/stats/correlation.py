@@ -94,11 +94,18 @@ def _corr_moments(x: jax.Array):
 class StreamingCorrelation:
     """Chunked all-pairs Pearson with pairwise-complete missing handling —
     closer to the reference's adjusted-count accumulation than the in-RAM
-    mean-impute path, and O(C^2) state."""
+    mean-impute path, and O(C^2) state.
+
+    Chunks are shifted by the first chunk's column means before the moment
+    matmuls: Pearson is shift-invariant, so the result is unchanged, but the
+    accumulators hold O(std)-sized residuals instead of O(mean)-sized raw
+    values — without this, columns with |mean| >> std cancel catastrophically
+    in the f32 cov/var subtraction and the streaming result collapses to 0."""
 
     def __init__(self):
         self.names: List[str] = []
         self._acc = None
+        self._shift: np.ndarray | None = None
 
     def update(self, data: ColumnarData, columns: List[ColumnConfig]) -> None:
         x, names = feature_matrix(data, columns)
@@ -106,8 +113,12 @@ class StreamingCorrelation:
             return
         if not self.names:
             self.names = names
+        if self._shift is None:
+            with np.errstate(invalid="ignore"):
+                shift = np.nanmean(x.astype(np.float64), axis=0)
+            self._shift = np.nan_to_num(shift, nan=0.0).astype(np.float32)
         part = [np.asarray(a, dtype=np.float64)
-                for a in _corr_moments(jnp.asarray(x))]
+                for a in _corr_moments(jnp.asarray(x - self._shift[None, :]))]
         if self._acc is None:
             self._acc = part
         else:

@@ -201,8 +201,11 @@ class DistinctSketch:
         # rho = leading-zero count of w in (64-P) bits, + 1
         bits = np.zeros(w.shape, dtype=np.int64)
         nz = w > 0
-        # w < 2^52 so float64 log2 is exact enough for bit_length
-        bits[nz] = np.floor(np.log2(w[nz].astype(np.float64))).astype(np.int64) + 1
+        # exact bit length: w < 2^52 is exactly representable in float64, and
+        # frexp's exponent IS bit_length for integers (w = m * 2^e, 0.5<=m<1).
+        # floor(log2(w)) would round UP for w one ulp below a power of two,
+        # understating rho.
+        bits[nz] = np.frexp(w[nz].astype(np.float64))[1]
         rho = (64 - self.P) - bits + 1
         np.maximum.at(self.registers, idx, rho.astype(np.uint8))
         if self.exact is not None:
@@ -274,6 +277,15 @@ class CategoricalSketch:
         self.total = 0.0
         self.numeric_parse_ok = 0.0
         self.saturated = False
+        # space-saving error tracking (Metwally et al.): per-key admission
+        # floors, the max OBSERVED count among evicted keys (error_bound, the
+        # per-key overcount ceiling for later admissions), and the total
+        # observed mass evicted (distinct-count undercount signal). Floors
+        # are excluded when a carried key is re-evicted, so neither quantity
+        # compounds across eviction rounds.
+        self.error_bound = 0.0
+        self.evicted_mass = 0.0
+        self._floor: Dict[str, float] = {}
 
     def update(self, raw: np.ndarray, missing_mask: np.ndarray) -> None:
         import pandas as pd
@@ -287,14 +299,24 @@ class CategoricalSketch:
         vc = ser.value_counts()
         for val, cnt in vc.items():
             key = str(val)
-            self.counts[key] = self.counts.get(key, 0.0) + float(cnt)
+            if key in self.counts:
+                self.counts[key] += float(cnt)
+            else:
+                # space-saving admission: a value that was evicted earlier
+                # re-enters carrying the error floor instead of restarting
+                # from zero (Metwally et al. SpaceSaving; vs plain
+                # frequent-items which undercounts re-entrants)
+                floor = self.error_bound if self.saturated else 0.0
+                self.counts[key] = float(cnt) + floor
+                if floor:
+                    self._floor[key] = floor
         if len(self.counts) > self.working_cap:
-            # frequent-items eviction (not refuse-admission): drop the
-            # smallest counts so a late-arriving frequent value still wins —
-            # the same bias profile as the reference's frequent-items sketch
-            # (CountAndFrequentItemsWritable)
             self.saturated = True
             kept = sorted(self.counts.items(), key=lambda kv: -kv[1])
+            for k, cnt in kept[self.working_cap:]:
+                observed = cnt - self._floor.pop(k, 0.0)
+                self.error_bound = max(self.error_bound, observed)
+                self.evicted_mass += observed
             self.counts = dict(kept[: self.working_cap])
 
     def distinct_count(self) -> int:
@@ -310,8 +332,9 @@ class CategoricalSketch:
             from shifu_tpu.utils.log import get_logger
 
             get_logger(__name__).warning(
-                "categorical sketch saturated at %d values; rare-tail counts "
-                "are approximate", self.working_cap,
+                "categorical sketch saturated at %d values; counts carry up "
+                "to +%.0f per-key overcount and %.0f total evicted mass",
+                self.working_cap, self.error_bound, self.evicted_mass,
             )
         items = sorted(self.counts.items(), key=lambda kv: -kv[1])
         cats = [k for k, _ in items]

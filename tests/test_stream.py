@@ -228,3 +228,160 @@ class TestBoundedMemoryPipeline:
         # far under the raw dataset size
         assert peak_total < budget + 16 * 1024 * 1024
         assert os.path.isfile(os.path.join(root, "models", "model0.nn"))
+
+
+class TestAdvisorFixes:
+    """Regression tests for the round-2 advisor findings (ADVICE.md)."""
+
+    def _columnar(self, arrays: dict):
+        from shifu_tpu.data.reader import ColumnarData
+
+        names = list(arrays)
+        raw = {k: np.array([f"{v:.6f}" for v in vals])
+               for k, vals in arrays.items()}
+        n = len(next(iter(arrays.values())))
+        return ColumnarData(names=names, raw=raw, n_rows=n)
+
+    def test_streaming_correlation_survives_large_means(self):
+        """|mean| >> std used to cancel catastrophically in the f32
+        un-centered moments, collapsing r to 0 (ADVICE high)."""
+        from shifu_tpu.config import ColumnConfig, ColumnType
+        from shifu_tpu.stats.correlation import (
+            StreamingCorrelation,
+            column_correlation,
+        )
+
+        rng = np.random.default_rng(3)
+        n = 4000
+        a = 1e5 + rng.normal(size=n)
+        b = 0.5 * (a - 1e5) + rng.normal(size=n)  # true r ~ 0.447
+        cols = [
+            ColumnConfig(column_num=i, column_name=nm,
+                         column_type=ColumnType.N)
+            for i, nm in enumerate(["a", "b"])
+        ]
+        whole = self._columnar({"a": a, "b": b})
+        exact, _ = column_correlation(whole, cols)
+
+        sc = StreamingCorrelation()
+        for start in range(0, n, 500):
+            sc.update(self._columnar(
+                {"a": a[start:start + 500], "b": b[start:start + 500]}), cols)
+        corr, names = sc.finalize()
+        assert names == ["a", "b"]
+        assert abs(corr[0, 1]) > 0.3  # not collapsed to zero
+        assert corr[0, 1] == pytest.approx(exact[0, 1], abs=0.01)
+
+    def test_header_filter_full_row_only_and_before_max_rows(self, tmp_path):
+        """A data row whose FIRST field equals the first column name must
+        survive; a full header row must not consume max_rows budget."""
+        from shifu_tpu.data.stream import iter_columnar_chunks
+
+        p = str(tmp_path / "d.csv")
+        names = ["a", "b"]
+        with open(p, "w") as fh:
+            fh.write("a|b\n")        # stray header (dropped, costs no budget)
+            fh.write("a|1\n")        # legit row: first field happens to be 'a'
+            fh.write("x|2\n")
+            fh.write("y|3\n")
+        chunks = list(iter_columnar_chunks(p, names, max_rows=3))
+        got = np.concatenate([c.column("a") for c in chunks])
+        assert list(got) == ["a", "x", "y"]
+
+    def test_categorical_sketch_space_saving_reentry(self):
+        """An evicted value that re-enters carries the error floor instead
+        of restarting from zero, and evicted mass is tracked."""
+        from shifu_tpu.stats.sketch import CategoricalSketch
+
+        sk = CategoricalSketch(working_cap=3)
+        no_miss = lambda n: np.zeros(n, dtype=bool)
+        sk.update(np.array(["a"] * 10 + ["b"] * 8 + ["c"] * 6 + ["d"] * 2),
+                  no_miss(26))
+        assert sk.saturated and sk.error_bound >= 2.0
+        assert sk.evicted_mass >= 2.0
+        # 'd' re-enters: admitted with +error_bound, never undercounted below
+        # its new observations
+        sk.update(np.array(["d"] * 5), no_miss(5))
+        assert sk.counts["d"] >= 5 + 2
+
+    def test_hll_bit_length_exact_at_power_of_two_boundaries(self):
+        """frexp-based bit length is exact where floor(log2) rounds up."""
+        from shifu_tpu.stats.sketch import DistinctSketch
+
+        sk = DistinctSketch(exact_limit=0)
+        sk.exact = None
+        # w = 2^40 - 1 has bit_length 40; naive floor(log2(float(w)))+1
+        # yields 41 because float64 rounds w up to exactly 2^40
+        h = np.array([((2**40 - 1) << 12) | 5], dtype=np.uint64)
+        sk.update_hashes(h)
+        # rho = (64-12) - 40 + 1 = 13
+        assert int(sk.registers[5]) == 13
+
+    def test_shuffle_shard_writer_global_permutation(self, tmp_path):
+        """External shuffle: all rows preserved, two lockstep writers stay
+        row-aligned, and a sorted input is decorrelated within shards."""
+        from shifu_tpu.norm.dataset import ShuffleShardWriter, load_normalized
+
+        n, k = 2000, 4
+        vals = np.arange(n, dtype=np.float32)[:, None]
+        tags = (np.arange(n) >= n // 2).astype(np.int8)  # label-sorted input
+        wts = np.arange(n, dtype=np.float32)
+        d1, d2 = str(tmp_path / "w1"), str(tmp_path / "w2")
+        w1 = ShuffleShardWriter(d1, "features", np.float32, ["v"], "ZSCALE",
+                                n_buckets=k, seed=11)
+        w2 = ShuffleShardWriter(d2, "features", np.float32, ["v"], "ZSCALE",
+                                n_buckets=k, seed=11)
+        for start in range(0, n, 300):
+            sl = slice(start, start + 300)
+            w1.add(vals[sl], tags[sl], wts[sl])
+            w2.add(vals[sl] * 10, tags[sl], wts[sl])
+        m1 = w1.close()
+        m2 = w2.close()
+        _, f1, t1, g1 = load_normalized(d1)
+        _, f2, t2, g2 = load_normalized(d2)
+        # every row present exactly once
+        assert sorted(np.asarray(f1)[:, 0].tolist()) == list(range(n))
+        # lockstep writers row-aligned
+        np.testing.assert_allclose(np.asarray(f2), np.asarray(f1) * 10)
+        np.testing.assert_array_equal(np.asarray(t2), np.asarray(t1))
+        # label-sorted input decorrelated: first-half of output not all-0
+        half = np.asarray(t1)[: n // 2]
+        assert 0.3 < half.mean() < 0.7
+        assert m1.shard_rows == m2.shard_rows and len(m1.shard_rows) == k
+
+    def test_streaming_norm_shuffle_is_permutation(self, tmp_path):
+        from shifu_tpu.norm.dataset import load_codes, load_normalized
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=1200)
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root).run() == 0
+        assert NormProcessor(root).run() == 0
+        _, f_plain, t_plain, _ = load_normalized(
+            os.path.join(root, "tmp", "norm", "NormalizedData"))
+        f_plain = np.asarray(f_plain).copy()
+        t_plain = np.asarray(t_plain).copy()
+
+        _set_props(**{"shifu.ingest.forceStreaming": "true",
+                      "shifu.ingest.chunkRows": "256"})
+        try:
+            assert NormProcessor(root, shuffle=True).run() == 0
+        finally:
+            _clear_props("shifu.ingest.forceStreaming",
+                         "shifu.ingest.chunkRows")
+        _, f_sh, t_sh, _ = load_normalized(
+            os.path.join(root, "tmp", "norm", "NormalizedData"))
+        _, c_sh, t_codes, _ = load_codes(
+            os.path.join(root, "tmp", "norm", "CleanedData"))
+        f_sh, t_sh = np.asarray(f_sh), np.asarray(t_sh)
+
+        # same multiset of rows, different order
+        key = lambda f, t: sorted(
+            map(tuple, np.column_stack([f.round(5), t]).tolist()))
+        assert key(f_sh, t_sh) == key(f_plain, t_plain)
+        assert not np.array_equal(f_sh, f_plain)
+        # features and codes artifacts row-aligned (same tag sequence)
+        np.testing.assert_array_equal(t_sh, np.asarray(t_codes))
