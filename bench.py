@@ -1,19 +1,32 @@
-"""Benchmark: NN training throughput vs a measured Encog-style CPU baseline.
+"""Benchmark: TPU training throughput vs a PINNED measured CPU baseline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
-The reference publishes no numbers (BASELINE.md), so the baseline is MEASURED
-here: the same full-batch MLP train step (fwd + backprop + RPROP update,
-double precision like Encog's FloatFlatNetwork path) implemented in numpy on
-one core — what one reference Hadoop worker does per iteration — scaled by
-the reference's nominal 100-worker cluster. vs_baseline > 1.0 means one TPU
-chip out-trains the modeled 100-node Hadoop deployment.
+The reference publishes no numbers (BASELINE.md), so the baseline is
+MEASURED: the same full-batch MLP train step (fwd + backprop, double
+precision like Encog's path) in single-core numpy — what one reference
+Hadoop worker does per iteration — scaled by the reference's nominal
+100-worker cluster. vs_baseline > 1.0 means one TPU chip out-trains the
+modeled 100-node Hadoop deployment.
+
+Round-2 verdict fixes:
+  * the baseline denominator is pinned in BASELINE_MEASURED.json (median of
+    10 reps, measured once and checked in) — a fresh 3-rep measurement per
+    run swung 3.5x and made vs_baseline meaningless. Re-measure explicitly
+    with `python bench.py --remeasure-baseline`.
+  * the TPU number is the median of N timed reps with the spread reported —
+    single-shot timings on the shared/tunneled chip swung ~30%.
+  * a compute-dense config (d=256, hidden 512/256) reports achieved GFLOP/s
+    alongside the bandwidth-bound headline config.
+  * the GBT histogram builder is benched too (row-trees/s).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
+import sys
 import time
 
 # single-core baseline: pin BLAS threads BEFORE numpy loads
@@ -24,76 +37,182 @@ os.environ.setdefault("MKL_NUM_THREADS", "1")
 import numpy as np
 
 N_REFERENCE_WORKERS = 100  # north-star cluster size (BASELINE.md)
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE_MEASURED.json")
+
+SMALL = dict(d=30, hidden=[50], n=1_000_000, epochs=50)
+DENSE = dict(d=256, hidden=[512, 256], n=250_000, epochs=20)
 
 
-def numpy_worker_row_epochs_per_s(d: int = 30, h: int = 50, n: int = 20_000) -> float:
-    """One Encog-worker-equivalent: full-batch fwd+backprop in float64."""
+def _mlp_flops_per_row_epoch(d: int, hidden: list) -> float:
+    """fwd+bwd ~= 3x the forward matmul cost; 2 flops per MAC."""
+    sizes = [d] + list(hidden) + [1]
+    macs = sum(a * b for a, b in zip(sizes[:-1], sizes[1:]))
+    return 6.0 * macs
+
+
+def numpy_worker_row_epochs_per_s(d: int, hidden: list, n: int = 20_000,
+                                  reps: int = 10) -> float:
+    """One Encog-worker-equivalent: full-batch fwd+backprop in float64.
+    Median of `reps` to damp scheduler noise."""
     rng = np.random.default_rng(0)
     x = rng.normal(size=(n, d))
     t = (rng.random(n) < 0.5).astype(np.float64)
-    w1 = rng.normal(size=(d, h)) * 0.1
-    b1 = np.zeros(h)
-    w2 = rng.normal(size=(h, 1)) * 0.1
-    b2 = np.zeros(1)
+    sizes = [d] + list(hidden) + [1]
+    ws = [rng.normal(size=(a, b)) * 0.1 for a, b in zip(sizes[:-1], sizes[1:])]
+    bs = [np.zeros(b) for b in sizes[1:]]
 
     def step():
-        z1 = x @ w1 + b1
-        a1 = np.tanh(z1)
-        z2 = a1 @ w2 + b2
-        p = 1.0 / (1.0 + np.exp(-z2[:, 0]))
-        delta2 = ((t - p) * p * (1 - p))[:, None]
-        g_w2 = a1.T @ delta2
-        delta1 = (delta2 @ w2.T) * (1 - a1 * a1)
-        g_w1 = x.T @ delta1
-        return g_w1.sum() + g_w2.sum()
+        hs = [x]
+        for w, b in zip(ws[:-1], bs[:-1]):
+            hs.append(np.tanh(hs[-1] @ w + b))
+        z = hs[-1] @ ws[-1] + bs[-1]
+        p = 1.0 / (1.0 + np.exp(-z[:, 0]))
+        delta = ((t - p) * p * (1 - p))[:, None]
+        acc = 0.0
+        for li in range(len(ws) - 1, -1, -1):
+            acc += (hs[li].T @ delta).sum()
+            if li:
+                delta = (delta @ ws[li].T) * (1 - hs[li] * hs[li])
+        return acc
 
     step()  # warm caches
-    reps, t0 = 3, time.perf_counter()
+    times = []
     for _ in range(reps):
+        t0 = time.perf_counter()
         step()
-    dt = (time.perf_counter() - t0) / reps
-    return n / dt
+        times.append(time.perf_counter() - t0)
+    return n / statistics.median(times)
 
 
-def main() -> None:
+def load_or_measure_baseline(remeasure: bool = False) -> dict:
+    configs = {"small": SMALL, "dense": DENSE}
+    if not remeasure:
+        if not os.path.isfile(BASELINE_FILE):
+            # re-measuring silently would reintroduce the unstable-denominator
+            # problem this file exists to fix
+            raise SystemExit(
+                f"{BASELINE_FILE} missing — it must be checked in; run "
+                "`python bench.py --remeasure-baseline` once to regenerate")
+        with open(BASELINE_FILE) as fh:
+            base = json.load(fh)
+        if base.get("configs") != configs:
+            raise SystemExit(
+                "BASELINE_MEASURED.json was measured for different bench "
+                "configs — rerun `python bench.py --remeasure-baseline`")
+        return base
+    base = {
+        "configs": configs,
+        "note": ("single-core f64 numpy MLP fwd+bwd row-epochs/s per "
+                 "reference worker; median of 10 reps; pinned so "
+                 "vs_baseline is stable across runs"),
+        "n_reference_workers": N_REFERENCE_WORKERS,
+        "small_row_epochs_per_s": round(
+            numpy_worker_row_epochs_per_s(SMALL["d"], SMALL["hidden"]), 1),
+        "dense_row_epochs_per_s": round(
+            numpy_worker_row_epochs_per_s(DENSE["d"], DENSE["hidden"],
+                                          n=5_000), 1),
+    }
+    with open(BASELINE_FILE, "w") as fh:
+        json.dump(base, fh, indent=2)
+    return base
+
+
+def _median_timed(fn, reps: int):
+    """Median wall-clock of reps calls (fn must block until done)."""
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times), min(times), max(times)
+
+
+def bench_nn(spec: dict, mixed_precision: bool, reps: int):
+    import jax
+
     from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
 
     rng = np.random.default_rng(0)
-    n, d = 1_000_000, 30
+    n, d = spec["n"], spec["d"]
     x = rng.normal(size=(n, d)).astype(np.float32)
     logits = x[:, 0] * 1.5 - x[:, 1] + 0.5 * x[:, 2] * x[:, 3]
     t = (logits + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
     w = np.ones(n, dtype=np.float32)
-
-    epochs = 50
     cfg = NNTrainConfig(
-        hidden_nodes=[50], activations=["tanh"], propagation="R",
-        num_epochs=epochs, valid_set_rate=0.1, seed=1, mixed_precision=True,
+        hidden_nodes=list(spec["hidden"]),
+        activations=["tanh"] * len(spec["hidden"]),
+        propagation="R", num_epochs=spec["epochs"], valid_set_rate=0.1,
+        seed=1, mixed_precision=mixed_precision,
     )
-
-    # resident dataset: upload once, train from HBM (the reference's workers
-    # likewise hold their shard in memory across iterations)
-    import jax
-
     x_dev = jax.device_put(x)
     t_dev = jax.device_put(t)
-
-    # warmup: compiles the program (epoch count is a traced arg, so the
-    # 2-epoch warmup warms the full run)
+    # warmup compiles the program (epoch count is traced, so 2 epochs warm
+    # the full run)
     warm = NNTrainConfig(**{**cfg.__dict__, "num_epochs": 2})
     train_nn(x_dev, t_dev, w, warm)
+    med, lo, hi = _median_timed(lambda: train_nn(x_dev, t_dev, w, cfg), reps)
+    row_epochs = n * spec["epochs"]
+    return {
+        "row_epochs_per_s": row_epochs / med,
+        "spread": [round(row_epochs / hi, 1), round(row_epochs / lo, 1)],
+        "gflops": row_epochs * _mlp_flops_per_row_epoch(d, spec["hidden"])
+        / med / 1e9,
+    }
 
-    t0 = time.perf_counter()
-    res = train_nn(x_dev, t_dev, w, cfg)
-    dt = time.perf_counter() - t0
 
-    throughput = n * res.iterations / dt
-    baseline = numpy_worker_row_epochs_per_s(d=d) * N_REFERENCE_WORKERS
+def bench_gbt(reps: int):
+    from shifu_tpu.train.tree_trainer import TreeTrainConfig, train_trees
+
+    rng = np.random.default_rng(0)
+    n, F, bins, trees = 1_000_000, 50, 32, 8
+    codes = rng.integers(0, bins, size=(n, F)).astype(np.int16)
+    y = (codes[:, 0] + codes[:, 1] + rng.integers(0, bins, size=n)
+         > 1.5 * bins).astype(np.int8)
+    w = np.ones(n, dtype=np.float32)
+    slots = [bins + 1] * F
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=trees, max_depth=6,
+                          learning_rate=0.1, valid_set_rate=0.1, seed=3)
+    cols = [f"f{i}" for i in range(F)]
+
+    def run():
+        train_trees(codes, y, w, slots, [False] * F, cols, cfg)
+
+    run()  # warmup/compile
+    med, lo, hi = _median_timed(run, reps)
+    return {
+        "row_trees_per_s": n * trees / med,
+        "spread": [round(n * trees / hi, 1), round(n * trees / lo, 1)],
+    }
+
+
+def main() -> None:
+    remeasure = "--remeasure-baseline" in sys.argv
+    base = load_or_measure_baseline(remeasure)
+
+    small = bench_nn(SMALL, mixed_precision=True, reps=5)
+    dense = bench_nn(DENSE, mixed_precision=True, reps=3)
+    gbt = bench_gbt(reps=3)
+
+    denom = base["small_row_epochs_per_s"] * base["n_reference_workers"]
+    dense_denom = base["dense_row_epochs_per_s"] * base["n_reference_workers"]
     print(json.dumps({
         "metric": "nn_train_row_epochs_per_s",
-        "value": round(throughput, 1),
+        "value": round(small["row_epochs_per_s"], 1),
         "unit": "row-epochs/s",
-        "vs_baseline": round(throughput / baseline, 4),
+        "vs_baseline": round(small["row_epochs_per_s"] / denom, 4),
+        "spread": small["spread"],
+        "baseline_pinned": True,
+        "dense": {
+            "row_epochs_per_s": round(dense["row_epochs_per_s"], 1),
+            "achieved_gflops": round(dense["gflops"], 1),
+            "vs_baseline": round(dense["row_epochs_per_s"] / dense_denom, 4),
+            "spread": dense["spread"],
+        },
+        "gbt": {
+            "row_trees_per_s": round(gbt["row_trees_per_s"], 1),
+            "spread": gbt["spread"],
+        },
     }))
 
 

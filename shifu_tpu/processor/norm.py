@@ -138,11 +138,7 @@ class NormProcessor(BasicProcessor):
         (ShuffleShardWriter) produces a true uniform global permutation —
         the MR shuffle's contract (core/shuffle/MapReduceShuffle.java:47) —
         with peak memory of one bucket."""
-        from shifu_tpu.data.stream import (
-            chunk_source,
-            dataset_size_bytes,
-            memory_budget_bytes,
-        )
+        from shifu_tpu.data.stream import chunk_source, memory_budget_bytes
         from shifu_tpu.norm.dataset import ShardWriter, ShuffleShardWriter
         from shifu_tpu.stats.engine import _prepare_rows
 
@@ -156,12 +152,13 @@ class NormProcessor(BasicProcessor):
         if self.shuffle:
             # bucket count so one bucket fits ~1/4 of the memory budget;
             # gz-compressed text typically expands ~4x when materialized
+            import os as _os
+
             from shifu_tpu.data.reader import _expand_paths
 
-            raw_bytes = dataset_size_bytes(self.resolve(ds.data_path))
-            if any(p.endswith(".gz")
-                   for p in _expand_paths(self.resolve(ds.data_path))):
-                raw_bytes *= 4
+            raw_bytes = sum(
+                _os.path.getsize(p) * (4 if p.endswith(".gz") else 1)
+                for p in _expand_paths(self.resolve(ds.data_path)))
             n_buckets = max(
                 default_shards(),
                 int(np.ceil(raw_bytes / max(memory_budget_bytes() // 4, 1))),
