@@ -94,6 +94,15 @@ def build_parser() -> argparse.ArgumentParser:
     p_convert = sub.add_parser("convert", help="convert model spec formats")
     p_convert.add_argument("-tozip", action="store_true")
     p_convert.add_argument("-tobin", action="store_true")
+    p_convert.add_argument("-toref", action="store_true",
+                           help="export to the reference's binary spec "
+                                "(EGB .nn / BinaryDTSerializer .gbt/.rf)")
+    p_convert.add_argument("-toeg", action="store_true",
+                           help="export an NN model to Encog EG text")
+    p_convert.add_argument("-tozipref", action="store_true",
+                           help="export a tree model to the reference zip spec")
+    p_convert.add_argument("-fromref", action="store_true",
+                           help="import a reference spec into a native spec")
     p_convert.add_argument("input", nargs="?")
     p_convert.add_argument("output", nargs="?")
 

@@ -36,8 +36,18 @@ def find_model_paths(models_dir: str) -> List[str]:
     return sorted(out)
 
 
-def load_model(path: str):
-    """Dispatch on extension to the right independent model class."""
+def load_model(path: str, column_configs=None, model_config=None):
+    """Dispatch on extension to the right independent model class.
+
+    Reference-format files (Encog EG text, BinaryNNSerializer gzip,
+    BinaryDTSerializer binary, zip spec) are sniffed by magic bytes and
+    wrapped in a RefModelAdapter — ModelSpecLoaderUtils.java:389 parity:
+    one models/ dir can mix native and reference specs."""
+    from shifu_tpu.compat.adapters import load_ref_model
+
+    adapter = load_ref_model(path, column_configs, model_config)
+    if adapter is not None:
+        return adapter
     suffix = os.path.splitext(path)[1]
     if suffix in (".nn", ".lr"):
         from shifu_tpu.models.nn import NNModelSpec
@@ -67,11 +77,13 @@ class ScoreResult:
 
 
 class ModelRunner:
-    def __init__(self, model_paths: List[str], scale: float = DEFAULT_SCORE_SCALE):
+    def __init__(self, model_paths: List[str], scale: float = DEFAULT_SCORE_SCALE,
+                 column_configs=None, model_config=None):
         if not model_paths:
             raise ValueError("no models to score with")
         self.paths = model_paths
-        self.specs = [load_model(p) for p in model_paths]
+        self.specs = [load_model(p, column_configs, model_config)
+                      for p in model_paths]
         # independent scorers are created once so their jitted forwards cache
         self.models = [self._independent(spec) for spec in self.specs]
         self.scale = scale
@@ -89,8 +101,11 @@ class ModelRunner:
 
     @staticmethod
     def _independent(spec):
+        from shifu_tpu.compat.adapters import RefModelAdapter
         from shifu_tpu.models.nn import IndependentNNModel, NNModelSpec
 
+        if isinstance(spec, RefModelAdapter):
+            return spec
         if isinstance(spec, NNModelSpec):
             return IndependentNNModel(spec)
         return spec.independent()
@@ -148,13 +163,16 @@ class ModelRunner:
         """Score raw records. NN/LR/WDL models normalize via their embedded
         plan; tree models bin via their embedded boundaries/categories
         (EvalScoreUDF loads models once, then scores row batches)."""
+        from shifu_tpu.compat.adapters import RefModelAdapter
         from shifu_tpu.models.tree import TreeModelSpec
         from shifu_tpu.models.wdl import WDLModelSpec
 
         self._check_batch(data)
         cols = []
         for spec, model in zip(self.specs, self.models):
-            if isinstance(spec, TreeModelSpec):
+            if isinstance(spec, RefModelAdapter):
+                cols.append(spec.score_raw(data) * self.scale)
+            elif isinstance(spec, TreeModelSpec):
                 codes = self._tree_codes(spec, model, data)
                 cols.append(model.compute(codes) * self.scale)
             elif isinstance(spec, WDLModelSpec):
@@ -167,7 +185,13 @@ class ModelRunner:
         return self._aggregate(cols)
 
     def score_normalized(self, feats: np.ndarray) -> ScoreResult:
-        cols = [m.compute(feats) * self.scale for m in self.models]
+        from shifu_tpu.compat.adapters import RefModelAdapter
+
+        cols = [
+            (m.score_normalized(feats) if isinstance(m, RefModelAdapter)
+             else m.compute(feats)) * self.scale
+            for m in self.models
+        ]
         return self._aggregate(cols)
 
     def _aggregate(self, cols: List[np.ndarray]) -> ScoreResult:

@@ -24,25 +24,101 @@ class ConvertProcessor(BasicProcessor):
     step = "convert"
 
     def __init__(self, root: str = ".", to_json: bool = True,
-                 input_path: str = None, output_path: str = None):
+                 input_path: str = None, output_path: str = None,
+                 mode: str = None):
         super().__init__(root)
         self.to_json = to_json
         self.input_path = input_path
         self.output_path = output_path
+        self.mode = mode  # toref | toeg | tozipref | fromref | None
 
     @classmethod
     def from_args(cls, args) -> "ConvertProcessor":
+        mode = None
+        for flag in ("toref", "toeg", "tozipref", "fromref"):
+            if getattr(args, flag, False):
+                mode = flag
+                break
         return cls(to_json=not args.tobin, input_path=args.input,
-                   output_path=args.output)
+                   output_path=args.output, mode=mode)
 
     def run_step(self) -> None:
         if not self.input_path:
             raise ShifuError(ErrorCode.INVALID_MODEL_CONFIG,
                              "convert needs an input model path")
-        if self.to_json:
+        if self.mode == "toref":
+            self._to_reference(fmt="binary")
+        elif self.mode == "toeg":
+            self._to_reference(fmt="eg")
+        elif self.mode == "tozipref":
+            self._to_reference(fmt="zip")
+        elif self.mode == "fromref":
+            self._from_reference()
+        elif self.to_json:
             self._to_json()
         else:
             self._to_binary()
+
+    def _to_reference(self, fmt: str) -> None:
+        """Export a native spec into the reference's model formats
+        (BinaryNNSerializer.java:46 / BinaryDTSerializer.java:62 /
+        IndependentTreeModelUtils.java:40 zip)."""
+        from shifu_tpu.compat.adapters import (
+            nn_spec_to_eg_bytes,
+            nn_spec_to_egb_bytes,
+            tree_spec_to_ref_bytes,
+            tree_spec_to_zip_bytes,
+        )
+        from shifu_tpu.eval.scorer import load_model
+        from shifu_tpu.models.nn import NNModelSpec
+        from shifu_tpu.models.tree import TreeModelSpec
+
+        spec = load_model(self.input_path)
+        suffix = os.path.splitext(self.input_path)[1]
+        if isinstance(spec, NNModelSpec):
+            if fmt == "eg":
+                blob = nn_spec_to_eg_bytes(spec)
+            else:
+                # EGB container needs the project ColumnConfig stats
+                try:
+                    self.setup()
+                except Exception:
+                    raise ShifuError(
+                        ErrorCode.INVALID_COLUMN_CONFIG,
+                        "-toref for NN needs ModelConfig/ColumnConfig in cwd "
+                        "(use -toeg for a standalone Encog text export)",
+                    )
+                blob = nn_spec_to_egb_bytes(
+                    spec, self.column_configs,
+                    cutoff=self.model_config.normalize.std_dev_cut_off or 4.0,
+                )
+            out = self.output_path or self.input_path + ".ref.nn"
+        elif isinstance(spec, TreeModelSpec):
+            if fmt == "zip":
+                blob = tree_spec_to_zip_bytes(spec)
+                out = self.output_path or self.input_path + ".zip"
+            else:
+                blob = tree_spec_to_ref_bytes(spec)
+                out = self.output_path or self.input_path + f".ref{suffix}"
+        else:
+            raise ShifuError(ErrorCode.MODEL_NOT_FOUND,
+                             f"cannot export {self.input_path} to reference format")
+        with open(out, "wb") as fh:
+            fh.write(blob)
+        log.info("exported %s -> %s (reference %s format)",
+                 self.input_path, out, fmt)
+
+    def _from_reference(self) -> None:
+        """Report on a reference spec; reference models score directly via
+        `shifu eval` (scorer sniffs formats), so import just validates."""
+        from shifu_tpu.compat.adapters import load_ref_model
+
+        adapter = load_ref_model(self.input_path)
+        if adapter is None:
+            raise ShifuError(ErrorCode.MODEL_NOT_FOUND,
+                             f"{self.input_path} is not a reference-format spec")
+        log.info("loaded reference spec %s: kind=%s algorithm=%s",
+                 self.input_path, adapter.kind, adapter.algorithm)
 
     def _to_json(self) -> None:
         from shifu_tpu.eval.scorer import load_model

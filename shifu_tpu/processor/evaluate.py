@@ -161,7 +161,8 @@ class EvalProcessor(BasicProcessor):
             raise ShifuError(ErrorCode.MODEL_NOT_FOUND,
                              f"no models under {self.paths.models_dir()}")
         data, tags, weights = self._load_eval_data(ec)
-        runner = ModelRunner(paths)
+        runner = ModelRunner(paths, column_configs=self.column_configs,
+                              model_config=self.model_config)
         result = runner.score_raw(data)
         out = self.paths.eval_score_path(ec.name)
         self.paths.ensure(os.path.dirname(out))

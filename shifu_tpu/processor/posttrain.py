@@ -41,7 +41,8 @@ class PostTrainProcessor(BasicProcessor):
         cmeta, codes, tags, weights = load_codes(codes_dir)
         _, feats, _, _ = load_normalized(norm_dir)
         codes = np.asarray(codes)
-        runner = ModelRunner(model_paths)
+        runner = ModelRunner(model_paths, column_configs=self.column_configs,
+                              model_config=self.model_config)
         from shifu_tpu.models.tree import TreeModelSpec
 
         if all(isinstance(s, TreeModelSpec) for s in runner.specs):
