@@ -106,7 +106,8 @@ class MultipleClassification(JsonEnum):
 
     NATIVE = "NATIVE"
     ONEVSALL = "ONEVSALL"
-    ONEVSONE = "ONEVSONE"
+    ONEVSREST = "ONEVSREST"  # alias of ONEVSALL in the reference
+    ONEVSONE = "ONEVSONE"  # not implemented upstream either
 
 
 class MissingValueFillType(JsonEnum):
@@ -223,10 +224,17 @@ class ModelTrainConf:
     convergence_judger: str = "error"
     algorithm: Algorithm = Algorithm.NN
     multi_classify_method: MultipleClassification = MultipleClassification.NATIVE
-    is_one_vs_all: bool = False
     params: Dict[str, Any] = field(default_factory=dict)
     grid_config_file: Optional[str] = None
     custom_paths: Optional[Dict[str, str]] = field(default_factory=dict)
+
+    def is_one_vs_all(self) -> bool:
+        """ModelTrainConf.isOneVsAll: ONEVSALL and ONEVSREST both mean
+        per-class binary models (ModelTrainConf.java:54)."""
+        return self.multi_classify_method in (
+            MultipleClassification.ONEVSALL,
+            MultipleClassification.ONEVSREST,
+        )
 
     def get_param(self, key: str, default: Any = None) -> Any:
         """Params map is case-sensitive in the reference, but user configs vary;

@@ -253,6 +253,32 @@ def make_tags(
     return out
 
 
+def make_class_tags(target_col: np.ndarray, tags: Sequence[str]) -> np.ndarray:
+    """Multi-class: map raw target values to their index in the flattened tag
+    list (posTags + negTags, one of which is empty in classification mode —
+    ModelConfig.getFlattenTags / getSetTags). -1 = invalid, filtered out."""
+    import pandas as pd
+
+    ser = pd.Series(target_col).str.strip()
+    out = np.full(len(target_col), -1, dtype=np.int32)
+    for i, tag in enumerate(tags):
+        out[(ser == str(tag).strip()).to_numpy()] = i
+    return out
+
+
+def make_tags_for(mc, target_col: np.ndarray,
+                  pos: Optional[Sequence[str]] = None,
+                  neg: Optional[Sequence[str]] = None) -> np.ndarray:
+    """Dispatch on the ModelConfig's classification mode: regression (binary
+    pos+neg) -> {1,0,-1}; multi-class classification -> class index 0..K-1."""
+    pos = mc.data_set.pos_tags if pos is None else pos
+    neg = mc.data_set.neg_tags if neg is None else neg
+    all_tags = list(pos or []) + list(neg or [])
+    if bool(pos) != bool(neg) and len(all_tags) > 2:
+        return make_class_tags(target_col, all_tags)
+    return make_tags(target_col, pos or [], neg or [])
+
+
 def make_weights(
     data: ColumnarData, weight_column: Optional[str]
 ) -> np.ndarray:

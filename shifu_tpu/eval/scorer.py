@@ -28,12 +28,21 @@ MODEL_SUFFIXES = (".nn", ".lr", ".gbt", ".rf", ".wdl")
 
 
 def find_model_paths(models_dir: str) -> List[str]:
-    """models/model*.{nn,lr,gbt,rf,wdl} sorted by index
-    (ModelSpecLoaderUtils.findModels)."""
+    """models/model*.{nn,lr,gbt,rf,wdl} sorted by NUMERIC index
+    (ModelSpecLoaderUtils.findModels). Numeric, not lexicographic: under
+    ONEVSALL the column order is load-bearing (column k = class k), and
+    lexicographic order would put model10 before model2."""
+    import re
+
     out = []
     for suf in MODEL_SUFFIXES:
         out.extend(glob.glob(os.path.join(models_dir, f"model*{suf}")))
-    return sorted(out)
+
+    def key(p: str):
+        m = re.search(r"model(\d+)", os.path.basename(p))
+        return (int(m.group(1)) if m else 1 << 30, os.path.basename(p))
+
+    return sorted(out, key=key)
 
 
 def load_model(path: str, column_configs=None, model_config=None):
@@ -66,14 +75,19 @@ def load_model(path: str, column_configs=None, model_config=None):
 
 @dataclass
 class ScoreResult:
-    """Per-record scores: raw per-model + aggregates, 0..scale."""
+    """Per-record scores: raw per-model + aggregates, 0..scale.
 
-    model_scores: np.ndarray  # [n, n_models]
+    Multi-class NATIVE models contribute one column PER CLASS, model-major
+    ("1,2,3 4,5,6: 1,2,3 is model 0" — ConfusionMatrix.java:760);
+    `model_widths[i]` is model i's column count (1 for binary/ONEVSALL)."""
+
+    model_scores: np.ndarray  # [n, sum(model_widths)]
     mean: np.ndarray
     max: np.ndarray
     min: np.ndarray
     median: np.ndarray
     model_names: List[str] = field(default_factory=list)
+    model_widths: List[int] = field(default_factory=list)
 
 
 class ModelRunner:
@@ -181,21 +195,33 @@ class ModelRunner:
                 cols.append(model.compute_parts(dense, wcodes) * self.scale)
             else:
                 x = self._normalized_input(spec, data)
-                cols.append(model.compute(x) * self.scale)
+                cols.append(self._nn_scores(spec, model, x))
         return self._aggregate(cols)
+
+    def _nn_scores(self, spec, model, x: np.ndarray) -> np.ndarray:
+        """Binary model -> [n]; NATIVE multi-class -> [n, K] per-class."""
+        if getattr(spec, "out_dim", 1) > 1:
+            return model.compute_all(x) * self.scale
+        return model.compute(x) * self.scale
 
     def score_normalized(self, feats: np.ndarray) -> ScoreResult:
         from shifu_tpu.compat.adapters import RefModelAdapter
+        from shifu_tpu.models.nn import NNModelSpec
 
-        cols = [
-            (m.score_normalized(feats) if isinstance(m, RefModelAdapter)
-             else m.compute(feats)) * self.scale
-            for m in self.models
-        ]
+        cols = []
+        for spec, m in zip(self.specs, self.models):
+            if isinstance(m, RefModelAdapter):
+                cols.append(m.score_normalized(feats) * self.scale)
+            elif isinstance(spec, NNModelSpec):
+                cols.append(self._nn_scores(spec, m, feats))
+            else:
+                cols.append(m.compute(feats) * self.scale)
         return self._aggregate(cols)
 
     def _aggregate(self, cols: List[np.ndarray]) -> ScoreResult:
-        m = np.stack(cols, axis=1)
+        mats = [c[:, None] if c.ndim == 1 else c for c in cols]
+        m = np.concatenate(mats, axis=1)
+        widths = [mat.shape[1] for mat in mats]
         return ScoreResult(
             model_scores=m,
             mean=m.mean(axis=1),
@@ -203,4 +229,5 @@ class ModelRunner:
             min=m.min(axis=1),
             median=np.median(m, axis=1),
             model_names=[os.path.basename(p) for p in self.paths],
+            model_widths=widths,
         )

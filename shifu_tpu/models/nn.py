@@ -142,6 +142,13 @@ class NNModelSpec:
     params: Optional[List[Dict[str, np.ndarray]]] = None
     train_error: Optional[float] = None
     valid_error: Optional[float] = None
+    # multi-class: the ordered tag list (flattened posTags+negTags); output k
+    # scores class_tags[k]. Empty = binary regression model.
+    class_tags: List[str] = field(default_factory=list)
+
+    @property
+    def out_dim(self) -> int:
+        return int(self.layer_sizes[-1]) if self.layer_sizes else 1
 
     def header(self) -> dict:
         return {
@@ -157,6 +164,7 @@ class NNModelSpec:
             "normCutoff": self.norm_cutoff,
             "trainError": self.train_error,
             "validError": self.valid_error,
+            "classTags": self.class_tags,
         }
 
     def save(self, path: str) -> None:
@@ -193,6 +201,7 @@ class NNModelSpec:
             norm_cutoff=float(head.get("normCutoff", 4.0)),
             train_error=head.get("trainError"),
             valid_error=head.get("validError"),
+            class_tags=head.get("classTags", []),
         )
         spec.params = unflatten_params(flat.copy(), shapes)
         return spec
@@ -213,6 +222,13 @@ class IndependentNNModel:
 
     def compute(self, x: np.ndarray) -> np.ndarray:
         """x: [n, n_in] normalized features -> [n] score (first output)."""
+        out = self.compute_all(x)
+        return out[:, 0] if out.ndim == 2 else out
+
+    def compute_all(self, x: np.ndarray) -> np.ndarray:
+        """All output neurons: [n, n_out] — multi-class NATIVE models emit
+        one score per class (IndependentNNModel.compute returns the full
+        output vector in the reference too)."""
         h = np.asarray(x, dtype=np.float32)
         if self._fwd is None:
             import jax
@@ -223,5 +239,4 @@ class IndependentNNModel:
                     self.spec.out_activation,
                 )
             )
-        out = np.asarray(self._fwd(h))
-        return out[:, 0] if out.ndim == 2 else out
+        return np.asarray(self._fwd(h))

@@ -18,7 +18,7 @@ import numpy as np
 from shifu_tpu.config.model_config import EvalConfig, RawSourceData
 from shifu_tpu.data.purify import combined_mask
 from shifu_tpu.data.reader import (
-    make_tags,
+    make_tags_for,
     make_weights,
     read_columnar,
     read_header,
@@ -147,10 +147,34 @@ class EvalProcessor(BasicProcessor):
         pos = ec.pos_tags if ec.pos_tags is not None else mc.data_set.pos_tags
         neg = ec.neg_tags if ec.neg_tags is not None else mc.data_set.neg_tags
         target = mc.data_set.target_column_name
-        tags = make_tags(data.column(target), pos, neg)
+        tags = make_tags_for(mc, data.column(target), pos, neg)
         weights = make_weights(data, ds.weight_column_name
                                or mc.data_set.weight_column_name)
         return data, tags, weights
+
+    def _score_meta_columns(self, ec: EvalConfig, data) -> List[tuple]:
+        """(name, raw values) pairs for evalConfig.scoreMetaColumns — the
+        reference echoes these raw columns into the score output
+        (EvalScoreUDF meta column pass-through; EvalConfig.java
+        scoreMetaColumnNameFile)."""
+        path = ec.score_meta_column_name_file
+        if not path:
+            return []
+        full = self.resolve(path)
+        if not os.path.isfile(full):
+            log.warning("scoreMetaColumns file %s not found; skipping", full)
+            return []
+        with open(full) as fh:
+            names = [ln.strip() for ln in fh if ln.strip()
+                     and not ln.strip().startswith("#")]
+        out = []
+        for name in names:
+            if name in data.raw:
+                out.append((name, data.column(name)))
+            else:
+                log.warning("scoreMetaColumns: column %s not in eval data",
+                            name)
+        return out
 
     # ---- steps ----
     def _score(self, ec: EvalConfig) -> None:
@@ -164,20 +188,30 @@ class EvalProcessor(BasicProcessor):
         runner = ModelRunner(paths, column_configs=self.column_configs,
                               model_config=self.model_config)
         result = runner.score_raw(data)
+        meta_cols = self._score_meta_columns(ec, data)
         out = self.paths.eval_score_path(ec.name)
         self.paths.ensure(os.path.dirname(out))
         sep = "|"
+        score_names: List[str] = []
+        for i, w in enumerate(result.model_widths
+                              or [1] * result.model_scores.shape[1]):
+            if w == 1:
+                score_names.append(f"model{i}")
+            else:  # NATIVE multi-class: one column per class, model-major
+                score_names.extend(f"model{i}_{k}" for k in range(w))
         with open(out, "w") as fh:
-            header = ["tag", "weight", "mean", "max", "min", "median"] + [
-                f"model{i}" for i in range(result.model_scores.shape[1])
-            ]
+            header = (["tag", "weight", "mean", "max", "min", "median"]
+                      + score_names + [name for name, _ in meta_cols])
             fh.write(sep.join(header) + "\n")
             for i in range(result.model_scores.shape[0]):
                 row = [
                     str(int(tags[i])), f"{weights[i]:g}",
                     f"{result.mean[i]:.3f}", f"{result.max[i]:.3f}",
                     f"{result.min[i]:.3f}", f"{result.median[i]:.3f}",
-                ] + [f"{s:.3f}" for s in result.model_scores[i]]
+                ] + [f"{s:.3f}" for s in result.model_scores[i]] + [
+                    # raw meta values must not smuggle the field separator
+                    str(vals[i]).replace(sep, " ") for _, vals in meta_cols
+                ]
                 fh.write(sep.join(row) + "\n")
         n_pos = int((tags == 1).sum())
         n_neg = int((tags == 0).sum())
@@ -202,6 +236,9 @@ class EvalProcessor(BasicProcessor):
         )
 
         mc = self.model_config
+        if mc.is_multi_classification():
+            self._multiclass_confusion(ec)
+            return
         df = self._read_scores(ec)
         valid = df["tag"] >= 0
         df = df[valid]
@@ -237,6 +274,80 @@ class EvalProcessor(BasicProcessor):
             ec.name, perf.area_under_roc, perf.weighted_area_under_roc,
             perf_path, self.paths.gain_chart_path(ec.name),
         )
+
+    def _multiclass_confusion(self, ec: EvalConfig) -> None:
+        """Multi-class eval: K x K confusion matrix + accuracy
+        (ConfusionMatrix.computeConfusionMatixForMultipleClassification:625,
+        prediction semantics in eval/multiclass.py). Replaces the binary
+        PR/ROC/gain path, as runConfusionMatrix does in the reference."""
+        from shifu_tpu.eval.multiclass import (
+            class_priors,
+            confusion_matrix_multi,
+            confusion_matrix_text,
+            multiclass_accuracy,
+            predict_native,
+            predict_one_vs_all,
+        )
+        from shifu_tpu.eval.scorer import DEFAULT_SCORE_SCALE
+
+        import re
+
+        mc = self.model_config
+        # class list must match the tag indices _load_eval_data produced —
+        # EvalConfig-level pos/neg overrides included
+        pos = ec.pos_tags if ec.pos_tags is not None else mc.data_set.pos_tags
+        neg = ec.neg_tags if ec.neg_tags is not None else mc.data_set.neg_tags
+        class_tags = [str(t) for t in list(pos or []) + list(neg or [])]
+        K = len(class_tags)
+        df = self._read_scores(ec)
+        df = df[df["tag"] >= 0]
+        # exact score-column names only — a scoreMetaColumns echo that
+        # happens to start with "model" must not leak into the matrix
+        score_re = re.compile(r"^model\d+(_\d+)?$")
+        score_cols = [c for c in df.columns if score_re.match(str(c))]
+        scores = df[score_cols].to_numpy(dtype=np.float64)
+        tags = df["tag"].to_numpy(dtype=np.int64)
+
+        priors = self._training_class_priors(K)
+        if priors is None:
+            priors = class_priors(tags, K)
+        if mc.train.is_one_vs_all():
+            pred = predict_one_vs_all(scores, priors,
+                                      scale=DEFAULT_SCORE_SCALE)
+        else:
+            pred = predict_native(scores, K)
+        matrix = confusion_matrix_multi(tags, pred, K)
+        cm_path = self.paths.eval_confusion_path(ec.name)
+        self.paths.ensure(os.path.dirname(cm_path))
+        with open(cm_path, "w") as fh:
+            fh.write(confusion_matrix_text(matrix, class_tags))
+        acc = multiclass_accuracy(matrix)
+        perf_path = self.paths.eval_performance_path(ec.name)
+        with open(perf_path, "w") as fh:
+            json.dump({
+                "version": "1.0",
+                "classes": class_tags,
+                "confusionMatrix": matrix.tolist(),
+                "accuracy": acc,
+                "classPriors": list(np.asarray(priors, float)),
+            }, fh, indent=2)
+        log.info("eval %s multi-class (%d classes): accuracy %.4f; "
+                 "confusion -> %s", ec.name, K, acc, cm_path)
+
+    def _training_class_priors(self, n_classes: int):
+        """Training-set class ratios recorded by `shifu norm` in meta.json
+        (binRatio source — the reference reads per-class binCountPos/Neg
+        from the target ColumnConfig)."""
+        from shifu_tpu.norm.dataset import read_meta
+
+        try:
+            meta = read_meta(self.paths.normalized_data_dir())
+        except Exception:
+            return None
+        priors = (meta.extra or {}).get("classPriors")
+        if priors and len(priors) == n_classes:
+            return np.asarray(priors, np.float64)
+        return None
 
     def _norm(self, ec: EvalConfig) -> None:
         """eval -norm: write the normalized eval matrix

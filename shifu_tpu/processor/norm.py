@@ -16,7 +16,12 @@ from __future__ import annotations
 import numpy as np
 
 from shifu_tpu.data.purify import combined_mask
-from shifu_tpu.data.reader import make_tags, make_weights, read_columnar, read_header
+from shifu_tpu.data.reader import (
+    make_tags_for,
+    make_weights,
+    read_columnar,
+    read_header,
+)
 from shifu_tpu.norm.dataset import write_codes, write_normalized
 from shifu_tpu.norm.normalizer import (
     _slots,
@@ -75,7 +80,7 @@ class NormProcessor(BasicProcessor):
         # purify + invalid-tag drop + norm sampling (NormalizeUDF filters rows
         # through DataPurifier and sampler before emitting)
         mask = combined_mask(ds.filter_expressions, data.raw, data.n_rows)
-        tags_all = make_tags(data.column(ds.target_column_name), ds.pos_tags, ds.neg_tags)
+        tags_all = make_tags_for(mc, data.column(ds.target_column_name))
         mask &= tags_all >= 0
         if mc.normalize.sample_rate < 1.0:
             rng = np.random.default_rng(self.seed)
@@ -101,6 +106,8 @@ class NormProcessor(BasicProcessor):
         # persist the output-name -> source-column mapping so later steps
         # (SE/ST varsel under one-hot expansion) don't have to reconstruct
         # the plan against possibly-changed ColumnConfigs
+        extra = {"sourceOf": plan.source_of}
+        self._add_class_meta(extra, tags)
         write_normalized(
             out_dir,
             feats,
@@ -109,7 +116,7 @@ class NormProcessor(BasicProcessor):
             plan.out_names,
             norm_type=mc.normalize.norm_type.value,
             n_shards=n_shards,
-            extra={"sourceOf": plan.source_of},
+            extra=extra,
         )
         log.info(
             "normalized %d rows x %d cols (%s) -> %s [%d shards]",
@@ -130,6 +137,22 @@ class NormProcessor(BasicProcessor):
             n_shards=n_shards,
         )
         log.info("bin codes -> %s", self.paths.cleaned_data_dir())
+
+    def _add_class_meta(self, extra: dict, tags: np.ndarray) -> None:
+        """Multi-class: record the tag list + training class priors in
+        meta.json — the eval confusion matrix's binRatio source (the
+        reference reads binCountPos/Neg per class from the target
+        ColumnConfig, ConfusionMatrix.java:645-653)."""
+        mc = self.model_config
+        if not mc.is_multi_classification():
+            return
+        from shifu_tpu.eval.multiclass import class_priors
+
+        class_tags = [str(t) for t in mc.tags()]
+        extra["classTags"] = class_tags
+        extra["classPriors"] = class_priors(
+            np.asarray(tags), len(class_tags)
+        ).tolist()
 
     def _run_streaming(self, names) -> None:
         """Bounded-memory norm: one chunked pass writes BOTH artifacts
@@ -192,6 +215,7 @@ class NormProcessor(BasicProcessor):
             missing_values=tuple(ds.missing_or_invalid_values),
         )
         n_rows = 0
+        all_tag_counts: dict = {}
         for ci, chunk in enumerate(factory()):
             chunk, tags, weights = _prepare_rows(
                 mc, chunk, [self.seed, ci], mc.normalize.sample_rate,
@@ -205,6 +229,15 @@ class NormProcessor(BasicProcessor):
             codes = bin_code_matrix(tree_cols, chunk, cache=code_cache)
             code_writer.add(codes, tags, weights)
             n_rows += chunk.n_rows
+            for t, c in zip(*np.unique(tags, return_counts=True)):
+                all_tag_counts[int(t)] = all_tag_counts.get(int(t), 0) + int(c)
+        if mc.is_multi_classification() and feat_writer.extra is not None:
+            class_tags = [str(t) for t in mc.tags()]
+            total = max(sum(all_tag_counts.values()), 1)
+            feat_writer.extra["classTags"] = class_tags
+            feat_writer.extra["classPriors"] = [
+                all_tag_counts.get(k, 0) / total for k in range(len(class_tags))
+            ]
         feat_meta = feat_writer.close()
         code_writer.close()
         log.info(

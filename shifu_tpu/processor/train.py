@@ -92,6 +92,11 @@ class TrainProcessor(BasicProcessor):
         self.paths.ensure(self.paths.models_dir())
         self.paths.ensure(self.paths.train_dir())
 
+        if mc.is_multi_classification() and mc.train.is_one_vs_all():
+            self._train_one_vs_all(alg, feats, tags, weights, mesh,
+                                   norm_json, suffix)
+            return
+
         composites = flatten_params(
             mc.train.params or {},
             self.resolve(mc.train.grid_config_file)
@@ -123,7 +128,7 @@ class TrainProcessor(BasicProcessor):
                 else None
                 for i in range(bagging)
             ]
-            base_cfg.checkpoint_every = 10
+            base_cfg.checkpoint_every = self._checkpoint_every()
             checkpoint_paths = [
                 os.path.join(self.paths.ensure(self.paths.checkpoint_dir(i)),
                              "weights.npy")
@@ -160,7 +165,7 @@ class TrainProcessor(BasicProcessor):
 
         cfg = NNTrainConfig.from_model_config(mc, trainer_id=0)
         init_flat = self._continuous_init(0, suffix) if mc.train.is_continuous else None
-        cfg.checkpoint_every = 10
+        cfg.checkpoint_every = self._checkpoint_every()
         cfg.checkpoint_path = os.path.join(
             self.paths.ensure(self.paths.checkpoint_dir(0)), "weights.npy"
         )
@@ -184,50 +189,146 @@ class TrainProcessor(BasicProcessor):
             fh.write(f"{result.valid_error}\n")
         log.info("model 0 -> %s (valid err %.6f)", path, result.valid_error)
 
-    def _grid_search(self, alg, composites, feats, tags, weights, mesh) -> dict:
-        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
+    def _train_one_vs_all(self, alg, feats, tags, weights, mesh, norm_json,
+                          suffix) -> None:
+        """ONEVSALL: one binary model per class, all classes trained as ONE
+        vmapped program on the member axis (the reference fans out
+        baggingNum=classes Guagua jobs, TrainModelProcessor.java:691-699;
+        trainer i's ideal is tag==i, NNWorker.java:116-120)."""
+        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn_bagged
 
         mc = self.model_config
-        results = []
+        class_tags = [str(t) for t in mc.tags()]
+        K = len(class_tags)
+        if (mc.train.bagging_num or 1) not in (1, K):
+            log.warning("'train:baggingNum' is overridden to %d because of "
+                        "ONEVSALL multiple classification.", K)
+        base_cfg = NNTrainConfig.from_model_config(mc, trainer_id=0)
+        base_cfg.checkpoint_every = self._checkpoint_every()
+        member_tags = np.stack(
+            [(tags == k).astype(np.float32) for k in range(K)]
+        )
+        init_flats = [
+            self._continuous_init(k, suffix) if mc.train.is_continuous else None
+            for k in range(K)
+        ]
+        checkpoint_paths = [
+            os.path.join(self.paths.ensure(self.paths.checkpoint_dir(k)),
+                         "weights.npy")
+            for k in range(K)
+        ]
+        results = train_nn_bagged(
+            feats, tags, weights, base_cfg, K, mesh=mesh,
+            init_flats=init_flats, checkpoint_paths=checkpoint_paths,
+            member_tags=member_tags,
+        )
+        meta_cols = self._norm_meta_columns()
+        for k, result in enumerate(results):
+            cfg_k = NNTrainConfig.from_model_config(mc, trainer_id=k)
+            spec = self._make_spec(alg, cfg_k, result, meta_cols, norm_json,
+                                   class_tags=class_tags)
+            path = self.paths.model_path(k, suffix)
+            spec.save(path)
+            with open(self.paths.val_error_path(k), "w") as fh:
+                fh.write(f"{result.valid_error}\n")
+            log.info("one-vs-all model %d (class %s) -> %s (valid err %.6f)",
+                     k, class_tags[k], path, result.valid_error)
+
+    def _norm_meta_columns(self) -> List[str]:
+        from shifu_tpu.norm.dataset import read_meta
+
+        try:
+            return list(read_meta(self.paths.normalized_data_dir()).columns)
+        except Exception:
+            return []
+
+    def _checkpoint_every(self) -> int:
+        """Checkpoint cadence = train.epochsPerIteration (the reference
+        writes tmp models every epochsPerIteration master iterations)."""
+        mc = self.model_config
+        per = int(mc.train.epochs_per_iteration or 1)
+        return max(per, 10) if per <= 1 else per
+
+    @staticmethod
+    def _program_signature(cfg) -> tuple:
+        """Everything baked STATICALLY into the compiled training program —
+        trials that share it differ only in traced operands (LearningRate,
+        seed) and can ride one vmapped member axis."""
+        return (
+            tuple(cfg.hidden_nodes), tuple(cfg.activations), cfg.loss,
+            cfg.dropout_rate, cfg.mixed_precision, cfg.mini_batchs,
+            cfg.early_stop_window, cfg.convergence_threshold,
+            cfg.learning_decay, (cfg.propagation or "Q").upper(),
+            cfg.momentum, cfg.regularized_constant, cfg.reg_level,
+            cfg.adam_beta1, cfg.adam_beta2, cfg.num_epochs,
+            cfg.valid_set_rate, cfg.bagging_sample_rate,
+            cfg.bagging_with_replacement, cfg.weight_init, cfg.n_classes,
+        )
+
+    def _grid_search(self, alg, composites, feats, tags, weights, mesh) -> dict:
+        """Grid trials batched on the vmapped member axis, grouped by
+        compiled-program signature — a 30-trial LearningRate sweep is ONE
+        XLA execution, not 30 (the reference runs each trial as a Guagua
+        job, gs/GridSearch.java:44 + TrainModelProcessor.java:768-945)."""
+        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn_bagged
+
+        mc = self.model_config
         orig_params = mc.train.params
+        cfgs = []
         for gi, params in enumerate(composites):
             mc.train.params = params
             try:
-                cfg = NNTrainConfig.from_model_config(mc, trainer_id=gi)
+                cfgs.append(NNTrainConfig.from_model_config(mc, trainer_id=gi))
             finally:
                 mc.train.params = orig_params
-            res = train_nn(feats, tags, weights, cfg, mesh=mesh)
-            results.append((res.valid_error, gi, params))
-            log.info("grid trial %d/%d valid err %.6f params=%s",
-                     gi + 1, len(composites), res.valid_error, params)
+        groups: dict = {}
+        for gi, cfg in enumerate(cfgs):
+            groups.setdefault(self._program_signature(cfg), []).append(gi)
+
+        results = []
+        for idxs in groups.values():
+            trial_results = train_nn_bagged(
+                feats, tags, weights, cfgs[idxs[0]], len(idxs), mesh=mesh,
+                member_seed=lambda i, _idxs=idxs: _idxs[i] * 1000 + 7,
+                member_lrs=[cfgs[i].learning_rate for i in idxs],
+            )
+            for gi, res in zip(idxs, trial_results):
+                results.append((res.valid_error, gi, composites[gi]))
+                log.info("grid trial %d/%d valid err %.6f params=%s",
+                         gi + 1, len(composites), res.valid_error,
+                         composites[gi])
+        log.info("grid search: %d trials in %d vmapped group(s)",
+                 len(composites), len(groups))
         results.sort(key=lambda r: r[0])
         return results[0][2]
 
     def _k_fold(self, alg, k, feats, tags, weights, mesh, norm_json, suffix) -> None:
-        """k models, fold i held out as validation; avg val error reported
-        (TrainModelProcessor.java:947-969)."""
-        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
+        """All k folds as ONE vmapped program: fold i's member holds out fold
+        i via per-member significance masks; the trainer's valid error IS the
+        holdout error (TrainModelProcessor.java:947-969)."""
+        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn_bagged
 
         mc = self.model_config
         n = feats.shape[0]
         fold = np.arange(n) % k
+        base = NNTrainConfig.from_model_config(mc, trainer_id=0)
+        base.valid_set_rate = 0.0  # folds drive the split instead
+        sig_t = np.stack(
+            [np.where(fold == i, 0.0, weights) for i in range(k)]
+        ).astype(np.float32)
+        sig_v = np.stack(
+            [np.where(fold == i, weights, 0.0) for i in range(k)]
+        ).astype(np.float32)
+        results = train_nn_bagged(feats, tags, weights, base, k, mesh=mesh,
+                                  member_sigs=(sig_t, sig_v))
+        meta_cols = self._norm_meta_columns()
         errors = []
-        for i in range(k):
-            cfg = NNTrainConfig.from_model_config(mc, trainer_id=i)
-            cfg.valid_set_rate = 0.0  # folds drive the split instead
-            val_mask = fold == i
-            w_train = np.where(val_mask, 0.0, weights).astype(np.float32)
-            res = train_nn(feats, tags, w_train, cfg, mesh=mesh)
-            # validation error on the held-out fold
-            from shifu_tpu.models.nn import IndependentNNModel, NNModelSpec
-
-            spec = self._make_spec(alg, cfg, res, [], norm_json)
-            scores = IndependentNNModel(spec).compute(feats[val_mask])
-            t = tags[val_mask]
-            err = float(np.mean((t - scores) ** 2)) if t.size else 0.0
-            errors.append(err)
+        for i, res in enumerate(results):
+            cfg_i = NNTrainConfig.from_model_config(mc, trainer_id=i)
+            spec = self._make_spec(alg, cfg_i, res, meta_cols, norm_json)
             spec.save(self.paths.model_path(i, suffix))
-            log.info("fold %d/%d holdout err %.6f", i + 1, k, err)
+            errors.append(res.valid_error)
+            log.info("fold %d/%d holdout err %.6f", i + 1, k, res.valid_error)
         log.info("k-fold avg validation error: %.6f", float(np.mean(errors)))
 
     def _continuous_init(self, i: int, suffix: str) -> Optional[np.ndarray]:
@@ -247,13 +348,19 @@ class TrainProcessor(BasicProcessor):
             log.warning("cannot resume from %s (%s); fresh start", path, e)
             return None
 
-    def _make_spec(self, alg, cfg, result, columns, norm_json):
+    def _make_spec(self, alg, cfg, result, columns, norm_json,
+                   class_tags=None):
         from shifu_tpu.models.nn import NNModelSpec
 
+        in_dim = result.params[0]["W"].shape[0]
+        out_dim = result.params[-1]["W"].shape[1]
+        mc = self.model_config
+        if class_tags is None and mc is not None and mc.is_multi_classification():
+            class_tags = [str(t) for t in mc.tags()]
         return NNModelSpec(
-            layer_sizes=[len(columns) if columns else result.params[0]["W"].shape[0]]
+            layer_sizes=[len(columns) if columns else in_dim]
             + list(cfg.hidden_nodes)
-            + [1],
+            + [out_dim],
             activations=list(cfg.activations),
             input_columns=list(columns),
             norm_type=norm_json.get("normType", "ZSCALE"),
@@ -264,6 +371,7 @@ class TrainProcessor(BasicProcessor):
             params=result.params,
             train_error=result.train_error,
             valid_error=result.valid_error,
+            class_tags=list(class_tags or []),
         )
 
     def _mesh(self):

@@ -55,6 +55,27 @@ def train_tree_models(proc, alg) -> None:
     proc.paths.ensure(proc.paths.train_dir())
     bagging = max(1, int(mc.train.bagging_num or 1))
 
+    # multi-class: ONEVSALL trains one binary forest per class (the
+    # reference's only GBT multi-class mode, TrainModelProcessor.java:341);
+    # member k's target is tag==k, and eval thresholds per-class scores.
+    one_vs_all_tags = None
+    if mc.is_multi_classification():
+        if not mc.train.is_one_vs_all():
+            raise ShifuError(
+                ErrorCode.INVALID_MODEL_CONFIG,
+                "NATIVE multi-class is not supported for tree models; set "
+                "train.multiClassifyMethod=ONEVSALL (the reference supports "
+                "ONEVSALL for GBT/RF, TrainModelProcessor.java:341-349)",
+            )
+        n_classes = len(mc.tags())
+        if bagging not in (1, n_classes):
+            log.warning("'train:baggingNum' overridden to %d for ONEVSALL",
+                        n_classes)
+        bagging = n_classes
+        one_vs_all_tags = [
+            (tags == k).astype(np.float32) for k in range(n_classes)
+        ]
+
     # row-shard the code matrix over every available chip (DTWorker shard
     # equivalent); histogram merge is the jit-inserted all-reduce
     import jax
@@ -75,8 +96,9 @@ def train_tree_models(proc, alg) -> None:
                 log.info("trainer %d tree %d train %.6f valid %.6f",
                          _i, k, tr, va)
 
+        tags_i = one_vs_all_tags[i] if one_vs_all_tags is not None else tags
         result = train_trees(
-            codes, tags, weights, slots, is_cat, meta.columns, cfg,
+            codes, tags_i, weights, slots, is_cat, meta.columns, cfg,
             boundaries=boundaries, categories=categories, progress_cb=progress,
             mesh=mesh,
         )

@@ -74,20 +74,30 @@ def build_codes(
 
 def _prepare_rows(
     mc: ModelConfig, data: ColumnarData, seed, sample_rate: float,
-    sample_neg_only: bool,
+    sample_neg_only: bool, fold_multiclass: bool = False,
 ) -> Tuple[ColumnarData, np.ndarray, np.ndarray]:
     """purify + invalid-tag drop + sampling (reference samples in the Pig
     job). `seed` may be a sequence (streaming passes [seed, chunk_idx] so
-    both passes sample identically)."""
+    both passes sample identically).
+
+    `fold_multiclass` (stats callers): fold K class-index tags to
+    class0-vs-rest so the binary bin aggregation (binagg counts tags==1 pos /
+    ==0 neg) still sees EVERY valid row and binCountPos+binCountNeg ==
+    n_valid_rows. Norm callers keep the class indices — they ARE the
+    training targets."""
     ds = mc.data_set
     mask = combined_mask(ds.filter_expressions, data.raw, data.n_rows)
-    tags_all = make_tags(data.column(ds.target_column_name), ds.pos_tags, ds.neg_tags)
+    from shifu_tpu.data.reader import make_tags_for
+
+    tags_all = make_tags_for(mc, data.column(ds.target_column_name))
+    if fold_multiclass and mc.is_multi_classification():
+        tags_all = np.where(tags_all > 0, 1, tags_all).astype(tags_all.dtype)
     mask &= tags_all >= 0
     if sample_rate < 1.0:
         rng = np.random.default_rng(seed)
         keep = rng.random(data.n_rows) < sample_rate
         if sample_neg_only:
-            keep |= tags_all == 1
+            keep |= tags_all >= 1
         mask &= keep
     data = data.select_rows(mask)
     tags = tags_all[mask]
@@ -103,7 +113,8 @@ def compute_stats(
 ) -> None:
     """Fill stats + binning for every non-target/meta/weight column, in place."""
     data, tags, weights = _prepare_rows(
-        mc, data, seed, mc.stats.sample_rate, mc.stats.sample_neg_only
+        mc, data, seed, mc.stats.sample_rate, mc.stats.sample_neg_only,
+        fold_multiclass=True,
     )
     log.info("stats over %d rows (%d pos / %d neg)", data.n_rows,
              int((tags == 1).sum()), int((tags == 0).sum()))
@@ -337,7 +348,7 @@ def compute_stats_streaming(
     for ci, chunk in enumerate(chunk_factory()):
         chunk, tags, weights = _prepare_rows(
             mc, chunk, [seed, ci], mc.stats.sample_rate,
-            mc.stats.sample_neg_only,
+            mc.stats.sample_neg_only, fold_multiclass=True,
         )
         if not chunk.n_rows:
             continue
@@ -393,7 +404,7 @@ def compute_stats_streaming(
     for ci, chunk in enumerate(chunk_factory()):
         chunk, tags, weights = _prepare_rows(
             mc, chunk, [seed, ci], mc.stats.sample_rate,
-            mc.stats.sample_neg_only,
+            mc.stats.sample_neg_only, fold_multiclass=True,
         )
         if not chunk.n_rows:
             continue

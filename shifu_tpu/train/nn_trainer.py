@@ -68,6 +68,7 @@ class NNTrainConfig:
     early_stop_window: int = 0  # 0 = disabled
     convergence_threshold: float = 0.0
     weight_init: str = "xavier"
+    n_classes: int = 2  # >2 = NATIVE multi-class: one-hot ideal, K sigmoid outputs
     seed: int = 0
     is_continuous: bool = False
     mixed_precision: bool = False  # bf16 matmuls (MXU), f32 accumulation
@@ -91,7 +92,13 @@ class NNTrainConfig:
         acts = [str(a) for a in g("ActivationFunc", ["tanh"])]
         if alg == "LR":
             hidden, acts = [], []
+        # NATIVE multi-class: K output nodes, one-hot ideal (NNWorker.java:128
+        # "ideal[ideaIndex] = 1f"); ONEVSALL stays binary per trainer.
+        n_classes = 2
+        if mc.is_multi_classification() and not t.is_one_vs_all():
+            n_classes = len(mc.tags())
         return cls(
+            n_classes=n_classes,
             hidden_nodes=hidden,
             activations=acts,
             learning_rate=float(g("LearningRate", 0.1)),
@@ -150,6 +157,9 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes):
     n_hidden = len(cfg.hidden_nodes)
     dropout = cfg.dropout_rate
     bf16 = cfg.mixed_precision
+    # output width comes from the final layer shape; >1 means NATIVE
+    # multi-class (t holds class indices, ideal is one-hot)
+    out_dim = shapes[-1][1]
 
     def unflatten(flat):
         params, off = [], 0
@@ -179,21 +189,33 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes):
                 keep = jax.random.bernoulli(sub, 1.0 - dropout, h.shape)
                 h = jnp.where(keep, h / (1.0 - dropout), 0.0)
         out = matmul(h, params[-1]["W"]) + params[-1]["b"]
-        return activation_fn("sigmoid")(out)[:, 0]
+        out = activation_fn("sigmoid")(out)
+        return out if out_dim > 1 else out[:, 0]
 
-    def record_loss(p, t):
+    def ideal_of(t):
+        """Targets: binary t in {0,1} [n]; multi-class t is the class index
+        and the ideal vector is one-hot over K sigmoid outputs
+        (NNWorker.java:128)."""
+        if out_dim > 1:
+            return jax.nn.one_hot(t.astype(jnp.int32), out_dim,
+                                  dtype=jnp.float32)
+        return t
+
+    def record_loss(p, ideal):
         if cfg.loss == "log":
             eps = 1e-7
             pc = jnp.clip(p, eps, 1 - eps)
-            return -(t * jnp.log(pc) + (1 - t) * jnp.log(1 - pc))
-        if cfg.loss == "absolute":
-            return jnp.abs(t - p)
-        return 0.5 * (t - p) ** 2
+            e = -(ideal * jnp.log(pc) + (1 - ideal) * jnp.log(1 - pc))
+        elif cfg.loss == "absolute":
+            e = jnp.abs(ideal - p)
+        else:
+            e = 0.5 * (ideal - p) ** 2
+        return e.sum(axis=-1) if out_dim > 1 else e
 
     def total_loss(flat, x, t, sig, key):
         params = unflatten(flat)
         p = fwd(params, x, key, train=True)
-        return jnp.sum(sig * record_loss(p, t)), p
+        return jnp.sum(sig * record_loss(p, ideal_of(t))), p
 
     grad_fn = jax.grad(total_loss, has_aux=True)
 
@@ -206,7 +228,10 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes):
         else:
             p = p_train
         # reported errors are squared-error means like Encog calculateError
-        sq = (t - p) ** 2
+        # (multi-class: mean over the K output neurons as well)
+        sq = (ideal_of(t) - p) ** 2
+        if out_dim > 1:
+            sq = sq.mean(axis=-1)
         train_err = jnp.sum(sig_train * sq) / jnp.maximum(jnp.sum(sig_train), 1.0)
         valid_err = jnp.sum(sig_valid * sq) / jnp.maximum(jnp.sum(sig_valid), 1.0)
         return g, train_err, valid_err
@@ -312,7 +337,8 @@ def train_nn(
     import jax.numpy as jnp
 
     n, d = features.shape
-    layer_sizes = [d] + list(cfg.hidden_nodes) + [1]
+    out_dim = cfg.n_classes if cfg.n_classes > 2 else 1
+    layer_sizes = [d] + list(cfg.hidden_nodes) + [out_dim]
     params0 = init_params(layer_sizes, seed=cfg.seed, init=cfg.weight_init)
     flat0, shapes = flatten_params(params0)
     if init_flat is not None and init_flat.size == flat0.size:
@@ -399,6 +425,9 @@ def train_nn_bagged(
     init_flats: Optional[List[Optional[np.ndarray]]] = None,
     member_seed: Callable[[int], int] = lambda i: i * 1000 + 7,
     checkpoint_paths: Optional[List[str]] = None,
+    member_tags: Optional[np.ndarray] = None,
+    member_lrs: Optional[List[float]] = None,
+    member_sigs: Optional[Tuple[np.ndarray, np.ndarray]] = None,
 ) -> List[TrainResult]:
     """Train all bagging members as ONE vmapped SPMD program.
 
@@ -408,12 +437,26 @@ def train_nn_bagged(
     shared row-sharded dataset, so the MXU sees [M, n, d] batched matmuls and
     all members train in one XLA execution. jax's while_loop batching rule
     masks members that early-stop, so per-member halting semantics match the
-    serial path exactly."""
+    serial path exactly.
+
+    `member_tags` [M, n] overrides the shared tags per member — the ONEVSALL
+    case (NNWorker.java:116-120: trainer i's ideal is tag==i) rides the same
+    member axis as bagging.
+
+    `member_lrs` [M] gives each member its own learning rate — grid-search
+    trials that differ only in traced hyperparams (LearningRate) batch onto
+    the member axis too (gs/GridSearch.java:44 flattens the grid; here the
+    flat trials become one vmapped program instead of N Guagua jobs).
+
+    `member_sigs` (sig_train [M, n], sig_valid [M, n]) overrides the
+    bagging/validation sampling entirely — the k-fold case: fold i's
+    sig_valid marks its held-out fold (TrainModelProcessor.java:947-969)."""
     import jax
     import jax.numpy as jnp
 
     n, d = features.shape
-    layer_sizes = [d] + list(base_cfg.hidden_nodes) + [1]
+    out_dim = base_cfg.n_classes if base_cfg.n_classes > 2 else 1
+    layer_sizes = [d] + list(base_cfg.hidden_nodes) + [out_dim]
     shapes = None
     flat0s, sig_ts, sig_vs, ntss, seeds = [], [], [], [], []
     for i in range(n_members):
@@ -424,15 +467,26 @@ def train_nn_bagged(
         init_i = (init_flats or [None] * n_members)[i]
         if init_i is not None and init_i.size == flat0.size:
             flat0 = init_i.astype(np.float32)
-        cfg_i = NNTrainConfig(**{**base_cfg.__dict__, "seed": seed_i})
-        sig, valid_mask = split_and_sample(n, cfg_i)
-        sig_ts.append((sig * weights).astype(np.float32))
-        sig_vs.append((valid_mask.astype(np.float32) * weights).astype(np.float32))
-        ntss.append(float(max(sig.sum(), 1.0)))
+        if member_sigs is not None:
+            sig_ts.append(np.asarray(member_sigs[0][i], np.float32))
+            sig_vs.append(np.asarray(member_sigs[1][i], np.float32))
+            ntss.append(float(max((member_sigs[0][i] > 0).sum(), 1.0)))
+        else:
+            cfg_i = NNTrainConfig(**{**base_cfg.__dict__, "seed": seed_i})
+            sig, valid_mask = split_and_sample(n, cfg_i)
+            sig_ts.append((sig * weights).astype(np.float32))
+            sig_vs.append(
+                (valid_mask.astype(np.float32) * weights).astype(np.float32)
+            )
+            ntss.append(float(max(sig.sum(), 1.0)))
         flat0s.append(flat0)
 
     x = features if isinstance(features, jax.Array) else features.astype(np.float32)
-    t = tags if isinstance(tags, jax.Array) else tags.astype(np.float32)
+    t_batched = member_tags is not None
+    if t_batched:
+        t = np.asarray(member_tags, np.float32)  # [M, n]
+    else:
+        t = tags if isinstance(tags, jax.Array) else tags.astype(np.float32)
     sig_t = np.stack(sig_ts)  # [M, n]
     sig_v = np.stack(sig_vs)
     if mesh is not None:
@@ -441,22 +495,29 @@ def train_nn_bagged(
         from shifu_tpu.parallel.mesh import pad_rows, shard_rows
 
         n_dev = mesh.devices.size
-        (x, t), _ = pad_rows([x, t], n_dev)
+        (x,), _ = pad_rows([x], n_dev)
+        member_rows = NamedSharding(mesh, P(None, "data"))
+        if t_batched:
+            t = jax.device_put(np.pad(t, ((0, 0), (0, x.shape[0] - n))),
+                               member_rows)
+        else:
+            (t,), _ = pad_rows([t], n_dev)
+            t = shard_rows(t, mesh)
         sig_t = np.pad(sig_t, ((0, 0), (0, x.shape[0] - n)))
         sig_v = np.pad(sig_v, ((0, 0), (0, x.shape[0] - n)))
         x = shard_rows(x, mesh)
-        t = shard_rows(t, mesh)
-        member_rows = NamedSharding(mesh, P(None, "data"))
         sig_t = jax.device_put(sig_t, member_rows)
         sig_v = jax.device_put(sig_v, member_rows)
 
     rows = x.shape[0]
     program, init_state = _get_program(base_cfg, shapes, rows)
-    bag_key = ("bagged", id(program), n_members)
+    bag_key = ("bagged", id(program), n_members, t_batched)
     program_b = _PROGRAMS.get(bag_key)
     if program_b is None:
         program_b = jax.jit(
-            jax.vmap(program, in_axes=(0, None, None, None, 0, 0, 0, 0)),
+            jax.vmap(program,
+                     in_axes=(0, None, None, 0 if t_batched else None,
+                              0, 0, 0, 0)),
             static_argnums=(),
         )
         _PROGRAMS[bag_key] = program_b
@@ -472,9 +533,14 @@ def train_nn_bagged(
         flat_j = replicate(flat_j, mesh)
         opt0 = replicate(opt0, mesh)
     M = n_members
+    lrs0 = (
+        jnp.asarray(member_lrs, jnp.float32)
+        if member_lrs is not None
+        else jnp.full(M, base_cfg.learning_rate, jnp.float32)
+    )
     carry0 = (
         flat_j, opt0, jnp.zeros(M, jnp.int32),
-        jnp.full(M, base_cfg.learning_rate, jnp.float32),
+        lrs0,
         jnp.full(M, np.inf, jnp.float32), flat_j, jnp.zeros(M, jnp.int32),
         jnp.zeros(M, dtype=bool), jnp.zeros(M, jnp.float32),
         jnp.zeros(M, jnp.float32),
@@ -522,7 +588,8 @@ def train_nn_bagged(
     best_flat_np = np.asarray(best_flat)
     for i in range(n_members):
         bv = float(np.asarray(best_val)[i])
-        use_best = base_cfg.valid_set_rate > 0 and math.isfinite(bv)
+        has_valid = base_cfg.valid_set_rate > 0 or member_sigs is not None
+        use_best = has_valid and math.isfinite(bv)
         chosen = best_flat_np[i] if use_best else flat_f_np[i]
         results.append(TrainResult(
             params=unflatten_params(chosen, shapes),

@@ -55,6 +55,76 @@ def make_binary_dataset(
     return names, rows, y
 
 
+def make_multiclass_dataset(
+    n_rows: int = 900,
+    n_numeric: int = 8,
+    seed: int = 11,
+    classes=("low", "mid", "high"),
+):
+    """K-class dataset: per-class shifted gaussians (separable), plus one
+    categorical column correlated with the class. Returns (names, rows, y)."""
+    rng = np.random.default_rng(seed)
+    k = len(classes)
+    y = rng.integers(k, size=n_rows)
+    names = ["grade"]
+    cols = []
+    for j in range(n_numeric):
+        scale = 1.0 if j % 2 == 0 else 1.5
+        x = rng.normal(loc=y * 2.0 * ((j % 3) + 1) / 3.0, scale=scale)
+        cols.append(x)
+        names.append(f"num_{j}")
+    cat_values = np.array(["aa", "bb", "cc", "dd"])
+    choice = (y + rng.integers(0, 2, size=n_rows)) % 4
+    names.append("cat_0")
+
+    rows = []
+    for i in range(n_rows):
+        fields = [str(classes[y[i]])]
+        fields.extend(f"{x[i]:.6g}" for x in cols)
+        fields.append(str(cat_values[choice[i]]))
+        rows.append(fields)
+    return names, rows, y
+
+
+def make_multiclass_model_set(
+    root: str,
+    n_rows: int = 900,
+    seed: int = 11,
+    algorithm: str = "NN",
+    method: str = "NATIVE",
+    classes=("low", "mid", "high"),
+):
+    """Model set in classification mode: posTags = all classes, negTags
+    empty (the reference's XOR semantics, ModelConfig.isClassification)."""
+    from shifu_tpu.config.model_config import (
+        Algorithm,
+        MultipleClassification,
+        new_model_config,
+    )
+
+    names, rows, _ = make_multiclass_dataset(
+        n_rows=n_rows, seed=seed, classes=classes
+    )
+    data_dir = os.path.join(root, "data")
+    data_path, header_path = write_dataset(data_dir, names, rows)
+
+    mc = new_model_config("TestMulti", Algorithm.parse(algorithm))
+    mc.data_set.data_path = data_path
+    mc.data_set.header_path = header_path
+    mc.data_set.data_delimiter = "|"
+    mc.data_set.header_delimiter = "|"
+    mc.data_set.target_column_name = "grade"
+    mc.data_set.pos_tags = list(classes)
+    mc.data_set.neg_tags = []
+    mc.train.multi_classify_method = MultipleClassification.parse(method)
+    mc.evals[0].data_set.data_path = data_path
+    mc.evals[0].data_set.header_path = header_path
+    mc.evals[0].data_set.data_delimiter = "|"
+    os.makedirs(root, exist_ok=True)
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    return root
+
+
 def write_dataset(dirpath: str, names, rows, delimiter: str = "|"):
     os.makedirs(dirpath, exist_ok=True)
     header = os.path.join(dirpath, "header.txt")

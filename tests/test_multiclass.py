@@ -1,0 +1,246 @@
+"""Multi-class classification (NATIVE + ONEVSALL) end to end.
+
+Parity anchors: ModelTrainConf.MultipleClassification (ModelTrainConf.java:54),
+NNWorker one-hot/per-trainer ideals (NNWorker.java:116-131), ONEVSALL bagging
+fan-out (TrainModelProcessor.java:685-699), multi-class confusion matrix
+(ConfusionMatrix.java:625), MultiClsTagPredictor argmax/threshold semantics.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_multiclass_model_set
+
+CLASSES = ("low", "mid", "high")
+
+
+def _run_pipeline(root):
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    assert TrainProcessor(root).run() == 0
+
+
+def _run_eval(root):
+    from shifu_tpu.processor.evaluate import EvalProcessor
+
+    assert EvalProcessor(root, run_name="Eval1").run() == 0
+    cm_path = os.path.join(root, "evals", "Eval1", "EvalConfusionMatrix.csv")
+    # pathfinder layout may differ; find it
+    if not os.path.isfile(cm_path):
+        import glob
+
+        hits = glob.glob(os.path.join(root, "**", "*onfusion*"),
+                         recursive=True)
+        assert hits, "no confusion matrix artifact written"
+        cm_path = hits[0]
+    return cm_path
+
+
+def _accuracy_from_perf(root):
+    import glob
+    import json
+
+    hits = glob.glob(os.path.join(root, "**", "*erformance*.json"),
+                     recursive=True)
+    assert hits
+    with open(hits[0]) as fh:
+        perf = json.load(fh)
+    assert "confusionMatrix" in perf
+    m = np.asarray(perf["confusionMatrix"])
+    assert m.shape == (3, 3)
+    return perf["accuracy"], m
+
+
+# ---------------------------------------------------------------------------
+# unit: tag parsing + prediction semantics
+# ---------------------------------------------------------------------------
+
+
+def test_make_class_tags():
+    from shifu_tpu.data.reader import make_class_tags
+
+    col = np.array(["low", "high", "mid", "junk", " low "], dtype=object)
+    t = make_class_tags(col, list(CLASSES))
+    assert t.tolist() == [0, 2, 1, -1, 0]
+
+
+def test_make_tags_for_dispatch():
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.data.reader import make_tags_for
+
+    mc = ModelConfig()
+    mc.data_set.pos_tags = list(CLASSES)
+    mc.data_set.neg_tags = []
+    col = np.array(["mid", "low", "nope"], dtype=object)
+    assert make_tags_for(mc, col).tolist() == [1, 0, -1]
+
+    mc.data_set.pos_tags = ["M"]
+    mc.data_set.neg_tags = ["B"]
+    col = np.array(["M", "B", "x"], dtype=object)
+    assert make_tags_for(mc, col).tolist() == [1, 0, -1]
+
+
+def test_predict_one_vs_all_threshold_semantics():
+    """ConfusionMatrix.java:708-744: positive iff score > (1-prior)*scale;
+    among positives the LARGEST-prior class wins; none positive -> the
+    largest-prior class overall."""
+    from shifu_tpu.eval.multiclass import predict_one_vs_all
+
+    priors = np.array([0.5, 0.3, 0.2])
+    # thresholds: 500, 700, 800
+    scores = np.array([
+        [600.0, 100.0, 100.0],   # only class 0 positive -> 0
+        [100.0, 750.0, 900.0],   # classes 1,2 positive -> class 1 (prior .3)
+        [100.0, 100.0, 100.0],   # none positive -> class 0 (max prior)
+        [900.0, 900.0, 900.0],   # all positive -> class 0
+    ])
+    pred = predict_one_vs_all(scores, priors, scale=1000.0)
+    assert pred.tolist() == [0, 1, 0, 0]
+
+
+def test_predict_native_model_major_blocks():
+    from shifu_tpu.eval.multiclass import predict_native
+
+    # two models x three classes, model-major: model0 votes class 2,
+    # model1 votes class 2 stronger -> average argmax = 2
+    scores = np.array([[0.1, 0.2, 0.7, 0.0, 0.3, 0.9]]) * 1000
+    assert predict_native(scores, 3).tolist() == [2]
+    with pytest.raises(ValueError):
+        predict_native(np.zeros((1, 5)), 3)
+
+
+def test_confusion_matrix_multi_and_text():
+    from shifu_tpu.eval.multiclass import (
+        confusion_matrix_multi,
+        confusion_matrix_text,
+        multiclass_accuracy,
+    )
+
+    tags = np.array([0, 0, 1, 2, 2, -1])
+    pred = np.array([0, 1, 1, 2, 0, 0])
+    m = confusion_matrix_multi(tags, pred, 3)
+    assert m.tolist() == [[1, 1, 0], [0, 1, 0], [1, 0, 1]]
+    text = confusion_matrix_text(m, CLASSES)
+    assert text.splitlines()[0] == "\tlow\tmid\thigh"
+    assert abs(multiclass_accuracy(m) - 3 / 5) < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# end to end: NATIVE NN
+# ---------------------------------------------------------------------------
+
+
+def test_native_nn_multiclass_end_to_end(tmp_path):
+    root = str(tmp_path / "ms")
+    make_multiclass_model_set(root, n_rows=700, method="NATIVE")
+    from shifu_tpu.config.model_config import ModelConfig
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 60
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    _run_pipeline(root)
+
+    from shifu_tpu.models.nn import IndependentNNModel, NNModelSpec
+
+    spec = NNModelSpec.load(os.path.join(root, "models", "model0.nn"))
+    assert spec.out_dim == 3  # K sigmoid outputs, NNWorker.java:131
+    assert spec.class_tags == list(CLASSES)
+
+    from shifu_tpu.norm.dataset import load_normalized, read_meta
+
+    norm_dir = os.path.join(root, "tmp", "norm", "NormalizedData")
+    meta = read_meta(norm_dir)
+    assert meta.extra.get("classTags") == list(CLASSES)
+    priors = meta.extra.get("classPriors")
+    assert priors and abs(sum(priors) - 1.0) < 1e-9
+
+    _, feats, tags, _ = load_normalized(norm_dir)
+    out = IndependentNNModel(spec).compute_all(np.asarray(feats))
+    assert out.shape[1] == 3
+    acc = float((np.argmax(out, axis=1) == np.asarray(tags)).mean())
+    assert acc > 0.8, f"NATIVE multi-class accuracy {acc}"
+
+    _run_eval(root)
+    eval_acc, m = _accuracy_from_perf(root)
+    assert eval_acc > 0.8
+    assert m.sum() == 700
+
+
+# ---------------------------------------------------------------------------
+# end to end: ONEVSALL (NN + GBT)
+# ---------------------------------------------------------------------------
+
+
+def test_onevsall_nn_multiclass(tmp_path):
+    root = str(tmp_path / "ms")
+    make_multiclass_model_set(root, n_rows=700, method="ONEVSALL")
+    from shifu_tpu.config.model_config import ModelConfig
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    assert mc.train.is_one_vs_all()
+    mc.train.num_train_epochs = 60
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    _run_pipeline(root)
+
+    # one binary model per class (TrainModelProcessor.java:693)
+    from shifu_tpu.models.nn import NNModelSpec
+
+    for k in range(3):
+        spec = NNModelSpec.load(os.path.join(root, "models", f"model{k}.nn"))
+        assert spec.out_dim == 1
+        assert spec.class_tags == list(CLASSES)
+
+    _run_eval(root)
+    eval_acc, _ = _accuracy_from_perf(root)
+    assert eval_acc > 0.75, f"ONEVSALL accuracy {eval_acc}"
+
+
+def test_onevsall_gbt_multiclass(tmp_path):
+    root = str(tmp_path / "ms")
+    make_multiclass_model_set(root, n_rows=600, method="ONEVSALL",
+                              algorithm="GBT")
+    from shifu_tpu.config.model_config import ModelConfig
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.params["TreeNum"] = 20
+    mc.train.params["MaxDepth"] = 4
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    _run_pipeline(root)
+
+    from shifu_tpu.models.tree import TreeModelSpec
+
+    for k in range(3):
+        path = os.path.join(root, "models", f"model{k}.gbt")
+        assert os.path.isfile(path)
+        spec = TreeModelSpec.load(path)
+        assert len(spec.trees) == 20
+
+    _run_eval(root)
+    eval_acc, _ = _accuracy_from_perf(root)
+    assert eval_acc > 0.7, f"ONEVSALL GBT accuracy {eval_acc}"
+
+
+def test_native_tree_multiclass_rejected(tmp_path):
+    root = str(tmp_path / "ms")
+    make_multiclass_model_set(root, n_rows=200, method="NATIVE",
+                              algorithm="GBT")
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    from shifu_tpu.utils.errors import ShifuError
+
+    with pytest.raises(ShifuError):  # clear error, not a silently-bad model
+        TrainProcessor(root).run()

