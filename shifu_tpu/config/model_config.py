@@ -224,14 +224,19 @@ class ModelTrainConf:
     convergence_judger: str = "error"
     algorithm: Algorithm = Algorithm.NN
     multi_classify_method: MultipleClassification = MultipleClassification.NATIVE
+    # legacy configs carry an explicit boolean; honored alongside the enum
+    legacy_one_vs_all: bool = field(
+        default=False, metadata={"json": "isOneVsAll"}
+    )
     params: Dict[str, Any] = field(default_factory=dict)
     grid_config_file: Optional[str] = None
     custom_paths: Optional[Dict[str, str]] = field(default_factory=dict)
 
     def is_one_vs_all(self) -> bool:
         """ModelTrainConf.isOneVsAll: ONEVSALL and ONEVSREST both mean
-        per-class binary models (ModelTrainConf.java:54)."""
-        return self.multi_classify_method in (
+        per-class binary models (ModelTrainConf.java:54); a legacy
+        "isOneVsAll": true JSON field is honored too."""
+        return self.legacy_one_vs_all or self.multi_classify_method in (
             MultipleClassification.ONEVSALL,
             MultipleClassification.ONEVSREST,
         )

@@ -274,7 +274,10 @@ def make_tags_for(mc, target_col: np.ndarray,
     pos = mc.data_set.pos_tags if pos is None else pos
     neg = mc.data_set.neg_tags if neg is None else neg
     all_tags = list(pos or []) + list(neg or [])
-    if bool(pos) != bool(neg) and len(all_tags) > 2:
+    # classification mode (XOR) uses class indices even for K == 2 — the
+    # binary make_tags else-branch would map BOTH listed classes to 1 and
+    # junk values to 0
+    if bool(pos) != bool(neg) and len(all_tags) >= 2:
         return make_class_tags(target_col, all_tags)
     return make_tags(target_col, pos or [], neg or [])
 

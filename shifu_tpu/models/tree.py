@@ -35,18 +35,37 @@ FORMAT_VERSION = 1
 
 @dataclass
 class DenseTree:
+    """Complete-binary layout (children implicit at 2i+1/2i+2) for
+    level-wise trees; leaf-wise trees (maxLeaves mode, DTMaster.java:137)
+    are lopsided, so they carry EXPLICIT child pointers in `left`/`right`
+    (-1 = none) and traversal follows those instead."""
+
     feature: np.ndarray  # [n_nodes] int32, -1 = leaf
     left_mask: np.ndarray  # [n_nodes, max_slots] bool
     leaf_value: np.ndarray  # [n_nodes] float32
     weight: float = 1.0  # tree weight (GBT learning rate folded in here)
+    left: Optional[np.ndarray] = None  # [n_nodes] int32, leaf-wise only
+    right: Optional[np.ndarray] = None
 
     @property
     def n_nodes(self) -> int:
         return int(self.feature.shape[0])
 
     @property
+    def is_dense_layout(self) -> bool:
+        return self.left is None
+
+    @property
     def depth(self) -> int:
-        return int(np.log2(self.n_nodes + 1)) - 1
+        if self.is_dense_layout:
+            return int(np.log2(self.n_nodes + 1)) - 1
+        # explicit-children tree: walk depths iteratively
+        depth = np.zeros(self.n_nodes, dtype=np.int32)
+        for i in range(self.n_nodes):
+            for c in (self.left[i], self.right[i]):
+                if c >= 0:
+                    depth[c] = depth[i] + 1
+        return int(depth.max()) if self.n_nodes else 0
 
 
 @dataclass
@@ -85,7 +104,7 @@ class TreeModelSpec:
             "validError": self.valid_error,
             "trees": [
                 {"nNodes": t.n_nodes, "maxSlots": int(t.left_mask.shape[1]),
-                 "weight": t.weight}
+                 "weight": t.weight, "leafWise": not t.is_dense_layout}
                 for t in self.trees
             ],
         }
@@ -98,6 +117,9 @@ class TreeModelSpec:
             buf.write(t.feature.astype("<i4").tobytes())
             buf.write(np.packbits(t.left_mask, axis=None).tobytes())
             buf.write(t.leaf_value.astype("<f4").tobytes())
+            if not t.is_dense_layout:
+                buf.write(t.left.astype("<i4").tobytes())
+                buf.write(t.right.astype("<i4").tobytes())
         with open(path, "wb") as fh:
             fh.write(buf.getvalue())
 
@@ -125,9 +147,16 @@ class TreeModelSpec:
             off += nbytes
             leaf_value = np.frombuffer(data, dtype="<f4", count=n, offset=off).copy()
             off += 4 * n
+            left = right = None
+            if tmeta.get("leafWise"):
+                left = np.frombuffer(data, dtype="<i4", count=n, offset=off).copy()
+                off += 4 * n
+                right = np.frombuffer(data, dtype="<i4", count=n, offset=off).copy()
+                off += 4 * n
             trees.append(
                 DenseTree(feature=feature, left_mask=left_mask,
-                          leaf_value=leaf_value, weight=tmeta.get("weight", 1.0))
+                          leaf_value=leaf_value, weight=tmeta.get("weight", 1.0),
+                          left=left, right=right)
             )
         return cls(
             algorithm=head["algorithm"],
@@ -158,6 +187,9 @@ def traverse_trees(trees: List[DenseTree], codes) -> "np.ndarray":
         feature = jnp.asarray(t.feature)
         left_mask = jnp.asarray(t.left_mask)
         leaf_value = jnp.asarray(t.leaf_value)
+        dense = t.is_dense_layout
+        lch = None if dense else jnp.asarray(t.left)
+        rch = None if dense else jnp.asarray(t.right)
         depth = t.depth
         node = jnp.zeros(n, dtype=jnp.int32)
         for _ in range(depth):
@@ -167,7 +199,10 @@ def traverse_trees(trees: List[DenseTree], codes) -> "np.ndarray":
                 codes, jnp.maximum(f, 0)[:, None], axis=1
             )[:, 0].astype(jnp.int32)
             goes_left = left_mask[node, jnp.clip(code, 0, left_mask.shape[1] - 1)]
-            child = jnp.where(goes_left, 2 * node + 1, 2 * node + 2)
+            if dense:
+                child = jnp.where(goes_left, 2 * node + 1, 2 * node + 2)
+            else:
+                child = jnp.where(goes_left, lch[node], rch[node])
             node = jnp.where(is_leaf, node, child)
         outs.append(leaf_value[node] * t.weight)
     return jnp.stack(outs, axis=1)

@@ -78,6 +78,19 @@ class TrainProcessor(BasicProcessor):
             raise ShifuError(
                 ErrorCode.DATA_NOT_FOUND, f"{norm_dir} — run `shifu norm` first"
             )
+        plan = build_norm_plan(mc, self.column_configs)
+        norm_json = plan_to_json(plan)
+        suffix = self._model_suffix(alg)
+        self.paths.ensure(self.paths.models_dir())
+        self.paths.ensure(self.paths.train_dir())
+
+        from shifu_tpu.train.streaming import should_stream_training
+
+        if should_stream_training(norm_dir,
+                                  force_attr=bool(mc.train.train_on_disk)):
+            self._train_nn_streamed(alg, norm_dir, norm_json, suffix)
+            return
+
         meta, feats, tags, weights = load_normalized(norm_dir)
         feats = np.asarray(feats, dtype=np.float32)
         tags = np.asarray(tags, dtype=np.float32)
@@ -86,16 +99,6 @@ class TrainProcessor(BasicProcessor):
                  feats.shape[0], feats.shape[1], alg.value)
 
         mesh = self._mesh()
-        plan = build_norm_plan(mc, self.column_configs)
-        norm_json = plan_to_json(plan)
-        suffix = self._model_suffix(alg)
-        self.paths.ensure(self.paths.models_dir())
-        self.paths.ensure(self.paths.train_dir())
-
-        if mc.is_multi_classification() and mc.train.is_one_vs_all():
-            self._train_one_vs_all(alg, feats, tags, weights, mesh,
-                                   norm_json, suffix)
-            return
 
         composites = flatten_params(
             mc.train.params or {},
@@ -106,6 +109,20 @@ class TrainProcessor(BasicProcessor):
         is_grid = len(composites) > 1
         num_kfold = mc.train.num_k_fold or -1
         bagging = max(1, int(mc.train.bagging_num or 1))
+
+        if mc.is_multi_classification() and mc.train.is_one_vs_all():
+            if is_grid:
+                raise ShifuError(
+                    ErrorCode.INVALID_MODEL_CONFIG,
+                    "grid search is not supported with ONEVSALL multi-class; "
+                    "pick one hyperparameter set",
+                )
+            if num_kfold > 0:
+                log.warning("num_k_fold is ignored under ONEVSALL "
+                            "multi-class (one model per class)")
+            self._train_one_vs_all(alg, feats, tags, weights, mesh,
+                                   norm_json, suffix)
+            return
 
         if is_grid:
             best = self._grid_search(alg, composites, feats, tags, weights, mesh)
@@ -188,6 +205,66 @@ class TrainProcessor(BasicProcessor):
         with open(self.paths.val_error_path(0), "w") as fh:
             fh.write(f"{result.valid_error}\n")
         log.info("model 0 -> %s (valid err %.6f)", path, result.valid_error)
+
+    def _train_nn_streamed(self, alg, norm_dir, norm_json, suffix) -> None:
+        """Larger-than-memory path: the normalized matrix never concatenates
+        into one host array; members stream the mmap'd shards through a
+        double-buffered device feed (train/streaming.py; the reference's
+        MemoryDiskFloatMLDataSet disk-spill analog). Bagging members /
+        one-vs-all classes run serially — each full run is itself one
+        chip-saturating program."""
+        from shifu_tpu.train.grid_search import flatten_params
+        from shifu_tpu.train.nn_trainer import NNTrainConfig
+        from shifu_tpu.train.streaming import train_nn_streamed
+
+        mc = self.model_config
+        composites = flatten_params(
+            mc.train.params or {},
+            self.resolve(mc.train.grid_config_file)
+            if mc.train.grid_config_file else None,
+        )
+        if len(composites) > 1 or (mc.train.num_k_fold or -1) > 0:
+            raise ShifuError(
+                ErrorCode.INVALID_MODEL_CONFIG,
+                "grid search / k-fold need the in-memory trainer; raise "
+                "-Dshifu.train.memoryBudgetMB or disable train.trainOnDisk",
+            )
+        multi = mc.is_multi_classification()
+        ova = multi and mc.train.is_one_vs_all()
+        class_tags = [str(t) for t in mc.tags()] if multi else None
+        n_members = (len(class_tags) if ova
+                     else max(1, int(mc.train.bagging_num or 1)))
+        meta_cols = self._norm_meta_columns()
+        log.info("training STREAMED from %s (%d member(s))", norm_dir,
+                 n_members)
+        for i in range(n_members):
+            cfg = NNTrainConfig.from_model_config(mc, trainer_id=i)
+            cfg.checkpoint_every = self._checkpoint_every()
+            cfg.checkpoint_path = os.path.join(
+                self.paths.ensure(self.paths.checkpoint_dir(i)), "weights.npy"
+            )
+            progress_path = self.paths.progress_path(i)
+
+            def progress(it, tr, va, _p=progress_path, _i=i):
+                with open(_p, "a") as fh:
+                    fh.write(
+                        f"Trainer {_i} Epoch #{it} Train Error:{tr:.8f} "
+                        f"Validation Error:{va:.8f}\n"
+                    )
+
+            cfg.progress_cb = progress
+            init_flat = (self._continuous_init(i, suffix)
+                         if mc.train.is_continuous else None)
+            res = train_nn_streamed(norm_dir, cfg, init_flat=init_flat,
+                                    target_class=i if ova else None)
+            spec = self._make_spec(alg, cfg, res, meta_cols, norm_json,
+                                   class_tags=class_tags)
+            path = self.paths.model_path(i, suffix)
+            spec.save(path)
+            with open(self.paths.val_error_path(i), "w") as fh:
+                fh.write(f"{res.valid_error}\n")
+            log.info("streamed model %d -> %s (valid err %.6f)", i, path,
+                     res.valid_error)
 
     def _train_one_vs_all(self, alg, feats, tags, weights, mesh, norm_json,
                           suffix) -> None:
@@ -313,12 +390,20 @@ class TrainProcessor(BasicProcessor):
         fold = np.arange(n) % k
         base = NNTrainConfig.from_model_config(mc, trainer_id=0)
         base.valid_set_rate = 0.0  # folds drive the split instead
-        sig_t = np.stack(
-            [np.where(fold == i, 0.0, weights) for i in range(k)]
-        ).astype(np.float32)
-        sig_v = np.stack(
-            [np.where(fold == i, weights, 0.0) for i in range(k)]
-        ).astype(np.float32)
+        base.early_stop_window = 0  # holdout must not steer training
+        sig_ts, sig_vs = [], []
+        for i in range(k):
+            # bagging sampling still applies inside each fold's train side,
+            # as the serial path's split_and_sample did
+            rng = np.random.default_rng(i * 1000 + 7)
+            if base.bagging_with_replacement:
+                bag = rng.poisson(base.bagging_sample_rate, size=n)
+            else:
+                bag = rng.random(n) < base.bagging_sample_rate
+            sig_ts.append(np.where(fold == i, 0.0, weights * bag))
+            sig_vs.append(np.where(fold == i, weights, 0.0))
+        sig_t = np.stack(sig_ts).astype(np.float32)
+        sig_v = np.stack(sig_vs).astype(np.float32)
         results = train_nn_bagged(feats, tags, weights, base, k, mesh=mesh,
                                   member_sigs=(sig_t, sig_v))
         meta_cols = self._norm_meta_columns()

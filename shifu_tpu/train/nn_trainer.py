@@ -588,13 +588,16 @@ def train_nn_bagged(
     best_flat_np = np.asarray(best_flat)
     for i in range(n_members):
         bv = float(np.asarray(best_val)[i])
-        has_valid = base_cfg.valid_set_rate > 0 or member_sigs is not None
-        use_best = has_valid and math.isfinite(bv)
+        # member_sigs (k-fold) stays an UNBIASED holdout: final weights and
+        # the final-epoch holdout error, not the min-over-epochs snapshot
+        # (TrainModelProcessor.java:947-969 evaluates the finished model)
+        use_best = (member_sigs is None and base_cfg.valid_set_rate > 0
+                    and math.isfinite(bv))
         chosen = best_flat_np[i] if use_best else flat_f_np[i]
         results.append(TrainResult(
             params=unflatten_params(chosen, shapes),
             train_error=float(np.asarray(tr_e)[i]),
-            valid_error=bv if math.isfinite(bv) else float(np.asarray(va_e)[i]),
+            valid_error=bv if use_best else float(np.asarray(va_e)[i]),
             iterations=int(np.asarray(it_f)[i]),
         ))
     log.info("bagged train done: %d members in one program, avg valid %.6f",

@@ -1,0 +1,274 @@
+"""Larger-than-memory training: stream mmap'd .npy shards through the chip.
+
+The reference trains on datasets that exceed worker memory by spilling rows
+to disk (core/dtrain/dataset/MemoryDiskFloatMLDataSet.java — memory portion
+first, BufferedFloatMLDataSet overflow on disk, re-read every epoch). The
+TPU analog keeps the SAME on-disk artifact `shifu norm` already writes —
+row-sharded .npy files — and feeds them through a double-buffered
+`jax.device_put` pipeline:
+
+    shard s is computing on device  |  shard s+1 is already in flight
+    (dispatch is async)             |  (device_put returns immediately)
+
+Every shard is padded to the max shard row count so ONE compiled per-shard
+gradient program serves the whole stream (padding rows carry zero
+significance). Peak host memory = 2 shards (current + prefetch), whatever
+the dataset size; full-batch BSP semantics are preserved exactly — the
+epoch gradient is the sum of shard gradients, the same sum NNMaster computes
+over worker results (NNMaster.java:240-249).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.norm.dataset import NormMeta, read_meta
+from shifu_tpu.train.nn_trainer import NNTrainConfig, TrainResult, _loss_and_errors
+from shifu_tpu.train.updaters import make_updater
+from shifu_tpu.models.nn import flatten_params, init_params, unflatten_params
+from shifu_tpu.utils import environment
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+DEFAULT_TRAIN_BUDGET_MB = 1024
+
+
+def train_memory_budget_bytes() -> int:
+    """shifu.train.memoryBudgetMB — datasets whose normalized matrix exceeds
+    it stream from shards instead of concatenating into one host array
+    (the reference's trainOnDisk / MemoryDiskFloatMLDataSet envelope,
+    shifuconfig:46-50)."""
+    mb = environment.get_int("shifu.train.memoryBudgetMB",
+                             DEFAULT_TRAIN_BUDGET_MB)
+    return int(mb) * 1024 * 1024
+
+
+def should_stream_training(data_dir: str, force_attr: bool = False) -> bool:
+    if environment.get_property("shifu.train.forceStreaming", "") in (
+        "true", "1",
+    ):
+        return True
+    if force_attr:
+        return True
+    try:
+        meta = read_meta(data_dir)
+    except Exception:
+        return False
+    n_cols = len(meta.columns)
+    return meta.n_rows * n_cols * 4 > train_memory_budget_bytes()
+
+
+class ShardFeed:
+    """Double-buffered device feed over the shard files of one data dir.
+
+    Each epoch iterates (x_dev, t_dev, sig_train_dev, sig_valid_dev) with
+    shard s+1's host->device transfer overlapping shard s's compute. Shards
+    are padded to the max shard length; sampling masks are drawn per shard
+    from a deterministic stream so every epoch sees the identical split
+    (AbstractNNWorker samples once at load time, not per epoch)."""
+
+    def __init__(self, data_dir: str, cfg: NNTrainConfig,
+                 prefix: str = "features"):
+        import jax
+
+        self.data_dir = data_dir
+        self.meta: NormMeta = read_meta(data_dir)
+        self.prefix = prefix
+        self.n_shards = len(self.meta.shard_rows)
+        self.pad_rows = max(self.meta.shard_rows) if self.meta.shard_rows else 0
+        self.cfg = cfg
+        self._jax = jax
+        # per-shard sampling masks (train significance / valid mask), drawn
+        # ONCE — identical across epochs, like the reference's load-time split
+        self._sig: List[Tuple[np.ndarray, np.ndarray]] = []
+        from shifu_tpu.train.nn_trainer import split_and_sample
+
+        for s, rows in enumerate(self.meta.shard_rows):
+            cfg_s = NNTrainConfig(
+                **{**cfg.__dict__, "seed": cfg.seed * 100_003 + s}
+            )
+            sig, valid = split_and_sample(rows, cfg_s)
+            w = np.load(self._path("weights", s), mmap_mode="r")
+            sig_t = (sig * np.asarray(w)).astype(np.float32)
+            sig_v = (valid.astype(np.float32) * np.asarray(w)).astype(
+                np.float32
+            )
+            self._sig.append((sig_t, sig_v))
+        self.n_train_size = float(
+            max(sum(float((st > 0).sum()) for st, _ in self._sig), 1.0)
+        )
+
+    def _path(self, prefix: str, s: int) -> str:
+        return os.path.join(self.data_dir, f"{prefix}-{s:05d}.npy")
+
+    def _load_padded(self, s: int):
+        """One shard, padded to pad_rows, as device arrays (transfer is
+        async — the caller overlaps it with the previous shard's compute)."""
+        jax = self._jax
+        rows = self.meta.shard_rows[s]
+        pad = self.pad_rows - rows
+        x = np.load(self._path(self.prefix, s), mmap_mode="r")
+        t = np.load(self._path("tags", s), mmap_mode="r")
+        sig_t, sig_v = self._sig[s]
+        x = np.asarray(x, np.float32)
+        t = np.asarray(t, np.float32)
+        if pad:
+            x = np.pad(x, ((0, pad), (0, 0)))
+            t = np.pad(t, (0, pad))
+            sig_t = np.pad(sig_t, (0, pad))
+            sig_v = np.pad(sig_v, (0, pad))
+        return (jax.device_put(x), jax.device_put(t),
+                jax.device_put(sig_t), jax.device_put(sig_v))
+
+    def __iter__(self):
+        nxt = self._load_padded(0) if self.n_shards else None
+        for s in range(self.n_shards):
+            cur = nxt
+            # kick off the next transfer BEFORE yielding: device_put returns
+            # immediately, so the copy rides under the caller's compute
+            nxt = self._load_padded(s + 1) if s + 1 < self.n_shards else None
+            yield cur
+
+
+# One compiled shard-gradient program per (arch, hyperparam) signature.
+_SHARD_PROGRAMS: dict = {}
+
+
+def _get_shard_program(cfg: NNTrainConfig, shapes):
+    import jax
+
+    key = (
+        tuple(shapes), tuple(cfg.activations), cfg.loss, cfg.dropout_rate,
+        cfg.mixed_precision,
+    )
+    prog = _SHARD_PROGRAMS.get(key)
+    if prog is None:
+        step_metrics = _loss_and_errors(cfg, shapes)
+
+        @jax.jit
+        def shard_grad(flat, x, t, sig_t, sig_v, key0, tclass):
+            import jax.numpy as jnp
+
+            # tclass >= 0: ONEVSALL member — binary target is (tag == class)
+            t2 = jnp.where(tclass >= 0,
+                           (t == tclass.astype(t.dtype)).astype(jnp.float32),
+                           t)
+            g, tr, va = step_metrics(flat, x, t2, sig_t, sig_v, key0)
+            # weighted squared-error SUMS so shard partials add exactly
+            tr_w = jnp.sum(sig_t)
+            va_w = jnp.sum(sig_v)
+            return g, tr * tr_w, va * va_w, tr_w, va_w
+
+        _SHARD_PROGRAMS[key] = shard_grad
+        prog = shard_grad
+    return prog
+
+
+def train_nn_streamed(
+    data_dir: str,
+    cfg: NNTrainConfig,
+    init_flat: Optional[np.ndarray] = None,
+    target_class: Optional[int] = None,
+) -> TrainResult:
+    """Full-batch BSP training streamed from shards: per epoch, sum shard
+    gradients (the NNMaster worker-sum), then ONE weight update. Matches
+    train_nn's semantics for full-batch runs; mini_batchs is ignored (each
+    shard already bounds device memory)."""
+    import jax
+    import jax.numpy as jnp
+
+    if cfg.mini_batchs > 1:
+        log.warning("MiniBatchs=%d is ignored on the streamed path — each "
+                    "epoch is one full-batch pass over the shards",
+                    cfg.mini_batchs)
+    feed = ShardFeed(data_dir, cfg)
+    d = len(feed.meta.columns)
+    out_dim = cfg.n_classes if cfg.n_classes > 2 else 1
+    layer_sizes = [d] + list(cfg.hidden_nodes) + [out_dim]
+    params0 = init_params(layer_sizes, seed=cfg.seed, init=cfg.weight_init)
+    flat0, shapes = flatten_params(params0)
+    if init_flat is not None and init_flat.size == flat0.size:
+        flat0 = init_flat.astype(np.float32)
+
+    shard_grad = _get_shard_program(cfg, shapes)
+    init_state, apply_update = make_updater(
+        cfg.propagation,
+        momentum=cfg.momentum,
+        reg=cfg.regularized_constant,
+        reg_level=cfg.reg_level,
+        adam_beta1=cfg.adam_beta1,
+        adam_beta2=cfg.adam_beta2,
+    )
+
+    flat = jnp.asarray(flat0)
+    opt = init_state(flat0.size)
+    lr = cfg.learning_rate
+    nts = jnp.float32(feed.n_train_size)
+    key0 = jax.random.PRNGKey(cfg.seed)
+    tclass = jnp.int32(-1 if target_class is None else target_class)
+
+    best_val = math.inf
+    best_flat = np.asarray(flat)
+    bad = 0
+    tr_e = va_e = 0.0
+    it_done = 0
+    for it in range(cfg.num_epochs):
+        key = jax.random.fold_in(key0, it)
+        g_sum = None
+        tr_sum = va_sum = tr_w = va_w = None
+        for s, (x, t, sig_t, sig_v) in enumerate(feed):
+            # fold the shard index in so dropout masks differ per shard
+            key_s = jax.random.fold_in(key, s)
+            g, trs, vas, trw, vaw = shard_grad(flat, x, t, sig_t, sig_v,
+                                               key_s, tclass)
+            if g_sum is None:
+                g_sum, tr_sum, va_sum, tr_w, va_w = g, trs, vas, trw, vaw
+            else:
+                g_sum = g_sum + g
+                tr_sum, va_sum = tr_sum + trs, va_sum + vas
+                tr_w, va_w = tr_w + trw, va_w + vaw
+        tr_e = float(tr_sum / jnp.maximum(tr_w, 1.0))
+        va_e = float(va_sum / jnp.maximum(va_w, 1.0))
+        # best-weights bookkeeping BEFORE the update (va measured pre-update)
+        if va_e < best_val:
+            best_val = va_e
+            best_flat = np.asarray(flat)
+            bad = 0
+        else:
+            bad += 1
+        flat, opt = apply_update(opt, flat, g_sum, jnp.float32(lr),
+                                 jnp.int32(it + 1), nts)
+        lr *= 1.0 - cfg.learning_decay
+        it_done = it + 1
+        if cfg.progress_cb and cfg.checkpoint_every and (
+            it_done % cfg.checkpoint_every == 0
+        ):
+            cfg.progress_cb(it_done, tr_e, va_e)
+        if cfg.checkpoint_path and cfg.checkpoint_every and (
+            it_done % cfg.checkpoint_every == 0
+        ):
+            np.save(cfg.checkpoint_path, np.asarray(flat))
+        if cfg.early_stop_window and bad >= cfg.early_stop_window:
+            log.info("streamed early stop at epoch %d", it_done)
+            break
+        if cfg.convergence_threshold and (
+            (tr_e + va_e) / 2.0 <= cfg.convergence_threshold
+        ):
+            break
+
+    use_best = cfg.valid_set_rate > 0 and math.isfinite(best_val)
+    chosen = best_flat if use_best else np.asarray(flat)
+    log.info("streamed train done: %d epochs over %d shards, train %.6f "
+             "valid %.6f", it_done, feed.n_shards, tr_e,
+             best_val if use_best else va_e)
+    return TrainResult(
+        params=unflatten_params(chosen, shapes),
+        train_error=tr_e,
+        valid_error=best_val if use_best else va_e,
+        iterations=it_done,
+    )

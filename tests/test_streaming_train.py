@@ -1,0 +1,148 @@
+"""Larger-than-memory training: shards stream through the chip instead of
+concatenating into one host array (MemoryDiskFloatMLDataSet parity,
+train/streaming.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_model_set
+
+
+def _write_shards(tmp_path, n=4000, d=12, n_shards=6, seed=3):
+    from shifu_tpu.norm.dataset import write_normalized
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    logits = 1.5 * x[:, 0] - x[:, 1] + 0.8 * x[:, 2]
+    t = (logits + rng.normal(scale=0.4, size=n) > 0).astype(np.int8)
+    w = np.ones(n, dtype=np.float32)
+    out = str(tmp_path / "NormalizedData")
+    write_normalized(out, x, t, w, [f"c{i}" for i in range(d)],
+                     n_shards=n_shards)
+    return out, x, t
+
+
+def test_streamed_training_learns(tmp_path):
+    from shifu_tpu.train.nn_trainer import NNTrainConfig
+    from shifu_tpu.train.streaming import train_nn_streamed
+
+    data_dir, x, t = _write_shards(tmp_path)
+    cfg = NNTrainConfig(hidden_nodes=[16], activations=["tanh"],
+                        propagation="R", num_epochs=40, valid_set_rate=0.15,
+                        seed=5)
+    res = train_nn_streamed(data_dir, cfg)
+    assert res.iterations == 40
+    assert res.valid_error < 0.08, res.valid_error
+
+    # the returned params score like an in-memory model
+    from shifu_tpu.models.nn import forward
+    import jax.numpy as jnp
+
+    p = np.asarray(forward(res.params, jnp.asarray(x), ["tanh"]))[:, 0]
+    acc = float(((p > 0.5).astype(int) == t).mean())
+    assert acc > 0.9
+
+
+def test_streamed_matches_inmemory_quality(tmp_path):
+    """Streamed full-batch BSP = sum of shard gradients; quality must track
+    the in-memory trainer on the same data (sampling streams differ, so
+    compare errors, not bits)."""
+    from shifu_tpu.norm.dataset import load_normalized
+    from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
+    from shifu_tpu.train.streaming import train_nn_streamed
+
+    data_dir, _, _ = _write_shards(tmp_path, n=3000, n_shards=5)
+    cfg = NNTrainConfig(hidden_nodes=[12], activations=["tanh"],
+                        propagation="R", num_epochs=40, valid_set_rate=0.15,
+                        seed=9)
+    streamed = train_nn_streamed(data_dir, cfg)
+    _, feats, tags, weights = load_normalized(data_dir)
+    mem = train_nn(np.asarray(feats, np.float32),
+                   np.asarray(tags, np.float32),
+                   np.asarray(weights, np.float32), cfg)
+    assert abs(streamed.valid_error - mem.valid_error) < 0.05
+    assert streamed.valid_error < 0.1 and mem.valid_error < 0.1
+
+
+def test_streamed_early_stop_and_checkpoint(tmp_path):
+    from shifu_tpu.train.nn_trainer import NNTrainConfig
+    from shifu_tpu.train.streaming import train_nn_streamed
+
+    data_dir, _, _ = _write_shards(tmp_path, n=1500, n_shards=3)
+    ck = str(tmp_path / "ck.npy")
+    seen = []
+    cfg = NNTrainConfig(hidden_nodes=[8], activations=["tanh"],
+                        propagation="R", num_epochs=200, valid_set_rate=0.2,
+                        early_stop_window=5, seed=2,
+                        checkpoint_every=10, checkpoint_path=ck,
+                        progress_cb=lambda it, tr, va: seen.append(it))
+    res = train_nn_streamed(data_dir, cfg)
+    assert res.iterations < 200  # early stop fired
+    assert os.path.isfile(ck)
+    assert seen and seen == sorted(seen)
+
+
+def test_processor_streams_when_forced(tmp_path):
+    """train.trainOnDisk=true routes through the streamed trainer and still
+    produces a loadable model + artifacts."""
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=400)
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 25
+    mc.train.train_on_disk = True
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    assert TrainProcessor(root).run() == 0
+
+    from shifu_tpu.models.nn import NNModelSpec
+
+    spec = NNModelSpec.load(os.path.join(root, "models", "model0.nn"))
+    assert spec.valid_error is not None and spec.valid_error < 0.2
+    assert os.path.isfile(os.path.join(root, "tmp", "train",
+                                       "progress_0.log"))
+
+
+def test_streaming_rejects_grid_search(tmp_path):
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=300)
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+    from shifu_tpu.utils.errors import ShifuError
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.train_on_disk = True
+    mc.train.params["LearningRate"] = [0.1, 0.2]
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    with pytest.raises(ShifuError):
+        TrainProcessor(root).run()
+
+
+def test_should_stream_training_budget(tmp_path):
+    from shifu_tpu.train.streaming import should_stream_training
+    from shifu_tpu.utils import environment
+
+    data_dir, _, _ = _write_shards(tmp_path, n=2000, d=8, n_shards=2)
+    assert not should_stream_training(data_dir)
+    assert should_stream_training(data_dir, force_attr=True)
+    environment.set_property("shifu.train.memoryBudgetMB", "0")
+    try:
+        assert should_stream_training(data_dir)
+    finally:
+        environment.set_property("shifu.train.memoryBudgetMB",
+                                 str(1024))
