@@ -28,12 +28,12 @@ class RefModelAdapter:
 
     def __init__(self, kind: str, model, path: str = "",
                  norm_plan=None):
-        self.kind = kind  # 'eg-nn' | 'egb-nn' | 'ref-tree'
+        self.kind = kind  # 'eg-nn' | 'egb-nn' | 'ref-tree' | 'ref-wdl'
         self.model = model
         self.path = path
         self.norm_plan = norm_plan  # NormPlan for eg-nn (external stats)
         self.algorithm = (
-            model.algorithm if kind == "ref-tree" else "NN"
+            model.algorithm if kind in ("ref-tree", "ref-wdl") else "NN"
         )
 
     # -- scoring -------------------------------------------------------------
@@ -69,6 +69,8 @@ class RefModelAdapter:
 
     def score_raw(self, data) -> np.ndarray:
         """ColumnarData of raw records -> scores in [0, 1]."""
+        if self.kind == "ref-wdl":
+            return np.clip(self.model.compute_raw(data), 0.0, 1.0)
         if self.kind == "ref-tree":
             m: treespec.RefTreeModel = self.model
             raw = m.compute(self._tree_matrix(data))
@@ -90,8 +92,11 @@ class RefModelAdapter:
         return np.clip(np.ravel(self.model.compute(feats)), 0.0, 1.0)
 
     def score_normalized(self, feats: np.ndarray) -> np.ndarray:
-        if self.kind == "ref-tree":
-            raise ValueError("reference tree models score raw values")
+        if self.kind in ("ref-tree", "ref-wdl"):
+            raise ValueError(
+                "reference tree/WDL models score raw records (they need "
+                "bin codes / categorical values, not a normalized matrix)"
+            )
         return np.clip(np.ravel(self.model.compute(feats)), 0.0, 1.0)
 
 
@@ -123,10 +128,15 @@ def load_ref_model(path: str, column_configs=None, model_config=None
         return RefModelAdapter("eg-nn", net, path, norm_plan=plan)
     if fmt == "zip":
         return RefModelAdapter("ref-tree", treespec.read_zip_model(blob), path)
-    # gzip java stream: tree vs nn container — try tree first by extension
+    # gzip java stream: tree vs nn vs wdl container — extension first
     suffix = path.rsplit(".", 1)[-1].lower()
     if suffix in ("gbt", "rf"):
         return RefModelAdapter("ref-tree", treespec.read_tree_model(blob), path)
+    if suffix == "wdl":
+        from shifu_tpu.compat import wdl as wdl_compat
+
+        return RefModelAdapter("ref-wdl", wdl_compat.read_wdl_model(blob),
+                               path)
     try:
         return RefModelAdapter("egb-nn", egb.read_nn_model(blob), path)
     except Exception:  # not an NN container after all

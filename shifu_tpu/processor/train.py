@@ -151,17 +151,13 @@ class TrainProcessor(BasicProcessor):
                              "weights.npy")
                 for i in range(bagging)
             ]
-            progress_paths = [self.paths.progress_path(i) for i in range(bagging)]
+            from shifu_tpu.processor.train_common import (
+                member_progress_writer,
+            )
 
-            def progress(member_it, tr, va):
-                i, it = member_it
-                with open(progress_paths[i], "a") as fh:
-                    fh.write(
-                        f"Trainer {i} Epoch #{it} Train Error:{tr:.8f} "
-                        f"Validation Error:{va:.8f}\n"
-                    )
-
-            base_cfg.progress_cb = progress
+            base_cfg.progress_cb = member_progress_writer(
+                [self.paths.progress_path(i) for i in range(bagging)]
+            )
             results = train_nn_bagged(feats, tags, weights, base_cfg, bagging,
                                       mesh=mesh, init_flats=init_flats,
                                       checkpoint_paths=checkpoint_paths)
@@ -186,17 +182,9 @@ class TrainProcessor(BasicProcessor):
         cfg.checkpoint_path = os.path.join(
             self.paths.ensure(self.paths.checkpoint_dir(0)), "weights.npy"
         )
-        progress_path = self.paths.progress_path(0)
+        from shifu_tpu.processor.train_common import progress_writer
 
-        def progress(it, tr, va, _p=progress_path):
-            with open(_p, "a") as fh:
-                fh.write(
-                    f"Trainer 0 Epoch #{it} Train Error:{tr:.8f} "
-                    f"Validation Error:{va:.8f}\n"
-                )
-            log.info("trainer 0 epoch %d train %.6f valid %.6f", it, tr, va)
-
-        cfg.progress_cb = progress
+        cfg.progress_cb = progress_writer(self.paths.progress_path(0))
         result = train_nn(feats, tags, weights, cfg, mesh=mesh,
                           init_flat=init_flat)
         spec = self._make_spec(alg, cfg, result, meta.columns, norm_json)
@@ -243,16 +231,9 @@ class TrainProcessor(BasicProcessor):
             cfg.checkpoint_path = os.path.join(
                 self.paths.ensure(self.paths.checkpoint_dir(i)), "weights.npy"
             )
-            progress_path = self.paths.progress_path(i)
+            from shifu_tpu.processor.train_common import progress_writer
 
-            def progress(it, tr, va, _p=progress_path, _i=i):
-                with open(_p, "a") as fh:
-                    fh.write(
-                        f"Trainer {_i} Epoch #{it} Train Error:{tr:.8f} "
-                        f"Validation Error:{va:.8f}\n"
-                    )
-
-            cfg.progress_cb = progress
+            cfg.progress_cb = progress_writer(self.paths.progress_path(i), i)
             init_flat = (self._continuous_init(i, suffix)
                          if mc.train.is_continuous else None)
             res = train_nn_streamed(norm_dir, cfg, init_flat=init_flat,

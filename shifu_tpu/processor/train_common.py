@@ -1,0 +1,37 @@
+"""Shared trainer-orchestration helpers for the NN and WDL processors.
+
+The progress-line format is a CONTRACT (the reference's NNOutput progress
+files are tailed by TailThread and parsed by downstream tooling,
+TrainModelProcessor.java:1862) — it must exist in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+
+def progress_line(trainer_id: int, epoch: int, train_err: float,
+                  valid_err: float) -> str:
+    return (f"Trainer {trainer_id} Epoch #{epoch} "
+            f"Train Error:{train_err:.8f} Validation Error:{valid_err:.8f}\n")
+
+
+def progress_writer(path: str, trainer_id: int = 0) -> Callable:
+    """Single-trainer progress callback: (epoch, train_err, valid_err)."""
+
+    def cb(it, tr, va):
+        with open(path, "a") as fh:
+            fh.write(progress_line(trainer_id, it, tr, va))
+
+    return cb
+
+
+def member_progress_writer(paths: List[str]) -> Callable:
+    """Vmapped-member progress callback: ((member, epoch), tr, va)."""
+
+    def cb(member_it, tr, va):
+        i, it = member_it
+        with open(paths[i], "a") as fh:
+            fh.write(progress_line(i, it, tr, va))
+
+    return cb

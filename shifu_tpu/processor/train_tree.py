@@ -84,6 +84,8 @@ def train_tree_models(proc, alg) -> None:
 
     mesh = data_mesh() if len(jax.devices()) > 1 else None
 
+    from shifu_tpu.models.tree import TreeModelSpec
+
     for i in range(bagging):
         cfg = TreeTrainConfig.from_model_config(mc, trainer_id=i)
         progress_path = proc.paths.progress_path(i)
@@ -96,14 +98,101 @@ def train_tree_models(proc, alg) -> None:
                 log.info("trainer %d tree %d train %.6f valid %.6f",
                          _i, k, tr, va)
 
+        # ---- per-tree checkpoint + resume (DTMaster.doCheckPoint:637,
+        # recovery :284-291): a killed run restarts from the last
+        # checkpointed tree, bit-equal thanks to per-tree RNG streams ----
+        ck_dir = proc.paths.ensure(proc.paths.checkpoint_dir(i))
+        ck_path = os.path.join(ck_dir, "trees.ckpt")
+        ck_state_path = ck_path + ".json"
+        ck_every = max(1, int(mc.train.get_param("CheckpointInterval", 10)))
+        # full hyperparameter fingerprint: a leftover checkpoint from a
+        # differently-configured run must NOT be silently grafted onto
+        # this one (bit-equal resume is only meaningful for the same cfg)
+        fingerprint = {
+            "algorithm": cfg.algorithm, "loss": cfg.loss,
+            "maxDepth": cfg.max_depth, "maxLeaves": cfg.max_leaves,
+            "impurity": cfg.impurity, "learningRate": cfg.learning_rate,
+            "minInstancesPerNode": cfg.min_instances_per_node,
+            "minInfoGain": cfg.min_info_gain,
+            "featureSubsetStrategy": cfg.feature_subset_strategy,
+            "baggingSampleRate": cfg.bagging_sample_rate,
+            "baggingWithReplacement": cfg.bagging_with_replacement,
+            "validSetRate": cfg.valid_set_rate, "seed": cfg.seed,
+        }
+        init_trees = None
+        init_val_errors = None
+        if os.path.isfile(ck_path):
+            import json as _json
+
+            try:
+                ck_spec = TreeModelSpec.load(ck_path)
+                state = {}
+                if os.path.isfile(ck_state_path):
+                    with open(ck_state_path) as fh:
+                        state = _json.load(fh)
+                if state.get("fingerprint") != fingerprint:
+                    log.warning("checkpoint %s was built with different "
+                                "hyperparameters; starting fresh", ck_path)
+                elif len(ck_spec.trees) < cfg.tree_num:
+                    init_trees = ck_spec.trees
+                    init_val_errors = state.get("validErrors")
+                    log.info("resuming trainer %d from checkpoint: %d trees",
+                             i, len(init_trees))
+            except Exception as e:  # corrupt checkpoint: fresh start
+                log.warning("cannot resume from %s (%s)", ck_path, e)
+
+        # ---- isContinuous: GBT keeps adding trees up to TreeNum
+        # (TrainModelProcessor.java:1166-1184); RF starts from scratch ----
+        if init_trees is None and mc.train.is_continuous:
+            model_path = proc.paths.model_path(i, suffix)
+            if cfg.algorithm != "GBT":
+                log.warning("RF doesn't support continuous training")
+            elif os.path.isfile(model_path):
+                try:
+                    old = TreeModelSpec.load(model_path)
+                    if old.loss != cfg.loss:
+                        log.warning("Loss changed, continuous training "
+                                    "disabled; starting from scratch")
+                    elif len(old.trees) >= cfg.tree_num:
+                        log.info("model %d already has %d >= TreeNum trees; "
+                                 "skipping", i, len(old.trees))
+                        continue
+                    else:
+                        init_trees = old.trees
+                        log.info("continuous training: model %d grows from "
+                                 "%d trees", i, len(init_trees))
+                except Exception as e:
+                    log.warning("cannot continue from %s (%s)", model_path, e)
+
+        def checkpoint(k, trees_now, val_errs, _ck=ck_path,
+                       _state=ck_state_path, _every=ck_every,
+                       _fp=fingerprint):
+            if k % _every == 0:
+                import json as _json
+
+                TreeModelSpec(
+                    algorithm=cfg.algorithm, trees=list(trees_now),
+                    input_columns=list(meta.columns),
+                    slots=[int(s) for s in slots],
+                    boundaries=boundaries, categories=categories,
+                    loss=cfg.loss, learning_rate=cfg.learning_rate,
+                ).save(_ck)
+                with open(_state, "w") as fh:
+                    _json.dump({"fingerprint": _fp,
+                                "validErrors": list(val_errs)}, fh)
+
         tags_i = one_vs_all_tags[i] if one_vs_all_tags is not None else tags
         result = train_trees(
             codes, tags_i, weights, slots, is_cat, meta.columns, cfg,
             boundaries=boundaries, categories=categories, progress_cb=progress,
-            mesh=mesh,
+            mesh=mesh, init_trees=init_trees,
+            init_valid_errors=init_val_errors, checkpoint_cb=checkpoint,
         )
         path = proc.paths.model_path(i, suffix)
         result.spec.save(path)
+        for leftover in (ck_path, ck_state_path):
+            if os.path.isfile(leftover):
+                os.remove(leftover)  # completed: checkpoint no longer needed
         with open(proc.paths.val_error_path(i), "w") as fh:
             fh.write(f"{result.valid_error}\n")
         log.info("model %d (%s, %d trees) -> %s (valid err %.6f)",

@@ -1,10 +1,15 @@
 """`shifu train` for WDL — dense numerics from NormalizedData, categorical
 codes from CleanedData (parity: prepareWDLParams TrainModelProcessor.java:1474,
-wdl/WDLWorker input wiring: numeric z-score + categorical sparse index)."""
+wdl/WDLWorker input wiring: numeric z-score + categorical sparse index).
+
+WDL is a FIRST-CLASS trainer: vmapped bagging, grid search, k-fold,
+continuous training and checkpoints — identical treatment to NN
+(TrainModelProcessor.java:768-945 fans WDL out exactly like NN jobs)."""
 
 from __future__ import annotations
 
 import os
+from typing import List, Optional
 
 import numpy as np
 
@@ -15,15 +20,30 @@ from shifu_tpu.utils.log import get_logger
 log = get_logger(__name__)
 
 
+def _wdl_signature(cfg) -> tuple:
+    """Static program signature: trials sharing it differ only in traced
+    operands (LearningRate, seed) and batch on the member axis."""
+    return (
+        tuple(cfg.hidden), tuple(cfg.activations), cfg.embed_dim,
+        cfg.optimizer, cfg.l2_reg, cfg.num_epochs, cfg.valid_set_rate,
+        cfg.bagging_sample_rate, cfg.bagging_with_replacement,
+        cfg.early_stop_window,
+    )
+
+
 def train_wdl_models(proc) -> None:
-    from shifu_tpu.models.wdl import WDLModelSpec
+    from shifu_tpu.models.wdl import WDLModelSpec, flatten_wdl
     from shifu_tpu.norm.normalizer import (
         build_norm_plan,
         norm_columns,
-        plan_to_json,
         spec_to_json,
     )
-    from shifu_tpu.train.wdl_trainer import WDLTrainConfig, train_wdl
+    from shifu_tpu.train.grid_search import flatten_params
+    from shifu_tpu.train.wdl_trainer import (
+        WDLTrainConfig,
+        train_wdl,
+        train_wdl_bagged,
+    )
 
     mc = proc.model_config
     norm_dir = proc.paths.normalized_data_dir()
@@ -68,12 +88,9 @@ def train_wdl_models(proc) -> None:
 
     proc.paths.ensure(proc.paths.models_dir())
     proc.paths.ensure(proc.paths.train_dir())
-    bagging = max(1, int(mc.train.bagging_num or 1))
-    for i in range(bagging):
-        cfg = WDLTrainConfig.from_model_config(mc, trainer_id=i)
-        res = train_wdl(dense, cat_codes, tags, weights, vocab_sizes, cfg,
-                        mesh=proc._mesh())
-        spec = WDLModelSpec(
+
+    def make_spec(cfg, res) -> "WDLModelSpec":
+        return WDLModelSpec(
             hidden=list(cfg.hidden),
             activations=list(cfg.activations),
             embed_dim=cfg.embed_dim,
@@ -88,9 +105,136 @@ def train_wdl_models(proc) -> None:
             train_error=res.train_error,
             valid_error=res.valid_error,
         )
+
+    def save_member(i, cfg, res):
+        spec = make_spec(cfg, res)
         path = proc.paths.model_path(i, "wdl")
         spec.save(path)
         with open(proc.paths.val_error_path(i), "w") as fh:
             fh.write(f"{res.valid_error}\n")
         log.info("model %d (WDL) -> %s (valid err %.6f)", i, path,
                  res.valid_error)
+
+    def continuous_init(i) -> Optional[np.ndarray]:
+        """Resume from the existing model's weights when isContinuous
+        (checkContinuousTraining:1149 parity; shape mismatch = scratch)."""
+        if not mc.train.is_continuous:
+            return None
+        path = proc.paths.model_path(i, "wdl")
+        if not os.path.isfile(path):
+            return None
+        try:
+            spec = WDLModelSpec.load(path)
+            flat = flatten_wdl(spec.params)
+            log.info("continuous training: resuming WDL model %d from %s",
+                     i, path)
+            return flat
+        except Exception as e:
+            log.warning("cannot resume from %s (%s); fresh start", path, e)
+            return None
+
+
+    mesh = proc._mesh()
+    composites = flatten_params(
+        mc.train.params or {},
+        proc.resolve(mc.train.grid_config_file)
+        if mc.train.grid_config_file else None,
+    )
+    num_kfold = mc.train.num_k_fold or -1
+    bagging = max(1, int(mc.train.bagging_num or 1))
+    ck_every = proc._checkpoint_every()
+
+    # ---- grid search: trials batched on the member axis per signature ----
+    if len(composites) > 1:
+        orig = mc.train.params
+        cfgs = []
+        for gi, params in enumerate(composites):
+            mc.train.params = params
+            try:
+                cfgs.append(WDLTrainConfig.from_model_config(mc, trainer_id=gi))
+            finally:
+                mc.train.params = orig
+        groups: dict = {}
+        for gi, cfg in enumerate(cfgs):
+            groups.setdefault(_wdl_signature(cfg), []).append(gi)
+        scored = []
+        for idxs in groups.values():
+            trial_results = train_wdl_bagged(
+                dense, cat_codes, tags, weights, vocab_sizes, cfgs[idxs[0]],
+                len(idxs), mesh=mesh,
+                member_lrs=[cfgs[i].learning_rate for i in idxs],
+            )
+            for gi, res in zip(idxs, trial_results):
+                scored.append((res.valid_error, gi, composites[gi]))
+                log.info("wdl grid trial %d/%d valid err %.6f params=%s",
+                         gi + 1, len(composites), res.valid_error,
+                         composites[gi])
+        scored.sort(key=lambda r: r[0])
+        best = scored[0][2]
+        log.info("wdl grid search best params: %s", best)
+        mc.train.params = best
+        composites = [best]
+
+    # ---- k-fold: folds on the member axis, unbiased holdout ----
+    if num_kfold > 0:
+        n = dense.shape[0]
+        fold = np.arange(n) % num_kfold
+        base = WDLTrainConfig.from_model_config(mc, trainer_id=0)
+        base.valid_set_rate = 0.0
+        base.early_stop_window = 0
+        sig_t = np.stack([
+            np.where(fold == i, 0.0, weights) for i in range(num_kfold)
+        ]).astype(np.float32)
+        sig_v = np.stack([
+            np.where(fold == i, weights, 0.0) for i in range(num_kfold)
+        ]).astype(np.float32)
+        results = train_wdl_bagged(
+            dense, cat_codes, tags, weights, vocab_sizes, base, num_kfold,
+            mesh=mesh, member_sigs=(sig_t, sig_v),
+        )
+        errors = []
+        for i, res in enumerate(results):
+            cfg_i = WDLTrainConfig.from_model_config(mc, trainer_id=i)
+            save_member(i, cfg_i, res)
+            errors.append(res.valid_error)
+            log.info("wdl fold %d/%d holdout err %.6f", i + 1, num_kfold,
+                     res.valid_error)
+        log.info("wdl k-fold avg validation error: %.6f",
+                 float(np.mean(errors)))
+        return
+
+    # ---- bagging (vmapped) / single model ----
+    base_cfg = WDLTrainConfig.from_model_config(mc, trainer_id=0)
+    base_cfg.checkpoint_every = ck_every
+    if bagging > 1:
+        init_flats = [continuous_init(i) for i in range(bagging)]
+        checkpoint_paths = [
+            os.path.join(proc.paths.ensure(proc.paths.checkpoint_dir(i)),
+                         "weights.npy")
+            for i in range(bagging)
+        ]
+        from shifu_tpu.processor.train_common import member_progress_writer
+
+        base_cfg.progress_cb = member_progress_writer(
+            [proc.paths.progress_path(i) for i in range(bagging)]
+        )
+        results = train_wdl_bagged(
+            dense, cat_codes, tags, weights, vocab_sizes, base_cfg, bagging,
+            mesh=mesh, init_flats=init_flats,
+            checkpoint_paths=checkpoint_paths,
+        )
+        for i, res in enumerate(results):
+            cfg_i = WDLTrainConfig.from_model_config(mc, trainer_id=i)
+            save_member(i, cfg_i, res)
+        return
+
+    cfg = base_cfg
+    cfg.checkpoint_path = os.path.join(
+        proc.paths.ensure(proc.paths.checkpoint_dir(0)), "weights.npy"
+    )
+    from shifu_tpu.processor.train_common import progress_writer
+
+    cfg.progress_cb = progress_writer(proc.paths.progress_path(0))
+    res = train_wdl(dense, cat_codes, tags, weights, vocab_sizes, cfg,
+                    mesh=mesh, init_flat=continuous_init(0))
+    save_member(0, cfg, res)

@@ -1,33 +1,44 @@
-"""GBT/RF histogram tree builder — level-wise, one fused scatter-add per level.
+"""GBT/RF histogram tree builder — fused scatter-add histograms, level-wise
+or leaf-wise growth, per-tree checkpoint/resume.
 
 What DTMaster/DTWorker do across a Hadoop cluster (SURVEY §3.2: workers
 accumulate per-node per-feature bin histograms via Impurity.featureUpdate
 dt/DTWorker.java:851, master merges + picks best split per node
-dt/DTMaster.java:274-360) happens here as one jit program per tree level:
+dt/DTMaster.java:274-360) happens here as jit programs over a FLAT
+per-feature slot layout:
 
-    histogram    [L, F, S, 3] (cnt, sum, sqsum) built by ONE scatter-add over
-                 the [n, F] code matrix — the Pallas-able hot op; XLA's TPU
-                 scatter handles it. Row-sharded inputs all-reduce (psum) the
-                 histogram when run on a mesh.
-    split scan   ordered prefix sums per (node, feature): numeric bins keep
-                 code order, categorical bins are sorted by label mean per
-                 node (the reference sorts categories by mean response,
-                 DTMaster split search); gain by impurity
-                 (variance/friedmanmse: dt/Impurity.java:106,255;
-                 entropy/gini via binary counts :368,553).
-    node update  rows re-position via the chosen feature's goes-left bin mask.
+    histogram  [3, L, T]  T = sum(slots_f): each feature owns exactly its
+               own slot segment, so one 10k-category column no longer
+               inflates every feature's histogram (the reference budgets
+               node batches by stats memory, DTMaster.java:450-467 — here
+               the node-batch size L is sized from MaxStatsMemoryMB over
+               the true T). Built by ONE scatter-add over the [n, F] code
+               matrix; row-sharded inputs all-reduce (psum) the histogram
+               when run on a mesh.
+    split scan ordered prefix sums per (node, feature segment): numeric
+               segments keep code order, categorical segments sort by label
+               mean (lexsort within static segment boundaries); gain by
+               impurity (variance/friedmanmse: dt/Impurity.java:106,255;
+               entropy/gini via binary counts :368,553).
+    growth     level-wise (default) or LEAF-WISE under maxLeaves
+               (DTMaster.java:137, toSplitQueue :260-271): best-gain leaf
+               splits first, explicit child pointers.
 
 GBT parity (dt/DTWorker.java:1470-1486): tree 0 weight 1.0, later trees
-weight=learningRate; per-tree labels are -loss gradient (squared -> residual,
-log -> y - sigmoid(pred)). RF: per-tree Poisson bagging + feature subset
-(FeatureSubsetStrategy.java).
+weight=learningRate; per-tree labels are -loss gradient. RF: per-tree
+Poisson bagging + feature subset (FeatureSubsetStrategy.java). Per-tree
+RNG streams are keyed by (seed, tree_index) so a checkpointed run resumes
+BIT-EQUAL (DTMaster.doCheckPoint:637, recovery :284-291); isContinuous
+keeps adding GBT trees up to TreeNum (TrainModelProcessor.java:1166-1184).
+Early stop: simple worsen-count OR the reference's windowed decider
+(dt/DTEarlyStopDecider.java:49) under EnableEarlyStop.
 """
 
 from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -42,6 +53,7 @@ class TreeTrainConfig:
     algorithm: str = "GBT"  # GBT | RF
     tree_num: int = 100
     max_depth: int = 6
+    max_leaves: int = -1  # > 0 switches to leaf-wise growth
     impurity: str = "variance"  # variance | friedmanmse | entropy | gini
     loss: str = "squared"  # squared | log (GBT label relabeling)
     learning_rate: float = 0.05
@@ -52,6 +64,8 @@ class TreeTrainConfig:
     bagging_with_replacement: bool = True
     valid_set_rate: float = 0.1
     early_stop_rounds: int = 0  # GBT: stop when valid error worsens N rounds
+    enable_early_stop: bool = False  # DTEarlyStopDecider windowed decider
+    max_stats_memory_mb: int = 256  # histogram node-batch budget
     seed: int = 0
 
     @classmethod
@@ -68,6 +82,7 @@ class TreeTrainConfig:
             algorithm=alg,
             tree_num=int(g("TreeNum", 100 if alg == "GBT" else 10)),
             max_depth=int(g("MaxDepth", 6 if alg == "GBT" else 10)),
+            max_leaves=int(g("MaxLeaves", -1)),
             impurity=str(g("Impurity", "variance")).lower(),
             loss=str(g("Loss", "squared")).lower(),
             learning_rate=float(g("LearningRate", 0.05)),
@@ -80,6 +95,8 @@ class TreeTrainConfig:
             bagging_with_replacement=bool(t.bagging_with_replacement),
             valid_set_rate=float(t.valid_set_rate or 0.1),
             early_stop_rounds=int(g("EarlyStopRounds", 0)),
+            enable_early_stop=bool(g("EnableEarlyStop", False)),
+            max_stats_memory_mb=int(g("MaxStatsMemoryMB", 256)),
             seed=trainer_id * 977 + 13,
         )
 
@@ -103,88 +120,175 @@ def subset_count(strategy: str, n_features: int) -> int:
     return n_features
 
 
-# Cached per-level compiled programs keyed by static shape/hyperparams.
-_LEVEL_PROGRAMS: Dict[tuple, object] = {}
+# ---------------------------------------------------------------------------
+# static per-feature slot layout
+# ---------------------------------------------------------------------------
 
 
-def _get_level_program(L: int, F: int, S: int, impurity: str,
-                       min_inst: int, min_gain: float):
-    key = (L, F, S, impurity, min_inst, float(min_gain))
-    prog = _LEVEL_PROGRAMS.get(key)
+@dataclass(frozen=True)
+class FeatureLayout:
+    """Flat per-feature slot addressing: feature f owns slots
+    [off[f], off[f]+slots[f]) of a T-wide axis. All arrays are static per
+    (slots, is_cat) signature and shared by every compiled program."""
+
+    slots: np.ndarray  # [F] int32
+    off: np.ndarray  # [F] int32 segment starts
+    T: int
+    seg_of_t: np.ndarray  # [T] feature id per flat slot
+    pos_in_seg: np.ndarray  # [T] slot rank within its segment
+    seg_start_t: np.ndarray  # [T]
+    seg_size_t: np.ndarray  # [T]
+    is_cat_t: np.ndarray  # [T] bool
+    clip_max: np.ndarray  # [F] slots-1
+    s_max: int
+
+
+_LAYOUTS: Dict[tuple, FeatureLayout] = {}
+
+
+def make_layout(slots: List[int], is_cat: List[bool]) -> FeatureLayout:
+    key = (tuple(int(s) for s in slots), tuple(bool(c) for c in is_cat))
+    lay = _LAYOUTS.get(key)
+    if lay is not None:
+        return lay
+    slots_np = np.asarray(slots, np.int32)
+    off = np.zeros(len(slots), np.int32)
+    off[1:] = np.cumsum(slots_np[:-1])
+    T = int(slots_np.sum())
+    seg = np.repeat(np.arange(len(slots), dtype=np.int32), slots_np)
+    pos = np.arange(T, dtype=np.int32) - off[seg]
+    lay = FeatureLayout(
+        slots=slots_np,
+        off=off,
+        T=T,
+        seg_of_t=seg,
+        pos_in_seg=pos,
+        seg_start_t=off[seg],
+        seg_size_t=slots_np[seg],
+        is_cat_t=np.asarray(is_cat, bool)[seg],
+        clip_max=np.maximum(slots_np - 1, 0),
+        s_max=int(slots_np.max()) if len(slots) else 1,
+    )
+    _LAYOUTS[key] = lay
+    return lay
+
+
+# ---------------------------------------------------------------------------
+# compiled programs (cached per shape/hyperparam signature)
+# ---------------------------------------------------------------------------
+
+_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _get_hist_program(L: int, T: int):
+    key = ("hist", L, T)
+    prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
-
     import jax
     import jax.numpy as jnp
 
     @jax.jit
-    def level_step(codes, labels, weights, node_local, active, is_cat, feat_ok):
-        """One tree level over L nodes.
-
-        codes [n, F] int32; labels/weights [n] f32; node_local [n] int32
-        (0..L-1, position within level); active [n] bool; is_cat [F] bool;
-        feat_ok [F] bool (feature-subset mask).
-
-        Returns (feature [L], cut_rank [L], order [L, F, S], leaf_value [L],
-        is_split [L]).
-        """
-        n = codes.shape[0]
+    def hist_accum(codes, labels, weights, node_slot, active, off_f, clip_f):
+        """[3, L, T] (cnt, sum, sqsum) by one scatter-add per component over
+        the [n, F] code matrix — the Impurity.featureUpdate hot loop fused.
+        Under a `data`-sharded mesh each device scatters its row shard and
+        XLA all-reduces the replicated histogram (the psum replacing
+        DTMaster's NodeStats merge, DTMaster.java:297-310)."""
+        n, F = codes.shape
         w = jnp.where(active, weights, 0.0)
-        nl = jnp.where(active, node_local, 0)
-
-        # ---- fused histogram: scatter-add of (w, w*y, w*y^2). One scatter
-        # per component keeps the peak intermediate at [n, F] instead of
-        # [n, F, 3]. Under a `data`-sharded mesh each device scatters its
-        # row shard and XLA all-reduces the replicated histogram — the psum
-        # that replaces DTMaster's NodeStats merge (DTMaster.java:297-310).
-        flat = (nl[:, None] * F + jnp.arange(F)[None, :]) * S + codes
+        nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
+        code_f = jnp.clip(codes, 0, clip_f[None, :])
+        flat = nl[:, None] * T + off_f[None, :] + code_f
         comps = (w, w * labels, w * labels * labels)
         planes = [
-            jnp.zeros((L * F * S,), jnp.float32)
+            jnp.zeros((L * T,), jnp.float32)
             .at[flat]
             .add(jnp.broadcast_to(c[:, None], (n, F)))
-            .reshape(L, F, S)
+            .reshape(L, T)
             for c in comps
         ]
-        cnt, s1, s2 = planes
+        return jnp.stack(planes)
 
-        # ---- bin ordering: numeric keeps code order, categorical sorts by
-        # mean label (empty bins pushed right) ----
+    _PROGRAMS[key] = hist_accum
+    return hist_accum
+
+
+def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
+                      min_inst: int, min_gain: float):
+    key = ("scan", L, T, s_max, impurity, min_inst, float(min_gain))
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def split_scan(hist, feat_ok_t, is_cat_t, seg_t, pos_t, start_t, size_t,
+                   off_f, clip_f, seg0_size):
+        """Best split per node from the flat histogram.
+
+        Ordered prefix sums inside static segment boundaries: lexsort on
+        (segment, key) where key = mean label for categorical segments
+        (the reference's mean-sort category split) and slot position for
+        numeric ones. Segment boundaries are static, so the ordered layout
+        keeps feature f at [off[f], off[f]+slots[f]).
+
+        Returns (feature [L], cut_rank [L], rank_flat [L, T], leaf_value
+        [L], is_split [L], best_gain [L], left_mask_model [L, s_max],
+        node_cnt [L])."""
+        cnt, s1, s2 = hist[0], hist[1], hist[2]
         mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, 1e-12), jnp.inf)
-        cat_order = jnp.argsort(mean, axis=-1)  # [L, F, S]
-        num_order = jnp.broadcast_to(jnp.arange(S), (L, F, S))
-        order = jnp.where(is_cat[None, :, None], cat_order, num_order)
+        sec = jnp.where(is_cat_t[None, :], mean,
+                        jnp.broadcast_to(pos_t.astype(jnp.float32), cnt.shape))
 
-        cnt_o = jnp.take_along_axis(cnt, order, axis=-1)
-        s1_o = jnp.take_along_axis(s1, order, axis=-1)
-        s2_o = jnp.take_along_axis(s2, order, axis=-1)
-        lcnt = jnp.cumsum(cnt_o, axis=-1)
-        ls1 = jnp.cumsum(s1_o, axis=-1)
-        ls2 = jnp.cumsum(s2_o, axis=-1)
-        tcnt, ts1, ts2 = lcnt[..., -1:], ls1[..., -1:], ls2[..., -1:]
+        def order_row(sec_row):
+            return jnp.lexsort((sec_row, seg_t))
+
+        order = jax.vmap(order_row)(sec)  # [L, T] original index per pos
+
+        def reorder(a):
+            return jnp.take_along_axis(a, order, axis=-1)
+
+        c0 = jnp.cumsum(reorder(cnt), axis=-1)
+        c1 = jnp.cumsum(reorder(s1), axis=-1)
+        c2 = jnp.cumsum(reorder(s2), axis=-1)
+
+        start_prev = jnp.maximum(start_t - 1, 0)
+        end_idx = start_t + size_t - 1
+
+        def seg_sums(c):
+            base = jnp.where(start_t > 0, c[:, start_prev], 0.0)
+            left = c - base
+            tot = c[:, end_idx] - base
+            return left, tot
+
+        lcnt, tcnt = seg_sums(c0)
+        ls1, ts1 = seg_sums(c1)
+        ls2, ts2 = seg_sums(c2)
         rcnt, rs1, rs2 = tcnt - lcnt, ts1 - ls1, ts2 - ls2
 
-        def sse(c, s, q):  # sum squared error = impurity mass (variance)
+        def sse(c, s, q):
             return q - s * s / jnp.maximum(c, 1e-12)
 
-        def gini_mass(c, pos):
-            neg = c - pos
-            return c - (pos * pos + neg * neg) / jnp.maximum(c, 1e-12)
+        def gini_mass(c, p):
+            ng = c - p
+            return c - (p * p + ng * ng) / jnp.maximum(c, 1e-12)
 
-        def entropy_mass(c, pos):
-            p = pos / jnp.maximum(c, 1e-12)
-            q = 1.0 - p
-            h = -(p * jnp.log2(jnp.maximum(p, 1e-12))
+        def entropy_mass(c, p):
+            pr = p / jnp.maximum(c, 1e-12)
+            q = 1.0 - pr
+            h = -(pr * jnp.log2(jnp.maximum(pr, 1e-12))
                   + q * jnp.log2(jnp.maximum(q, 1e-12)))
             return c * h
 
-        if impurity in ("entropy",):
+        if impurity == "entropy":
             gain = (entropy_mass(tcnt, ts1) - entropy_mass(lcnt, ls1)
                     - entropy_mass(rcnt, rs1))
-        elif impurity in ("gini",):
-            gain = gini_mass(tcnt, ts1) - gini_mass(lcnt, ls1) - gini_mass(rcnt, rs1)
+        elif impurity == "gini":
+            gain = (gini_mass(tcnt, ts1) - gini_mass(lcnt, ls1)
+                    - gini_mass(rcnt, rs1))
         elif impurity == "friedmanmse":
-            # FriedmanMSE (Impurity.java:255): (nl*nr)/(nl+nr) * (ml - mr)^2
             ml = ls1 / jnp.maximum(lcnt, 1e-12)
             mr = rs1 / jnp.maximum(rcnt, 1e-12)
             gain = lcnt * rcnt / jnp.maximum(tcnt, 1e-12) * (ml - mr) ** 2
@@ -195,52 +299,139 @@ def _get_level_program(L: int, F: int, S: int, impurity: str,
             (lcnt >= min_inst)
             & (rcnt >= min_inst)
             & (gain > min_gain)
-            & feat_ok[None, :, None]
+            & feat_ok_t[None, :]
+            & (pos_t < size_t - 1)[None, :]  # cut at segment end = no split
         )
         gain = jnp.where(valid, gain, -jnp.inf)
 
-        # best cut per node over (F, S) — cut at ordered rank k means ordered
-        # bins [0..k] go left (k = S-1 would send all left: invalid via rcnt)
-        flat_gain = gain.reshape(L, F * S)
-        best = jnp.argmax(flat_gain, axis=-1)
-        best_gain = jnp.take_along_axis(flat_gain, best[:, None], axis=-1)[:, 0]
-        best_feat = (best // S).astype(jnp.int32)
-        best_rank = (best % S).astype(jnp.int32)
+        best = jnp.argmax(gain, axis=-1)  # ordered position
+        best_gain = jnp.take_along_axis(gain, best[:, None], axis=-1)[:, 0]
+        feature = seg_t[best].astype(jnp.int32)
+        cut_rank = pos_t[best].astype(jnp.int32)
         is_split = jnp.isfinite(best_gain)
 
-        node_cnt = tcnt[:, 0, 0]
-        node_sum = ts1[:, 0, 0]
+        # rank of each ORIGINAL flat slot within its segment's ordering
+        rank_flat = (
+            jnp.zeros((L, T), jnp.int32)
+            .at[jnp.arange(L)[:, None], order]
+            .set(jnp.broadcast_to(pos_t, (L, T)))
+        )
+
+        node_cnt = c0[:, seg0_size - 1]
+        node_sum = c1[:, seg0_size - 1]
         leaf_value = node_sum / jnp.maximum(node_cnt, 1e-12)
-        return best_feat, best_rank, order, leaf_value, is_split
+
+        # model-facing mask over ORIGINAL codes [L, s_max]
+        s_range = jnp.arange(s_max, dtype=jnp.int32)
+        f_clip = clip_f[feature]  # [L]
+        s_idx = jnp.minimum(s_range[None, :], f_clip[:, None])
+        flat_idx = off_f[feature][:, None] + s_idx
+        ranks = jnp.take_along_axis(rank_flat, flat_idx, axis=-1)
+        left_mask = (
+            (ranks <= cut_rank[:, None])
+            & (s_range[None, :] <= f_clip[:, None])
+            & is_split[:, None]
+        )
+        return (feature, cut_rank, rank_flat, leaf_value, is_split,
+                best_gain, left_mask, node_cnt)
+
+    _PROGRAMS[key] = split_scan
+    return split_scan
+
+
+def _get_update_program(L: int, T: int):
+    key = ("update", L, T)
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+    import jax.numpy as jnp
 
     @jax.jit
-    def finalize_level(bf, br, order, is_split, node_local, active, resting,
-                       codes, base):
-        """Build the level's goes-left masks, settle non-split rows, and
-        reposition the rest — all on device, so the per-level Python loop
-        never blocks on a host transfer (one sync per TREE, not per level;
-        matters enormously over a tunneled TPU link)."""
-        # inverse permutation of each node's best-feature bin order -> rank
-        order_best = order[jnp.arange(L), bf]  # [L, S]
-        rank = jnp.zeros((L, S), jnp.int32).at[
-            jnp.arange(L)[:, None], order_best
-        ].set(jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (L, S)))
-        lm = (rank <= br[:, None]) & is_split[:, None]
-
-        settled = active & ~is_split[node_local]
-        resting2 = jnp.where(settled, base + node_local, resting)
-
-        f = jnp.where(is_split, bf, 0)[node_local]
+    def row_update(codes, node_slot, active, resting, feature, cut_rank,
+                   rank_flat, is_split, base, off_f, clip_f):
+        """Settle non-split rows at base+slot, send the rest left/right
+        (level-wise child numbering: 2i / 2i+1 within the next level)."""
+        nl = jnp.clip(node_slot, 0, L - 1)
+        settled = active & ~is_split[nl]
+        resting2 = jnp.where(settled, base + nl, resting)
+        f = jnp.where(is_split, feature, 0)[nl]
         code = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
-        goes_left = lm[node_local, jnp.clip(code, 0, S - 1)]
-        new_local = jnp.where(goes_left, 2 * node_local, 2 * node_local + 1)
-        still = is_split[node_local] & active
-        node_local2 = jnp.where(still, new_local, 0)
-        feature_level = jnp.where(is_split, bf, -1)
-        return lm, feature_level, resting2, node_local2, still
+        cf = off_f[f] + jnp.clip(code, 0, clip_f[f])
+        goes_left = rank_flat[nl, cf] <= cut_rank[nl]
+        new_local = jnp.where(goes_left, 2 * nl, 2 * nl + 1)
+        still = is_split[nl] & active
+        return resting2, jnp.where(still, new_local, 0), still
 
-    _LEVEL_PROGRAMS[key] = (level_step, finalize_level)
-    return _LEVEL_PROGRAMS[key]
+    _PROGRAMS[key] = row_update
+    return row_update
+
+
+def _node_batch_size(T: int, max_stats_memory_mb: int) -> int:
+    """Nodes per histogram batch under the stats-memory budget
+    (DTMaster.getStatsMem node batching, DTMaster.java:450-467): the
+    [3, L, T] f32 histogram must fit maxStatsMemoryMB."""
+    budget = max(1, max_stats_memory_mb) * (1 << 20)
+    return max(1, budget // (3 * 4 * max(T, 1)))
+
+
+@dataclass
+class _LayoutArrays:
+    """Device copies of the static layout arrays."""
+
+    off: object
+    clip: object
+    feat_ok_t: object
+    is_cat_t: object
+    seg_t: object
+    pos_t: object
+    start_t: object
+    size_t: object
+    seg0_size: int
+
+
+def _device_layout(lay: FeatureLayout, feat_ok: np.ndarray, replicate_fn=None):
+    import jax.numpy as jnp
+
+    arrs = _LayoutArrays(
+        off=jnp.asarray(lay.off),
+        clip=jnp.asarray(lay.clip_max),
+        feat_ok_t=jnp.asarray(np.asarray(feat_ok, bool)[lay.seg_of_t]),
+        is_cat_t=jnp.asarray(lay.is_cat_t),
+        seg_t=jnp.asarray(lay.seg_of_t),
+        pos_t=jnp.asarray(lay.pos_in_seg),
+        start_t=jnp.asarray(lay.seg_start_t),
+        size_t=jnp.asarray(lay.seg_size_t),
+        seg0_size=int(lay.slots[0]) if len(lay.slots) else 1,
+    )
+    if replicate_fn is not None:
+        for name in ("off", "clip", "feat_ok_t", "is_cat_t", "seg_t",
+                     "pos_t", "start_t", "size_t"):
+            setattr(arrs, name, replicate_fn(getattr(arrs, name)))
+    return arrs
+
+
+def _scan_batched(hists, la, lay, cfg, L_level):
+    """Run split_scan over node batches and concatenate to full-level
+    arrays. `hists` yields ([3, Lb, T], Lb, batch_start)."""
+    feats, cuts, ranks, leaves, splits, gains, masks, cnts = (
+        [], [], [], [], [], [], [], []
+    )
+    for hist, Lb, _b0 in hists:
+        scan = _get_scan_program(Lb, lay.T, lay.s_max, cfg.impurity,
+                                 cfg.min_instances_per_node,
+                                 cfg.min_info_gain)
+        (f, c, r, lv, sp, g, m, nc) = scan(
+            hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t, la.start_t,
+            la.size_t, la.off, la.clip, la.seg0_size,
+        )
+        feats.append(f); cuts.append(c); ranks.append(r); leaves.append(lv)
+        splits.append(sp); gains.append(g); masks.append(m); cnts.append(nc)
+    import jax.numpy as jnp
+
+    cat = lambda xs: jnp.concatenate(xs, axis=0)  # noqa: E731
+    return (cat(feats), cat(cuts), cat(ranks), cat(leaves), cat(splits),
+            cat(gains), cat(masks), cat(cnts))
 
 
 def build_tree(
@@ -253,65 +444,76 @@ def build_tree(
     feat_ok: np.ndarray,
     mesh=None,
 ) -> Tuple[DenseTree, np.ndarray]:
-    """One tree, level-wise. codes [n, F] int32 on device; labels/weights
-    [n] f32 on device (weights already carry bagging significance). With a
-    `mesh`, the row arrays must already be sharded over its `data` axis —
-    per-level row state is created with the same sharding so every level
-    runs SPMD with one histogram all-reduce.
+    """One LEVEL-WISE tree. codes [n, F] int32 on device; labels/weights [n]
+    f32 on device (weights already carry bagging significance). With a
+    `mesh`, the row arrays must already be sharded over its `data` axis.
 
-    Returns (tree, resting [n] int32) — resting is the global node index each
-    row ends at, so callers get per-row predictions without re-traversal
-    (leaf_value[resting])."""
+    Returns (tree, resting [n] int32) — resting is the node index each row
+    ends at, so callers get per-row predictions without re-traversal."""
     import jax.numpy as jnp
 
     n, F = codes.shape
-    S = int(slots.max())
+    lay = make_layout(list(np.asarray(slots)), list(np.asarray(is_cat, bool)))
     D = cfg.max_depth
+    batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb)
 
-    is_cat_j = jnp.asarray(is_cat)
-    feat_ok_j = jnp.asarray(feat_ok)
-    node_local = jnp.zeros(n, dtype=jnp.int32)
-    active = jnp.ones(n, dtype=bool)
-    resting = jnp.zeros(n, dtype=jnp.int32)
+    replicate_fn = None
     if mesh is not None:
         from shifu_tpu.parallel.mesh import replicate, shard_rows
 
-        node_local = shard_rows(node_local, mesh)
-        active = shard_rows(active, mesh)
-        resting = shard_rows(resting, mesh)
-        is_cat_j = replicate(is_cat_j, mesh)
-        feat_ok_j = replicate(feat_ok_j, mesh)
+        replicate_fn = lambda a: replicate(a, mesh)  # noqa: E731
+        node_local = shard_rows(jnp.zeros(n, dtype=jnp.int32), mesh)
+        active = shard_rows(jnp.ones(n, dtype=bool), mesh)
+        resting = shard_rows(jnp.zeros(n, dtype=jnp.int32), mesh)
+    else:
+        node_local = jnp.zeros(n, dtype=jnp.int32)
+        active = jnp.ones(n, dtype=bool)
+        resting = jnp.zeros(n, dtype=jnp.int32)
+    la = _device_layout(lay, feat_ok, replicate_fn)
 
     feat_levels, mask_levels, leaf_levels = [], [], []
     for depth in range(D):
         L = 2**depth
         base = 2**depth - 1
-        level_step, finalize_level = _get_level_program(
-            L, F, S, cfg.impurity, cfg.min_instances_per_node, cfg.min_info_gain
+
+        def hist_batches():
+            for b0 in range(0, L, batch_cap):
+                Lb = min(batch_cap, L - b0)
+                hist_p = _get_hist_program(Lb, lay.T)
+                in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
+                yield hist_p(codes, labels, weights, node_local - b0,
+                             in_batch, la.off, la.clip), Lb, b0
+
+        (bf, br, rank_flat, lv, is_split, _gain, lm, _nc) = _scan_batched(
+            hist_batches(), la, lay, cfg, L
         )
-        bf, br, order, lv, is_split = level_step(
-            codes, labels, weights, node_local, active, is_cat_j, feat_ok_j
+        upd = _get_update_program(L, lay.T)
+        resting, node_local, active = upd(
+            codes, node_local, active, resting, bf, br, rank_flat, is_split,
+            jnp.int32(base), la.off, la.clip,
         )
-        lm, feature_level, resting, node_local, active = finalize_level(
-            bf, br, order, is_split, node_local, active, resting, codes,
-            jnp.int32(base),
-        )
-        feat_levels.append(feature_level)
+        feat_levels.append(jnp.where(is_split, bf, -1))
         mask_levels.append(lm)
         leaf_levels.append(lv)
 
     # final level: leaf values for the deepest children + settle leftovers
     L2 = 2**D
     base2 = L2 - 1
-    level_step2, _ = _get_level_program(
-        L2, F, S, cfg.impurity, cfg.min_instances_per_node, cfg.min_info_gain
-    )
-    _, _, _, lv2, _ = level_step2(
-        codes, labels, weights, node_local, active, is_cat_j, feat_ok_j
+
+    def hist_batches_final():
+        for b0 in range(0, L2, batch_cap):
+            Lb = min(batch_cap, L2 - b0)
+            hist_p = _get_hist_program(Lb, lay.T)
+            in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
+            yield hist_p(codes, labels, weights, node_local - b0, in_batch,
+                         la.off, la.clip), Lb, b0
+
+    (_f2, _c2, _r2, lv2, _s2, _g2, _m2, _nc2) = _scan_batched(
+        hist_batches_final(), la, lay, cfg, L2
     )
     leaf_levels.append(lv2)
     feat_levels.append(jnp.full(L2, -1, jnp.int32))
-    mask_levels.append(jnp.zeros((L2, S), bool))
+    mask_levels.append(jnp.zeros((L2, lay.s_max), bool))
     resting = jnp.where(active, base2 + node_local, resting)
 
     # ONE host sync for the whole tree
@@ -330,11 +532,215 @@ def build_tree(
     return tree, resting
 
 
+def build_tree_leafwise(
+    codes,
+    labels,
+    weights,
+    slots: np.ndarray,
+    is_cat: np.ndarray,
+    cfg: TreeTrainConfig,
+    feat_ok: np.ndarray,
+) -> Tuple[DenseTree, np.ndarray]:
+    """LEAF-WISE growth under maxLeaves (DTMaster.java:137: the toSplitQueue
+    splits the best-gain leaf first). Each iteration evaluates only the new
+    frontier nodes (a 2-slot histogram batch), picks the global best-gain
+    leaf, and splits it; nodes append parent-before-child, so children get
+    EXPLICIT pointers and the tree may be lopsided.
+
+    Returns (tree, resting node ids [n])."""
+    import jax.numpy as jnp
+
+    n, F = codes.shape
+    lay = make_layout(list(np.asarray(slots)), list(np.asarray(is_cat, bool)))
+    la = _device_layout(lay, feat_ok)
+    max_leaves = cfg.max_leaves
+    max_nodes = 2 * max_leaves - 1
+
+    node_id = jnp.zeros(n, dtype=jnp.int32)  # explicit node ids per row
+
+    # host-side growing tree arrays (parent-before-child ordering)
+    feature = [-1]
+    left_c = [-1]
+    right_c = [-1]
+    leaf_val = [0.0]
+    masks = [np.zeros(lay.s_max, bool)]
+    depth_of = {0: 0}
+    # candidate splits per leaf: id -> (gain, feat, cut_rank, rank_row, mask)
+    candidates: Dict[int, tuple] = {}
+
+    hist1 = _get_hist_program(1, lay.T)
+    scan1 = _get_scan_program(1, lay.T, lay.s_max, cfg.impurity,
+                              cfg.min_instances_per_node, cfg.min_info_gain)
+
+    def evaluate(leaf_ids: List[int]):
+        """Candidate split for each listed leaf (a 1-slot program per leaf
+        keeps shapes static; at most 2 leaves per iteration)."""
+        for lid in leaf_ids:
+            act = node_id == lid
+            hist = hist1(codes, labels, weights, jnp.zeros(n, jnp.int32),
+                         act, la.off, la.clip)
+            (f, c, r, lv, sp, g, m, _nc) = scan1(
+                hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t,
+                la.start_t, la.size_t, la.off, la.clip, la.seg0_size,
+            )
+            leaf_val[lid] = float(lv[0])
+            if bool(sp[0]) and depth_of[lid] < cfg.max_depth:
+                candidates[lid] = (float(g[0]), int(f[0]), int(c[0]),
+                                   r[0], np.asarray(m[0]))
+
+    evaluate([0])
+    n_leaves = 1
+    while n_leaves < max_leaves and candidates:
+        best_id = max(candidates, key=lambda k: candidates[k][0])
+        _gain, bf, cut, rank_row, mask_row = candidates.pop(best_id)
+        li, ri = len(feature), len(feature) + 1
+        if ri > max_nodes:
+            break
+        feature[best_id] = bf
+        left_c[best_id] = li
+        right_c[best_id] = ri
+        masks[best_id] = mask_row
+        for _ in range(2):
+            feature.append(-1)
+            left_c.append(-1)
+            right_c.append(-1)
+            leaf_val.append(0.0)
+            masks.append(np.zeros(lay.s_max, bool))
+        depth_of[li] = depth_of[ri] = depth_of[best_id] + 1
+        # reroute rows of the split node
+        sel = node_id == best_id
+        code = codes[:, bf]
+        cf = int(lay.off[bf]) + jnp.clip(code, 0, int(lay.clip_max[bf]))
+        goes_left = rank_row[cf] <= cut
+        node_id = jnp.where(sel, jnp.where(goes_left, li, ri), node_id)
+        n_leaves += 1
+        evaluate([li, ri])
+
+    tree = DenseTree(
+        feature=np.asarray(feature, np.int32),
+        left_mask=np.stack(masks).astype(bool),
+        leaf_value=np.asarray(leaf_val, np.float32),
+        weight=1.0,
+        left=np.asarray(left_c, np.int32),
+        right=np.asarray(right_c, np.int32),
+    )
+    return tree, node_id
+
+
+# ---------------------------------------------------------------------------
+# early stop (dt/DTEarlyStopDecider.java:49)
+# ---------------------------------------------------------------------------
+
+
+class _MinQueue:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.restart()
+
+    def restart(self):
+        self.min = float("inf")
+        self.size = -1
+
+    def add(self, v: float) -> bool:
+        self.min = min(self.min, v)
+        self.size += 1
+        return self.size >= self.capacity
+
+    def pop_min(self) -> float:
+        m = self.min
+        self.restart()
+        return m
+
+
+class _AverageQueue:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.arr = [0.0] * capacity
+        self.restart()
+
+    def restart(self):
+        self.total = 0
+        self.sum = 0.0
+
+    def add(self, v: float) -> bool:
+        idx = self.total % self.capacity
+        self.total += 1
+        if self.total <= self.capacity:
+            self.sum += v
+            self.arr[idx] = self.sum / self.total
+            return False
+        self.sum += v - self.arr[idx]
+        self.arr[idx] = self.sum / self.capacity
+        return True
+
+    def gain(self) -> float:
+        cur = (self.total - 1) % self.capacity
+        last = (self.total - 2) % self.capacity
+        return self.arr[last] - self.arr[cur]
+
+    def average(self) -> float:
+        k = min(self.total, self.capacity)
+        return self.arr[(self.total - 1) % self.capacity] if k else 0.0
+
+
+class DTEarlyStopDecider:
+    """Windowed early-stop: min over a window feeds a moving average; when
+    the average's gain stays ~zero for 3 windows the decider "restarts", and
+    3 restarts mean stop (dt/DTEarlyStopDecider.java:49, MAGIC_NUMBER=3,
+    NEARLY_ZERO=1e-6)."""
+
+    MAGIC = 3
+    NEARLY_ZERO = 1e-6
+
+    def __init__(self, tree_depth: int):
+        if tree_depth <= 0:
+            raise ValueError("tree depth must be positive")
+        self.min_queue = _MinQueue(tree_depth * self.MAGIC)
+        self.avg_queue = _AverageQueue(tree_depth)
+        self.gain_zero_count = 0
+        self.restart_count = 0
+
+    def add(self, validation_error: float) -> bool:
+        if self.min_queue.add(validation_error):
+            m = self.min_queue.pop_min()
+            if self.avg_queue.add(m):
+                if self.avg_queue.gain() < self.NEARLY_ZERO:
+                    self.gain_zero_count += 1
+                    if self.gain_zero_count >= self.MAGIC:
+                        self.avg_queue.restart()
+                        self.restart_count += 1
+                        self.gain_zero_count = 0
+                else:
+                    self.gain_zero_count = 0
+        return self.can_stop()
+
+    def can_stop(self) -> bool:
+        return self.restart_count >= self.MAGIC
+
+
+# ---------------------------------------------------------------------------
+# full training run
+# ---------------------------------------------------------------------------
+
+
 @dataclass
 class TreeTrainResult:
     spec: TreeModelSpec
     train_error: float
     valid_error: float
+
+
+def _score_existing(trees: List[DenseTree], codes) -> "object":
+    """Raw GBT prediction F(x) of an existing forest (continuous-training
+    recovery: DTWorker.recoverGBTData:1452 re-derives predict state)."""
+    import jax.numpy as jnp
+
+    from shifu_tpu.models.tree import traverse_trees
+
+    if not trees:
+        return jnp.zeros(codes.shape[0], dtype=jnp.float32)
+    per_tree = traverse_trees(trees, codes)
+    return jnp.sum(per_tree, axis=1)
 
 
 def train_trees(
@@ -349,17 +755,31 @@ def train_trees(
     categories: Optional[List] = None,
     progress_cb=None,
     mesh=None,
+    init_trees: Optional[List[DenseTree]] = None,
+    init_valid_errors: Optional[List[float]] = None,
+    checkpoint_cb: Optional[
+        Callable[[int, List[DenseTree], List[float]], None]
+    ] = None,
 ) -> TreeTrainResult:
     """Full GBT/RF training run. `mesh` shards rows over its `data` axis
-    (the TPU equivalent of DTWorker row shards); None = single device."""
+    (the TPU equivalent of DTWorker row shards); None = single device.
+
+    `init_trees` resumes/continues from an existing forest: per-tree RNG
+    streams are keyed by (seed, tree index), so training trees k..N after
+    loading trees 0..k-1 reproduces the uninterrupted run BIT-EQUAL
+    (DTMaster checkpoint recovery :284-291; GBT isContinuous
+    TrainModelProcessor.java:1166-1184). Pass the checkpointed
+    `init_valid_errors` history too so the early-stop state (worsen count,
+    windowed decider) replays exactly; `checkpoint_cb(k, trees,
+    valid_errors)` fires after each tree for the caller to persist both."""
     import jax
     import jax.numpy as jnp
 
     n, F = codes.shape
     n_orig = n  # rng draws always use the UNpadded count so the stream (and
     # therefore every tree) is identical with and without a mesh
-    rng = np.random.default_rng(cfg.seed)
-    valid_mask = rng.random(n) < cfg.valid_set_rate
+    valid_mask = np.random.default_rng([cfg.seed, 999_983]).random(n) \
+        < cfg.valid_set_rate
     codes_np = codes.astype(np.int32)
     y_np = tags.astype(np.float32)
     base_w_np = np.where(valid_mask, 0.0, weights).astype(np.float32)
@@ -389,7 +809,12 @@ def train_trees(
     is_cat_np = np.asarray(is_cat, dtype=bool)
 
     k_sub = subset_count(cfg.feature_subset_strategy, F)
-    trees: List[DenseTree] = []
+    leaf_wise = cfg.max_leaves and cfg.max_leaves > 0
+    if leaf_wise and mesh is not None:
+        log.warning("leaf-wise growth runs single-device; ignoring mesh")
+        mesh = None
+    trees: List[DenseTree] = list(init_trees or [])
+    start_k = len(trees)
     lr = cfg.learning_rate
     is_gbt = cfg.algorithm == "GBT"
     log_loss = cfg.loss == "log"
@@ -403,17 +828,39 @@ def train_trees(
         t = jnp.sum(jnp.where(tsel, sq, 0.0)) / jnp.maximum(jnp.sum(tsel), 1.0)
         return t, v
 
-    pred = row_put(jnp.zeros(n, dtype=jnp.float32))  # GBT raw prediction F(x)
-    valid_errors: List[float] = []
+    # prediction state re-derived from loaded trees on resume (the workers'
+    # recoverGBTData analog): GBT keeps the raw sum F(x), RF the running
+    # mean over trees built so far
+    if start_k:
+        s = np.asarray(_score_existing(trees, jnp.asarray(codes_np)))
+        pred = row_put((s if is_gbt else s / start_k).astype(np.float32))
+    else:
+        pred = row_put(jnp.zeros(n, dtype=jnp.float32))
+    # replay the checkpointed error history through the early-stop state so
+    # a resumed run stops at the same tree the uninterrupted run would
+    valid_errors: List[float] = list(init_valid_errors or [])[:start_k]
     bad_rounds = 0
+    decider = (DTEarlyStopDecider(cfg.max_depth)
+               if cfg.enable_early_stop else None)
+    for idx, v in enumerate(valid_errors):
+        if decider is not None:
+            decider.add(v)
+        if cfg.early_stop_rounds and idx >= 1:
+            if v > min(valid_errors[:idx + 1]):
+                bad_rounds += 1
+            else:
+                bad_rounds = 0
     terr = verr = 0.0
 
-    for k in range(cfg.tree_num):
+    for k in range(start_k, cfg.tree_num):
+        # per-tree RNG stream: keyed by tree index, NOT a shared sequential
+        # stream — resume at tree k replays identically
+        rng_k = np.random.default_rng([cfg.seed, k])
         if cfg.algorithm == "RF":
             if cfg.bagging_with_replacement:
-                bag = rng.poisson(cfg.bagging_sample_rate, size=n_orig)
+                bag = rng_k.poisson(cfg.bagging_sample_rate, size=n_orig)
             else:
-                bag = rng.random(n_orig) < cfg.bagging_sample_rate
+                bag = rng_k.random(n_orig) < cfg.bagging_sample_rate
             bag = np.pad(bag.astype(np.float32), (0, n - n_orig))
             w_k = base_w_j * row_put(bag)
             labels_k = y_j
@@ -428,12 +875,17 @@ def train_trees(
         if k_sub >= F:
             feat_ok[:] = True
         else:
-            feat_ok[rng.choice(F, size=k_sub, replace=False)] = True
+            feat_ok[rng_k.choice(F, size=k_sub, replace=False)] = True
 
-        tree, resting = build_tree(
-            codes_j, labels_k, w_k, slots_np, is_cat_np, cfg, feat_ok,
-            mesh=mesh,
-        )
+        if leaf_wise:
+            tree, resting = build_tree_leafwise(
+                codes_j, labels_k, w_k, slots_np, is_cat_np, cfg, feat_ok
+            )
+        else:
+            tree, resting = build_tree(
+                codes_j, labels_k, w_k, slots_np, is_cat_np, cfg, feat_ok,
+                mesh=mesh,
+            )
         tree.weight = 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0)
         trees.append(tree)
 
@@ -446,7 +898,8 @@ def train_trees(
                 else jnp.clip(pred, 0.0, 1.0)
             )
         else:
-            pred = tree_pred if k == 0 else (pred * k + tree_pred) / (k + 1)
+            n_prev = k  # RF running mean over trees built so far
+            pred = tree_pred if k == 0 else (pred * n_prev + tree_pred) / (k + 1)
             score = jnp.clip(pred, 0.0, 1.0)
 
         t_e, v_e = errors_of(score)
@@ -454,6 +907,12 @@ def train_trees(
         valid_errors.append(verr)
         if progress_cb:
             progress_cb(k + 1, terr, verr)
+        if checkpoint_cb:
+            checkpoint_cb(k + 1, trees, valid_errors)
+        if decider is not None and decider.add(verr):
+            log.info("windowed early stop after %d trees "
+                     "(DTEarlyStopDecider)", k + 1)
+            break
         if cfg.early_stop_rounds and len(valid_errors) > 1:
             if verr > min(valid_errors):
                 bad_rounds += 1

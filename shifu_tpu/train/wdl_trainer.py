@@ -44,6 +44,9 @@ class WDLTrainConfig:
     bagging_with_replacement: bool = False
     early_stop_window: int = 0
     seed: int = 0
+    checkpoint_every: int = 0
+    checkpoint_path: Optional[str] = None
+    progress_cb: Optional[object] = None
 
     @classmethod
     def from_model_config(cls, mc, trainer_id: int = 0) -> "WDLTrainConfig":
@@ -155,6 +158,18 @@ def _get_program(cfg: WDLTrainConfig, template: WDLParams, mesh=None):
     return _PROGRAMS[key]
 
 
+def _to_host_params(chosen: np.ndarray, template: WDLParams) -> WDLParams:
+    params = unflatten_wdl(chosen, template)
+    return WDLParams(
+        embed=[np.asarray(a) for a in params.embed],
+        wide=[np.asarray(a) for a in params.wide],
+        wide_dense=np.asarray(params.wide_dense),
+        dense_layers=[{k: np.asarray(v) for k, v in l.items()}
+                      for l in params.dense_layers],
+        bias=np.asarray(params.bias),
+    )
+
+
 def train_wdl(
     dense: np.ndarray,
     codes: np.ndarray,
@@ -163,7 +178,10 @@ def train_wdl(
     vocab_sizes: List[int],
     cfg: WDLTrainConfig,
     mesh=None,
+    init_flat: Optional[np.ndarray] = None,
 ) -> WDLTrainResult:
+    """One WDL model. `init_flat` resumes continuous training from existing
+    weights (checkContinuousTraining parity, like the NN path)."""
     import jax
     import jax.numpy as jnp
 
@@ -172,6 +190,8 @@ def train_wdl(
         dense.shape[1], vocab_sizes, cfg.embed_dim, cfg.hidden, seed=cfg.seed
     )
     flat0 = flatten_wdl(template)
+    if init_flat is not None and init_flat.size == flat0.size:
+        flat0 = init_flat.astype(np.float32)
 
     from shifu_tpu.train.nn_trainer import split_and_sample
 
@@ -205,27 +225,36 @@ def train_wdl(
         flat_j = replicate(flat_j, mesh)
         opt0 = replicate(opt0, mesh)
 
-    carry0 = (
+    carry = (
         flat_j, opt0, jnp.int32(0), jnp.float32(np.inf), flat_j,
         jnp.int32(0), jnp.zeros((), bool), jnp.float32(0.0), jnp.float32(0.0),
     )
-    result = program(carry0, jnp.int32(cfg.num_epochs), d, c, t,
-                     sig_tr, sig_va, jnp.float32(nts),
-                     jnp.float32(cfg.learning_rate))
+
+    def run_until(cr, limit):
+        return program(cr, jnp.int32(limit), d, c, t, sig_tr, sig_va,
+                       jnp.float32(nts), jnp.float32(cfg.learning_rate))
+
+    if cfg.checkpoint_every and cfg.checkpoint_every > 0:
+        it = 0
+        while it < cfg.num_epochs:
+            carry = run_until(carry, min(it + cfg.checkpoint_every,
+                                         cfg.num_epochs))
+            it = int(carry[2])
+            if cfg.progress_cb:
+                cfg.progress_cb(it, float(carry[7]), float(carry[8]))
+            if cfg.checkpoint_path:
+                np.save(cfg.checkpoint_path, np.asarray(carry[0]))
+            if bool(carry[6]) or it >= cfg.num_epochs:
+                break
+        result = carry
+    else:
+        result = run_until(carry, cfg.num_epochs)
     (flat_f, _, it_f, best_val, best_flat, _, _, tr_e, va_e) = result
     import math as _math
 
     use_best = cfg.valid_set_rate > 0 and _math.isfinite(float(best_val))
     chosen = np.asarray(best_flat if use_best else flat_f)
-    params = unflatten_wdl(chosen, template)
-    params = WDLParams(
-        embed=[np.asarray(a) for a in params.embed],
-        wide=[np.asarray(a) for a in params.wide],
-        wide_dense=np.asarray(params.wide_dense),
-        dense_layers=[{k: np.asarray(v) for k, v in l.items()}
-                      for l in params.dense_layers],
-        bias=np.asarray(params.bias),
-    )
+    params = _to_host_params(chosen, template)
     final_valid = float(best_val) if use_best else float(va_e)
     log.info("wdl train done: %d iterations, train_err %.6f valid_err %.6f",
              int(it_f), float(tr_e), final_valid)
@@ -233,3 +262,166 @@ def train_wdl(
         params=params, train_error=float(tr_e), valid_error=final_valid,
         iterations=int(it_f),
     )
+
+
+def train_wdl_bagged(
+    dense: np.ndarray,
+    codes: np.ndarray,
+    tags: np.ndarray,
+    weights: np.ndarray,
+    vocab_sizes: List[int],
+    base_cfg: WDLTrainConfig,
+    n_members: int,
+    mesh=None,
+    init_flats: Optional[List[Optional[np.ndarray]]] = None,
+    member_lrs: Optional[List[float]] = None,
+    member_sigs: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    checkpoint_paths: Optional[List[str]] = None,
+) -> List[WDLTrainResult]:
+    """All bagging members / grid trials / k-folds as ONE vmapped program —
+    the WDL twin of train_nn_bagged (the reference fans WDL bagging out as
+    Guagua jobs exactly like NN, TrainModelProcessor.java:768-945 +
+    prepareWDLParams :1474).
+
+    `member_lrs` batches grid trials that differ only in LearningRate;
+    `member_sigs` (sig_train [M, n], sig_valid [M, n]) batches k-fold folds
+    with unbiased final-weights holdout semantics."""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.train.nn_trainer import split_and_sample
+
+    n = dense.shape[0]
+    M = n_members
+    template = init_wdl_params(
+        dense.shape[1], vocab_sizes, base_cfg.embed_dim, base_cfg.hidden,
+        seed=base_cfg.seed,
+    )
+    flat0s, sig_ts, sig_vs, ntss = [], [], [], []
+    for i in range(M):
+        seed_i = base_cfg.seed + i * 1000
+        tpl_i = init_wdl_params(
+            dense.shape[1], vocab_sizes, base_cfg.embed_dim, base_cfg.hidden,
+            seed=seed_i,
+        )
+        flat0 = flatten_wdl(tpl_i)
+        init_i = (init_flats or [None] * M)[i]
+        if init_i is not None and init_i.size == flat0.size:
+            flat0 = init_i.astype(np.float32)
+        flat0s.append(flat0)
+        if member_sigs is not None:
+            sig_ts.append(np.asarray(member_sigs[0][i], np.float32))
+            sig_vs.append(np.asarray(member_sigs[1][i], np.float32))
+            ntss.append(float(max((member_sigs[0][i] > 0).sum(), 1.0)))
+        else:
+            cfg_i = WDLTrainConfig(**{**base_cfg.__dict__, "seed": seed_i})
+            sig, valid = split_and_sample(n, cfg_i)
+            sig_ts.append((sig * weights).astype(np.float32))
+            sig_vs.append(
+                (valid.astype(np.float32) * weights).astype(np.float32)
+            )
+            ntss.append(float(max(sig.sum(), 1.0)))
+
+    d = dense.astype(np.float32)
+    c = codes.astype(np.int32)
+    t = tags.astype(np.float32)
+    sig_t = np.stack(sig_ts)
+    sig_v = np.stack(sig_vs)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from shifu_tpu.parallel.mesh import pad_rows, shard_rows
+
+        n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+            "data", mesh.devices.size
+        )
+        (d, c, t), _ = pad_rows([d, c, t], n_data)
+        sig_t = np.pad(sig_t, ((0, 0), (0, d.shape[0] - n)))
+        sig_v = np.pad(sig_v, ((0, 0), (0, d.shape[0] - n)))
+        d = shard_rows(d, mesh)
+        c = shard_rows(c, mesh)
+        t = shard_rows(t, mesh)
+        member_rows = NamedSharding(mesh, P(None, "data"))
+        sig_t = jax.device_put(sig_t, member_rows)
+        sig_v = jax.device_put(sig_v, member_rows)
+
+    program, init_state = _get_program(base_cfg, template, mesh=mesh)
+    bag_key = ("wdl-bagged", id(program), M)
+    program_b = _PROGRAMS.get(bag_key)
+    if program_b is None:
+        program_b = jax.jit(
+            jax.vmap(program,
+                     in_axes=(0, None, None, None, None, 0, 0, 0, 0))
+        )
+        _PROGRAMS[bag_key] = program_b
+
+    n_flat = flat0s[0].size
+    flat_j = jnp.asarray(np.stack(flat0s))
+    opt0 = jax.tree_util.tree_map(
+        lambda *a: jnp.stack(a), *[init_state(n_flat) for _ in range(M)]
+    )
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import replicate
+
+        flat_j = replicate(flat_j, mesh)
+        opt0 = replicate(opt0, mesh)
+    carry = (
+        flat_j, opt0, jnp.zeros(M, jnp.int32),
+        jnp.full(M, np.inf, jnp.float32), flat_j, jnp.zeros(M, jnp.int32),
+        jnp.zeros(M, bool), jnp.zeros(M, jnp.float32),
+        jnp.zeros(M, jnp.float32),
+    )
+    nts_j = jnp.asarray(ntss, jnp.float32)
+    lrs = (jnp.asarray(member_lrs, jnp.float32) if member_lrs is not None
+           else jnp.full(M, base_cfg.learning_rate, jnp.float32))
+
+    def run_until(cr, limit):
+        return program_b(cr, jnp.int32(limit), d, c, t, sig_t, sig_v,
+                         nts_j, lrs)
+
+    if base_cfg.checkpoint_every and base_cfg.checkpoint_every > 0:
+        it = 0
+        last_reported = [-1] * M
+        while it < base_cfg.num_epochs:
+            carry = run_until(carry, min(it + base_cfg.checkpoint_every,
+                                         base_cfg.num_epochs))
+            it = int(np.asarray(carry[2]).max())
+            its = np.asarray(carry[2])
+            trs, vas = np.asarray(carry[7]), np.asarray(carry[8])
+            flats = np.asarray(carry[0])
+            for i in range(M):
+                it_i = int(its[i])
+                if it_i == last_reported[i]:
+                    continue  # member already halted
+                last_reported[i] = it_i
+                if base_cfg.progress_cb:
+                    base_cfg.progress_cb((i, it_i), float(trs[i]),
+                                         float(vas[i]))
+                if checkpoint_paths and checkpoint_paths[i]:
+                    np.save(checkpoint_paths[i], flats[i])
+            if bool(np.asarray(carry[6]).all()) or it >= base_cfg.num_epochs:
+                break
+        out = carry
+    else:
+        out = run_until(carry, base_cfg.num_epochs)
+    (flat_f, _, it_f, best_val, best_flat, _, _, tr_e, va_e) = out
+
+    import math as _math
+
+    results = []
+    flat_f_np = np.asarray(flat_f)
+    best_flat_np = np.asarray(best_flat)
+    for i in range(M):
+        bv = float(np.asarray(best_val)[i])
+        use_best = (member_sigs is None and base_cfg.valid_set_rate > 0
+                    and _math.isfinite(bv))
+        chosen = best_flat_np[i] if use_best else flat_f_np[i]
+        results.append(WDLTrainResult(
+            params=_to_host_params(chosen, template),
+            train_error=float(np.asarray(tr_e)[i]),
+            valid_error=bv if use_best else float(np.asarray(va_e)[i]),
+            iterations=int(np.asarray(it_f)[i]),
+        ))
+    log.info("wdl bagged train done: %d members in one program, avg valid "
+             "%.6f", M, float(np.mean([r.valid_error for r in results])))
+    return results

@@ -1,0 +1,126 @@
+"""Reference WDL binary format (BinaryWDLSerializer/IndependentWDLModel)
+round-trip + scoring parity against the native WDL model."""
+
+import os
+
+import numpy as np
+
+from tests.helpers import make_model_set
+
+
+def _trained_wdl_root(tmp_path):
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=400, algorithm="WDL")
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 30
+    mc.train.params.update({"NumHiddenNodes": [16],
+                            "ActivationFunc": ["relu"]})
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    assert TrainProcessor(root).run() == 0
+    return root
+
+
+def _raw_data(root):
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.data.reader import read_columnar, read_header
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    names = read_header(mc.data_set.header_path, mc.data_set.header_delimiter)
+    return read_columnar(mc.data_set.data_path, names,
+                         delimiter=mc.data_set.data_delimiter)
+
+
+def test_wdl_ref_roundtrip_and_scoring(tmp_path):
+    from shifu_tpu.compat import wdl as cwdl
+    from shifu_tpu.config.column_config import load_column_config_list
+    from shifu_tpu.models.wdl import IndependentWDLModel, WDLModelSpec
+
+    root = _trained_wdl_root(tmp_path)
+    spec = WDLModelSpec.load(os.path.join(root, "models", "model0.wdl"))
+    ccs = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+    ref = cwdl.wdl_spec_to_ref(spec, ccs)
+    blob = cwdl.write_wdl_model(ref)
+    again = cwdl.read_wdl_model(blob)
+
+    # structural round-trip
+    assert again.norm_type == ref.norm_type
+    assert again.dense_column_ids == ref.dense_column_ids
+    assert again.embed_column_ids == ref.embed_column_ids
+    assert again.hidden_nodes == ref.hidden_nodes
+    assert len(again.column_stats) == len(ref.column_stats)
+    for a, b in zip(again.embed_tables, ref.embed_tables):
+        assert a[0] == b[0]
+        np.testing.assert_allclose(a[1], b[1], rtol=1e-6)
+    np.testing.assert_allclose(again.final_layer.weights,
+                               ref.final_layer.weights, rtol=1e-6)
+
+    # scoring parity: reference-format model vs native independent model
+    data = _raw_data(root)
+    native = IndependentWDLModel(spec).compute_raw(data)
+    ref_scores = again.compute_raw(data)
+    corr = np.corrcoef(native, ref_scores)[0, 1]
+    assert corr > 0.99, f"native vs ref-format corr {corr}"
+    np.testing.assert_allclose(ref_scores, native, atol=0.05)
+
+
+def test_wdl_ref_model_via_model_runner(tmp_path):
+    """A reference-format .wdl dropped into models/ scores through
+    ModelRunner next to (or instead of) native specs."""
+    from shifu_tpu.compat import wdl as cwdl
+    from shifu_tpu.config.column_config import load_column_config_list
+    from shifu_tpu.eval.scorer import ModelRunner
+    from shifu_tpu.models.wdl import WDLModelSpec
+
+    root = _trained_wdl_root(tmp_path)
+    spec = WDLModelSpec.load(os.path.join(root, "models", "model0.wdl"))
+    ccs = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+    blob = cwdl.write_wdl_model(cwdl.wdl_spec_to_ref(spec, ccs))
+    ref_path = os.path.join(root, "models", "model1.wdl")
+    with open(ref_path, "wb") as fh:
+        fh.write(blob)
+
+    runner = ModelRunner([os.path.join(root, "models", "model0.wdl"),
+                          ref_path])
+    data = _raw_data(root)
+    result = runner.score_raw(data)
+    assert result.model_scores.shape[1] == 2
+    corr = np.corrcoef(result.model_scores[:, 0],
+                       result.model_scores[:, 1])[0, 1]
+    assert corr > 0.99
+
+
+def test_ref_to_wdl_params_roundtrip(tmp_path):
+    """Imported reference WDL weights map back into our WDLParams and score
+    identically on pre-built (dense, codes) inputs."""
+    from shifu_tpu.compat import wdl as cwdl
+    from shifu_tpu.config.column_config import load_column_config_list
+    from shifu_tpu.models.wdl import IndependentWDLModel, WDLModelSpec
+
+    root = _trained_wdl_root(tmp_path)
+    spec = WDLModelSpec.load(os.path.join(root, "models", "model0.wdl"))
+    ccs = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+    ref = cwdl.read_wdl_model(
+        cwdl.write_wdl_model(cwdl.wdl_spec_to_ref(spec, ccs)))
+    params = cwdl.ref_to_wdl_params(ref)
+
+    data = _raw_data(root)
+    ind = IndependentWDLModel(spec)
+    dense, codes = ind.inputs_from_raw(data)
+    native = ind.compute_parts(dense, codes)
+    spec2 = WDLModelSpec(
+        hidden=spec.hidden, activations=spec.activations,
+        embed_dim=spec.embed_dim, dense_columns=spec.dense_columns,
+        cat_columns=spec.cat_columns, vocab_sizes=spec.vocab_sizes,
+        params=params,
+    )
+    imported = IndependentWDLModel(spec2).compute_parts(dense, codes)
+    np.testing.assert_allclose(imported, native, atol=1e-5)

@@ -1,0 +1,258 @@
+"""Tree training parity features: flat per-feature slot layout, leaf-wise
+growth (maxLeaves), per-tree checkpoint/resume (bit-equal), GBT continuous
+training, windowed early stop (DTEarlyStopDecider)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.models.tree import DenseTree, TreeModelSpec
+from shifu_tpu.train.tree_trainer import (
+    DTEarlyStopDecider,
+    TreeTrainConfig,
+    build_tree,
+    build_tree_leafwise,
+    make_layout,
+    train_trees,
+)
+
+
+def _make_data(n=1500, seed=0):
+    rng = np.random.default_rng(seed)
+    slots = [4, 12, 3, 8]  # deliberately ragged slot counts
+    codes = np.stack(
+        [rng.integers(0, s, size=n) for s in slots], axis=1
+    ).astype(np.int32)
+    logits = (codes[:, 1] >= 6) * 2.0 + (codes[:, 0] <= 1) * 1.0 - 1.4
+    y = (logits + rng.normal(scale=0.4, size=n) > 0).astype(np.float32)
+    w = np.ones(n, dtype=np.float32)
+    return codes, y, w, slots
+
+
+def test_layout_ragged_segments():
+    lay = make_layout([3, 5, 2], [False, True, False])
+    assert lay.T == 10
+    assert lay.off.tolist() == [0, 3, 8]
+    assert lay.seg_of_t.tolist() == [0, 0, 0, 1, 1, 1, 1, 1, 2, 2]
+    assert lay.pos_in_seg.tolist() == [0, 1, 2, 0, 1, 2, 3, 4, 0, 1]
+    assert lay.is_cat_t.tolist() == [False] * 3 + [True] * 5 + [False] * 2
+    assert lay.s_max == 5
+
+
+def test_ragged_slots_split_correctness():
+    """The wide feature (12 slots) carries the signal; the flat layout must
+    find its cut without inflating the narrow features' segments."""
+    import jax.numpy as jnp
+
+    codes, y, w, slots = _make_data()
+    cfg = TreeTrainConfig(max_depth=2, min_instances_per_node=1)
+    tree, resting = build_tree(
+        jnp.asarray(codes), jnp.asarray(y), jnp.asarray(w),
+        np.asarray(slots), np.asarray([False] * 4), cfg,
+        np.asarray([True] * 4),
+    )
+    assert tree.feature[0] == 1  # root splits the signal feature
+    # mask semantics: bins < 6 go one way, >= 6 the other
+    left = set(np.nonzero(tree.left_mask[0][:12])[0].tolist())
+    assert left in ({0, 1, 2, 3, 4, 5}, set(range(6, 12)))
+
+
+def test_leafwise_growth():
+    import jax.numpy as jnp
+
+    codes, y, w, slots = _make_data()
+    cfg = TreeTrainConfig(max_depth=6, max_leaves=5,
+                          min_instances_per_node=1)
+    tree, resting = build_tree_leafwise(
+        jnp.asarray(codes), jnp.asarray(y), jnp.asarray(w),
+        np.asarray(slots), np.asarray([False] * 4), cfg,
+        np.asarray([True] * 4),
+    )
+    assert not tree.is_dense_layout
+    n_leaves = int((tree.feature == -1).sum())
+    n_splits = int((tree.feature >= 0).sum())
+    assert n_leaves <= 5
+    assert n_splits == n_leaves - 1  # binary tree invariant
+    # children appended after parents (traversal depth relies on it)
+    for i in range(tree.n_nodes):
+        if tree.left[i] >= 0:
+            assert tree.left[i] > i and tree.right[i] > i
+
+    # resting ids give per-row predictions consistent with traversal
+    from shifu_tpu.models.tree import traverse_trees
+
+    pred_resting = tree.leaf_value[np.asarray(resting)]
+    pred_traverse = np.asarray(
+        traverse_trees([tree], jnp.asarray(codes))
+    )[:, 0]
+    np.testing.assert_allclose(pred_resting, pred_traverse, atol=1e-6)
+
+
+def test_leafwise_model_roundtrip(tmp_path):
+    codes, y, w, slots = _make_data(n=800)
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=5, max_depth=5,
+                          max_leaves=6, learning_rate=0.3, seed=1)
+    res = train_trees(codes, y, w, slots, [False] * 4,
+                      [f"c{i}" for i in range(4)], cfg)
+    path = str(tmp_path / "model0.gbt")
+    res.spec.save(path)
+    loaded = TreeModelSpec.load(path)
+    assert all(not t.is_dense_layout for t in loaded.trees)
+    s1 = res.spec.independent().compute(codes)
+    s2 = loaded.independent().compute(codes)
+    np.testing.assert_allclose(s1, s2, atol=1e-6)
+    # leaf-wise GBT still learns
+    assert ((s1 > 0.5) == (y > 0.5)).mean() > 0.8
+
+
+@pytest.mark.parametrize("alg", ["GBT", "RF"])
+def test_resume_is_bit_equal(alg):
+    """Kill at tree 5 of 12, resume from the checkpointed forest — the
+    resumed run must reproduce the uninterrupted forest BIT-EQUAL
+    (per-tree RNG streams keyed by (seed, tree index))."""
+    codes, y, w, slots = _make_data(n=1000, seed=4)
+    cfg = TreeTrainConfig(algorithm=alg, tree_num=12, max_depth=3,
+                          learning_rate=0.2, seed=7,
+                          feature_subset_strategy="TWOTHIRDS")
+    cols = [f"c{i}" for i in range(4)]
+    full = train_trees(codes, y, w, slots, [False] * 4, cols, cfg)
+
+    cfg5 = TreeTrainConfig(**{**cfg.__dict__, "tree_num": 5})
+    part = train_trees(codes, y, w, slots, [False] * 4, cols, cfg5)
+    resumed = train_trees(codes, y, w, slots, [False] * 4, cols, cfg,
+                          init_trees=part.spec.trees)
+
+    assert len(resumed.spec.trees) == len(full.spec.trees) == 12
+    for tf, tr in zip(full.spec.trees, resumed.spec.trees):
+        np.testing.assert_array_equal(tf.feature, tr.feature)
+        np.testing.assert_array_equal(tf.left_mask, tr.left_mask)
+        np.testing.assert_allclose(tf.leaf_value, tr.leaf_value, atol=0)
+        assert tf.weight == tr.weight
+    # trees are the bit-equal contract; the running-mean error accumulator
+    # re-associates floating point on resume (RF), so compare to 1e-7
+    assert resumed.valid_error == pytest.approx(full.valid_error, abs=1e-7)
+
+
+def test_checkpoint_cb_fires():
+    codes, y, w, slots = _make_data(n=500)
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=6, max_depth=2, seed=2)
+    seen = []
+    train_trees(
+        codes, y, w, slots, [False] * 4, [f"c{i}" for i in range(4)], cfg,
+        checkpoint_cb=lambda k, trees, errs: seen.append(
+            (k, len(trees), len(errs))),
+    )
+    assert seen == [(k, k, k) for k in range(1, 7)]
+
+
+def test_processor_checkpoint_resume_and_continuous(tmp_path):
+    """Processor-level: a leftover checkpoint resumes to the same forest a
+    clean run produces; isContinuous then grows the forest to a larger
+    TreeNum with the original trees intact."""
+    from tests.helpers import make_model_set
+
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=400, algorithm="GBT")
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+
+    def set_train(**kw):
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        for k, v in kw.items():
+            if k in ("TreeNum", "MaxDepth", "CheckpointInterval"):
+                mc.train.params[k] = v
+            else:
+                setattr(mc.train, k, v)
+        mc.save(os.path.join(root, "ModelConfig.json"))
+
+    set_train(TreeNum=8, MaxDepth=3, CheckpointInterval=2)
+    assert TrainProcessor(root).run() == 0
+    clean = TreeModelSpec.load(os.path.join(root, "models", "model0.gbt"))
+    assert len(clean.trees) == 8
+    # checkpoint removed after a successful run
+    ck = os.path.join(root, "tmp", "checkpoints", "trainer_0", "trees.ckpt")
+    assert not os.path.isfile(ck)
+
+    # simulate a crash at tree 4: plant a checkpoint (+ state sidecar with
+    # the matching hyperparameter fingerprint), delete the model
+    import json
+
+    cfg = TreeTrainConfig.from_model_config(
+        ModelConfig.load(os.path.join(root, "ModelConfig.json")), 0)
+    TreeModelSpec(
+        algorithm="GBT", trees=clean.trees[:4],
+        input_columns=clean.input_columns, slots=clean.slots,
+        boundaries=clean.boundaries, categories=clean.categories,
+        loss=clean.loss, learning_rate=clean.learning_rate,
+    ).save(ck)
+    with open(ck + ".json", "w") as fh:
+        json.dump({
+            "fingerprint": {
+                "algorithm": cfg.algorithm, "loss": cfg.loss,
+                "maxDepth": cfg.max_depth, "maxLeaves": cfg.max_leaves,
+                "impurity": cfg.impurity,
+                "learningRate": cfg.learning_rate,
+                "minInstancesPerNode": cfg.min_instances_per_node,
+                "minInfoGain": cfg.min_info_gain,
+                "featureSubsetStrategy": cfg.feature_subset_strategy,
+                "baggingSampleRate": cfg.bagging_sample_rate,
+                "baggingWithReplacement": cfg.bagging_with_replacement,
+                "validSetRate": cfg.valid_set_rate, "seed": cfg.seed,
+            },
+            "validErrors": [0.5, 0.4, 0.3, 0.2],
+        }, fh)
+    os.remove(os.path.join(root, "models", "model0.gbt"))
+    assert TrainProcessor(root).run() == 0
+    resumed = TreeModelSpec.load(os.path.join(root, "models", "model0.gbt"))
+    assert len(resumed.trees) == 8
+    for tc, tr in zip(clean.trees, resumed.trees):
+        np.testing.assert_array_equal(tc.feature, tr.feature)
+        np.testing.assert_allclose(tc.leaf_value, tr.leaf_value, atol=0)
+
+    # continuous: raise TreeNum, original trees stay put
+    set_train(TreeNum=12, is_continuous=True)
+    assert TrainProcessor(root).run() == 0
+    grown = TreeModelSpec.load(os.path.join(root, "models", "model0.gbt"))
+    assert len(grown.trees) == 12
+    for tc, tg in zip(clean.trees, grown.trees[:8]):
+        np.testing.assert_array_equal(tc.feature, tg.feature)
+
+    # already at TreeNum: skip without touching the model
+    mtime = os.path.getmtime(os.path.join(root, "models", "model0.gbt"))
+    set_train(TreeNum=12, is_continuous=True)
+    assert TrainProcessor(root).run() == 0
+    assert os.path.getmtime(
+        os.path.join(root, "models", "model0.gbt")) == mtime
+
+
+def test_windowed_early_stop_decider():
+    """Flat validation error (no gain) triggers the 3-restart stop; a
+    steadily improving series never stops (DTEarlyStopDecider.java:49)."""
+    d = DTEarlyStopDecider(3)
+    stopped_at = None
+    for i in range(400):
+        if d.add(0.5):  # perfectly flat: worth no more iterations
+            stopped_at = i
+            break
+    assert stopped_at is not None
+
+    d2 = DTEarlyStopDecider(3)
+    for i in range(200):
+        assert not d2.add(1.0 / (i + 1.0))  # keeps improving fast
+
+
+def test_enable_early_stop_via_params():
+    codes, y, w, slots = _make_data(n=600)
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=300, max_depth=2,
+                          learning_rate=0.5, enable_early_stop=True, seed=3)
+    res = train_trees(codes, y, w, slots, [False] * 4,
+                      [f"c{i}" for i in range(4)], cfg)
+    assert len(res.spec.trees) < 300  # decider fired well before TreeNum
