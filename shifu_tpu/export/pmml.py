@@ -142,3 +142,158 @@ def nn_to_pmml(spec: NNModelSpec, model_name: str = "shifu_tpu_model") -> str:
 
     ET.indent(root)
     return ET.tostring(root, encoding="unicode", xml_declaration=True)
+
+
+# ---------------------------------------------------------------------------
+# Tree-ensemble PMML (GBT/RF)
+# Parity: core/pmml/builder/impl/TreeEnsemblePmmlCreator.java (MiningModel +
+# Segmentation of per-tree TreeModels), TreeNodePmmlElementCreator (split
+# predicates over RAW values), MiningModelPmmlCreator.
+# ---------------------------------------------------------------------------
+
+
+def _predicate_for(el, tree, spec, node_idx: int, go_left: bool):
+    """Attach the predicate that routes a row into this child.
+
+    Split translation back to RAW values:
+      numeric f, ordered cut rank r  ->  left iff x < boundaries[r+1]
+        (bin i covers [b_i, b_{i+1}); numeric splits keep code order and
+        missing always routes right — BinUtils.getNumericalBinIndex)
+      categorical f -> left iff value in {categories[i] : left_mask[i]};
+        the right child carries the complement set (missing is handled by
+        missingValueStrategy=defaultChild on the parent).
+    """
+    feature = int(tree.feature[node_idx])
+    name = spec.input_columns[feature]
+    cats = spec.categories[feature] if feature < len(spec.categories) else None
+    mask = tree.left_mask[node_idx]
+    if cats:
+        # the isIn side is chosen so UNSEEN categories (present, not in
+        # either training set — they bin to the missing slot natively)
+        # follow the missing slot's routing via the isNotIn complement
+        missing_left = len(cats) < len(mask) and bool(mask[len(cats)])
+        in_side_left = not missing_left
+        members = [
+            str(cats[i]) for i in range(len(cats))
+            if (i < len(mask) and bool(mask[i])) == in_side_left
+        ]
+        ssp = _el(el, "SimpleSetPredicate", field=name,
+                  booleanOperator="isIn" if go_left == in_side_left
+                  else "isNotIn")
+        arr = _el(ssp, "Array", type="string", n=str(len(members)))
+        arr.text = " ".join(f'"{c}"' for c in members)
+        return
+    bounds = spec.boundaries[feature] or []
+    real = [i for i in range(min(len(bounds), len(mask))) if mask[i]]
+    cut = (max(real) if real else -1) + 1
+    if cut < len(bounds):
+        thr = float(bounds[cut])
+        _el(el, "SimplePredicate", field=name,
+            operator="lessThan" if go_left else "greaterOrEqual",
+            value=f"{thr}")
+    else:  # left = every real value; only missing goes right
+        _el(el, "SimplePredicate", field=name,
+            operator="isNotMissing" if go_left else "isMissing")
+
+
+def _missing_goes_left(tree, spec, node_idx: int) -> bool:
+    feature = int(tree.feature[node_idx])
+    cats = spec.categories[feature] if feature < len(spec.categories) else None
+    mask = tree.left_mask[node_idx]
+    if cats:
+        return len(cats) < len(mask) and bool(mask[len(cats)])
+    return False  # numeric missing bin is the last slot, never in the prefix
+
+
+def _tree_nodes(tree, spec, parent, node_idx: int, node_id_prefix: str,
+                fold_weight: float, predicate=None):
+    """Emit one PMML Node (recursively) for DenseTree node `node_idx`.
+    `predicate(el)` attaches this node's routing predicate (True at root)."""
+    node = _el(parent, "Node", id=f"{node_id_prefix}{node_idx}",
+               score=f"{float(tree.leaf_value[node_idx]) * fold_weight}")
+    if predicate is None:
+        _el(node, "True")
+    else:
+        predicate(node)
+    feature = int(tree.feature[node_idx])
+    if feature < 0:  # leaf
+        return node
+    dense = tree.is_dense_layout
+    li = int(tree.left[node_idx]) if not dense else 2 * node_idx + 1
+    ri = int(tree.right[node_idx]) if not dense else 2 * node_idx + 2
+    _tree_nodes(tree, spec, node, li, node_id_prefix, fold_weight,
+                lambda el, n=node_idx: _predicate_for(el, tree, spec, n, True))
+    _tree_nodes(tree, spec, node, ri, node_id_prefix, fold_weight,
+                lambda el, n=node_idx: _predicate_for(el, tree, spec, n, False))
+    default = li if _missing_goes_left(tree, spec, node_idx) else ri
+    node.set("defaultChild", f"{node_id_prefix}{default}")
+    return node
+
+
+def tree_to_pmml(spec, model_name: str = "shifu_tpu_model") -> str:
+    """TreeModelSpec -> PMML MiningModel with one TreeModel Segment per tree
+    (TreeEnsemblePmmlCreator.convert). GBT folds each tree's weight into its
+    leaf scores and sums segments (exact weighted-sum semantics); RF
+    averages equal-weight segments. Log-loss GBT emits RAW logits — the
+    sigmoid conversion happens scorer-side, like the reference's
+    gbtScoreConvertStrategy."""
+    root = ET.Element("PMML", version="4.2", xmlns=PMML_NS)
+    header = _el(root, "Header", description="shifu-tpu exported tree model")
+    _el(header, "Application", name="shifu-tpu", version="0.1")
+
+    dd = _el(root, "DataDictionary")
+    for j, name in enumerate(spec.input_columns):
+        cats = spec.categories[j] if j < len(spec.categories) else None
+        _el(dd, "DataField", name=name,
+            optype="categorical" if cats else "continuous",
+            dataType="string" if cats else "double")
+    _el(dd, "DataField", name="TARGET", optype="categorical",
+        dataType="string")
+    dd.set("numberOfFields", str(len(spec.input_columns) + 1))
+
+    mm = _el(root, "MiningModel", modelName=model_name,
+             functionName="regression")
+    ms = _el(mm, "MiningSchema")
+    for name in spec.input_columns:
+        _el(ms, "MiningField", name=name, usageType="active")
+    _el(ms, "MiningField", name="TARGET", usageType="target")
+
+    out = _el(mm, "Output")
+    _el(out, "OutputField", name="RawResult", optype="continuous",
+        dataType="double", feature="predictedValue")
+    fr = _el(out, "OutputField", name="FinalResult", optype="continuous",
+             dataType="double", feature="transformedValue")
+    ncont = _el(fr, "NormContinuous", field="RawResult")
+    _el(ncont, "LinearNorm", orig="0.0", norm="0.0")
+    _el(ncont, "LinearNorm", orig="1.0", norm="1000.0")
+
+    hybrid_cols = [
+        name for j, name in enumerate(spec.input_columns)
+        if (spec.categories[j] if j < len(spec.categories) else None)
+        and (spec.boundaries[j] if j < len(spec.boundaries) else None)
+    ]
+    if hybrid_cols:
+        raise ValueError(
+            "PMML export does not support hybrid (H) columns yet — their "
+            "combined numeric+category bin axis has no faithful single "
+            f"PMML predicate; columns: {hybrid_cols}"
+        )
+
+    is_gbt = spec.algorithm.upper() == "GBT"
+    seg = _el(mm, "Segmentation",
+              multipleModelMethod="sum" if is_gbt else "average")
+    for k, tree in enumerate(spec.trees):
+        segment = _el(seg, "Segment", id=f"Segement{k}", weight=f"{tree.weight}")
+        _el(segment, "True")
+        tm = _el(segment, "TreeModel", modelName=str(k),
+                 functionName="regression",
+                 missingValueStrategy="defaultChild",
+                 splitCharacteristic="binarySplit")
+        tms = _el(tm, "MiningSchema")
+        for name in spec.input_columns:
+            _el(tms, "MiningField", name=name, usageType="active")
+        fold = tree.weight if is_gbt else 1.0
+        _tree_nodes(tree, spec, tm, 0, f"t{k}n", fold)
+
+    ET.indent(root)
+    return ET.tostring(root, encoding="unicode", xml_declaration=True)

@@ -225,18 +225,24 @@ class IndependentTreeModel:
         """ColumnarData -> [n, F] codes using embedded binning."""
         from shifu_tpu.stats.binning import (
             categorical_bin_index,
+            hybrid_bin_index,
             numeric_bin_index,
         )
 
         cols = []
         for j, name in enumerate(self.spec.input_columns):
             cats = self.spec.categories[j] if j < len(self.spec.categories) else None
-            if cats:
+            bounds = self.spec.boundaries[j] if j < len(self.spec.boundaries) else None
+            if cats and bounds:  # hybrid column: numeric bins then cats
+                miss = data.missing_mask(name)
+                cols.append(hybrid_bin_index(data.column(name), bounds, cats,
+                                             miss))
+            elif cats:
                 miss = data.missing_mask(name)
                 cols.append(categorical_bin_index(data.column(name), cats, miss))
             else:
-                bounds = self.spec.boundaries[j] or [float("-inf")]
-                cols.append(numeric_bin_index(data.numeric(name), bounds))
+                cols.append(numeric_bin_index(data.numeric(name),
+                                              bounds or [float("-inf")]))
         return np.stack(cols, axis=1).astype(np.int32)
 
     def compute(self, codes: np.ndarray) -> np.ndarray:

@@ -78,6 +78,9 @@ def _slots(cc: ColumnConfig) -> int:
     """Bin-slot count incl. the trailing missing slot."""
     if cc.is_categorical():
         return len(cc.column_binning.bin_category or []) + 1
+    if cc.is_hybrid():
+        return (len(cc.column_binning.bin_boundary or [float("-inf")])
+                + len(cc.column_binning.bin_category or []) + 1)
     return len(cc.column_binning.bin_boundary or [float("-inf")]) + 1
 
 
@@ -254,7 +257,9 @@ def build_column_spec(
         return _table_spec(cc, _zscored_table(cc, t, wm, ws, cutoff))
 
     if nt in (NormType.HYBRID, NormType.WEIGHT_HYBRID):
-        if is_cat:
+        # hybridNormalize (Normalizer.java:683): NUMERICAL columns z-score,
+        # everything else (categorical AND hybrid-H) takes the woe path
+        if is_cat or cc.is_hybrid():
             return _table_spec(cc, _woe_table(cc, nt == NormType.WEIGHT_HYBRID))
         return _value_spec(cc, cutoff)
 
@@ -362,6 +367,15 @@ def _bin_codes_for(
         cats = cc.column_binning.bin_category or []
         out = categorical_bin_index(
             data.column(cc.column_name), cats, data.missing_mask(cc.column_name)
+        )
+    elif cc.is_hybrid():
+        from shifu_tpu.stats.binning import hybrid_bin_index
+
+        out = hybrid_bin_index(
+            data.column(cc.column_name),
+            cc.column_binning.bin_boundary or [float("-inf")],
+            cc.column_binning.bin_category or [],
+            data.missing_mask(cc.column_name),
         )
     else:
         bounds = cc.column_binning.bin_boundary or [float("-inf")]
@@ -525,6 +539,10 @@ def spec_to_json(s: ColumnNormSpec) -> dict:
         d["table"] = [float(x) for x in s.table]
     if s.cc.is_categorical():
         d["categories"] = list(s.cc.column_binning.bin_category or [])
+    elif s.cc.is_hybrid():
+        d["hybrid"] = True
+        d["categories"] = list(s.cc.column_binning.bin_category or [])
+        d["boundaries"] = [float(b) for b in (s.cc.column_binning.bin_boundary or [])]
     else:
         d["boundaries"] = [float(b) for b in (s.cc.column_binning.bin_boundary or [])]
     return d
@@ -546,7 +564,13 @@ def plan_from_json(d: dict) -> NormPlan:
     specs = []
     for cd in d.get("columns", []):
         cc = ColumnConfig(column_name=cd["name"])
-        if "categories" in cd:
+        if cd.get("hybrid"):
+            cc.column_type = ColumnType.H
+            cc.column_binning.bin_category = list(cd.get("categories", []))
+            cc.column_binning.bin_boundary = [
+                float(b) for b in cd.get("boundaries", [])
+            ]
+        elif "categories" in cd:
             cc.column_type = ColumnType.C
             cc.column_binning.bin_category = list(cd["categories"])
         else:

@@ -68,11 +68,32 @@ class BasicProcessor:
     def run(self) -> int:
         t0 = time.time()
         log.info("Step %s starts.", self.step)
+        profile_dir = self._profile_dir()
         try:
-            self.run_step()
+            if profile_dir:
+                # -Dshifu.profile=<dir>: wrap the step in a jax.profiler
+                # trace (the TPU answer to the reference's per-phase
+                # wall-clock logging + JMap introspection, SURVEY §5);
+                # inspect with TensorBoard or xprof
+                import jax
+
+                os.makedirs(profile_dir, exist_ok=True)
+                with jax.profiler.trace(profile_dir):
+                    self.run_step()
+                log.info("profiler trace -> %s", profile_dir)
+            else:
+                self.run_step()
         finally:
             log.info("Step %s finished in %.1f s.", self.step, time.time() - t0)
         return 0
+
+    def _profile_dir(self):
+        from shifu_tpu.utils import environment
+
+        d = environment.get_property("shifu.profile", "")
+        if not d:
+            return None
+        return os.path.join(self.resolve(d), self.step)
 
     def run_step(self) -> None:  # pragma: no cover - abstract
         raise NotImplementedError

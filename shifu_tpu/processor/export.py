@@ -41,19 +41,26 @@ class ExportProcessor(BasicProcessor):
 
     def _export_pmml(self) -> None:
         from shifu_tpu.eval.scorer import find_model_paths
-        from shifu_tpu.export.pmml import nn_to_pmml
+        from shifu_tpu.export.pmml import nn_to_pmml, tree_to_pmml
         from shifu_tpu.models.nn import NNModelSpec
+        from shifu_tpu.models.tree import TreeModelSpec
 
         paths = [p for p in find_model_paths(self.paths.models_dir())
-                 if p.endswith((".nn", ".lr"))]
+                 if p.endswith((".nn", ".lr", ".gbt", ".rf"))]
         if not paths:
             raise ShifuError(
                 ErrorCode.MODEL_NOT_FOUND,
-                "PMML export supports NN/LR models; none found under models/",
+                "PMML export supports NN/LR/GBT/RF models; none under models/",
             )
         for i, p in enumerate(paths):
-            spec = NNModelSpec.load(p)
-            xml = nn_to_pmml(spec, model_name=self.model_config.basic.name)
+            if p.endswith((".gbt", ".rf")):
+                spec = TreeModelSpec.load(p)
+                xml = tree_to_pmml(spec,
+                                   model_name=self.model_config.basic.name)
+            else:
+                spec = NNModelSpec.load(p)
+                xml = nn_to_pmml(spec,
+                                 model_name=self.model_config.basic.name)
             out = self.paths.pmml_path(i)
             with open(out, "w") as fh:
                 fh.write(xml)

@@ -16,12 +16,21 @@ def progress_line(trainer_id: int, epoch: int, train_err: float,
             f"Train Error:{train_err:.8f} Validation Error:{valid_err:.8f}\n")
 
 
-def progress_writer(path: str, trainer_id: int = 0) -> Callable:
-    """Single-trainer progress callback: (epoch, train_err, valid_err)."""
+def progress_writer(path: str, trainer_id: int = 0,
+                    echo: bool = True) -> Callable:
+    """Single-trainer progress callback: (epoch, train_err, valid_err).
+    `echo` mirrors the line to the console (the reference TailThread tails
+    progress files to the console for interactive runs)."""
+    from shifu_tpu.utils.log import get_logger
+
+    log = get_logger(__name__)
 
     def cb(it, tr, va):
         with open(path, "a") as fh:
             fh.write(progress_line(trainer_id, it, tr, va))
+        if echo:
+            log.info("trainer %d epoch %d train %.6f valid %.6f",
+                     trainer_id, it, tr, va)
 
     return cb
 

@@ -45,10 +45,18 @@ def train_tree_models(proc, alg) -> None:
                 f"CleanedData column {name} is no longer selected in "
                 f"ColumnConfig.json — re-run `shifu norm`",
             )
-        cat = cc.is_categorical()
+        # hybrid columns split like categoricals (their combined bin axis is
+        # not totally ordered, so mean-sorted subset splits apply) but keep
+        # BOTH binning tables so raw-record scoring can rebuild hybrid codes
+        cat = cc.is_categorical() or cc.is_hybrid()
         is_cat.append(cat)
-        boundaries.append(None if cat else list(cc.column_binning.bin_boundary or []))
-        categories.append(list(cc.column_binning.bin_category or []) if cat else None)
+        boundaries.append(
+            list(cc.column_binning.bin_boundary or [])
+            if (not cc.is_categorical()) else None
+        )
+        categories.append(
+            list(cc.column_binning.bin_category or []) if cat else None
+        )
 
     suffix = proc._model_suffix(alg)
     proc.paths.ensure(proc.paths.models_dir())
@@ -108,6 +116,15 @@ def train_tree_models(proc, alg) -> None:
         # full hyperparameter fingerprint: a leftover checkpoint from a
         # differently-configured run must NOT be silently grafted onto
         # this one (bit-equal resume is only meaningful for the same cfg)
+        # data identity: a checkpoint built on a different binning (re-run
+        # stats/norm) must not be grafted onto incompatible codes
+        import hashlib
+        import json as _json
+
+        data_sig = hashlib.sha1(_json.dumps(
+            [list(meta.columns), [int(s) for s in slots], boundaries,
+             categories], sort_keys=True, default=str
+        ).encode()).hexdigest()
         fingerprint = {
             "algorithm": cfg.algorithm, "loss": cfg.loss,
             "maxDepth": cfg.max_depth, "maxLeaves": cfg.max_leaves,
@@ -118,6 +135,7 @@ def train_tree_models(proc, alg) -> None:
             "baggingSampleRate": cfg.bagging_sample_rate,
             "baggingWithReplacement": cfg.bagging_with_replacement,
             "validSetRate": cfg.valid_set_rate, "seed": cfg.seed,
+            "dataSignature": data_sig,
         }
         init_trees = None
         init_val_errors = None

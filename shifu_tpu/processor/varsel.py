@@ -100,6 +100,9 @@ class VarSelProcessor(BasicProcessor):
         elif filter_by == "FI":
             scores = self._feature_importance()
             self._select_by_scores(scores, vs.filter_num)
+        elif filter_by in ("VOTED", "V"):
+            scores = self._voted(vs)
+            self._select_by_scores(scores, vs.wrapper_num or vs.filter_num)
         else:
             from shifu_tpu.varsel.selector import select_by_filter
 
@@ -210,6 +213,51 @@ class VarSelProcessor(BasicProcessor):
                 fh.write(f"{name},{s:.8g}\n")
         log.info("%s sensitivity computed for %d columns -> se.csv",
                  se_type, len(out))
+        return out
+
+    def _voted(self, vs) -> dict:
+        """Voted selection (dvarsel): the GA wrapper proposes candidate
+        variable subsets, every generation trains/validates the WHOLE
+        population as one vmapped program, and the best seed wins
+        (core/dvarsel/VarSelMaster.java:39, wrapper/CandidateGenerator).
+        Scores: best-seed members rank first (1 + vote share), the rest by
+        final-population vote share — so _select_by_scores keeps the seed."""
+        from shifu_tpu.norm.dataset import load_normalized
+        from shifu_tpu.varsel.voted import VotedConfig, voted_selection
+
+        norm_dir = self.paths.normalized_data_dir()
+        if not os.path.isdir(norm_dir):
+            raise ShifuError(ErrorCode.DATA_NOT_FOUND,
+                             f"{norm_dir} — run `shifu norm` first")
+        meta, feats, tags, weights = load_normalized(norm_dir)
+        feats = np.asarray(feats, np.float32)
+        tags = np.asarray(tags, np.float32)
+        weights = np.asarray(weights, np.float32)
+        params = vs.params or {}
+        cfg = VotedConfig(
+            expect_var_count=int(params.get(
+                "expect_variable_cnt", vs.wrapper_num or 20)),
+            population_size=int(params.get("population_live_size", 30)),
+            generations=int(params.get("population_multiply_cnt", 5)),
+            cross_percent=int(params.get("hybrid_percent", 60)),
+            mutation_percent=int(params.get("mutation_percent", 20)),
+        )
+        best, votes = voted_selection(feats, tags, weights, cfg)
+
+        # map normalized output columns back to source columns (one-hot
+        # expansion etc.), same as the SE path
+        src_of = (meta.extra or {}).get("sourceOf") or {}
+        best_set = set(best)
+        out: dict = {}
+        for j, name in enumerate(meta.columns):
+            src = src_of.get(name, name)
+            score = (1.0 + float(votes[j])) if j in best_set else float(votes[j])
+            out[src] = max(out.get(src, float("-inf")), score)
+        with open(os.path.join(self.paths.varsel_dir(), "voted.csv"), "w") as fh:
+            fh.write("column,score\n")
+            for name, s in sorted(out.items(), key=lambda kv: -kv[1]):
+                fh.write(f"{name},{s:.6g}\n")
+        log.info("voted selection: best seed has %d columns", len(best))
         return out
 
     def _feature_importance(self) -> dict:

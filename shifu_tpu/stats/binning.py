@@ -142,3 +142,33 @@ def categorical_bin_index(
     )  # copy: pandas may hand back a read-only buffer
     idx[missing_mask] = len(categories)
     return idx.astype(np.int32)
+
+
+def hybrid_bin_index(
+    raw: np.ndarray,
+    boundaries: Sequence[float],
+    categories: Sequence[str],
+    missing_mask: np.ndarray,
+) -> np.ndarray:
+    """Hybrid (H) column bin index — Normalizer.java:622-638: try the
+    categorical lookup first (hit -> |numeric bins| + category index), else
+    parse as a number (numeric bin; unparseable -> the trailing missing slot
+    at |numeric bins| + |categories|)."""
+    import pandas as pd
+
+    nb = len(boundaries)
+    miss_slot = nb + len(categories)
+    lookup = {c: i for i, c in enumerate(categories)}
+    ser = pd.Series(raw).str.strip()
+    cat_idx = ser.map(lookup)
+    vals = pd.to_numeric(ser, errors="coerce").to_numpy(dtype=np.float64)
+    num_idx = numeric_bin_index(vals, boundaries)
+    out = np.where(
+        cat_idx.notna().to_numpy(),
+        nb + cat_idx.fillna(0).to_numpy(dtype=np.int64),
+        # non-finite parses ("Infinity") are missing too, like
+        # ColumnarData.numeric does for pure-numeric columns
+        np.where(~np.isfinite(vals), miss_slot, num_idx),
+    ).astype(np.int32)
+    out[np.asarray(missing_mask)] = miss_slot
+    return out

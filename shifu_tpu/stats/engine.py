@@ -54,6 +54,21 @@ def build_codes(
                 data.column(cc.column_name), cats, miss
             )
             slots.append(len(cats) + 1)
+        elif cc.is_hybrid():
+            # hybrid: numeric bins then category bins then missing
+            # (Normalizer.java:622-638); numeric moments come from the
+            # parseable values only
+            from shifu_tpu.stats.binning import hybrid_bin_index
+
+            bounds = cc.column_binning.bin_boundary or [float("-inf")]
+            cats = cc.column_binning.bin_category or []
+            miss = data.missing_mask(cc.column_name)
+            codes[:, j] = hybrid_bin_index(
+                data.column(cc.column_name), bounds, cats, miss
+            )
+            slots.append(len(bounds) + len(cats) + 1)
+            numeric_cols.append(cc)
+            numeric_mat.append(data.numeric(cc.column_name).astype(np.float32))
         else:
             bounds = cc.column_binning.bin_boundary or [float("-inf")]
             vals = data.numeric(cc.column_name)
@@ -133,6 +148,24 @@ def compute_stats(
             cc.column_binning.bin_category = cats
             cc.column_binning.bin_boundary = None
             cc.column_binning.length = len(cats)
+        elif cc.is_hybrid():
+            # hybrid: numeric boundaries from parseable values PLUS
+            # categories from non-parseable non-missing tokens
+            # (udf/stats/NumericalVarStats hybrid handling)
+            vals = data.numeric(cc.column_name)
+            miss = data.missing_mask(cc.column_name)
+            bounds = numeric_boundaries(
+                vals, tags, weights, mc.stats.binning_method, max_bins
+            )
+            unparseable = np.isnan(vals) & ~miss
+            cats = categorical_bins(
+                data.column(cc.column_name)[unparseable],
+                np.zeros(int(unparseable.sum()), dtype=bool),
+                cate_max,
+            ) if unparseable.any() else []
+            cc.column_binning.bin_boundary = bounds
+            cc.column_binning.bin_category = cats
+            cc.column_binning.length = len(bounds) + len(cats)
         else:
             vals = data.numeric(cc.column_name)
             bounds = numeric_boundaries(

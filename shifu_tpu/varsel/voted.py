@@ -1,0 +1,183 @@
+"""Voted variable selection — the dvarsel genetic wrapper, vmapped.
+
+Parity: core/dvarsel/VarSelMaster.java:39 + wrapper/CandidateGenerator.java —
+a population of candidate variable subsets ("seeds") evolves over
+generations: every seed is trained/validated, seeds sort by validation
+error, the best INHERIT, the middle CROSS over, the worst MUTATE
+(nextGeneration), and after the configured generations the best seed wins
+the vote (voteBestSeed).
+
+TPU-first shape: one generation = ONE vmapped program. Each candidate's
+feature subset is a {0,1} mask over the feature axis applied to the first
+dense layer (x @ (W1 * mask[:, None]) — masked features get zero forward
+signal AND zero gradient), so P candidate models train simultaneously on
+the shared row-sharded matrix instead of P Guagua worker fleets
+(wrapper/ValidationConductor.java trains one Encog net per seed per
+worker).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass
+class VotedConfig:
+    """Knobs mirror CandidateGenerator's params (defaults follow
+    Constants.java / dvarsel defaults where the reference defines them)."""
+
+    expect_var_count: int = 20  # EXPECT_VARIABLE_CNT (varSelect.wrapperNum)
+    population_size: int = 30  # POPULATION_LIVE_SIZE
+    generations: int = 5  # POPULATION_MULTIPLY_CNT
+    cross_percent: int = 60  # HYBRID_PERCENT
+    mutation_percent: int = 20  # MUTATION_PERCENT
+    hidden: int = 10
+    epochs: int = 30
+    learning_rate: float = 0.05
+    valid_rate: float = 0.2
+    seed: int = 0
+
+
+_PROGRAMS: Dict[tuple, object] = {}
+
+
+def _get_eval_program(d: int, hidden: int, epochs: int, lr: float):
+    """Vmapped candidate evaluator: (flat0 [P, nw], masks [P, d], x, t,
+    sig_tr, sig_va) -> valid_error [P]."""
+    key = (d, hidden, epochs, lr)
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+    import jax.numpy as jnp
+
+    n_w1 = d * hidden
+    n_b1 = hidden
+    n_w2 = hidden
+    n_total = n_w1 + n_b1 + n_w2 + 1
+
+    def fwd(flat, mask, x):
+        w1 = flat[:n_w1].reshape(d, hidden) * mask[:, None]
+        b1 = flat[n_w1:n_w1 + n_b1]
+        w2 = flat[n_w1 + n_b1:n_w1 + n_b1 + n_w2]
+        b2 = flat[-1]
+        h = jnp.tanh(x @ w1 + b1)
+        return 1.0 / (1.0 + jnp.exp(-(h @ w2 + b2)))
+
+    def loss(flat, mask, x, t, sig):
+        p = fwd(flat, mask, x)
+        return jnp.sum(sig * (t - p) ** 2)
+
+    grad = jax.grad(loss)
+
+    def train_one(flat0, mask, x, t, sig_tr, sig_va):
+        def body(_, carry):
+            flat, m, v, step = carry
+            g = grad(flat, mask, x, t, sig_tr)
+            # Adam (fixed betas; the candidate model is a probe, not a
+            # deliverable — ValidationConductor trains a quick Encog net too)
+            m2 = 0.9 * m + 0.1 * g
+            v2 = 0.999 * v + 0.001 * g * g
+            mh = m2 / (1.0 - 0.9 ** (step + 1.0))
+            vh = v2 / (1.0 - 0.999 ** (step + 1.0))
+            flat2 = flat - lr * mh / (jnp.sqrt(vh) + 1e-8)
+            return flat2, m2, v2, step + 1.0
+
+        carry = (flat0, jnp.zeros_like(flat0), jnp.zeros_like(flat0), 0.0)
+        flat, _, _, _ = jax.lax.fori_loop(
+            0, epochs, lambda i, c: body(i, c), carry)
+        p = fwd(flat, mask, x)
+        sq = (t - p) ** 2
+        return jnp.sum(sig_va * sq) / jnp.maximum(jnp.sum(sig_va), 1.0)
+
+    prog = jax.jit(jax.vmap(train_one, in_axes=(0, 0, None, None, None, None)))
+    _PROGRAMS[key] = (prog, n_total)
+    return _PROGRAMS[key]
+
+
+def _masks_from_seeds(seeds: List[List[int]], d: int) -> np.ndarray:
+    masks = np.zeros((len(seeds), d), np.float32)
+    for i, s in enumerate(seeds):
+        masks[i, list(s)] = 1.0
+    return masks
+
+
+def _next_generation(seeds: List[List[int]], errors: np.ndarray,
+                     cfg: VotedConfig, rng, d: int) -> List[List[int]]:
+    """CandidateGenerator.nextGeneration: sort by error; best inherit,
+    middle crossover (parents from the best pool), worst replaced by
+    mutants."""
+    order = np.argsort(errors)
+    seeds = [seeds[i] for i in order]
+    p = len(seeds)
+    n_best = max(1, (100 - cfg.cross_percent - cfg.mutation_percent) * p // 100)
+    n_cross = cfg.cross_percent * p // 100
+    k = cfg.expect_var_count
+    out = [list(s) for s in seeds[:n_best]]
+    while len(out) < n_best + n_cross:
+        a, b = rng.choice(n_best, size=2, replace=True)
+        pool = sorted(set(seeds[a]) | set(seeds[b]))
+        out.append(sorted(rng.choice(pool, size=min(k, len(pool)),
+                                     replace=False).tolist()))
+    while len(out) < p:
+        out.append(sorted(rng.choice(d, size=min(k, d),
+                                     replace=False).tolist()))
+    return out
+
+
+def voted_selection(
+    feats: np.ndarray,
+    tags: np.ndarray,
+    weights: np.ndarray,
+    cfg: VotedConfig,
+) -> Tuple[List[int], np.ndarray]:
+    """Run the GA; returns (best seed column indices, per-column vote
+    frequency over the final population — diagnostic like the reference's
+    worker vote tallies)."""
+    import jax.numpy as jnp
+
+    n, d = feats.shape
+    rng = np.random.default_rng(cfg.seed)
+    k = min(cfg.expect_var_count, d)
+    seeds = [
+        sorted(rng.choice(d, size=k, replace=False).tolist())
+        for _ in range(cfg.population_size)
+    ]
+    valid = rng.random(n) < cfg.valid_rate
+    sig_tr = (np.where(valid, 0.0, weights)).astype(np.float32)
+    sig_va = (np.where(valid, weights, 0.0)).astype(np.float32)
+
+    (prog, n_total) = _get_eval_program(d, cfg.hidden, cfg.epochs,
+                                        cfg.learning_rate)
+    x = jnp.asarray(feats.astype(np.float32))
+    t = jnp.asarray(tags.astype(np.float32))
+    sig_tr_j = jnp.asarray(sig_tr)
+    sig_va_j = jnp.asarray(sig_va)
+
+    best_seed: List[int] = seeds[0]
+    best_err = float("inf")
+    errors = np.zeros(len(seeds))
+    for gen in range(cfg.generations):
+        flats = rng.normal(0, 0.1, size=(len(seeds), n_total)).astype(np.float32)
+        masks = _masks_from_seeds(seeds, d)
+        errors = np.asarray(prog(jnp.asarray(flats), jnp.asarray(masks),
+                                 x, t, sig_tr_j, sig_va_j))
+        gi = int(np.argmin(errors))
+        if float(errors[gi]) < best_err:
+            best_err = float(errors[gi])
+            best_seed = list(seeds[gi])
+        log.info("voted varsel generation %d/%d: best err %.6f "
+                 "(global best %.6f)", gen + 1, cfg.generations,
+                 float(errors[gi]), best_err)
+        if gen + 1 < cfg.generations:
+            seeds = _next_generation(seeds, errors, cfg, rng, d)
+
+    votes = _masks_from_seeds(seeds, d).sum(axis=0) / max(len(seeds), 1)
+    return sorted(best_seed), votes

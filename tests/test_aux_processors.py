@@ -199,3 +199,24 @@ class TestCombo:
         perf = json.load(open(os.path.join(root, "evals", "Combo",
                                            "EvalPerformance.json")))
         assert perf["areaUnderRoc"] > 0.85
+
+
+def test_profiler_hook(tmp_path):
+    """-Dshifu.profile=<dir> wraps any step in a jax.profiler trace
+    (SURVEY §5 tracing obligation)."""
+    from tests.helpers import make_model_set
+
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=120)
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.utils import environment
+
+    environment.set_property("shifu.profile", "profout")
+    try:
+        assert InitProcessor(root).run() == 0
+    finally:
+        environment.set_property("shifu.profile", "")
+    prof = os.path.join(root, "profout", "init")
+    assert os.path.isdir(prof)
+    # jax writes a plugins/profile/<ts> dir with trace artifacts
+    assert any(os.scandir(prof)), "no profiler artifacts written"

@@ -288,3 +288,79 @@ def test_egb_normalization_matches_manual_zscore():
     np.testing.assert_allclose(
         model.normalize_rows(rows), _zscore_normalize(rows), rtol=1e-10
     )
+
+
+@needs_ref
+def test_golden_readablespec_gbt_parses_and_roundtrips():
+    """The reference's checked-in readablespec/model1.gbt (100-tree GBT)
+    parses, scores deterministically, and survives our write->read
+    round-trip bit-for-bit at the structural level."""
+    blob = open(f"{READABLE}/model1.gbt", "rb").read()
+    m = treespec.read_tree_model(blob)
+    assert m.algorithm.upper() == "GBT"
+    assert m.loss == "squared"
+    assert len(m.bags) == 1 and len(m.bags[0]) == 100
+    assert len(m.column_mapping) == 30
+
+    x = np.zeros((5, len(m.column_mapping)))
+    s1 = m.compute(x)
+    again = treespec.read_tree_model(treespec.write_tree_model(m))
+    assert len(again.bags[0]) == 100
+    np.testing.assert_allclose(again.compute(x), s1, rtol=1e-12)
+    # model0.gbt is the identical spec checked in twice upstream
+    blob0 = open(f"{READABLE}/model0.gbt", "rb").read()
+    m0 = treespec.read_tree_model(blob0)
+    np.testing.assert_allclose(m0.compute(x), s1, rtol=1e-12)
+
+
+def test_egb_nn_byte_layout_pinned():
+    """Field-by-field byte pin of the EGB .nn container prefix against
+    BinaryNNSerializer.java:52-104 (writeInt version; StringUtils.writeString
+    norm; int nStats; NNColumnStats.write per NNColumnStats.java:97-124;
+    int mappingSize + (int,int) pairs; int nNetworks) — constructed here
+    INDEPENDENTLY with struct.pack, not via our writer."""
+    import struct
+
+    stats = egb.RefNNColumnStats(
+        column_num=7, column_name="ab", column_type="C", cutoff=4.0,
+        mean=1.5, stddev=0.5, woe_mean=0.25, woe_stddev=1.25,
+        woe_wgt_mean=-0.5, woe_wgt_stddev=2.0,
+        bin_boundaries=[], bin_categories=["x", "yz"],
+        bin_pos_rates=[0.25, 0.75], bin_count_woes=[0.1, -0.1],
+        bin_weight_woes=[0.2, -0.2],
+    )
+    model = egb.RefNNModel("ZSCALE", [stats], {7: 0}, [])
+    blob = egb.write_nn_model(model, compress=False)
+
+    def jstr(s):  # dtrain StringUtils.writeString: int byte-length + utf8
+        b = s.encode("utf-8")
+        return struct.pack(">i", len(b)) + b
+
+    def dlist(vals):  # NNColumnStats.writeDoubleList: int size + doubles
+        return struct.pack(">i", len(vals)) + b"".join(
+            struct.pack(">d", v) for v in vals)
+
+    expected = (
+        struct.pack(">i", 1)            # NN_FORMAT_VERSION
+        + jstr("ZSCALE")                # norm type
+        + struct.pack(">i", 1)          # nStats
+        + struct.pack(">i", 7)          # columnNum
+        + jstr("ab")                    # columnName
+        + struct.pack(">b", 2)          # ColumnType.C byte (ColumnType.java:19)
+        + struct.pack(">d", 4.0)        # cutoff
+        + struct.pack(">d", 1.5)        # mean
+        + struct.pack(">d", 0.5)        # stddev
+        + struct.pack(">d", 0.25)       # woeMean
+        + struct.pack(">d", 1.25)       # woeStddev
+        + struct.pack(">d", -0.5)       # woeWgtMean
+        + struct.pack(">d", 2.0)        # woeWgtStddev
+        + dlist([])                     # binBoundaries
+        + struct.pack(">i", 2) + jstr("x") + jstr("yz")  # binCategories
+        + dlist([0.25, 0.75])           # binPosRates
+        + dlist([0.1, -0.1])            # binCountWoes
+        + dlist([0.2, -0.2])            # binWeightWoes
+        + struct.pack(">i", 1)          # columnMapping size
+        + struct.pack(">ii", 7, 0)      # columnNum -> input index
+        + struct.pack(">i", 0)          # zero networks
+    )
+    assert blob == expected

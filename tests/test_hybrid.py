@@ -1,0 +1,150 @@
+"""Hybrid (H) column semantics — numeric bins + category bins + missing
+(Normalizer.hybridNormalize:683, bin layout Normalizer.java:622-638)."""
+
+import os
+
+import numpy as np
+
+
+def test_hybrid_bin_index_layout():
+    from shifu_tpu.stats.binning import hybrid_bin_index
+
+    bounds = [-np.inf, 0.0, 10.0]  # 3 numeric bins
+    cats = ["NA_SPECIAL", "REFUSED"]
+    raw = np.array(["-5", "3", "12", "NA_SPECIAL", "REFUSED", "junk", "7"],
+                   dtype=object)
+    miss = np.zeros(7, bool)
+    idx = hybrid_bin_index(raw, bounds, cats, miss)
+    # numeric: -5 -> bin0, 3 -> bin1, 12 -> bin2, 7 -> bin1
+    # cats: NA_SPECIAL -> 3+0, REFUSED -> 3+1; junk -> missing slot 5
+    assert idx.tolist() == [0, 1, 2, 3, 4, 5, 1]
+    miss[0] = True  # configured-missing token overrides everything
+    assert hybrid_bin_index(raw, bounds, cats, miss)[0] == 5
+
+
+def _hybrid_model_set(tmp_path, n=500, seed=9):
+    """Dataset whose `mixed` column is numeric with special string codes."""
+    from shifu_tpu.config.model_config import Algorithm, new_model_config
+
+    rng = np.random.default_rng(seed)
+    y = (rng.random(n) < 0.45).astype(int)
+    x = rng.normal(loc=y * 2.0, scale=1.0, size=n)
+    special = rng.random(n) < 0.25
+    # special codes carry their own signal (strongly negative class)
+    mixed = np.where(special, np.where(y == 1, "SP_POS", "SP_NEG"),
+                     np.char.mod("%.4f", x))
+    other = rng.normal(loc=y, scale=1.2, size=n)
+
+    root = str(tmp_path / "ms")
+    data_dir = os.path.join(root, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    with open(os.path.join(data_dir, "header.txt"), "w") as fh:
+        fh.write("target|mixed|other\n")
+    with open(os.path.join(data_dir, "data.txt"), "w") as fh:
+        for i in range(n):
+            fh.write(f"{'M' if y[i] else 'B'}|{mixed[i]}|{other[i]:.5f}\n")
+
+    mc = new_model_config("HybridTest", Algorithm.NN)
+    mc.data_set.data_path = os.path.join(data_dir, "data.txt")
+    mc.data_set.header_path = os.path.join(data_dir, "header.txt")
+    mc.data_set.data_delimiter = "|"
+    mc.data_set.header_delimiter = "|"
+    mc.data_set.target_column_name = "target"
+    mc.data_set.pos_tags = ["M"]
+    mc.data_set.neg_tags = ["B"]
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    return root
+
+
+def test_hybrid_stats_and_norm_end_to_end(tmp_path):
+    from shifu_tpu.config.column_config import (
+        ColumnType,
+        load_column_config_list,
+    )
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+
+    root = _hybrid_model_set(tmp_path)
+    assert InitProcessor(root).run() == 0
+
+    # mark the mixed column H (users opt in, like the reference)
+    cc_path = os.path.join(root, "ColumnConfig.json")
+    ccs = load_column_config_list(cc_path)
+    for c in ccs:
+        if c.column_name == "mixed":
+            c.column_type = ColumnType.H
+    from shifu_tpu.config.column_config import save_column_config_list
+
+    save_column_config_list(cc_path, ccs)
+
+    assert StatsProcessor(root).run() == 0
+    ccs = load_column_config_list(cc_path)
+    mixed = next(c for c in ccs if c.column_name == "mixed")
+    assert mixed.column_type == ColumnType.H
+    bn = mixed.column_binning
+    assert bn.bin_boundary, "hybrid column lost its numeric bins"
+    assert set(bn.bin_category or []) == {"SP_POS", "SP_NEG"}
+    total_bins = len(bn.bin_boundary) + len(bn.bin_category) + 1
+    assert len(bn.bin_count_pos) == total_bins
+    # every valid row lands in some bin
+    assert sum(bn.bin_count_pos) + sum(bn.bin_count_neg) > 0
+    # special-code bins carry their class signal
+    nb = len(bn.bin_boundary)
+    sp_pos_idx = nb + (bn.bin_category or []).index("SP_POS")
+    sp_neg_idx = nb + (bn.bin_category or []).index("SP_NEG")
+    assert bn.bin_pos_rate[sp_pos_idx] > 0.9
+    assert bn.bin_pos_rate[sp_neg_idx] < 0.1
+    # numeric moments computed over parseable values only
+    assert mixed.column_stats.mean is not None
+    assert abs(mixed.column_stats.mean) < 5
+
+    assert NormProcessor(root).run() == 0
+    from shifu_tpu.norm.dataset import load_codes
+
+    meta, codes, tags, _ = load_codes(
+        os.path.join(root, "tmp", "norm", "CleanedData"))
+    j = meta.columns.index("mixed")
+    assert int(meta.extra["slots"][j]) == total_bins
+    assert codes[:, j].max() < total_bins
+
+
+def test_hybrid_woe_norm_table_covers_all_bins(tmp_path):
+    from shifu_tpu.config.column_config import (
+        ColumnType,
+        load_column_config_list,
+        save_column_config_list,
+    )
+    from shifu_tpu.config.model_config import ModelConfig, NormType
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+
+    root = _hybrid_model_set(tmp_path)
+    assert InitProcessor(root).run() == 0
+    cc_path = os.path.join(root, "ColumnConfig.json")
+    ccs = load_column_config_list(cc_path)
+    for c in ccs:
+        if c.column_name == "mixed":
+            c.column_type = ColumnType.H
+    save_column_config_list(cc_path, ccs)
+    assert StatsProcessor(root).run() == 0
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.normalize.norm_type = NormType.HYBRID
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    assert NormProcessor(root).run() == 0
+
+    from shifu_tpu.norm.normalizer import build_norm_plan, spec_to_json
+
+    ccs = load_column_config_list(cc_path)
+    plan = build_norm_plan(mc, ccs)
+    spec = next(s for s in plan.specs if s.cc.column_name == "mixed")
+    # hybridNormalize: H columns take the woe table (Normalizer.java:683)
+    assert spec.kind == "table"
+    mixed = next(c for c in ccs if c.column_name == "mixed")
+    total_bins = (len(mixed.column_binning.bin_boundary)
+                  + len(mixed.column_binning.bin_category) + 1)
+    assert len(spec.table) == total_bins
+    d = spec_to_json(spec)
+    assert d.get("hybrid") and d.get("boundaries") and d.get("categories")

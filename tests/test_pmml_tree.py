@@ -1,0 +1,233 @@
+"""Tree-ensemble PMML export: structure matches the reference golden's
+schema (PMML-4_2, DataDictionary/MiningSchema/Output with RawResult ->
+FinalResult x1000 scaling — dttest/model/golf0.pmml) and an INDEPENDENT
+mini PMML evaluator (standard Node/SimplePredicate/SimpleSetPredicate/
+Segmentation semantics, written against the PMML 4.2 spec, not against our
+writer) reproduces the native scores."""
+
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+NS = "{http://www.dmg.org/PMML-4_2}"
+
+
+# ---------------------------------------------------------------------------
+# minimal spec-faithful PMML evaluator (TreeModel + Segmentation)
+# ---------------------------------------------------------------------------
+
+
+def _pred_eval(el, row):
+    """True/False/None(unknown) per PMML predicate semantics."""
+    tag = el.tag.replace(NS, "")
+    if tag == "True":
+        return True
+    if tag == "False":
+        return False
+    if tag == "SimplePredicate":
+        field, op = el.get("field"), el.get("operator")
+        v = row.get(field)
+        if op == "isMissing":
+            return v is None
+        if op == "isNotMissing":
+            return v is not None
+        if v is None:
+            return None  # unknown
+        x, t = float(v), float(el.get("value"))
+        return {
+            "lessThan": x < t, "lessOrEqual": x <= t,
+            "greaterThan": x > t, "greaterOrEqual": x >= t,
+            "equal": x == t, "notEqual": x != t,
+        }[op]
+    if tag == "SimpleSetPredicate":
+        field = el.get("field")
+        v = row.get(field)
+        if v is None:
+            return None
+        arr = el.find(f"{NS}Array")
+        members = [s.strip('"') for s in (arr.text or "").split('" "')]
+        members = [m.strip('"') for m in members]
+        inside = str(v) in members
+        return inside if el.get("booleanOperator") == "isIn" else not inside
+    raise ValueError(f"unsupported predicate {tag}")
+
+
+def _node_children(node):
+    return node.findall(f"{NS}Node")
+
+
+def _eval_tree_node(node, row):
+    """PMML TreeModel traversal with missingValueStrategy=defaultChild."""
+    children = _node_children(node)
+    if not children:
+        return float(node.get("score"))
+    results = []
+    for ch in children:
+        pred = next(e for e in ch if e.tag != f"{NS}Node")
+        results.append(_pred_eval(pred, row))
+    for ch, r in zip(children, results):
+        if r is True:
+            return _eval_tree_node(ch, row)
+    if any(r is None for r in results):  # unknown -> defaultChild
+        default = node.get("defaultChild")
+        for ch in children:
+            if ch.get("id") == default:
+                return _eval_tree_node(ch, row)
+    return float(node.get("score"))  # noTrueChild: fall back to own score
+
+
+def eval_pmml_mining_model(xml_text, rows):
+    root = ET.fromstring(xml_text)
+    mm = root.find(f"{NS}MiningModel")
+    seg = mm.find(f"{NS}Segmentation")
+    method = seg.get("multipleModelMethod")
+    out = np.zeros(len(rows))
+    n_seg = 0
+    for segment in seg.findall(f"{NS}Segment"):
+        tm = segment.find(f"{NS}TreeModel")
+        top = tm.find(f"{NS}Node")
+        n_seg += 1
+        for i, row in enumerate(rows):
+            out[i] += _eval_tree_node(top, row)
+    if method == "average":
+        out /= max(n_seg, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+# ---------------------------------------------------------------------------
+
+
+def _mixed_spec(seed=0, algorithm="GBT", trees=8, max_leaves=-1):
+    from shifu_tpu.train.tree_trainer import TreeTrainConfig, train_trees
+
+    rng = np.random.default_rng(seed)
+    n = 1200
+    bounds = [-np.inf, -1.0, 0.0, 1.0]  # numeric feature, 5 slots w/ missing
+    cats = ["aa", "bb", "cc"]  # categorical, 4 slots w/ missing
+    x_num = rng.normal(size=n)
+    x_cat = rng.integers(0, 3, size=n)
+    codes_num = np.searchsorted(bounds, x_num, side="right") - 1
+    y = ((x_num > 0) | (x_cat == 1)).astype(np.float32)
+    codes = np.stack([codes_num, x_cat], axis=1).astype(np.int32)
+    cfg = TreeTrainConfig(algorithm=algorithm, tree_num=trees, max_depth=4,
+                          max_leaves=max_leaves, learning_rate=0.3,
+                          valid_set_rate=0.1, seed=3,
+                          min_instances_per_node=1)
+    res = train_trees(codes, y, np.ones(n, np.float32), [5, 4],
+                      [False, True], ["num0", "cat0"], cfg,
+                      boundaries=[[float(b) for b in bounds], None],
+                      categories=[None, cats])
+    rows = [
+        {"num0": float(x_num[i]), "cat0": cats[x_cat[i]]} for i in range(n)
+    ]
+    return res.spec, codes, rows
+
+
+@pytest.mark.parametrize("algorithm", ["GBT", "RF"])
+def test_tree_pmml_scores_match_native(algorithm):
+    from shifu_tpu.export.pmml import tree_to_pmml
+    from shifu_tpu.models.tree import traverse_trees
+
+    spec, codes, rows = _mixed_spec(algorithm=algorithm)
+    xml = tree_to_pmml(spec)
+    pmml_scores = eval_pmml_mining_model(xml, rows)
+
+    import jax.numpy as jnp
+
+    per_tree = np.asarray(traverse_trees(spec.trees, jnp.asarray(codes)))
+    native = (per_tree.sum(axis=1) if algorithm == "GBT"
+              else per_tree.mean(axis=1))
+    np.testing.assert_allclose(pmml_scores, native, atol=1e-5)
+
+
+def test_leafwise_tree_pmml_scores_match_native():
+    from shifu_tpu.export.pmml import tree_to_pmml
+    from shifu_tpu.models.tree import traverse_trees
+
+    spec, codes, rows = _mixed_spec(algorithm="GBT", trees=5, max_leaves=6)
+    xml = tree_to_pmml(spec)
+    pmml_scores = eval_pmml_mining_model(xml, rows)
+    import jax.numpy as jnp
+
+    native = np.asarray(
+        traverse_trees(spec.trees, jnp.asarray(codes))).sum(axis=1)
+    np.testing.assert_allclose(pmml_scores, native, atol=1e-5)
+
+
+def test_tree_pmml_missing_routing():
+    """Missing numeric -> defaultChild right; missing category -> the
+    missing slot's mask side."""
+    from shifu_tpu.export.pmml import tree_to_pmml
+    from shifu_tpu.models.tree import traverse_trees
+
+    spec, codes, _rows = _mixed_spec(algorithm="GBT", trees=4)
+    xml = tree_to_pmml(spec)
+    rows = [{"num0": None, "cat0": None}]  # all missing
+    pmml_scores = eval_pmml_mining_model(xml, rows)
+    # native: missing codes are the last slot per feature
+    import jax.numpy as jnp
+
+    miss_codes = np.array([[4, 3]], np.int32)
+    native = np.asarray(
+        traverse_trees(spec.trees, jnp.asarray(miss_codes))).sum(axis=1)
+    np.testing.assert_allclose(pmml_scores, native, atol=1e-5)
+
+
+def test_tree_pmml_golden_schema_shape():
+    """Same top-level schema as the reference golden (golf0.pmml): PMML-4_2
+    namespace, Header/Application, DataDictionary fields, MiningSchema with
+    target, Output RawResult + FinalResult scaled 0..1000."""
+    from shifu_tpu.export.pmml import tree_to_pmml
+
+    spec, _codes, _rows = _mixed_spec(trees=3)
+    root = ET.fromstring(tree_to_pmml(spec))
+    assert root.tag == f"{NS}PMML"
+    assert root.find(f"{NS}Header/{NS}Application") is not None
+    dd = root.find(f"{NS}DataDictionary")
+    names = [df.get("name") for df in dd.findall(f"{NS}DataField")]
+    assert names == ["num0", "cat0", "TARGET"]
+    mm = root.find(f"{NS}MiningModel")
+    assert mm.get("functionName") == "regression"
+    usage = {mf.get("name"): mf.get("usageType")
+             for mf in mm.find(f"{NS}MiningSchema")}
+    assert usage["TARGET"] == "target"
+    outs = mm.find(f"{NS}Output").findall(f"{NS}OutputField")
+    assert [o.get("name") for o in outs] == ["RawResult", "FinalResult"]
+    norms = outs[1].find(f"{NS}NormContinuous").findall(f"{NS}LinearNorm")
+    assert [(n.get("orig"), n.get("norm")) for n in norms] == [
+        ("0.0", "0.0"), ("1.0", "1000.0")
+    ]
+    seg = mm.find(f"{NS}Segmentation")
+    assert len(seg.findall(f"{NS}Segment")) == 3
+
+
+def test_export_processor_writes_tree_pmml(tmp_path):
+    from tests.helpers import make_model_set
+
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=300, algorithm="GBT")
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.export import ExportProcessor
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.params.update({"TreeNum": 5, "MaxDepth": 3})
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    assert TrainProcessor(root).run() == 0
+    assert ExportProcessor(root, kind="pmml").run() == 0
+    import glob
+
+    hits = glob.glob(os.path.join(root, "**", "*.pmml"), recursive=True)
+    assert hits
+    xml = open(hits[0]).read()
+    assert "MiningModel" in xml and "Segmentation" in xml

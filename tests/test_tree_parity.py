@@ -193,6 +193,12 @@ def test_processor_checkpoint_resume_and_continuous(tmp_path):
         boundaries=clean.boundaries, categories=clean.categories,
         loss=clean.loss, learning_rate=clean.learning_rate,
     ).save(ck)
+    import hashlib
+
+    data_sig = hashlib.sha1(json.dumps(
+        [list(clean.input_columns), [int(s) for s in clean.slots],
+         clean.boundaries, clean.categories],
+        sort_keys=True, default=str).encode()).hexdigest()
     with open(ck + ".json", "w") as fh:
         json.dump({
             "fingerprint": {
@@ -206,6 +212,7 @@ def test_processor_checkpoint_resume_and_continuous(tmp_path):
                 "baggingSampleRate": cfg.bagging_sample_rate,
                 "baggingWithReplacement": cfg.bagging_with_replacement,
                 "validSetRate": cfg.valid_set_rate, "seed": cfg.seed,
+                "dataSignature": data_sig,
             },
             "validErrors": [0.5, 0.4, 0.3, 0.2],
         }, fh)

@@ -173,3 +173,54 @@ class TestVarSelProcessor:
         cols = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
         assert sum(1 for c in cols if c.final_select) == 6
         assert os.path.isfile(os.path.join(root, "tmp", "varsel", "se.csv"))
+
+
+class TestVotedSelection:
+    """dvarsel voted selection (VarSelMaster.java:39 + CandidateGenerator)."""
+
+    def test_ga_finds_informative_columns(self):
+        from shifu_tpu.varsel.voted import VotedConfig, voted_selection
+
+        rng = np.random.default_rng(5)
+        n, d = 1200, 12
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        # only columns 0 and 3 carry signal
+        y = ((1.8 * x[:, 0] - 1.5 * x[:, 3]
+              + rng.normal(scale=0.3, size=n)) > 0).astype(np.float32)
+        w = np.ones(n, np.float32)
+        cfg = VotedConfig(expect_var_count=3, population_size=16,
+                          generations=4, epochs=40, seed=2)
+        best, votes = voted_selection(x, y, w, cfg)
+        assert len(best) == 3
+        assert 0 in best and 3 in best, f"best seed {best} missed signal cols"
+        assert votes.shape == (d,)
+
+    def test_voted_processor_end_to_end(self, tmp_path):
+        from tests.helpers import make_model_set
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=400)
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+        from shifu_tpu.processor.varsel import VarSelProcessor
+
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root).run() == 0
+        assert NormProcessor(root).run() == 0
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        mc.var_select.filter_by = "VOTED"
+        mc.var_select.wrapper_num = 5
+        mc.var_select.params = {"population_live_size": 10,
+                                "population_multiply_cnt": 2}
+        mc.save(os.path.join(root, "ModelConfig.json"))
+        assert VarSelProcessor(root).run() == 0
+
+        from shifu_tpu.config.column_config import load_column_config_list
+
+        ccs = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+        n_sel = sum(1 for c in ccs if c.final_select)
+        assert 0 < n_sel <= 5
+        assert os.path.isfile(os.path.join(root, "tmp", "varsel",
+                                           "voted.csv"))
