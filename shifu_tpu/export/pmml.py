@@ -181,7 +181,11 @@ def _predicate_for(el, tree, spec, node_idx: int, go_left: bool):
                   booleanOperator="isIn" if go_left == in_side_left
                   else "isNotIn")
         arr = _el(ssp, "Array", type="string", n=str(len(members)))
-        arr.text = " ".join(f'"{c}"' for c in members)
+        # PMML Array quoting: backslash-escape embedded quotes/backslashes
+        arr.text = " ".join(
+            '"' + c.replace("\\", "\\\\").replace('"', '\\"') + '"'
+            for c in members
+        )
         return
     bounds = spec.boundaries[feature] or []
     real = [i for i in range(min(len(bounds), len(mask))) if mask[i]]

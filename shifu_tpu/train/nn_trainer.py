@@ -172,7 +172,9 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes):
         return params
 
     def matmul(h, w):
-        if bf16:  # MXU-friendly: bf16 operands, f32 result
+        if bf16:  # MXU-friendly: bf16 operands, f32 result (bf16
+            # activations measured SLOWER on v5e — the elementwise chain
+            # between matmuls does not repay the extra converts)
             return (h.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16)).astype(
                 jnp.float32
             )

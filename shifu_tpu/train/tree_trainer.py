@@ -179,22 +179,37 @@ def make_layout(slots: List[int], is_cat: List[bool]) -> FeatureLayout:
 
 _PROGRAMS: Dict[tuple, object] = {}
 
+# matmul histograms beat XLA's scatter (which serializes on TPU, ~100M
+# updates/s) whenever the padded per-node work L*s_max stays modest — the
+# one-hot contraction rides the MXU instead
+MATMUL_HIST_NODE_CAP = 8192
 
-def _get_hist_program(L: int, T: int):
-    key = ("hist", L, T)
-    prog = _PROGRAMS.get(key)
-    if prog is not None:
-        return prog
-    import jax
+
+def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True):
+    """Traced histogram builder: [3, L, T] (cnt, sum, sqsum) over the flat
+    per-feature slot axis — the Impurity.featureUpdate hot loop
+    (dt/DTWorker.java:851) fused into one device op. Under a `data`-sharded
+    mesh each device reduces its row shard and XLA all-reduces the
+    replicated histogram (the psum replacing DTMaster's NodeStats merge,
+    DTMaster.java:297-310).
+
+    Two lowerings, chosen statically:
+      * matmul (SURVEY §7.5's histogram-kernel obligation, MXU-shaped):
+        one-hot(node)ᵀ @ (one-hot(code) ⊙ component) per feature chunk —
+        f32 operands so counts/sums accumulate exactly;
+      * scatter-add fallback when L*s_max is too wide to pad (one
+        10k-category column must not inflate the contraction)."""
     import jax.numpy as jnp
 
-    @jax.jit
-    def hist_accum(codes, labels, weights, node_slot, active, off_f, clip_f):
-        """[3, L, T] (cnt, sum, sqsum) by one scatter-add per component over
-        the [n, F] code matrix — the Impurity.featureUpdate hot loop fused.
-        Under a `data`-sharded mesh each device scatters its row shard and
-        XLA all-reduces the replicated histogram (the psum replacing
-        DTMaster's NodeStats merge, DTMaster.java:297-310)."""
+    # bound BOTH the padded contraction width (L*s_max) and L itself — the
+    # per-block lhs [blk, 3L] scales with L alone, and deep trees (RF
+    # MaxDepth=10 -> L=1024) would blow past the stats budget even when
+    # every feature is narrow
+    use_matmul = (allow_matmul and L * s_max <= MATMUL_HIST_NODE_CAP
+                  and L <= 128)
+
+    def hist_scatter(codes, labels, weights, node_slot, active, off_f,
+                     clip_f, seg_t, pos_t):
         n, F = codes.shape
         w = jnp.where(active, weights, 0.0)
         nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
@@ -210,8 +225,64 @@ def _get_hist_program(L: int, T: int):
         ]
         return jnp.stack(planes)
 
-    _PROGRAMS[key] = hist_accum
-    return hist_accum
+    def hist_matmul(codes, labels, weights, node_slot, active, off_f,
+                    clip_f, seg_t, pos_t):
+        import jax
+
+        n, F = codes.shape
+        w = jnp.where(active, weights, 0.0)
+        nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
+        comps = jnp.stack([w, w * labels, w * labels * labels], 1)  # [n, 3]
+
+        # row blocks bound every materialized one-hot; a lax.scan
+        # accumulates block partials into the [3L, F, s_max] histogram
+        blk = min(131072, n)
+        n_pad = -(-n // blk) * blk
+        pad = n_pad - n
+        codes_p = jnp.pad(codes, ((0, pad), (0, 0)))
+        nl_p = jnp.pad(nl, (0, pad))
+        comps_p = jnp.pad(comps, ((0, pad), (0, 0)))
+        # feature chunks bound the code one-hot to ~64 MB per block
+        fb = max(1, (64 << 20) // (4 * blk * max(s_max, 1)))
+        srange = jnp.arange(s_max)[None, None, :]
+
+        def block(hist, i):
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * blk, blk, 0)
+            nl_b = sl(nl_p)
+            oh_node = (nl_b[:, None] == jnp.arange(L)[None, :]).astype(
+                jnp.float32)
+            # [blk, 3L]: component-weighted node one-hot, one matmul lhs
+            A = (sl(comps_p)[:, :, None] * oh_node[:, None, :]).reshape(
+                blk, 3 * L)
+            code_b = sl(codes_p)
+            parts = []
+            for f0 in range(0, F, fb):
+                code_c = jnp.clip(code_b[:, f0:f0 + fb], 0,
+                                  clip_f[None, f0:f0 + fb])
+                oh_code = (code_c[:, :, None] == srange).astype(jnp.float32)
+                parts.append(A.T @ oh_code.reshape(blk, -1))  # [3L, fc*S]
+            contrib = jnp.concatenate(parts, axis=1).reshape(3, L, F, s_max)
+            return hist + contrib, None
+
+        hist0 = jnp.zeros((3, L, F, s_max), jnp.float32)
+        hist_pad, _ = jax.lax.scan(block, hist0,
+                                   jnp.arange(n_pad // blk))
+        return hist_pad[:, :, seg_t, pos_t]  # flat ragged [3, L, T]
+
+    return hist_matmul if use_matmul else hist_scatter
+
+
+def _get_hist_program(L: int, T: int, s_max: int,
+                      allow_matmul: bool = True):
+    key = ("hist", L, T, s_max, allow_matmul)
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+
+    prog = jax.jit(_make_hist_fn(L, T, s_max, allow_matmul))
+    _PROGRAMS[key] = prog
+    return prog
 
 
 def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
@@ -434,6 +505,117 @@ def _scan_batched(hists, la, lay, cfg, L_level):
             cat(gains), cat(masks), cat(cnts))
 
 
+def _get_tree_program(D: int, T: int, s_max: int, impurity: str,
+                      min_inst: int, min_gain: float,
+                      allow_matmul: bool = True):
+    """ONE jit program for a whole level-wise tree: every level runs at the
+    padded width L_max = 2^D inside a lax.fori_loop (inactive node slots
+    have empty histograms, so their gain is -inf and they never split).
+    Collapses the per-level dispatch chain (hist, scan, update per depth)
+    into a single device call — on a tunneled/remote TPU the per-dispatch
+    round-trip otherwise dominates tree building wall-clock."""
+    key = ("tree", D, T, s_max, impurity, min_inst, float(min_gain),
+           allow_matmul)
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+    import jax.numpy as jnp
+
+    L = 2**D
+    min_inst_eff = max(min_inst, 1)
+    hist_fn = _make_hist_fn(L, T, s_max, allow_matmul)
+
+    def hist_of(codes, labels, weights, node_local, active, off_f, clip_f,
+                seg_t, pos_t):
+        return hist_fn(codes, labels, weights, node_local, active, off_f,
+                       clip_f, seg_t, pos_t)
+
+    def scan_of(hist, la_tuple):
+        (feat_ok_t, is_cat_t, seg_t, pos_t, start_t, size_t, off_f, clip_f,
+         seg0_size) = la_tuple
+        scan = _get_scan_program(L, T, s_max, impurity, min_inst_eff,
+                                 min_gain)
+        return scan(hist, feat_ok_t, is_cat_t, seg_t, pos_t, start_t,
+                    size_t, off_f, clip_f, seg0_size)
+
+    @jax.jit
+    def tree_program(codes, labels, weights, off_f, clip_f, feat_ok_t,
+                     is_cat_t, seg_t, pos_t, start_t, size_t, seg0_size):
+        n = codes.shape[0]
+        node_local = jnp.zeros(n, jnp.int32)
+        active = jnp.ones(n, bool)
+        resting = jnp.zeros(n, jnp.int32)
+        feats = jnp.full((D + 1, L), -1, jnp.int32)
+        masks = jnp.zeros((D + 1, L, s_max), bool)
+        leaves = jnp.zeros((D + 1, L), jnp.float32)
+        la_tuple = (feat_ok_t, is_cat_t, seg_t, pos_t, start_t, size_t,
+                    off_f, clip_f, seg0_size)
+
+        def level_body(d, carry):
+            node_local, active, resting, feats, masks, leaves = carry
+            hist = hist_of(codes, labels, weights, node_local, active,
+                           off_f, clip_f, seg_t, pos_t)
+            (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = scan_of(
+                hist, la_tuple)
+            level_width = jnp.left_shift(1, d)
+            in_level = jnp.arange(L) < level_width
+            is_split = is_split & in_level
+            base = level_width - 1
+            nl = jnp.clip(node_local, 0, L - 1)
+            settled = active & ~is_split[nl]
+            resting = jnp.where(settled, base + nl, resting)
+            f = jnp.where(is_split, bf, 0)[nl]
+            code = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
+            cf = off_f[f] + jnp.clip(code, 0, clip_f[f])
+            goes_left = rank_flat[nl, cf] <= br[nl]
+            new_local = jnp.where(goes_left, 2 * nl, 2 * nl + 1)
+            still = is_split[nl] & active
+            feats = feats.at[d].set(jnp.where(is_split, bf, -1))
+            masks = masks.at[d].set(lm & in_level[:, None])
+            leaves = leaves.at[d].set(lv)
+            return (jnp.where(still, new_local, 0), still, resting, feats,
+                    masks, leaves)
+
+        carry = (node_local, active, resting, feats, masks, leaves)
+        (node_local, active, resting, feats, masks, leaves) = jax.lax.fori_loop(
+            0, D, level_body, carry)
+
+        # final level: leaf values only + settle leftovers
+        hist = hist_of(codes, labels, weights, node_local, active, off_f,
+                       clip_f, seg_t, pos_t)
+        (_bf, _br, _rf, lv2, _sp, _g, _lm, _nc) = scan_of(hist, la_tuple)
+        leaves = leaves.at[D].set(lv2)
+        resting = jnp.where(active, (L - 1) + node_local, resting)
+        # per-row leaf prediction computed in-program (dense node ids index
+        # the concatenated level-leaf vector), so callers never need a
+        # host round-trip between trees
+        leaf_flat = jnp.concatenate(
+            [leaves[d][: 2**d] for d in range(D + 1)])
+        row_pred = leaf_flat[resting]
+        return feats, masks, leaves, resting, row_pred
+
+    _PROGRAMS[key] = tree_program
+    return tree_program
+
+
+def _assemble_dense_tree(feats, masks, leaves, D: int) -> DenseTree:
+    """Host assembly: level d contributes its first 2^d padded slots."""
+    f_parts, m_parts, l_parts = [], [], []
+    for d in range(D + 1):
+        w = 2**d
+        f_parts.append(np.asarray(feats[d][:w], np.int32) if d < D
+                       else np.full(w, -1, np.int32))
+        m_parts.append(np.asarray(masks[d][:w], bool))
+        l_parts.append(np.asarray(leaves[d][:w], np.float32))
+    return DenseTree(
+        feature=np.concatenate(f_parts),
+        left_mask=np.concatenate(m_parts, axis=0),
+        leaf_value=np.concatenate(l_parts),
+        weight=1.0,
+    )
+
+
 def build_tree(
     codes,
     labels,
@@ -462,6 +644,31 @@ def build_tree(
         from shifu_tpu.parallel.mesh import replicate, shard_rows
 
         replicate_fn = lambda a: replicate(a, mesh)  # noqa: E731
+    la = _device_layout(lay, feat_ok, replicate_fn)
+
+    # fused single-dispatch path: whole tree in ONE jit call when the
+    # full-width [3, 2^D, T] histogram fits the stats-memory budget —
+    # collapses ~3 dispatches/level into 1/tree (tunnel latency dominates
+    # per-level dispatch chains on remote TPU links)
+    if 2**D <= batch_cap:
+        prog = _get_tree_program(D, lay.T, lay.s_max, cfg.impurity,
+                                 cfg.min_instances_per_node,
+                                 cfg.min_info_gain,
+                                 allow_matmul=mesh is None)
+        feats_d, masks_d, leaves_d, resting, _row_pred = prog(
+            codes, labels, weights, la.off, la.clip, la.feat_ok_t,
+            la.is_cat_t, la.seg_t, la.pos_t, la.start_t, la.size_t,
+            la.seg0_size,
+        )
+        import jax
+
+        feats_h, masks_h, leaves_h = jax.device_get(
+            (feats_d, masks_d, leaves_d))
+        return _assemble_dense_tree(feats_h, masks_h, leaves_h, D), resting
+
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import shard_rows
+
         node_local = shard_rows(jnp.zeros(n, dtype=jnp.int32), mesh)
         active = shard_rows(jnp.ones(n, dtype=bool), mesh)
         resting = shard_rows(jnp.zeros(n, dtype=jnp.int32), mesh)
@@ -469,7 +676,6 @@ def build_tree(
         node_local = jnp.zeros(n, dtype=jnp.int32)
         active = jnp.ones(n, dtype=bool)
         resting = jnp.zeros(n, dtype=jnp.int32)
-    la = _device_layout(lay, feat_ok, replicate_fn)
 
     feat_levels, mask_levels, leaf_levels = [], [], []
     for depth in range(D):
@@ -479,10 +685,12 @@ def build_tree(
         def hist_batches():
             for b0 in range(0, L, batch_cap):
                 Lb = min(batch_cap, L - b0)
-                hist_p = _get_hist_program(Lb, lay.T)
+                hist_p = _get_hist_program(Lb, lay.T, lay.s_max,
+                                           allow_matmul=mesh is None)
                 in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
                 yield hist_p(codes, labels, weights, node_local - b0,
-                             in_batch, la.off, la.clip), Lb, b0
+                             in_batch, la.off, la.clip, la.seg_t,
+                             la.pos_t), Lb, b0
 
         (bf, br, rank_flat, lv, is_split, _gain, lm, _nc) = _scan_batched(
             hist_batches(), la, lay, cfg, L
@@ -503,10 +711,11 @@ def build_tree(
     def hist_batches_final():
         for b0 in range(0, L2, batch_cap):
             Lb = min(batch_cap, L2 - b0)
-            hist_p = _get_hist_program(Lb, lay.T)
+            hist_p = _get_hist_program(Lb, lay.T, lay.s_max,
+                                       allow_matmul=mesh is None)
             in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
             yield hist_p(codes, labels, weights, node_local - b0, in_batch,
-                         la.off, la.clip), Lb, b0
+                         la.off, la.clip, la.seg_t, la.pos_t), Lb, b0
 
     (_f2, _c2, _r2, lv2, _s2, _g2, _m2, _nc2) = _scan_batched(
         hist_batches_final(), la, lay, cfg, L2
@@ -568,7 +777,7 @@ def build_tree_leafwise(
     # candidate splits per leaf: id -> (gain, feat, cut_rank, rank_row, mask)
     candidates: Dict[int, tuple] = {}
 
-    hist1 = _get_hist_program(1, lay.T)
+    hist1 = _get_hist_program(1, lay.T, lay.s_max)
     scan1 = _get_scan_program(1, lay.T, lay.s_max, cfg.impurity,
                               cfg.min_instances_per_node, cfg.min_info_gain)
 
@@ -578,7 +787,7 @@ def build_tree_leafwise(
         for lid in leaf_ids:
             act = node_id == lid
             hist = hist1(codes, labels, weights, jnp.zeros(n, jnp.int32),
-                         act, la.off, la.clip)
+                         act, la.off, la.clip, la.seg_t, la.pos_t)
             (f, c, r, lv, sp, g, m, _nc) = scan1(
                 hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t,
                 la.start_t, la.size_t, la.off, la.clip, la.seg0_size,
@@ -743,6 +952,20 @@ def _score_existing(trees: List[DenseTree], codes) -> "object":
     return jnp.sum(per_tree, axis=1)
 
 
+def _assemble_deferred(trees: List, deferred: List[tuple],
+                       cfg: TreeTrainConfig) -> None:
+    """Materialize fused-path trees from their device results (one host
+    transfer for the whole backlog)."""
+    import jax
+
+    host = jax.device_get([(f, m, lv) for _k, _w, f, m, lv in deferred])
+    for (k, weight_k, _f, _m, _lv), (fh, mh, lh) in zip(deferred, host):
+        tree = _assemble_dense_tree(fh, mh, lh, cfg.max_depth)
+        tree.weight = weight_k
+        trees[k] = tree  # trees list is indexed by global tree id
+    deferred.clear()
+
+
 def train_trees(
     codes: np.ndarray,
     tags: np.ndarray,
@@ -852,6 +1075,30 @@ def train_trees(
                 bad_rounds = 0
     terr = verr = 0.0
 
+    # per-tree host sync only when someone consumes per-tree results;
+    # otherwise the whole forest builds as ONE async dispatch chain
+    # (progress/checkpoint/early-stop all off => no tunnel round-trips
+    # between trees)
+    need_sync = bool(progress_cb or checkpoint_cb or cfg.early_stop_rounds
+                     or decider is not None)
+    lay = make_layout([int(s) for s in slots_np], [bool(c) for c in is_cat_np])
+    batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb)
+    fused = (not leaf_wise) and 2**cfg.max_depth <= batch_cap
+    la = None
+    if fused:
+        replicate_fn = None
+        if mesh is not None:
+            from shifu_tpu.parallel.mesh import replicate
+
+            replicate_fn = lambda a: replicate(a, mesh)  # noqa: E731
+        tree_prog = _get_tree_program(
+            cfg.max_depth, lay.T, lay.s_max, cfg.impurity,
+            cfg.min_instances_per_node, cfg.min_info_gain,
+            allow_matmul=mesh is None,
+        )
+    deferred: List[tuple] = []  # (k, weight, feats_d, masks_d, leaves_d)
+    err_pairs: List[tuple] = []  # device (train, valid) when deferred
+
     for k in range(start_k, cfg.tree_num):
         # per-tree RNG stream: keyed by tree index, NOT a shared sequential
         # stream — resume at tree k replays identically
@@ -877,22 +1124,41 @@ def train_trees(
         else:
             feat_ok[rng_k.choice(F, size=k_sub, replace=False)] = True
 
+        tree = None
         if leaf_wise:
             tree, resting = build_tree_leafwise(
                 codes_j, labels_k, w_k, slots_np, is_cat_np, cfg, feat_ok
             )
+            tree_pred = jnp.asarray(tree.leaf_value)[resting]
+        elif fused:
+            if la is None:
+                la = _device_layout(lay, feat_ok, replicate_fn)
+            else:  # only feat_ok changes per tree
+                fot = jnp.asarray(np.asarray(feat_ok, bool)[lay.seg_of_t])
+                la.feat_ok_t = (replicate_fn(fot) if replicate_fn else fot)
+            feats_d, masks_d, leaves_d, _resting, tree_pred = tree_prog(
+                codes_j, labels_k, w_k, la.off, la.clip, la.feat_ok_t,
+                la.is_cat_t, la.seg_t, la.pos_t, la.start_t, la.size_t,
+                la.seg0_size,
+            )
+            deferred.append(
+                (k, 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0),
+                 feats_d, masks_d, leaves_d))
         else:
             tree, resting = build_tree(
                 codes_j, labels_k, w_k, slots_np, is_cat_np, cfg, feat_ok,
                 mesh=mesh,
             )
-        tree.weight = 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0)
-        trees.append(tree)
+            tree_pred = jnp.asarray(tree.leaf_value)[resting]
+        weight_k = 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0)
+        if tree is not None:
+            tree.weight = weight_k
+            trees.append(tree)
+        else:
+            trees.append(None)  # placeholder; assembled after the loop
 
-        # per-row prediction straight from the build (no re-traversal)
-        tree_pred = jnp.asarray(tree.leaf_value)[resting]
         if is_gbt:
-            pred = pred + tree.weight * tree_pred
+            pred = pred + weight_k * tree_pred
             score = (
                 1.0 / (1.0 + jnp.exp(-pred)) if log_loss
                 else jnp.clip(pred, 0.0, 1.0)
@@ -903,6 +1169,12 @@ def train_trees(
             score = jnp.clip(pred, 0.0, 1.0)
 
         t_e, v_e = errors_of(score)
+        if not need_sync:
+            err_pairs.append((t_e, v_e))
+            valid_errors.append(None)  # filled after the final sync
+            continue
+        if deferred:  # sync consumers need real trees: drain the backlog
+            _assemble_deferred(trees, deferred, cfg)
         terr, verr = float(t_e), float(v_e)  # one sync per tree
         valid_errors.append(verr)
         if progress_cb:
@@ -921,6 +1193,18 @@ def train_trees(
                     break
             else:
                 bad_rounds = 0
+
+    if deferred:
+        _assemble_deferred(trees, deferred, cfg)
+    if err_pairs:  # deferred error sync: one host transfer for the run
+        host = jax.device_get(err_pairs)
+        errs = [(float(t), float(v)) for t, v in host]
+        terr, verr = errs[-1]
+        j = 0
+        for i in range(len(valid_errors)):
+            if valid_errors[i] is None:
+                valid_errors[i] = errs[j][1]
+                j += 1
 
     spec = TreeModelSpec(
         algorithm=cfg.algorithm,

@@ -263,3 +263,26 @@ def test_enable_early_stop_via_params():
     res = train_trees(codes, y, w, slots, [False] * 4,
                       [f"c{i}" for i in range(4)], cfg)
     assert len(res.spec.trees) < 300  # decider fired well before TreeNum
+
+
+def test_fused_and_per_level_paths_agree(monkeypatch):
+    """The single-dispatch fused tree program and the node-batched
+    per-level path must grow identical trees (the budget only picks the
+    execution strategy, never the result)."""
+    codes, y, w, slots = _make_data(n=900, seed=6)
+    cols = [f"c{i}" for i in range(4)]
+    base = dict(algorithm="GBT", tree_num=4, max_depth=4, learning_rate=0.3,
+                seed=11, min_instances_per_node=2)
+    fused = train_trees(codes, y, w, slots, [False, True, False, False],
+                        cols, TreeTrainConfig(**base))
+    # force the per-level node-batched path (cap of 2 nodes per histogram)
+    import shifu_tpu.train.tree_trainer as tt
+
+    monkeypatch.setattr(tt, "_node_batch_size", lambda T, mb: 2)
+    batched = train_trees(codes, y, w, slots, [False, True, False, False],
+                          cols, TreeTrainConfig(**base))
+    assert len(fused.spec.trees) == len(batched.spec.trees)
+    for tf, tb in zip(fused.spec.trees, batched.spec.trees):
+        np.testing.assert_array_equal(tf.feature, tb.feature)
+        np.testing.assert_array_equal(tf.left_mask, tb.left_mask)
+        np.testing.assert_allclose(tf.leaf_value, tb.leaf_value, atol=1e-5)
