@@ -58,11 +58,16 @@ def _check_data_set(mc: ModelConfig, result: ValidateResult, base_dir: str) -> N
     if not ds.data_path:
         result.fail("dataSet.dataPath is empty")
     else:
+        from shifu_tpu.fs.source import is_remote
+
         path = ds.data_path
-        if not os.path.isabs(path):
-            path = os.path.normpath(os.path.join(base_dir, path))
-        if not os.path.exists(path):
-            result.fail(f"dataSet.dataPath not found: {ds.data_path}")
+        if is_remote(path):
+            pass  # remote existence is the reader's job (fs/source.py)
+        else:
+            if not os.path.isabs(path):
+                path = os.path.normpath(os.path.join(base_dir, path))
+            if not os.path.exists(path):
+                result.fail(f"dataSet.dataPath not found: {ds.data_path}")
     if not ds.target_column_name:
         result.fail("dataSet.targetColumnName is empty")
     overlap = set(ds.pos_tags) & set(ds.neg_tags)
@@ -144,8 +149,13 @@ def _check_evals(mc: ModelConfig, result: ValidateResult, base_dir: str) -> None
 
 def probe(mc: ModelConfig, step: str, base_dir: str = ".") -> ValidateResult:
     """Validate the sections required by `step` (reference ModelInspector.probe
-    ModelInspector.java:113-170)."""
+    ModelInspector.java:113-170). Schema-level constraints run first via the
+    bundled config meta (MetaFactory.java:44 parity, config/meta.py)."""
     result = ValidateResult()
+    from shifu_tpu.config.meta import validate_model_config
+
+    for cause in validate_model_config(mc):
+        result.fail(cause)
     if not mc.basic.name:
         result.fail("basic.name is empty")
     if mc.basic.run_mode is None:

@@ -33,25 +33,48 @@ def strip_namespace(name: str) -> str:
 
 
 def read_header(header_path: str, delimiter: str = "|") -> List[str]:
+    from shifu_tpu.fs.source import is_remote, open_source
+
+    if is_remote(header_path):
+        import io
+
+        try:
+            raw = open_source(header_path, "rb")
+        except (OSError, FileNotFoundError) as e:
+            raise ShifuError(ErrorCode.HEADER_NOT_FOUND,
+                             f"{header_path} ({e})")
+        try:
+            fh = (gzip.open(raw, "rt") if header_path.endswith(".gz")
+                  else io.TextIOWrapper(raw))
+            with fh:
+                line = fh.readline().rstrip("\n\r")
+        finally:
+            raw.close()  # gzip.open(fileobj) does not close the wrapped obj
+        names = [strip_namespace(c) for c in line.split(delimiter)]
+        return _dedupe_names(names)
     if not os.path.isfile(header_path):
         raise ShifuError(ErrorCode.HEADER_NOT_FOUND, header_path)
     opener = gzip.open if header_path.endswith(".gz") else open
     with opener(header_path, "rt") as fh:
         line = fh.readline().rstrip("\n\r")
     names = [strip_namespace(c) for c in line.split(delimiter)]
-    if len(names) != len(set(names)):
-        # de-duplicate with positional suffixes, as the reference warns+renames
-        seen: Dict[str, int] = {}
-        out = []
-        for n in names:
-            if n in seen:
-                seen[n] += 1
-                out.append(f"{n}_{seen[n]}")
-            else:
-                seen[n] = 0
-                out.append(n)
-        names = out
-    return names
+    return _dedupe_names(names)
+
+
+def _dedupe_names(names: List[str]) -> List[str]:
+    if len(names) == len(set(names)):
+        return names
+    # de-duplicate with positional suffixes, as the reference warns+renames
+    seen: Dict[str, int] = {}
+    out = []
+    for n in names:
+        if n in seen:
+            seen[n] += 1
+            out.append(f"{n}_{seen[n]}")
+        else:
+            seen[n] = 0
+            out.append(n)
+    return out
 
 
 def _is_data_file(path: str) -> bool:
@@ -63,6 +86,13 @@ def _is_data_file(path: str) -> bool:
 
 
 def _expand_paths(data_path: str) -> List[str]:
+    from shifu_tpu.fs.source import expand_remote, is_remote
+
+    if is_remote(data_path):
+        # scheme-ful sources (hdfs://, s3://, gs://, memory://) route
+        # through the SourceType seam (fs/source.py); pandas consumes the
+        # returned URLs directly
+        return expand_remote(data_path)
     if os.path.isdir(data_path):
         parts = sorted(
             p for p in glob.glob(os.path.join(data_path, "*")) if _is_data_file(p)

@@ -44,7 +44,9 @@ def memory_budget_bytes() -> int:
 
 
 def dataset_size_bytes(data_path: str) -> int:
-    return sum(os.path.getsize(p) for p in _expand_paths(data_path))
+    from shifu_tpu.fs.source import size_of
+
+    return sum(size_of(p) for p in _expand_paths(data_path))
 
 
 def should_stream(data_path: str) -> bool:

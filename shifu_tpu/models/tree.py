@@ -85,6 +85,9 @@ class TreeModelSpec:
     valid_error: Optional[float] = None
     norm_type: str = "CODES"
     norm_specs: List[Dict[str, Any]] = field(default_factory=list)  # unused; NN parity
+    # >= 3: NATIVE RF multi-class — leaf values are CLASS INDICES and
+    # scoring returns per-class vote fractions (ConfusionMatrix.java:683)
+    n_classes: int = 0
 
     # ---- serialization ----
     def save(self, path: str) -> None:
@@ -102,6 +105,7 @@ class TreeModelSpec:
             "convertToProb": self.convert_to_prob,
             "trainError": self.train_error,
             "validError": self.valid_error,
+            "nClasses": self.n_classes,
             "trees": [
                 {"nNodes": t.n_nodes, "maxSlots": int(t.left_mask.shape[1]),
                  "weight": t.weight, "leafWise": not t.is_dense_layout}
@@ -171,6 +175,7 @@ class TreeModelSpec:
             convert_to_prob=head.get("convertToProb", "SIGMOID"),
             train_error=head.get("trainError"),
             valid_error=head.get("validError"),
+            n_classes=int(head.get("nClasses", 0)),
         )
 
     def independent(self) -> "IndependentTreeModel":
@@ -246,7 +251,10 @@ class IndependentTreeModel:
         return np.stack(cols, axis=1).astype(np.int32)
 
     def compute(self, codes: np.ndarray) -> np.ndarray:
-        """codes [n, F] -> score [n] in [0, 1]."""
+        """codes [n, F] -> score [n] in [0, 1] (regression/binary) or
+        per-class vote fractions [n, K] (NATIVE RF multi-class — the
+        reference's eval counts per-tree class votes,
+        ConfusionMatrix.java:683-697; vote fractions argmax the same)."""
         import jax
         import jax.numpy as jnp
 
@@ -256,6 +264,12 @@ class IndependentTreeModel:
 
             def fwd(c):
                 per_tree = traverse_trees(spec.trees, c)
+                if spec.n_classes >= 3:
+                    cls = jnp.clip(per_tree.astype(jnp.int32), 0,
+                                   spec.n_classes - 1)
+                    votes = jax.nn.one_hot(cls, spec.n_classes,
+                                           dtype=jnp.float32).sum(axis=1)
+                    return votes / max(len(spec.trees), 1)
                 if spec.algorithm == "GBT":
                     raw = spec.init_pred + jnp.sum(per_tree, axis=1)
                     if spec.loss == "log" or spec.convert_to_prob == "SIGMOID":

@@ -59,8 +59,12 @@ class BasicProcessor:
         self.model_config.save(self.paths.model_config_path())
 
     def resolve(self, path: str) -> str:
-        """Paths in configs are relative to the model-set root."""
-        if os.path.isabs(path):
+        """Paths in configs are relative to the model-set root; scheme-ful
+        URIs (hdfs://, s3://, memory://...) pass through untouched — the
+        SourceType seam (fs/source.py) owns them."""
+        from shifu_tpu.fs.source import is_remote
+
+        if is_remote(path) or os.path.isabs(path):
             return path
         return os.path.normpath(os.path.join(self.root, path))
 

@@ -189,6 +189,12 @@ class EvalProcessor(BasicProcessor):
                               model_config=self.model_config)
         result = runner.score_raw(data)
         meta_cols = self._score_meta_columns(ec, data)
+        reasons = self._reason_codes(ec, data)
+        if reasons is not None:
+            meta_cols.append(
+                ("reasons",
+                 np.asarray(["^".join(r) for r in reasons], dtype=object))
+            )
         out = self.paths.eval_score_path(ec.name)
         self.paths.ensure(os.path.dirname(out))
         sep = "|"
@@ -217,6 +223,25 @@ class EvalProcessor(BasicProcessor):
         n_neg = int((tags == 0).sum())
         log.info("eval %s scored %d records (%d pos / %d neg) with %d models -> %s",
                  ec.name, data.n_rows, n_pos, n_neg, len(paths), out)
+
+    def _reason_codes(self, ec: EvalConfig, data):
+        """Top-N reason codes per record when the eval set configures a
+        reasonCodePath (core/Reasoner.java + CalculateReasonCodeUDF parity;
+        needs posttrain's binAvgScore in ColumnConfig)."""
+        path = (ec.custom_paths or {}).get("reasonCodePath")
+        if not path:
+            return None
+        from shifu_tpu.eval.reasoner import Reasoner, load_reason_code_map
+
+        full = self.resolve(path)
+        code_map = (load_reason_code_map(full) if os.path.isfile(full)
+                    else {})
+        reasoner = Reasoner(self.column_configs, code_map)
+        if not reasoner.columns:
+            log.warning("reasonCodePath configured but no column has "
+                        "binAvgScore — run `shifu posttrain` first")
+            return None
+        return reasoner.reason_codes(data)
 
     def _read_scores(self, ec: EvalConfig):
         path = self.paths.eval_score_path(ec.name)

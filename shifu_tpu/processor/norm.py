@@ -175,12 +175,11 @@ class NormProcessor(BasicProcessor):
         if self.shuffle:
             # bucket count so one bucket fits ~1/4 of the memory budget;
             # gz-compressed text typically expands ~4x when materialized
-            import os as _os
-
             from shifu_tpu.data.reader import _expand_paths
+            from shifu_tpu.fs.source import size_of
 
             raw_bytes = sum(
-                _os.path.getsize(p) * (4 if p.endswith(".gz") else 1)
+                size_of(p) * (4 if p.endswith(".gz") else 1)
                 for p in _expand_paths(self.resolve(ds.data_path)))
             n_buckets = max(
                 default_shards(),

@@ -63,26 +63,31 @@ def train_tree_models(proc, alg) -> None:
     proc.paths.ensure(proc.paths.train_dir())
     bagging = max(1, int(mc.train.bagging_num or 1))
 
-    # multi-class: ONEVSALL trains one binary forest per class (the
-    # reference's only GBT multi-class mode, TrainModelProcessor.java:341);
-    # member k's target is tag==k, and eval thresholds per-class scores.
+    # multi-class: ONEVSALL trains one binary forest per class (member k's
+    # target is tag==k; eval thresholds per-class scores); NATIVE is
+    # RF-only — per-class histogram counts, majority-vote leaves, per-tree
+    # class votes at eval (TrainModelProcessor.java:341-349: "Only GBT and
+    # RF and NN support OneVsAll", NATIVE "is supported in NN/RF").
     one_vs_all_tags = None
     if mc.is_multi_classification():
-        if not mc.train.is_one_vs_all():
+        if mc.train.is_one_vs_all():
+            n_classes = len(mc.tags())
+            if bagging not in (1, n_classes):
+                log.warning("'train:baggingNum' overridden to %d for "
+                            "ONEVSALL", n_classes)
+            bagging = n_classes
+            one_vs_all_tags = [
+                (tags == k).astype(np.float32) for k in range(n_classes)
+            ]
+        elif alg.value not in ("RF", "DT"):
             raise ShifuError(
                 ErrorCode.INVALID_MODEL_CONFIG,
-                "NATIVE multi-class is not supported for tree models; set "
-                "train.multiClassifyMethod=ONEVSALL (the reference supports "
-                "ONEVSALL for GBT/RF, TrainModelProcessor.java:341-349)",
+                "NATIVE multi-class tree training is RF-only; use "
+                "train.multiClassifyMethod=ONEVSALL for GBT "
+                "(TrainModelProcessor.java:341-349)",
             )
-        n_classes = len(mc.tags())
-        if bagging not in (1, n_classes):
-            log.warning("'train:baggingNum' overridden to %d for ONEVSALL",
-                        n_classes)
-        bagging = n_classes
-        one_vs_all_tags = [
-            (tags == k).astype(np.float32) for k in range(n_classes)
-        ]
+        # RF NATIVE: tags stay class indices; TreeTrainConfig picks up
+        # n_classes from the ModelConfig
 
     # row-shard the code matrix over every available chip (DTWorker shard
     # equivalent); histogram merge is the jit-inserted all-reduce
@@ -135,6 +140,8 @@ def train_tree_models(proc, alg) -> None:
             "baggingSampleRate": cfg.bagging_sample_rate,
             "baggingWithReplacement": cfg.bagging_with_replacement,
             "validSetRate": cfg.valid_set_rate, "seed": cfg.seed,
+            "nClasses": cfg.n_classes,
+            "oneVsAll": bool(mc.train.is_one_vs_all()),
             "dataSignature": data_sig,
         }
         init_trees = None

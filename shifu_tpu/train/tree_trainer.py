@@ -66,6 +66,7 @@ class TreeTrainConfig:
     early_stop_rounds: int = 0  # GBT: stop when valid error worsens N rounds
     enable_early_stop: bool = False  # DTEarlyStopDecider windowed decider
     max_stats_memory_mb: int = 256  # histogram node-batch budget
+    n_classes: int = 0  # >= 3: NATIVE RF multi-class (majority-vote leaves)
     seed: int = 0
 
     @classmethod
@@ -97,6 +98,9 @@ class TreeTrainConfig:
             early_stop_rounds=int(g("EarlyStopRounds", 0)),
             enable_early_stop=bool(g("EnableEarlyStop", False)),
             max_stats_memory_mb=int(g("MaxStatsMemoryMB", 256)),
+            n_classes=(len(mc.tags())
+                       if (mc.is_multi_classification()
+                           and not t.is_one_vs_all()) else 0),
             seed=trainer_id * 977 + 13,
         )
 
@@ -185,13 +189,17 @@ _PROGRAMS: Dict[tuple, object] = {}
 MATMUL_HIST_NODE_CAP = 8192
 
 
-def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True):
-    """Traced histogram builder: [3, L, T] (cnt, sum, sqsum) over the flat
-    per-feature slot axis — the Impurity.featureUpdate hot loop
-    (dt/DTWorker.java:851) fused into one device op. Under a `data`-sharded
-    mesh each device reduces its row shard and XLA all-reduces the
-    replicated histogram (the psum replacing DTMaster's NodeStats merge,
-    DTMaster.java:297-310).
+def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True,
+                  n_classes: int = 0):
+    """Traced histogram builder: [C, L, T] over the flat per-feature slot
+    axis — the Impurity.featureUpdate hot loop (dt/DTWorker.java:851) fused
+    into one device op. Regression/binary uses C=3 components (cnt, sum,
+    sqsum); NATIVE multi-class (n_classes >= 3, RF classification) uses one
+    weighted COUNT PLANE PER CLASS (the reference's Entropy/Gini
+    featureUpdate keeps per-class counts, dt/Impurity.java:368,553). Under
+    a `data`-sharded mesh each device reduces its row shard and XLA
+    all-reduces the replicated histogram (the psum replacing DTMaster's
+    NodeStats merge, DTMaster.java:297-310).
 
     Two lowerings, chosen statically:
       * matmul (SURVEY §7.5's histogram-kernel obligation, MXU-shaped):
@@ -201,12 +209,21 @@ def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True):
         10k-category column must not inflate the contraction)."""
     import jax.numpy as jnp
 
+    C = n_classes if n_classes >= 3 else 3
+
     # bound BOTH the padded contraction width (L*s_max) and L itself — the
-    # per-block lhs [blk, 3L] scales with L alone, and deep trees (RF
+    # per-block lhs [blk, C*L] scales with L alone, and deep trees (RF
     # MaxDepth=10 -> L=1024) would blow past the stats budget even when
     # every feature is narrow
     use_matmul = (allow_matmul and L * s_max <= MATMUL_HIST_NODE_CAP
-                  and L <= 128)
+                  and C * L <= 512)
+
+    def comps_of(w, labels):
+        if n_classes >= 3:
+            cls = jnp.clip(labels.astype(jnp.int32), 0, n_classes - 1)
+            return [w * (cls == c).astype(jnp.float32)
+                    for c in range(n_classes)]
+        return [w, w * labels, w * labels * labels]
 
     def hist_scatter(codes, labels, weights, node_slot, active, off_f,
                      clip_f, seg_t, pos_t):
@@ -215,13 +232,12 @@ def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True):
         nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
         code_f = jnp.clip(codes, 0, clip_f[None, :])
         flat = nl[:, None] * T + off_f[None, :] + code_f
-        comps = (w, w * labels, w * labels * labels)
         planes = [
             jnp.zeros((L * T,), jnp.float32)
             .at[flat]
             .add(jnp.broadcast_to(c[:, None], (n, F)))
             .reshape(L, T)
-            for c in comps
+            for c in comps_of(w, labels)
         ]
         return jnp.stack(planes)
 
@@ -232,10 +248,10 @@ def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True):
         n, F = codes.shape
         w = jnp.where(active, weights, 0.0)
         nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
-        comps = jnp.stack([w, w * labels, w * labels * labels], 1)  # [n, 3]
+        comps = jnp.stack(comps_of(w, labels), 1)  # [n, C]
 
         # row blocks bound every materialized one-hot; a lax.scan
-        # accumulates block partials into the [3L, F, s_max] histogram
+        # accumulates block partials into the [C*L, F, s_max] histogram
         blk = min(131072, n)
         n_pad = -(-n // blk) * blk
         pad = n_pad - n
@@ -251,48 +267,55 @@ def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True):
             nl_b = sl(nl_p)
             oh_node = (nl_b[:, None] == jnp.arange(L)[None, :]).astype(
                 jnp.float32)
-            # [blk, 3L]: component-weighted node one-hot, one matmul lhs
+            # [blk, C*L]: component-weighted node one-hot, one matmul lhs
             A = (sl(comps_p)[:, :, None] * oh_node[:, None, :]).reshape(
-                blk, 3 * L)
+                blk, C * L)
             code_b = sl(codes_p)
             parts = []
             for f0 in range(0, F, fb):
                 code_c = jnp.clip(code_b[:, f0:f0 + fb], 0,
                                   clip_f[None, f0:f0 + fb])
                 oh_code = (code_c[:, :, None] == srange).astype(jnp.float32)
-                parts.append(A.T @ oh_code.reshape(blk, -1))  # [3L, fc*S]
-            contrib = jnp.concatenate(parts, axis=1).reshape(3, L, F, s_max)
+                parts.append(A.T @ oh_code.reshape(blk, -1))  # [C*L, fc*S]
+            contrib = jnp.concatenate(parts, axis=1).reshape(C, L, F, s_max)
             return hist + contrib, None
 
-        hist0 = jnp.zeros((3, L, F, s_max), jnp.float32)
+        hist0 = jnp.zeros((C, L, F, s_max), jnp.float32)
         hist_pad, _ = jax.lax.scan(block, hist0,
                                    jnp.arange(n_pad // blk))
-        return hist_pad[:, :, seg_t, pos_t]  # flat ragged [3, L, T]
+        return hist_pad[:, :, seg_t, pos_t]  # flat ragged [C, L, T]
 
     return hist_matmul if use_matmul else hist_scatter
 
 
 def _get_hist_program(L: int, T: int, s_max: int,
-                      allow_matmul: bool = True):
-    key = ("hist", L, T, s_max, allow_matmul)
+                      allow_matmul: bool = True, n_classes: int = 0):
+    key = ("hist", L, T, s_max, allow_matmul, n_classes)
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
     import jax
 
-    prog = jax.jit(_make_hist_fn(L, T, s_max, allow_matmul))
+    prog = jax.jit(_make_hist_fn(L, T, s_max, allow_matmul, n_classes))
     _PROGRAMS[key] = prog
     return prog
 
 
 def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
-                      min_inst: int, min_gain: float):
-    key = ("scan", L, T, s_max, impurity, min_inst, float(min_gain))
+                      min_inst: int, min_gain: float, n_classes: int = 0):
+    key = ("scan", L, T, s_max, impurity, min_inst, float(min_gain),
+           n_classes)
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
     import jax
     import jax.numpy as jnp
+
+    if n_classes >= 3:
+        prog = jax.jit(_make_cls_scan(L, T, s_max, impurity, min_inst,
+                                      min_gain, n_classes))
+        _PROGRAMS[key] = prog
+        return prog
 
     @jax.jit
     def split_scan(hist, feat_ok_t, is_cat_t, seg_t, pos_t, start_t, size_t,
@@ -410,6 +433,103 @@ def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
     return split_scan
 
 
+def _make_cls_scan(L: int, T: int, s_max: int, impurity: str, min_inst: int,
+                   min_gain: float, K: int):
+    """Multi-class split scan over per-class count planes [K, L, T] —
+    NATIVE RF classification (reference Entropy/Gini multi-class counts,
+    dt/Impurity.java:368,553). Leaf value = MAJORITY CLASS index; the gain
+    is the K-class entropy/gini mass drop (variance/friedmanmse fall back
+    to gini — the reference only supports entropy/gini for classification).
+
+    Returns the same tuple shape as the regression scan so the tree
+    builders are oblivious to the mode."""
+    import jax
+    import jax.numpy as jnp
+
+    use_entropy = impurity == "entropy"
+
+    def cls_scan(hist, feat_ok_t, is_cat_t, seg_t, pos_t, start_t, size_t,
+                 off_f, clip_f, seg0_size):
+        cnt = hist.sum(0)  # [L, T] total weighted count per slot
+        # categorical ordering key: expected class index (the multi-class
+        # generalization of the reference's mean-response category sort)
+        exp = (hist * jnp.arange(K, dtype=jnp.float32)[:, None, None]).sum(0)
+        mean = jnp.where(cnt > 0, exp / jnp.maximum(cnt, 1e-12), jnp.inf)
+        sec = jnp.where(is_cat_t[None, :], mean,
+                        jnp.broadcast_to(pos_t.astype(jnp.float32), cnt.shape))
+
+        def order_row(sec_row):
+            return jnp.lexsort((sec_row, seg_t))
+
+        order = jax.vmap(order_row)(sec)  # [L, T]
+
+        def reorder(a):
+            return jnp.take_along_axis(a, order, axis=-1)
+
+        ccum = jnp.cumsum(jax.vmap(reorder)(hist), axis=-1)  # [K, L, T]
+
+        start_prev = jnp.maximum(start_t - 1, 0)
+        end_idx = start_t + size_t - 1
+        base = jnp.where(start_t[None, None, :] > 0,
+                         ccum[:, :, start_prev], 0.0)
+        left = ccum - base  # per-class left counts
+        tot = ccum[:, :, end_idx] - base
+        right = tot - left
+        lcnt = left.sum(0)
+        rcnt = right.sum(0)
+        tcnt = tot.sum(0)
+
+        def mass(counts, total):
+            p = counts / jnp.maximum(total[None], 1e-12)
+            if use_entropy:
+                h = -(p * jnp.log2(jnp.maximum(p, 1e-12))).sum(0)
+            else:  # gini
+                h = 1.0 - (p * p).sum(0)
+            return total * h
+
+        gain = (mass(tot, tcnt) - mass(left, lcnt) - mass(right, rcnt))
+
+        valid = (
+            (lcnt >= min_inst)
+            & (rcnt >= min_inst)
+            & (gain > min_gain)
+            & feat_ok_t[None, :]
+            & (pos_t < size_t - 1)[None, :]
+        )
+        gain = jnp.where(valid, gain, -jnp.inf)
+
+        best = jnp.argmax(gain, axis=-1)
+        best_gain = jnp.take_along_axis(gain, best[:, None], axis=-1)[:, 0]
+        feature = seg_t[best].astype(jnp.int32)
+        cut_rank = pos_t[best].astype(jnp.int32)
+        is_split = jnp.isfinite(best_gain)
+
+        rank_flat = (
+            jnp.zeros((L, T), jnp.int32)
+            .at[jnp.arange(L)[:, None], order]
+            .set(jnp.broadcast_to(pos_t, (L, T)))
+        )
+
+        node_class_cnt = ccum[:, :, seg0_size - 1]  # [K, L]
+        node_cnt = node_class_cnt.sum(0)
+        leaf_value = jnp.argmax(node_class_cnt, axis=0).astype(jnp.float32)
+
+        s_range = jnp.arange(s_max, dtype=jnp.int32)
+        f_clip = clip_f[feature]
+        s_idx = jnp.minimum(s_range[None, :], f_clip[:, None])
+        flat_idx = off_f[feature][:, None] + s_idx
+        ranks = jnp.take_along_axis(rank_flat, flat_idx, axis=-1)
+        left_mask = (
+            (ranks <= cut_rank[:, None])
+            & (s_range[None, :] <= f_clip[:, None])
+            & is_split[:, None]
+        )
+        return (feature, cut_rank, rank_flat, leaf_value, is_split,
+                best_gain, left_mask, node_cnt)
+
+    return cls_scan
+
+
 def _get_update_program(L: int, T: int):
     key = ("update", L, T)
     prog = _PROGRAMS.get(key)
@@ -438,12 +558,15 @@ def _get_update_program(L: int, T: int):
     return row_update
 
 
-def _node_batch_size(T: int, max_stats_memory_mb: int) -> int:
+def _node_batch_size(T: int, max_stats_memory_mb: int,
+                     n_classes: int = 0) -> int:
     """Nodes per histogram batch under the stats-memory budget
     (DTMaster.getStatsMem node batching, DTMaster.java:450-467): the
-    [3, L, T] f32 histogram must fit maxStatsMemoryMB."""
+    [C, L, T] f32 histogram must fit maxStatsMemoryMB, where C = 3 for
+    regression/binary and C = n_classes for NATIVE multi-class."""
+    planes = n_classes if n_classes >= 3 else 3
     budget = max(1, max_stats_memory_mb) * (1 << 20)
-    return max(1, budget // (3 * 4 * max(T, 1)))
+    return max(1, budget // (planes * 4 * max(T, 1)))
 
 
 @dataclass
@@ -491,7 +614,7 @@ def _scan_batched(hists, la, lay, cfg, L_level):
     for hist, Lb, _b0 in hists:
         scan = _get_scan_program(Lb, lay.T, lay.s_max, cfg.impurity,
                                  cfg.min_instances_per_node,
-                                 cfg.min_info_gain)
+                                 cfg.min_info_gain, cfg.n_classes)
         (f, c, r, lv, sp, g, m, nc) = scan(
             hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t, la.start_t,
             la.size_t, la.off, la.clip, la.seg0_size,
@@ -507,7 +630,7 @@ def _scan_batched(hists, la, lay, cfg, L_level):
 
 def _get_tree_program(D: int, T: int, s_max: int, impurity: str,
                       min_inst: int, min_gain: float,
-                      allow_matmul: bool = True):
+                      allow_matmul: bool = True, n_classes: int = 0):
     """ONE jit program for a whole level-wise tree: every level runs at the
     padded width L_max = 2^D inside a lax.fori_loop (inactive node slots
     have empty histograms, so their gain is -inf and they never split).
@@ -515,7 +638,7 @@ def _get_tree_program(D: int, T: int, s_max: int, impurity: str,
     into a single device call — on a tunneled/remote TPU the per-dispatch
     round-trip otherwise dominates tree building wall-clock."""
     key = ("tree", D, T, s_max, impurity, min_inst, float(min_gain),
-           allow_matmul)
+           allow_matmul, n_classes)
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
@@ -524,7 +647,7 @@ def _get_tree_program(D: int, T: int, s_max: int, impurity: str,
 
     L = 2**D
     min_inst_eff = max(min_inst, 1)
-    hist_fn = _make_hist_fn(L, T, s_max, allow_matmul)
+    hist_fn = _make_hist_fn(L, T, s_max, allow_matmul, n_classes)
 
     def hist_of(codes, labels, weights, node_local, active, off_f, clip_f,
                 seg_t, pos_t):
@@ -535,7 +658,7 @@ def _get_tree_program(D: int, T: int, s_max: int, impurity: str,
         (feat_ok_t, is_cat_t, seg_t, pos_t, start_t, size_t, off_f, clip_f,
          seg0_size) = la_tuple
         scan = _get_scan_program(L, T, s_max, impurity, min_inst_eff,
-                                 min_gain)
+                                 min_gain, n_classes)
         return scan(hist, feat_ok_t, is_cat_t, seg_t, pos_t, start_t,
                     size_t, off_f, clip_f, seg0_size)
 
@@ -637,7 +760,8 @@ def build_tree(
     n, F = codes.shape
     lay = make_layout(list(np.asarray(slots)), list(np.asarray(is_cat, bool)))
     D = cfg.max_depth
-    batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb)
+    batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
+                                 cfg.n_classes)
 
     replicate_fn = None
     if mesh is not None:
@@ -654,7 +778,8 @@ def build_tree(
         prog = _get_tree_program(D, lay.T, lay.s_max, cfg.impurity,
                                  cfg.min_instances_per_node,
                                  cfg.min_info_gain,
-                                 allow_matmul=mesh is None)
+                                 allow_matmul=mesh is None,
+                                 n_classes=cfg.n_classes)
         feats_d, masks_d, leaves_d, resting, _row_pred = prog(
             codes, labels, weights, la.off, la.clip, la.feat_ok_t,
             la.is_cat_t, la.seg_t, la.pos_t, la.start_t, la.size_t,
@@ -686,7 +811,8 @@ def build_tree(
             for b0 in range(0, L, batch_cap):
                 Lb = min(batch_cap, L - b0)
                 hist_p = _get_hist_program(Lb, lay.T, lay.s_max,
-                                           allow_matmul=mesh is None)
+                                           allow_matmul=mesh is None,
+                                           n_classes=cfg.n_classes)
                 in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
                 yield hist_p(codes, labels, weights, node_local - b0,
                              in_batch, la.off, la.clip, la.seg_t,
@@ -712,7 +838,8 @@ def build_tree(
         for b0 in range(0, L2, batch_cap):
             Lb = min(batch_cap, L2 - b0)
             hist_p = _get_hist_program(Lb, lay.T, lay.s_max,
-                                       allow_matmul=mesh is None)
+                                       allow_matmul=mesh is None,
+                                       n_classes=cfg.n_classes)
             in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
             yield hist_p(codes, labels, weights, node_local - b0, in_batch,
                          la.off, la.clip, la.seg_t, la.pos_t), Lb, b0
@@ -777,9 +904,11 @@ def build_tree_leafwise(
     # candidate splits per leaf: id -> (gain, feat, cut_rank, rank_row, mask)
     candidates: Dict[int, tuple] = {}
 
-    hist1 = _get_hist_program(1, lay.T, lay.s_max)
+    hist1 = _get_hist_program(1, lay.T, lay.s_max,
+                              n_classes=cfg.n_classes)
     scan1 = _get_scan_program(1, lay.T, lay.s_max, cfg.impurity,
-                              cfg.min_instances_per_node, cfg.min_info_gain)
+                              cfg.min_instances_per_node, cfg.min_info_gain,
+                              cfg.n_classes)
 
     def evaluate(leaf_ids: List[int]):
         """Candidate split for each listed leaf (a 1-slot program per leaf
@@ -1051,10 +1180,46 @@ def train_trees(
         t = jnp.sum(jnp.where(tsel, sq, 0.0)) / jnp.maximum(jnp.sum(tsel), 1.0)
         return t, v
 
+    is_cls = cfg.n_classes >= 3
+    if is_cls and is_gbt:
+        raise ValueError(
+            "NATIVE multi-class tree training is RF-only (the reference "
+            "supports GBT multi-class via ONEVSALL, "
+            "TrainModelProcessor.java:341-349)"
+        )
+    if is_cls:
+        @jax.jit
+        def cls_errors_of(votes):
+            pred_class = jnp.argmax(votes, axis=1).astype(jnp.float32)
+            err = (pred_class != y_j).astype(jnp.float32)
+            vsel = vm_j & real_j
+            tsel = (~vm_j) & real_j
+            v = (jnp.sum(jnp.where(vsel, err, 0.0))
+                 / jnp.maximum(jnp.sum(vsel), 1.0))
+            t = (jnp.sum(jnp.where(tsel, err, 0.0))
+                 / jnp.maximum(jnp.sum(tsel), 1.0))
+            return t, v
+
     # prediction state re-derived from loaded trees on resume (the workers'
     # recoverGBTData analog): GBT keeps the raw sum F(x), RF the running
-    # mean over trees built so far
-    if start_k:
+    # mean over trees built so far — classification keeps per-class VOTES
+    votes = None
+    if is_cls:
+        if start_k:
+            from shifu_tpu.models.tree import traverse_trees
+
+            per_tree = np.asarray(
+                traverse_trees(trees, jnp.asarray(codes_np)))  # [n, k] class
+            votes_np = np.zeros((n, cfg.n_classes), np.float32)
+            for col in range(per_tree.shape[1]):
+                cls_idx = np.clip(per_tree[:, col].astype(np.int64), 0,
+                                  cfg.n_classes - 1)
+                votes_np[np.arange(n), cls_idx] += 1.0
+            votes = row_put(votes_np)
+        else:
+            votes = row_put(np.zeros((n, cfg.n_classes), np.float32))
+        pred = row_put(jnp.zeros(n, dtype=jnp.float32))
+    elif start_k:
         s = np.asarray(_score_existing(trees, jnp.asarray(codes_np)))
         pred = row_put((s if is_gbt else s / start_k).astype(np.float32))
     else:
@@ -1082,7 +1247,8 @@ def train_trees(
     need_sync = bool(progress_cb or checkpoint_cb or cfg.early_stop_rounds
                      or decider is not None)
     lay = make_layout([int(s) for s in slots_np], [bool(c) for c in is_cat_np])
-    batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb)
+    batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
+                                 cfg.n_classes)
     fused = (not leaf_wise) and 2**cfg.max_depth <= batch_cap
     la = None
     if fused:
@@ -1094,7 +1260,7 @@ def train_trees(
         tree_prog = _get_tree_program(
             cfg.max_depth, lay.T, lay.s_max, cfg.impurity,
             cfg.min_instances_per_node, cfg.min_info_gain,
-            allow_matmul=mesh is None,
+            allow_matmul=mesh is None, n_classes=cfg.n_classes,
         )
     deferred: List[tuple] = []  # (k, weight, feats_d, masks_d, leaves_d)
     err_pairs: List[tuple] = []  # device (train, valid) when deferred
@@ -1157,18 +1323,25 @@ def train_trees(
         else:
             trees.append(None)  # placeholder; assembled after the loop
 
-        if is_gbt:
+        if is_cls:
+            import jax.nn as jnn
+
+            votes = votes + jnn.one_hot(
+                jnp.clip(tree_pred.astype(jnp.int32), 0, cfg.n_classes - 1),
+                cfg.n_classes, dtype=jnp.float32)
+            t_e, v_e = cls_errors_of(votes)
+        elif is_gbt:
             pred = pred + weight_k * tree_pred
             score = (
                 1.0 / (1.0 + jnp.exp(-pred)) if log_loss
                 else jnp.clip(pred, 0.0, 1.0)
             )
+            t_e, v_e = errors_of(score)
         else:
             n_prev = k  # RF running mean over trees built so far
             pred = tree_pred if k == 0 else (pred * n_prev + tree_pred) / (k + 1)
             score = jnp.clip(pred, 0.0, 1.0)
-
-        t_e, v_e = errors_of(score)
+            t_e, v_e = errors_of(score)
         if not need_sync:
             err_pairs.append((t_e, v_e))
             valid_errors.append(None)  # filled after the final sync
@@ -1219,6 +1392,7 @@ def train_trees(
         convert_to_prob="SIGMOID" if cfg.loss == "log" else "RAW",
         train_error=terr,
         valid_error=valid_errors[-1] if valid_errors else None,
+        n_classes=cfg.n_classes,
     )
     return TreeTrainResult(spec=spec, train_error=terr,
                            valid_error=valid_errors[-1] if valid_errors else 0.0)

@@ -185,3 +185,55 @@ def test_inspector_data_path(tmp_path):
     mc.data_set.target_column_name = "y"
     result = probe(mc, ModelStep.INIT, base_dir=str(tmp_path))
     assert not result.status
+
+
+class TestMetaValidation:
+    """Meta-driven schema validation (MetaFactory.java:44 +
+    ModelConfigMeta.json parity, config/meta.py)."""
+
+    def _mc(self):
+        from shifu_tpu.config.model_config import Algorithm, new_model_config
+
+        mc = new_model_config("MetaTest", Algorithm.NN)
+        mc.data_set.data_path = "data.txt"
+        mc.data_set.target_column_name = "t"
+        return mc
+
+    def test_clean_config_passes(self):
+        from shifu_tpu.config.meta import validate_model_config
+
+        assert validate_model_config(self._mc()) == []
+
+    def test_range_violations_reported_with_wire_names(self):
+        from shifu_tpu.config.meta import validate_model_config
+
+        mc = self._mc()
+        mc.stats.sample_rate = 1.5
+        mc.train.bagging_num = 0
+        mc.train.valid_set_rate = 0.95
+        errors = validate_model_config(mc)
+        assert any("stats.sampleRate" in e and "1.5" in e for e in errors)
+        assert any("train.baggingNum" in e for e in errors)
+        assert any("train.validSetRate" in e for e in errors)
+
+    def test_per_element_eval_validation(self):
+        from shifu_tpu.config.meta import validate_model_config
+
+        mc = self._mc()
+        mc.evals[0].performance_bucket_num = 0
+        errors = validate_model_config(mc)
+        assert any("evals[0].performanceBucketNum" in e for e in errors)
+
+    def test_probe_integrates_meta(self, tmp_path):
+        import os
+
+        from shifu_tpu.config.inspector import ModelStep, probe
+
+        mc = self._mc()
+        data = tmp_path / "data.txt"
+        data.write_text("a|b\n")
+        mc.data_set.data_path = str(data)
+        mc.stats.max_num_bin = 1  # below the schema minimum of 2
+        result = probe(mc, ModelStep.STATS, base_dir=str(tmp_path))
+        assert not result.status
+        assert any("stats.maxNumBin" in c for c in result.causes)

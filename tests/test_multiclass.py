@@ -244,3 +244,69 @@ def test_native_tree_multiclass_rejected(tmp_path):
 
     with pytest.raises(ShifuError):  # clear error, not a silently-bad model
         TrainProcessor(root).run()
+
+
+# ---------------------------------------------------------------------------
+# NATIVE RF multi-class (per-class histograms, majority-vote leaves)
+# ---------------------------------------------------------------------------
+
+
+def test_rf_native_multiclass_trainer():
+    """RF classification: entropy gain over K class-count planes, leaf =
+    majority class, model emits per-class vote fractions
+    (dt/Impurity.java:368, ConfusionMatrix.java:683)."""
+    from shifu_tpu.train.tree_trainer import TreeTrainConfig, train_trees
+
+    rng = np.random.default_rng(7)
+    n, F, bins, K = 1500, 6, 8, 3
+    codes = rng.integers(0, bins, size=(n, F)).astype(np.int32)
+    # class determined by two features with noise
+    y = ((codes[:, 0] >= 5).astype(int) + (codes[:, 1] >= 4).astype(int))
+    flip = rng.random(n) < 0.05
+    y = np.where(flip, rng.integers(0, K, size=n), y).astype(np.float32)
+    w = np.ones(n, np.float32)
+    cfg = TreeTrainConfig(algorithm="RF", tree_num=10, max_depth=5,
+                          impurity="entropy", n_classes=K,
+                          feature_subset_strategy="TWOTHIRDS", seed=5,
+                          min_instances_per_node=2)
+    res = train_trees(codes, y, w, [bins] * F, [False] * F,
+                      [f"c{i}" for i in range(F)], cfg)
+    assert res.spec.n_classes == K
+    # leaf values are class indices
+    for t in res.spec.trees:
+        vals = t.leaf_value[t.feature == -1]
+        assert np.allclose(vals, np.round(vals))
+        assert vals.min() >= 0 and vals.max() <= K - 1
+    # valid error is a misclassification rate, and the forest learns
+    assert 0.0 <= res.valid_error <= 1.0
+    assert res.valid_error < 0.2, res.valid_error
+
+    votes = res.spec.independent().compute(codes)
+    assert votes.shape == (n, K)
+    np.testing.assert_allclose(votes.sum(1), 1.0, atol=1e-5)
+    acc = float((np.argmax(votes, 1) == y).mean())
+    assert acc > 0.85, acc
+
+
+def test_rf_native_multiclass_end_to_end(tmp_path):
+    root = str(tmp_path / "ms")
+    make_multiclass_model_set(root, n_rows=700, method="NATIVE",
+                              algorithm="RF")
+    from shifu_tpu.config.model_config import ModelConfig
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.params.update({"TreeNum": 10, "MaxDepth": 5,
+                            "Impurity": "entropy",
+                            "MinInstancesPerNode": 2})
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    _run_pipeline(root)
+
+    from shifu_tpu.models.tree import TreeModelSpec
+
+    spec = TreeModelSpec.load(os.path.join(root, "models", "model0.rf"))
+    assert spec.n_classes == 3
+
+    _run_eval(root)
+    eval_acc, m = _accuracy_from_perf(root)
+    assert eval_acc > 0.75, eval_acc
+    assert m.sum() == 700

@@ -1,0 +1,89 @@
+"""Reason codes (core/Reasoner.java + CalculateReasonCodeUDF parity)."""
+
+import json
+import os
+
+import numpy as np
+
+from tests.helpers import make_model_set
+
+
+def _posttrained_root(tmp_path):
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=400)
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.posttrain import PostTrainProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+    from shifu_tpu.processor.varsel import VarSelProcessor
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert VarSelProcessor(root).run() == 0  # Reasoner needs finalSelect
+    assert NormProcessor(root).run() == 0
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 25
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    assert TrainProcessor(root).run() == 0
+    assert PostTrainProcessor(root).run() == 0
+    return root
+
+
+def test_reasoner_ranks_by_bin_avg_score(tmp_path):
+    from shifu_tpu.config.column_config import load_column_config_list
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.data.reader import read_columnar, read_header
+    from shifu_tpu.eval.reasoner import Reasoner
+
+    root = _posttrained_root(tmp_path)
+    ccs = load_column_config_list(os.path.join(root, "ColumnConfig.json"))
+    assert any(c.column_binning.bin_avg_score for c in ccs
+               if c.final_select), "posttrain must fill binAvgScore"
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    names = read_header(mc.data_set.header_path, mc.data_set.header_delimiter)
+    data = read_columnar(mc.data_set.data_path, names, delimiter="|")
+
+    reasoner = Reasoner(ccs, {"num_0": "RC_NUM0"}, num_top_variables=3)
+    codes = reasoner.reason_codes(data)
+    assert len(codes) == data.n_rows
+    assert all(1 <= len(r) <= 3 for r in codes)
+    # mapped name appears when num_0 ranks; unmapped columns fall back to
+    # their own name
+    flat = {c for row in codes for c in row}
+    assert flat  # nonempty reason vocabulary
+    diffs = reasoner.score_diffs(data)
+    # the top reason of row 0 really is its argmax column
+    top_col = reasoner.columns[int(np.argmax(diffs[0]))].column_name
+    expected = {"num_0": "RC_NUM0"}.get(top_col, top_col)
+    assert codes[0][0] == expected
+
+
+def test_eval_score_appends_reason_column(tmp_path):
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.evaluate import EvalProcessor
+
+    root = _posttrained_root(tmp_path)
+    rc_path = os.path.join(root, "reasoncodes.json")
+    with open(rc_path, "w") as fh:
+        json.dump({"num_0": "RC_NUM0", "num_3": "RC_NUM3"}, fh)
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    ev = mc.evals[0]
+    ev.data_set.data_path = mc.data_set.data_path
+    ev.data_set.header_path = mc.data_set.header_path
+    ev.data_set.data_delimiter = "|"
+    ev.custom_paths = {"reasonCodePath": rc_path}
+    mc.save(os.path.join(root, "ModelConfig.json"))
+
+    assert EvalProcessor(root, score_name="Eval1").run() == 0
+    import glob
+
+    score_file = glob.glob(os.path.join(root, "**", "EvalScore*"),
+                           recursive=True)[0]
+    with open(score_file) as fh:
+        header = fh.readline().strip().split("|")
+        first = fh.readline().strip().split("|")
+    assert header[-1] == "reasons"
+    assert first[-1]  # nonempty ^-joined reason list

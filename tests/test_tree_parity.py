@@ -278,7 +278,7 @@ def test_fused_and_per_level_paths_agree(monkeypatch):
     # force the per-level node-batched path (cap of 2 nodes per histogram)
     import shifu_tpu.train.tree_trainer as tt
 
-    monkeypatch.setattr(tt, "_node_batch_size", lambda T, mb: 2)
+    monkeypatch.setattr(tt, "_node_batch_size", lambda T, mb, k=0: 2)
     batched = train_trees(codes, y, w, slots, [False, True, False, False],
                           cols, TreeTrainConfig(**base))
     assert len(fused.spec.trees) == len(batched.spec.trees)
