@@ -20,9 +20,12 @@ import numpy as np
 
 
 def load_reason_code_map(path: str) -> Dict[str, str]:
-    """column name -> reason code. JSON object, or lines of `column,code`."""
-    with open(path) as fh:
-        text = fh.read()
+    """column name -> reason code. JSON object, or lines of `column,code`.
+    Local path or any fs/source.py scheme (hdfs://, s3://...)."""
+    from shifu_tpu.fs.source import open_source
+
+    with open_source(path, "rb") as fh:
+        text = fh.read().decode("utf-8")
     try:
         data = json.loads(text)
         if isinstance(data, dict):
@@ -44,9 +47,11 @@ class Reasoner:
     """Batch reason-code calculator over raw records."""
 
     def __init__(self, column_configs, reason_code_map: Optional[Dict[str, str]] = None,
-                 num_top_variables: int = 5):
+                 num_top_variables: int = 5,
+                 code_cache: Optional[dict] = None):
         self.reason_code_map = reason_code_map or {}
         self.num_top = num_top_variables
+        self.code_cache = {} if code_cache is None else code_cache
         # eligible: final-selected columns that posttrain scored
         # (Reasoner skips columns without binAvgScore)
         self.columns = [
@@ -65,7 +70,8 @@ class Reasoner:
                 [float(v) for v in cc.column_binning.bin_avg_score],
                 np.float64,
             )
-            codes = np.clip(_bin_codes_for(cc, data), 0, len(table) - 1)
+            codes = np.clip(_bin_codes_for(cc, data, self.code_cache), 0,
+                            len(table) - 1)
             out[:, j] = table[codes]
         return out
 

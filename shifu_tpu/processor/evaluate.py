@@ -234,8 +234,12 @@ class EvalProcessor(BasicProcessor):
         from shifu_tpu.eval.reasoner import Reasoner, load_reason_code_map
 
         full = self.resolve(path)
-        code_map = (load_reason_code_map(full) if os.path.isfile(full)
-                    else {})
+        try:
+            code_map = load_reason_code_map(full)
+        except (OSError, FileNotFoundError) as e:
+            log.warning("reasonCodePath %s is unreadable (%s); reasons "
+                        "fall back to raw column names", full, e)
+            code_map = {}
         reasoner = Reasoner(self.column_configs, code_map)
         if not reasoner.columns:
             log.warning("reasonCodePath configured but no column has "

@@ -28,10 +28,31 @@ def train_tree_models(proc, alg) -> None:
         raise ShifuError(
             ErrorCode.DATA_NOT_FOUND, f"{codes_dir} — run `shifu norm` first"
         )
-    meta, codes, tags, weights = load_codes(codes_dir)
-    codes = np.asarray(codes, dtype=np.int32)
-    tags = np.asarray(tags, dtype=np.float32)
-    weights = np.asarray(weights, dtype=np.float32)
+    from shifu_tpu.train.streaming import should_stream_training
+
+    stream = should_stream_training(codes_dir,
+                                    force_attr=bool(mc.train.train_on_disk))
+    if (stream and mc.is_multi_classification()
+            and not mc.train.is_one_vs_all()):
+        log.warning("NATIVE multi-class RF is not streamed yet; using the "
+                    "in-memory trainer despite the memory budget")
+        stream = False
+    if stream:
+        # larger-than-memory: only tags materialize (tiny); the code
+        # shards stream per level (train/streaming_tree.py)
+        from shifu_tpu.norm.dataset import read_meta
+
+        meta = read_meta(codes_dir)
+        tags = np.concatenate([
+            np.load(os.path.join(codes_dir, f"tags-{s:05d}.npy"))
+            for s in range(len(meta.shard_rows))
+        ]).astype(np.float32)
+        codes = None
+    else:
+        meta, codes, tags, weights = load_codes(codes_dir)
+        codes = np.asarray(codes, dtype=np.int32)
+        tags = np.asarray(tags, dtype=np.float32)
+        weights = np.asarray(weights, dtype=np.float32)
     slots = [int(s) for s in meta.extra["slots"]]
 
     cols = norm_columns(proc.column_configs)
@@ -207,12 +228,26 @@ def train_tree_models(proc, alg) -> None:
                                 "validErrors": list(val_errs)}, fh)
 
         tags_i = one_vs_all_tags[i] if one_vs_all_tags is not None else tags
-        result = train_trees(
-            codes, tags_i, weights, slots, is_cat, meta.columns, cfg,
-            boundaries=boundaries, categories=categories, progress_cb=progress,
-            mesh=mesh, init_trees=init_trees,
-            init_valid_errors=init_val_errors, checkpoint_cb=checkpoint,
-        )
+        if stream:
+            from shifu_tpu.train.streaming_tree import train_trees_streamed
+
+            if init_trees is not None:
+                log.warning("streamed tree training starts fresh — "
+                            "checkpoint resume needs the in-memory trainer")
+            result = train_trees_streamed(
+                codes_dir, slots, is_cat, meta.columns, cfg,
+                tags_override=(one_vs_all_tags[i]
+                               if one_vs_all_tags is not None else None),
+                boundaries=boundaries, categories=categories,
+                progress_cb=progress,
+            )
+        else:
+            result = train_trees(
+                codes, tags_i, weights, slots, is_cat, meta.columns, cfg,
+                boundaries=boundaries, categories=categories,
+                progress_cb=progress, mesh=mesh, init_trees=init_trees,
+                init_valid_errors=init_val_errors, checkpoint_cb=checkpoint,
+            )
         path = proc.paths.model_path(i, suffix)
         result.spec.save(path)
         for leftover in (ck_path, ck_state_path):

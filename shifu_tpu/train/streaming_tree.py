@@ -1,0 +1,296 @@
+"""Larger-than-memory GBT/RF: stream the bin-code shards per tree level.
+
+The per-row STATE of tree building is tiny (node position, activity,
+resting node, GBT prediction — ~13 bytes/row), so it stays on device for
+every shard; only the [n, F] CODE matrix is too big, and it streams from
+the mmap'd CleanedData shards once per level:
+
+    per level:  for each shard s:
+                    device_put(codes_s)                (async transfer)
+                    row_update_s for the PREVIOUS level's decisions
+                    hist += hist_program(codes_s, state_s)
+                split scan on the merged histogram     (tiny)
+
+The merged-histogram-then-split structure is exactly DTWorker partial
+stats -> DTMaster merge (dt/DTMaster.java:297-310) with disk shards
+standing in for workers. The same RNG streams as the in-memory trainer
+drive sampling, so forests match it up to histogram float-summation order
+(per-shard partial sums associate differently than one whole-array pass).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from shifu_tpu.models.tree import DenseTree, TreeModelSpec
+from shifu_tpu.norm.dataset import read_meta
+from shifu_tpu.train.tree_trainer import (
+    DTEarlyStopDecider,
+    TreeTrainConfig,
+    TreeTrainResult,
+    _device_layout,
+    _get_hist_program,
+    _get_scan_program,
+    _get_update_program,
+    make_layout,
+    subset_count,
+)
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+class CodesFeed:
+    """Shard loader over CleanedData codes-*.npy (mmap'd; one shard of
+    codes resident at a time)."""
+
+    def __init__(self, data_dir: str):
+        self.data_dir = data_dir
+        self.meta = read_meta(data_dir)
+        self.n_shards = len(self.meta.shard_rows)
+        self.n_rows = self.meta.n_rows
+
+    def codes(self, s: int) -> np.ndarray:
+        return np.load(
+            os.path.join(self.data_dir, f"codes-{s:05d}.npy"), mmap_mode="r"
+        )
+
+    def tags(self, s: int) -> np.ndarray:
+        return np.load(
+            os.path.join(self.data_dir, f"tags-{s:05d}.npy"), mmap_mode="r"
+        )
+
+    def weights(self, s: int) -> np.ndarray:
+        return np.load(
+            os.path.join(self.data_dir, f"weights-{s:05d}.npy"),
+            mmap_mode="r",
+        )
+
+
+def train_trees_streamed(
+    codes_dir: str,
+    slots: List[int],
+    is_cat: List[bool],
+    columns: List[str],
+    cfg: TreeTrainConfig,
+    tags_override: Optional[np.ndarray] = None,
+    boundaries: Optional[List] = None,
+    categories: Optional[List] = None,
+    progress_cb=None,
+) -> TreeTrainResult:
+    """Level-wise GBT/RF streamed from shards (single device; the in-memory
+    trainer owns the meshed path). `tags_override` supplies per-class
+    binary targets for ONEVSALL members."""
+    import jax
+    import jax.numpy as jnp
+
+    if cfg.n_classes >= 3:
+        raise ValueError(
+            "NATIVE multi-class RF is not streamed yet — raise "
+            "-Dshifu.train.memoryBudgetMB to use the in-memory trainer"
+        )
+    feed = CodesFeed(codes_dir)
+    F = len(slots)
+    lay = make_layout([int(s) for s in slots], [bool(c) for c in is_cat])
+    la = _device_layout(lay, np.ones(F, bool))
+    D = cfg.max_depth
+    if cfg.max_leaves and cfg.max_leaves > 0:
+        log.warning("leaf-wise growth is not streamed; using level-wise")
+    is_gbt = cfg.algorithm == "GBT"
+    log_loss = cfg.loss == "log"
+    lr = cfg.learning_rate
+
+    # per-shard device state (small): labels/weights/valid stay resident
+    rng_valid = np.random.default_rng([cfg.seed, 999_983])
+    shard_state = []
+    offset = 0
+    for s in range(feed.n_shards):
+        rows = feed.meta.shard_rows[s]
+        # one GLOBAL valid draw keeps the split identical to the in-memory
+        # trainer (same seed stream over the concatenated row order)
+        valid = rng_valid.random(rows) < cfg.valid_set_rate
+        y = np.asarray(feed.tags(s), np.float32)
+        if tags_override is not None:
+            y = tags_override[offset:offset + rows].astype(np.float32)
+        w = np.where(valid, 0.0, np.asarray(feed.weights(s), np.float32))
+        shard_state.append({
+            "rows": rows,
+            "y": jnp.asarray(y),
+            "base_w": jnp.asarray(w.astype(np.float32)),
+            "valid": jnp.asarray(valid),
+            "pred": jnp.zeros(rows, jnp.float32),
+        })
+        offset += rows
+
+    @jax.jit
+    def shard_errors(score, y, valid):
+        sq = (y - score) ** 2
+        v = jnp.sum(jnp.where(valid, sq, 0.0))
+        t = jnp.sum(jnp.where(valid, 0.0, sq))
+        return t, v, jnp.sum(valid.astype(jnp.float32))
+
+    trees: List[DenseTree] = []
+    valid_errors: List[float] = []
+    bad_rounds = 0
+    decider = (DTEarlyStopDecider(cfg.max_depth)
+               if cfg.enable_early_stop else None)
+    terr = verr = 0.0
+    n_total = feed.n_rows
+
+    for k in range(cfg.tree_num):
+        rng_k = np.random.default_rng([cfg.seed, k])
+        if cfg.algorithm == "RF":
+            if cfg.bagging_with_replacement:
+                bag_all = rng_k.poisson(cfg.bagging_sample_rate,
+                                        size=n_total)
+            else:
+                bag_all = (rng_k.random(n_total)
+                           < cfg.bagging_sample_rate)
+        k_sub = subset_count(cfg.feature_subset_strategy, F)
+        feat_ok = np.zeros(F, dtype=bool)
+        if k_sub >= F:
+            feat_ok[:] = True
+        else:
+            feat_ok[rng_k.choice(F, size=k_sub, replace=False)] = True
+        fot = np.asarray(feat_ok, bool)[lay.seg_of_t]
+        la.feat_ok_t = jnp.asarray(fot)
+
+        # per-shard per-tree working arrays
+        work = []
+        offset = 0
+        for s, st in enumerate(shard_state):
+            rows = st["rows"]
+            if cfg.algorithm == "RF":
+                w_k = st["base_w"] * jnp.asarray(
+                    bag_all[offset:offset + rows].astype(np.float32))
+                labels = st["y"]
+            else:
+                w_k = st["base_w"]
+                if log_loss:
+                    labels = st["y"] - 1.0 / (1.0 + jnp.exp(-st["pred"]))
+                else:
+                    labels = st["y"] - st["pred"]
+            work.append({
+                "labels": labels, "w": w_k,
+                "node": jnp.zeros(rows, jnp.int32),
+                "active": jnp.ones(rows, bool),
+                "resting": jnp.zeros(rows, jnp.int32),
+            })
+            offset += rows
+
+        feat_levels, mask_levels, leaf_levels = [], [], []
+        # pending = the previous level's split decisions; each shard applies
+        # them the next time its codes are resident, so exactly ONE shard's
+        # code matrix lives on device at any moment and every level costs
+        # one transfer per shard
+        pending = None
+        for depth in range(D + 1):
+            L = 2**depth
+            base = L - 1
+            hist_p = _get_hist_program(L, lay.T, lay.s_max,
+                                       n_classes=cfg.n_classes)
+            hist = None
+            for s, wk in enumerate(work):
+                codes_s = jnp.asarray(np.asarray(feed.codes(s), np.int32))
+                if pending is not None:
+                    pbf, pbr, prank, psplit, pbase, pL = pending
+                    upd = _get_update_program(pL, lay.T)
+                    wk["resting"], wk["node"], wk["active"] = upd(
+                        codes_s, wk["node"], wk["active"], wk["resting"],
+                        pbf, pbr, prank, psplit, jnp.int32(pbase), la.off,
+                        la.clip,
+                    )
+                h = hist_p(codes_s, wk["labels"], wk["w"], wk["node"],
+                           wk["active"], la.off, la.clip, la.seg_t, la.pos_t)
+                hist = h if hist is None else hist + h
+                del codes_s  # drop before the next shard loads
+            pending = None
+            scan = _get_scan_program(L, lay.T, lay.s_max, cfg.impurity,
+                                     cfg.min_instances_per_node,
+                                     cfg.min_info_gain, cfg.n_classes)
+            (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = scan(
+                hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t,
+                la.start_t, la.size_t, la.off, la.clip, la.seg0_size,
+            )
+            if depth == D:  # final level: leaves only + settle leftovers
+                leaf_levels.append(lv)
+                feat_levels.append(jnp.full(L, -1, jnp.int32))
+                mask_levels.append(jnp.zeros((L, lay.s_max), bool))
+                for wk in work:
+                    wk["resting"] = jnp.where(
+                        wk["active"], base + wk["node"], wk["resting"])
+                break
+            pending = (bf, br, rank_flat, is_split, base, L)
+            feat_levels.append(jnp.where(is_split, bf, -1))
+            mask_levels.append(lm)
+            leaf_levels.append(lv)
+
+        feature, left_mask, leaf_value = jax.device_get(
+            (jnp.concatenate(feat_levels),
+             jnp.concatenate(mask_levels, axis=0),
+             jnp.concatenate(leaf_levels))
+        )
+        tree = DenseTree(
+            feature=np.asarray(feature, np.int32),
+            left_mask=np.asarray(left_mask, bool),
+            leaf_value=np.asarray(leaf_value, np.float32),
+            weight=1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0),
+        )
+        trees.append(tree)
+
+        # per-shard prediction/error updates
+        t_sum = v_sum = v_cnt = 0.0
+        t_cnt = 0.0
+        leaf_j = jnp.asarray(tree.leaf_value)
+        for wk, st in zip(work, shard_state):
+            tree_pred = leaf_j[wk["resting"]]
+            if is_gbt:
+                st["pred"] = st["pred"] + tree.weight * tree_pred
+                score = (1.0 / (1.0 + jnp.exp(-st["pred"])) if log_loss
+                         else jnp.clip(st["pred"], 0.0, 1.0))
+            else:
+                st["pred"] = (tree_pred if k == 0
+                              else (st["pred"] * k + tree_pred) / (k + 1))
+                score = jnp.clip(st["pred"], 0.0, 1.0)
+            ts, vs, vc = shard_errors(score, st["y"], st["valid"])
+            t_sum += float(ts)
+            v_sum += float(vs)
+            v_cnt += float(vc)
+            t_cnt += st["rows"] - float(vc)
+        terr = t_sum / max(t_cnt, 1.0)
+        verr = v_sum / max(v_cnt, 1.0)
+        valid_errors.append(verr)
+        if progress_cb:
+            progress_cb(k + 1, terr, verr)
+        if decider is not None and decider.add(verr):
+            log.info("streamed windowed early stop after %d trees", k + 1)
+            break
+        if cfg.early_stop_rounds and len(valid_errors) > 1:
+            if verr > min(valid_errors):
+                bad_rounds += 1
+                if bad_rounds >= cfg.early_stop_rounds:
+                    log.info("streamed early stop after %d trees", k + 1)
+                    break
+            else:
+                bad_rounds = 0
+
+    spec = TreeModelSpec(
+        algorithm=cfg.algorithm,
+        trees=trees,
+        input_columns=list(columns),
+        slots=[int(s) for s in slots],
+        boundaries=boundaries or [None] * F,
+        categories=categories or [None] * F,
+        loss=cfg.loss,
+        learning_rate=lr,
+        init_pred=0.0,
+        convert_to_prob="SIGMOID" if cfg.loss == "log" else "RAW",
+        train_error=terr,
+        valid_error=valid_errors[-1] if valid_errors else None,
+        n_classes=cfg.n_classes,
+    )
+    return TreeTrainResult(spec=spec, train_error=terr,
+                           valid_error=valid_errors[-1] if valid_errors else 0.0)

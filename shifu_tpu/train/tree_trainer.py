@@ -215,8 +215,11 @@ def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True,
     # per-block lhs [blk, C*L] scales with L alone, and deep trees (RF
     # MaxDepth=10 -> L=1024) would blow past the stats budget even when
     # every feature is narrow
+    # binary/regression keeps the measured L <= 128 gate (changing it
+    # would alter float summation order and break bit-equal resume against
+    # existing checkpoints); classification bounds the C*L lhs width
     use_matmul = (allow_matmul and L * s_max <= MATMUL_HIST_NODE_CAP
-                  and C * L <= 512)
+                  and (L <= 128 if n_classes < 3 else C * L <= 512))
 
     def comps_of(w, labels):
         if n_classes >= 3:

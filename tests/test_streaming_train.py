@@ -146,3 +146,86 @@ def test_should_stream_training_budget(tmp_path):
     finally:
         environment.set_property("shifu.train.memoryBudgetMB",
                                  str(1024))
+
+
+class TestStreamedTrees:
+    """Larger-than-memory GBT/RF (train/streaming_tree.py)."""
+
+    def _write_code_shards(self, tmp_path, n=3000, f=6, bins=8, shards=5,
+                           seed=4):
+        from shifu_tpu.norm.dataset import write_codes
+
+        rng = np.random.default_rng(seed)
+        codes = rng.integers(0, bins, size=(n, f)).astype(np.int16)
+        y = ((codes[:, 0] >= 4) | (codes[:, 1] <= 2)).astype(np.int8)
+        w = np.ones(n, np.float32)
+        out = str(tmp_path / "CleanedData")
+        write_codes(out, codes, y, w, [f"c{i}" for i in range(f)],
+                    [bins] * f, n_shards=shards)
+        return out, codes, y, w
+
+    def test_streamed_matches_in_memory_forest(self, tmp_path):
+        from shifu_tpu.train.streaming_tree import train_trees_streamed
+        from shifu_tpu.train.tree_trainer import (
+            TreeTrainConfig,
+            train_trees,
+        )
+
+        out, codes, y, w, = self._write_code_shards(tmp_path)
+        f = codes.shape[1]
+        cfg = TreeTrainConfig(algorithm="GBT", tree_num=6, max_depth=4,
+                              learning_rate=0.3, valid_set_rate=0.15,
+                              seed=9, min_instances_per_node=2)
+        cols = [f"c{i}" for i in range(f)]
+        streamed = train_trees_streamed(out, [9] * f, [False] * f, cols, cfg)
+        mem = train_trees(codes.astype(np.int32), y.astype(np.float32), w,
+                          [9] * f, [False] * f, cols, cfg)
+        assert len(streamed.spec.trees) == len(mem.spec.trees)
+        for ts, tm in zip(streamed.spec.trees, mem.spec.trees):
+            np.testing.assert_array_equal(ts.feature, tm.feature)
+            np.testing.assert_array_equal(ts.left_mask, tm.left_mask)
+            np.testing.assert_allclose(ts.leaf_value, tm.leaf_value,
+                                       atol=1e-4)
+        assert streamed.valid_error == pytest.approx(mem.valid_error,
+                                                     abs=1e-5)
+
+    def test_streamed_rf(self, tmp_path):
+        from shifu_tpu.train.streaming_tree import train_trees_streamed
+        from shifu_tpu.train.tree_trainer import TreeTrainConfig
+
+        out, codes, y, _w = self._write_code_shards(tmp_path, seed=6)
+        f = codes.shape[1]
+        cfg = TreeTrainConfig(algorithm="RF", tree_num=5, max_depth=4,
+                              feature_subset_strategy="TWOTHIRDS",
+                              valid_set_rate=0.15, seed=3,
+                              min_instances_per_node=2)
+        res = train_trees_streamed(out, [9] * f, [False] * f,
+                                   [f"c{i}" for i in range(f)], cfg)
+        scores = res.spec.independent().compute(codes.astype(np.int32))
+        acc = float(((scores > 0.5) == (y > 0.5)).mean())
+        assert acc > 0.9, acc
+
+    def test_processor_streams_trees_when_forced(self, tmp_path):
+        from tests.helpers import make_model_set
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=400, algorithm="GBT")
+        from shifu_tpu.config.model_config import ModelConfig
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+        from shifu_tpu.processor.train import TrainProcessor
+
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root).run() == 0
+        assert NormProcessor(root).run() == 0
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        mc.train.train_on_disk = True
+        mc.train.params.update({"TreeNum": 6, "MaxDepth": 3})
+        mc.save(os.path.join(root, "ModelConfig.json"))
+        assert TrainProcessor(root).run() == 0
+
+        from shifu_tpu.models.tree import TreeModelSpec
+
+        spec = TreeModelSpec.load(os.path.join(root, "models", "model0.gbt"))
+        assert len(spec.trees) == 6
