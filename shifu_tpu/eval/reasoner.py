@@ -6,9 +6,9 @@ score IS its contribution proxy (Reasoner.ScoreDiffObject.scoreDiff =
 binAvgScore[binNum]); the top-N columns by that score, mapped through the
 reason-code dictionary, are the record's reasons.
 
-Vectorized: one bin-index pass per column (shared with the norm/tree code
-cache), one [n, C] gather, one argsort — the per-record loop of the
-reference becomes three device-friendly array ops.
+Vectorized: one bin-index pass per column, one [n, C] gather, one
+argsort — the per-record loop of the reference becomes three
+device-friendly array ops.
 """
 
 from __future__ import annotations
@@ -47,11 +47,9 @@ class Reasoner:
     """Batch reason-code calculator over raw records."""
 
     def __init__(self, column_configs, reason_code_map: Optional[Dict[str, str]] = None,
-                 num_top_variables: int = 5,
-                 code_cache: Optional[dict] = None):
+                 num_top_variables: int = 5):
         self.reason_code_map = reason_code_map or {}
         self.num_top = num_top_variables
-        self.code_cache = {} if code_cache is None else code_cache
         # eligible: final-selected columns that posttrain scored
         # (Reasoner skips columns without binAvgScore)
         self.columns = [
@@ -70,8 +68,7 @@ class Reasoner:
                 [float(v) for v in cc.column_binning.bin_avg_score],
                 np.float64,
             )
-            codes = np.clip(_bin_codes_for(cc, data, self.code_cache), 0,
-                            len(table) - 1)
+            codes = np.clip(_bin_codes_for(cc, data), 0, len(table) - 1)
             out[:, j] = table[codes]
         return out
 

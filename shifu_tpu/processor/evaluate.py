@@ -178,12 +178,23 @@ class EvalProcessor(BasicProcessor):
 
     # ---- steps ----
     def _score(self, ec: EvalConfig) -> None:
+        from shifu_tpu.data.stream import should_stream
         from shifu_tpu.eval.scorer import ModelRunner, find_model_paths
 
         paths = find_model_paths(self.paths.models_dir())
         if not paths:
             raise ShifuError(ErrorCode.MODEL_NOT_FOUND,
                              f"no models under {self.paths.models_dir()}")
+        mc = self.model_config
+        data_path = self.resolve(ec.data_set.data_path
+                                 or mc.data_set.data_path)
+        try:
+            stream = should_stream(data_path)
+        except Exception:
+            stream = False
+        if stream:
+            self._score_streaming(ec, paths)
+            return
         data, tags, weights = self._load_eval_data(ec)
         runner = ModelRunner(paths, column_configs=self.column_configs,
                               model_config=self.model_config)
@@ -224,10 +235,121 @@ class EvalProcessor(BasicProcessor):
         log.info("eval %s scored %d records (%d pos / %d neg) with %d models -> %s",
                  ec.name, data.n_rows, n_pos, n_neg, len(paths), out)
 
-    def _reason_codes(self, ec: EvalConfig, data):
-        """Top-N reason codes per record when the eval set configures a
-        reasonCodePath (core/Reasoner.java + CalculateReasonCodeUDF parity;
-        needs posttrain's binAvgScore in ColumnConfig)."""
+    def _score_streaming(self, ec: EvalConfig, paths: List[str]) -> None:
+        """Bounded-memory scoring: raw records stream in ingest chunks, each
+        chunk purifies/tags/scores independently, rows append to the score
+        file — peak host memory is one chunk regardless of eval-set size
+        (the Pig Eval.pig job's mapper-streaming memory envelope)."""
+        from shifu_tpu.data.stream import iter_columnar_chunks
+        from shifu_tpu.eval.scorer import ModelRunner
+
+        mc = self.model_config
+        ds = ec.data_set
+        header = ds.header_path or mc.data_set.header_path
+        if header:
+            names = read_header(self.resolve(header),
+                                ds.header_delimiter
+                                or mc.data_set.header_delimiter)
+        else:
+            names = [c.column_name for c in self.column_configs]
+        runner = ModelRunner(paths, column_configs=self.column_configs,
+                             model_config=self.model_config)
+        pos = ec.pos_tags if ec.pos_tags is not None else mc.data_set.pos_tags
+        neg = ec.neg_tags if ec.neg_tags is not None else mc.data_set.neg_tags
+        target = mc.data_set.target_column_name
+        # hoisted per-run state: the reasoner (possibly a remote code map)
+        # and score column names must not rebuild per 64k-row chunk
+        reasoner = self._make_reasoner(ec)
+
+        out = self.paths.eval_score_path(ec.name)
+        self.paths.ensure(os.path.dirname(out))
+        sep = "|"
+        n_rows = n_pos = n_neg = 0
+        wrote_header = False
+        with open(out, "w") as fh:
+            for chunk in iter_columnar_chunks(
+                self.resolve(ds.data_path or mc.data_set.data_path), names,
+                delimiter=ds.data_delimiter or mc.data_set.data_delimiter,
+                missing_values=tuple(mc.data_set.missing_or_invalid_values),
+            ):
+                mask = combined_mask(ds.filter_expressions, chunk.raw,
+                                     chunk.n_rows)
+                chunk = chunk.select_rows(mask)
+                if not chunk.n_rows:
+                    continue
+                tags = make_tags_for(mc, chunk.column(target), pos, neg)
+                weights = make_weights(
+                    chunk, ds.weight_column_name
+                    or mc.data_set.weight_column_name)
+                result = runner.score_raw(chunk)
+                meta_cols = self._score_meta_columns(ec, chunk)
+                if reasoner is not None:
+                    reasons = reasoner.reason_codes(chunk)
+                    meta_cols.append(
+                        ("reasons", np.asarray(
+                            ["^".join(r) for r in reasons], dtype=object)))
+                if not wrote_header:
+                    score_names: List[str] = []
+                    for i, w in enumerate(result.model_widths
+                                          or [1] * result.model_scores.shape[1]):
+                        if w == 1:
+                            score_names.append(f"model{i}")
+                        else:
+                            score_names.extend(
+                                f"model{i}_{k}" for k in range(w))
+                    fh.write(sep.join(
+                        ["tag", "weight", "mean", "max", "min", "median"]
+                        + score_names + [n for n, _ in meta_cols]) + "\n")
+                    wrote_header = True
+                for i in range(result.model_scores.shape[0]):
+                    row = [
+                        str(int(tags[i])), f"{weights[i]:g}",
+                        f"{result.mean[i]:.3f}", f"{result.max[i]:.3f}",
+                        f"{result.min[i]:.3f}", f"{result.median[i]:.3f}",
+                    ] + [f"{s:.3f}" for s in result.model_scores[i]] + [
+                        str(vals[i]).replace(sep, " ")
+                        for _, vals in meta_cols
+                    ]
+                    fh.write(sep.join(row) + "\n")
+                n_rows += chunk.n_rows
+                n_pos += int((tags == 1).sum())
+                n_neg += int((tags == 0).sum())
+            if not wrote_header:
+                # empty eval set: header-only file so the perf step reads a
+                # well-formed (zero-row) score table like the in-memory path
+                score_names = self._spec_score_names(runner)
+                fh.write(sep.join(
+                    ["tag", "weight", "mean", "max", "min", "median"]
+                    + score_names) + "\n")
+        log.info("eval %s STREAMED %d records (%d pos / %d neg) with %d "
+                 "models -> %s", ec.name, n_rows, n_pos, n_neg, len(paths),
+                 out)
+
+    @staticmethod
+    def _spec_score_names(runner) -> List[str]:
+        """Score column names derived from the model specs alone (needed
+        when an eval set yields zero rows)."""
+        from shifu_tpu.models.nn import NNModelSpec
+        from shifu_tpu.models.tree import TreeModelSpec
+
+        names: List[str] = []
+        for i, spec in enumerate(runner.specs):
+            w = 1
+            if isinstance(spec, NNModelSpec) and spec.out_dim > 1:
+                w = spec.out_dim
+            elif isinstance(spec, TreeModelSpec) and spec.n_classes >= 3:
+                w = spec.n_classes
+            if w == 1:
+                names.append(f"model{i}")
+            else:
+                names.extend(f"model{i}_{k}" for k in range(w))
+        return names
+
+    def _make_reasoner(self, ec: EvalConfig):
+        """Reasoner for the eval set's reasonCodePath, or None — built ONCE
+        per eval run (the streaming path scores many chunks with it;
+        core/Reasoner.java + CalculateReasonCodeUDF parity, needs
+        posttrain's binAvgScore in ColumnConfig)."""
         path = (ec.custom_paths or {}).get("reasonCodePath")
         if not path:
             return None
@@ -236,7 +358,9 @@ class EvalProcessor(BasicProcessor):
         full = self.resolve(path)
         try:
             code_map = load_reason_code_map(full)
-        except (OSError, FileNotFoundError) as e:
+        except (OSError, ValueError, ImportError) as e:
+            # OSError covers missing files; ValueError/ImportError cover an
+            # absent fsspec connector for a remote reasonCodePath
             log.warning("reasonCodePath %s is unreadable (%s); reasons "
                         "fall back to raw column names", full, e)
             code_map = {}
@@ -245,7 +369,11 @@ class EvalProcessor(BasicProcessor):
             log.warning("reasonCodePath configured but no column has "
                         "binAvgScore — run `shifu posttrain` first")
             return None
-        return reasoner.reason_codes(data)
+        return reasoner
+
+    def _reason_codes(self, ec: EvalConfig, data):
+        reasoner = self._make_reasoner(ec)
+        return reasoner.reason_codes(data) if reasoner is not None else None
 
     def _read_scores(self, ec: EvalConfig):
         path = self.paths.eval_score_path(ec.name)

@@ -155,6 +155,7 @@ def train_tree_models(proc, alg) -> None:
             "algorithm": cfg.algorithm, "loss": cfg.loss,
             "maxDepth": cfg.max_depth, "maxLeaves": cfg.max_leaves,
             "impurity": cfg.impurity, "learningRate": cfg.learning_rate,
+            "dropoutRate": cfg.dropout_rate,
             "minInstancesPerNode": cfg.min_instances_per_node,
             "minInfoGain": cfg.min_info_gain,
             "featureSubsetStrategy": cfg.feature_subset_strategy,
@@ -231,6 +232,15 @@ def train_tree_models(proc, alg) -> None:
         if stream:
             from shifu_tpu.train.streaming_tree import train_trees_streamed
 
+            if (mc.train.is_continuous
+                    and os.path.isfile(proc.paths.model_path(i, suffix))):
+                raise ShifuError(
+                    ErrorCode.INVALID_MODEL_CONFIG,
+                    "isContinuous would overwrite the existing model: "
+                    "continuous training is not streamed yet — raise "
+                    "-Dshifu.train.memoryBudgetMB or disable "
+                    "train.trainOnDisk",
+                )
             if init_trees is not None:
                 log.warning("streamed tree training starts fresh — "
                             "checkpoint resume needs the in-memory trainer")

@@ -33,8 +33,9 @@ from shifu_tpu.train.tree_trainer import (
     TreeTrainResult,
     _device_layout,
     _get_hist_program,
-    _get_scan_program,
     _get_update_program,
+    _node_batch_size,
+    _scan_batched,
     make_layout,
     subset_count,
 )
@@ -185,14 +186,18 @@ def train_trees_streamed(
         # pending = the previous level's split decisions; each shard applies
         # them the next time its codes are resident, so exactly ONE shard's
         # code matrix lives on device at any moment and every level costs
-        # one transfer per shard
+        # one transfer per shard. Node batches honor the stats-memory
+        # budget exactly like the in-memory per-level path
+        # (DTMaster.java:450-467).
+        batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
+                                     cfg.n_classes)
         pending = None
         for depth in range(D + 1):
             L = 2**depth
             base = L - 1
-            hist_p = _get_hist_program(L, lay.T, lay.s_max,
-                                       n_classes=cfg.n_classes)
-            hist = None
+            ranges = [(b0, min(batch_cap, L - b0))
+                      for b0 in range(0, L, batch_cap)]
+            hist_parts = [None] * len(ranges)
             for s, wk in enumerate(work):
                 codes_s = jnp.asarray(np.asarray(feed.codes(s), np.int32))
                 if pending is not None:
@@ -203,17 +208,22 @@ def train_trees_streamed(
                         pbf, pbr, prank, psplit, jnp.int32(pbase), la.off,
                         la.clip,
                     )
-                h = hist_p(codes_s, wk["labels"], wk["w"], wk["node"],
-                           wk["active"], la.off, la.clip, la.seg_t, la.pos_t)
-                hist = h if hist is None else hist + h
+                for bi, (b0, Lb) in enumerate(ranges):
+                    hist_p = _get_hist_program(Lb, lay.T, lay.s_max,
+                                               n_classes=cfg.n_classes)
+                    in_batch = (wk["active"] & (wk["node"] >= b0)
+                                & (wk["node"] < b0 + Lb))
+                    h = hist_p(codes_s, wk["labels"], wk["w"],
+                               wk["node"] - b0, in_batch, la.off, la.clip,
+                               la.seg_t, la.pos_t)
+                    hist_parts[bi] = (h if hist_parts[bi] is None
+                                      else hist_parts[bi] + h)
                 del codes_s  # drop before the next shard loads
             pending = None
-            scan = _get_scan_program(L, lay.T, lay.s_max, cfg.impurity,
-                                     cfg.min_instances_per_node,
-                                     cfg.min_info_gain, cfg.n_classes)
-            (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = scan(
-                hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t,
-                la.start_t, la.size_t, la.off, la.clip, la.seg0_size,
+            (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = _scan_batched(
+                ((hist_parts[bi], Lb, b0)
+                 for bi, (b0, Lb) in enumerate(ranges)),
+                la, lay, cfg, L,
             )
             if depth == D:  # final level: leaves only + settle leftovers
                 leaf_levels.append(lv)
@@ -241,13 +251,25 @@ def train_trees_streamed(
         )
         trees.append(tree)
 
-        # per-shard prediction/error updates
+        # per-shard prediction/error updates (incl. DART per-row dropout,
+        # same keyed stream as the in-memory trainer)
+        drop_all = None
+        if is_gbt and cfg.dropout_rate > 0.0 and k > 0:
+            drop_all = (np.random.default_rng([cfg.seed, k, 777])
+                        .random(n_total) >= cfg.dropout_rate)
         t_sum = v_sum = v_cnt = 0.0
         t_cnt = 0.0
         leaf_j = jnp.asarray(tree.leaf_value)
+        drop_off = 0
         for wk, st in zip(work, shard_state):
             tree_pred = leaf_j[wk["resting"]]
             if is_gbt:
+                if drop_all is not None:
+                    keep = jnp.asarray(
+                        drop_all[drop_off:drop_off + st["rows"]]
+                        .astype(np.float32))
+                    tree_pred = tree_pred * keep
+                drop_off += st["rows"]
                 st["pred"] = st["pred"] + tree.weight * tree_pred
                 score = (1.0 / (1.0 + jnp.exp(-st["pred"])) if log_loss
                          else jnp.clip(st["pred"], 0.0, 1.0))

@@ -63,6 +63,7 @@ class TreeTrainConfig:
     bagging_sample_rate: float = 1.0
     bagging_with_replacement: bool = True
     valid_set_rate: float = 0.1
+    dropout_rate: float = 0.0  # GBT DART-style per-row drop (DROPOUT_RATE)
     early_stop_rounds: int = 0  # GBT: stop when valid error worsens N rounds
     enable_early_stop: bool = False  # DTEarlyStopDecider windowed decider
     max_stats_memory_mb: int = 256  # histogram node-batch budget
@@ -87,6 +88,7 @@ class TreeTrainConfig:
             impurity=str(g("Impurity", "variance")).lower(),
             loss=str(g("Loss", "squared")).lower(),
             learning_rate=float(g("LearningRate", 0.05)),
+            dropout_rate=float(g("DropoutRate", 0.0)),
             min_instances_per_node=int(g("MinInstancesPerNode", 5)),
             min_info_gain=float(g("MinInfoGain", 0.0)),
             feature_subset_strategy=str(
@@ -1223,7 +1225,25 @@ def train_trees(
             votes = row_put(np.zeros((n, cfg.n_classes), np.float32))
         pred = row_put(jnp.zeros(n, dtype=jnp.float32))
     elif start_k:
-        s = np.asarray(_score_existing(trees, jnp.asarray(codes_np)))
+        if is_gbt and cfg.dropout_rate > 0.0:
+            # DART resume: regenerate each tree's keyed per-row keep mask
+            # so the running prediction matches the uninterrupted run
+            from shifu_tpu.models.tree import traverse_trees
+
+            per_tree = np.asarray(
+                traverse_trees(trees, jnp.asarray(codes_np)))  # [n, k]
+            s = np.zeros(n, np.float32)
+            for col in range(per_tree.shape[1]):
+                contrib = per_tree[:, col]  # weight folded by traverse
+                if col > 0:
+                    keep = (np.random.default_rng([cfg.seed, col, 777])
+                            .random(n_orig) >= cfg.dropout_rate)
+                    keep = np.pad(keep.astype(np.float32),
+                                  (0, n - n_orig), constant_values=1.0)
+                    contrib = contrib * keep
+                s += contrib
+        else:
+            s = np.asarray(_score_existing(trees, jnp.asarray(codes_np)))
         pred = row_put((s if is_gbt else s / start_k).astype(np.float32))
     else:
         pred = row_put(jnp.zeros(n, dtype=jnp.float32))
@@ -1334,7 +1354,18 @@ def train_trees(
                 cfg.n_classes, dtype=jnp.float32)
             t_e, v_e = cls_errors_of(votes)
         elif is_gbt:
-            pred = pred + weight_k * tree_pred
+            if cfg.dropout_rate > 0.0 and k > 0:
+                # DART-ish per-row dropout (dt/DTWorker.java:634-640): each
+                # row independently skips this tree's contribution to its
+                # RUNNING prediction (the gradient target), never the model;
+                # keyed per tree so checkpoint resume replays identically
+                keep = (np.random.default_rng([cfg.seed, k, 777])
+                        .random(n_orig) >= cfg.dropout_rate)
+                keep = np.pad(keep.astype(np.float32), (0, n - n_orig),
+                              constant_values=1.0)
+                pred = pred + weight_k * tree_pred * row_put(keep)
+            else:
+                pred = pred + weight_k * tree_pred
             score = (
                 1.0 / (1.0 + jnp.exp(-pred)) if log_loss
                 else jnp.clip(pred, 0.0, 1.0)

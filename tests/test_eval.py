@@ -166,3 +166,49 @@ class TestEvalProcessor:
         assert EvalProcessor(root, norm_name="").run() == 0
         out = os.path.join(root, "evals", "Eval1", "NormalizedData")
         assert os.path.isfile(os.path.join(out, "meta.json"))
+
+
+def test_eval_streaming_matches_in_memory(tmp_path):
+    """Forced streaming eval writes the same score file as the in-memory
+    path (chunks purify/tag/score independently)."""
+    from tests.helpers import make_model_set
+
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=400)
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.evaluate import EvalProcessor
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+    from shifu_tpu.utils import environment
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 20
+    ev = mc.evals[0]
+    ev.data_set.data_path = mc.data_set.data_path
+    ev.data_set.header_path = mc.data_set.header_path
+    ev.data_set.data_delimiter = "|"
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    assert TrainProcessor(root).run() == 0
+
+    assert EvalProcessor(root, score_name="Eval1").run() == 0
+    import glob
+
+    score_file = glob.glob(os.path.join(root, "**", "EvalScore*"),
+                           recursive=True)[0]
+    in_memory = open(score_file).read()
+
+    environment.set_property("shifu.ingest.forceStreaming", "true")
+    environment.set_property("shifu.ingest.chunkRows", "64")
+    try:
+        assert EvalProcessor(root, score_name="Eval1").run() == 0
+    finally:
+        environment.set_property("shifu.ingest.forceStreaming", "")
+        environment.set_property("shifu.ingest.chunkRows",
+                                 str(65536))
+    streamed = open(score_file).read()
+    assert streamed == in_memory

@@ -286,3 +286,64 @@ def test_fused_and_per_level_paths_agree(monkeypatch):
         np.testing.assert_array_equal(tf.feature, tb.feature)
         np.testing.assert_array_equal(tf.left_mask, tb.left_mask)
         np.testing.assert_allclose(tf.leaf_value, tb.leaf_value, atol=1e-5)
+
+
+def test_gbt_dart_dropout():
+    """DropoutRate > 0: each row independently skips a tree's contribution
+    to its running prediction (dt/DTWorker.java:634-640) — the final model
+    keeps every tree, but training targets diverge from plain GBT."""
+    codes, y, w, slots = _make_data(n=900, seed=8)
+    cols = [f"c{i}" for i in range(4)]
+    base = dict(algorithm="GBT", tree_num=8, max_depth=3, learning_rate=0.3,
+                seed=13, min_instances_per_node=2)
+    plain = train_trees(codes, y, w, slots, [False] * 4, cols,
+                        TreeTrainConfig(**base))
+    dart = train_trees(codes, y, w, slots, [False] * 4, cols,
+                       TreeTrainConfig(**base, dropout_rate=0.3))
+    assert len(dart.spec.trees) == 8
+    # tree 0 identical (dropout starts at tree 1); later trees diverge
+    np.testing.assert_array_equal(plain.spec.trees[0].feature,
+                                  dart.spec.trees[0].feature)
+    diverged = any(
+        not np.array_equal(p.feature, d.feature)
+        or not np.allclose(p.leaf_value, d.leaf_value)
+        for p, d in zip(plain.spec.trees[1:], dart.spec.trees[1:])
+    )
+    assert diverged
+    # still learns
+    scores = dart.spec.independent().compute(codes)
+    assert ((scores > 0.5) == (y > 0.5)).mean() > 0.8
+
+    # streamed path draws the identical dropout stream
+    from shifu_tpu.norm.dataset import write_codes
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "CleanedData")
+        write_codes(out, codes.astype(np.int16), y.astype(np.int8), w,
+                    cols, slots, n_shards=3)
+        from shifu_tpu.train.streaming_tree import train_trees_streamed
+
+        streamed = train_trees_streamed(
+            out, slots, [False] * 4, cols,
+            TreeTrainConfig(**base, dropout_rate=0.3))
+        for ts, tm in zip(streamed.spec.trees, dart.spec.trees):
+            np.testing.assert_array_equal(ts.feature, tm.feature)
+
+
+def test_gbt_dart_resume_is_bit_equal():
+    """DART runs resume bit-equal too: the per-row keep masks regenerate
+    from their (seed, tree, 777) streams."""
+    codes, y, w, slots = _make_data(n=800, seed=9)
+    cols = [f"c{i}" for i in range(4)]
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=8, max_depth=3,
+                          learning_rate=0.3, dropout_rate=0.25, seed=21,
+                          min_instances_per_node=2)
+    full = train_trees(codes, y, w, slots, [False] * 4, cols, cfg)
+    cfg4 = TreeTrainConfig(**{**cfg.__dict__, "tree_num": 4})
+    part = train_trees(codes, y, w, slots, [False] * 4, cols, cfg4)
+    resumed = train_trees(codes, y, w, slots, [False] * 4, cols, cfg,
+                          init_trees=part.spec.trees)
+    for tf, tr in zip(full.spec.trees, resumed.spec.trees):
+        np.testing.assert_array_equal(tf.feature, tr.feature)
+        np.testing.assert_allclose(tf.leaf_value, tr.leaf_value, atol=1e-6)
