@@ -31,11 +31,35 @@ def _wdl_signature(cfg) -> tuple:
     )
 
 
+def _wdl_column_mapping(proc, nmeta, cmeta):
+    """(num_idx, num_names, cat_idx, cat_names, vocab_sizes, categories):
+    numeric feature columns come from the normalized matrix; categorical
+    ones from the code matrix (embedding + wide indices)."""
+    from shifu_tpu.norm.normalizer import norm_columns
+
+    cols = norm_columns(proc.column_configs)
+    by_name = {c.column_name: c for c in cols}
+    num_idx, num_names = [], []
+    for j, name in enumerate(nmeta.columns):
+        cc = by_name.get(name)
+        if cc is not None and not cc.is_categorical():
+            num_idx.append(j)
+            num_names.append(name)
+    cat_idx, cat_names, vocab_sizes, categories = [], [], [], []
+    for j, name in enumerate(cmeta.columns):
+        cc = by_name.get(name)
+        if cc is not None and cc.is_categorical():
+            cat_idx.append(j)
+            cat_names.append(name)
+            vocab_sizes.append(int(cmeta.extra["slots"][j]))
+            categories.append(list(cc.column_binning.bin_category or []))
+    return num_idx, num_names, cat_idx, cat_names, vocab_sizes, categories
+
+
 def train_wdl_models(proc) -> None:
     from shifu_tpu.models.wdl import WDLModelSpec, flatten_wdl
     from shifu_tpu.norm.normalizer import (
         build_norm_plan,
-        norm_columns,
         spec_to_json,
     )
     from shifu_tpu.train.grid_search import flatten_params
@@ -51,28 +75,19 @@ def train_wdl_models(proc) -> None:
     if not (os.path.isdir(norm_dir) and os.path.isdir(codes_dir)):
         raise ShifuError(ErrorCode.DATA_NOT_FOUND,
                          "run `shifu norm` before WDL training")
+
+    from shifu_tpu.train.streaming import should_stream_training
+
+    if (should_stream_training(norm_dir,
+                               force_attr=bool(mc.train.train_on_disk))
+            or should_stream_training(codes_dir)):
+        _train_wdl_streamed(proc)
+        return
+
     nmeta, feats, tags, weights = load_normalized(norm_dir)
     cmeta, codes, _, _ = load_codes(codes_dir)
-
-    cols = norm_columns(proc.column_configs)
-    by_name = {c.column_name: c for c in cols}
-
-    # numeric feature columns come from the normalized matrix; categorical
-    # ones from the code matrix (embedding + wide indices)
-    num_idx, num_names = [], []
-    for j, name in enumerate(nmeta.columns):
-        cc = by_name.get(name)
-        if cc is not None and not cc.is_categorical():
-            num_idx.append(j)
-            num_names.append(name)
-    cat_idx, cat_names, vocab_sizes, categories = [], [], [], []
-    for j, name in enumerate(cmeta.columns):
-        cc = by_name.get(name)
-        if cc is not None and cc.is_categorical():
-            cat_idx.append(j)
-            cat_names.append(name)
-            vocab_sizes.append(int(cmeta.extra["slots"][j]))
-            categories.append(list(cc.column_binning.bin_category or []))
+    (num_idx, num_names, cat_idx, cat_names, vocab_sizes,
+     categories) = _wdl_column_mapping(proc, nmeta, cmeta)
 
     dense = np.asarray(feats, np.float32)[:, num_idx]
     cat_codes = np.asarray(codes, np.int32)[:, cat_idx]
@@ -89,31 +104,9 @@ def train_wdl_models(proc) -> None:
     proc.paths.ensure(proc.paths.models_dir())
     proc.paths.ensure(proc.paths.train_dir())
 
-    def make_spec(cfg, res) -> "WDLModelSpec":
-        return WDLModelSpec(
-            hidden=list(cfg.hidden),
-            activations=list(cfg.activations),
-            embed_dim=cfg.embed_dim,
-            dense_columns=num_names,
-            cat_columns=cat_names,
-            vocab_sizes=vocab_sizes,
-            norm_specs=dense_specs,
-            norm_cutoff=plan.cutoff,
-            categories=categories,
-            norm_type=mc.normalize.norm_type.value,
-            params=res.params,
-            train_error=res.train_error,
-            valid_error=res.valid_error,
-        )
-
     def save_member(i, cfg, res):
-        spec = make_spec(cfg, res)
-        path = proc.paths.model_path(i, "wdl")
-        spec.save(path)
-        with open(proc.paths.val_error_path(i), "w") as fh:
-            fh.write(f"{res.valid_error}\n")
-        log.info("model %d (WDL) -> %s (valid err %.6f)", i, path,
-                 res.valid_error)
+        _save_wdl_member(proc, i, cfg, res, num_names, cat_names,
+                         vocab_sizes, dense_specs, plan.cutoff, categories)
 
     def continuous_init(i) -> Optional[np.ndarray]:
         """Resume from the existing model's weights when isContinuous
@@ -238,3 +231,99 @@ def train_wdl_models(proc) -> None:
     res = train_wdl(dense, cat_codes, tags, weights, vocab_sizes, cfg,
                     mesh=mesh, init_flat=continuous_init(0))
     save_member(0, cfg, res)
+
+
+def _save_wdl_member(proc, i, cfg, res, num_names, cat_names, vocab_sizes,
+                     dense_specs, cutoff, categories) -> None:
+    """ONE spec construction + artifact write for both the in-memory and
+    streamed WDL paths — the schema must never diverge between them."""
+    from shifu_tpu.models.wdl import WDLModelSpec
+
+    mc = proc.model_config
+    spec = WDLModelSpec(
+        hidden=list(cfg.hidden),
+        activations=list(cfg.activations),
+        embed_dim=cfg.embed_dim,
+        dense_columns=num_names,
+        cat_columns=cat_names,
+        vocab_sizes=vocab_sizes,
+        norm_specs=dense_specs,
+        norm_cutoff=cutoff,
+        categories=categories,
+        norm_type=mc.normalize.norm_type.value,
+        params=res.params,
+        train_error=res.train_error,
+        valid_error=res.valid_error,
+    )
+    path = proc.paths.model_path(i, "wdl")
+    spec.save(path)
+    with open(proc.paths.val_error_path(i), "w") as fh:
+        fh.write(f"{res.valid_error}\n")
+    log.info("model %d (WDL) -> %s (valid err %.6f)", i, path,
+             res.valid_error)
+
+
+def _train_wdl_streamed(proc) -> None:
+    """Larger-than-memory WDL: per-shard gradient accumulation over the
+    row-aligned (NormalizedData, CleanedData) shard pairs
+    (train/streaming_wdl.py). Members run serially; grid/k-fold need the
+    in-memory trainer."""
+    from shifu_tpu.models.wdl import WDLModelSpec, flatten_wdl
+    from shifu_tpu.norm.dataset import read_meta
+    from shifu_tpu.norm.normalizer import build_norm_plan, spec_to_json
+    from shifu_tpu.train.grid_search import flatten_params
+    from shifu_tpu.train.streaming_wdl import train_wdl_streamed
+    from shifu_tpu.train.wdl_trainer import WDLTrainConfig
+
+    mc = proc.model_config
+    norm_dir = proc.paths.normalized_data_dir()
+    codes_dir = proc.paths.cleaned_data_dir()
+    composites = flatten_params(
+        mc.train.params or {},
+        proc.resolve(mc.train.grid_config_file)
+        if mc.train.grid_config_file else None,
+    )
+    if len(composites) > 1 or (mc.train.num_k_fold or -1) > 0:
+        raise ShifuError(
+            ErrorCode.INVALID_MODEL_CONFIG,
+            "WDL grid search / k-fold need the in-memory trainer; raise "
+            "-Dshifu.train.memoryBudgetMB or disable train.trainOnDisk",
+        )
+    nmeta = read_meta(norm_dir)
+    cmeta = read_meta(codes_dir)
+    (num_idx, num_names, cat_idx, cat_names, vocab_sizes,
+     categories) = _wdl_column_mapping(proc, nmeta, cmeta)
+    plan = build_norm_plan(mc, proc.column_configs)
+    dense_specs = [
+        spec_to_json(s) for s in plan.specs
+        if s.cc.column_name in set(num_names)
+    ]
+    proc.paths.ensure(proc.paths.models_dir())
+    proc.paths.ensure(proc.paths.train_dir())
+    bagging = max(1, int(mc.train.bagging_num or 1))
+    log.info("WDL training STREAMED from %s + %s (%d member(s)); runs "
+             "single-device — tensor-parallel embedding sharding needs the "
+             "in-memory trainer", norm_dir, codes_dir, bagging)
+
+    for i in range(bagging):
+        cfg = WDLTrainConfig.from_model_config(mc, trainer_id=i)
+        cfg.checkpoint_every = proc._checkpoint_every()
+        cfg.checkpoint_path = os.path.join(
+            proc.paths.ensure(proc.paths.checkpoint_dir(i)), "weights.npy"
+        )
+        from shifu_tpu.processor.train_common import progress_writer
+
+        cfg.progress_cb = progress_writer(proc.paths.progress_path(i), i)
+        init_flat = None
+        if mc.train.is_continuous:
+            path = proc.paths.model_path(i, "wdl")
+            if os.path.isfile(path):
+                try:
+                    init_flat = flatten_wdl(WDLModelSpec.load(path).params)
+                    log.info("continuous: resuming WDL model %d", i)
+                except Exception as e:
+                    log.warning("cannot resume from %s (%s)", path, e)
+        res = train_wdl_streamed(norm_dir, codes_dir, num_idx, cat_idx,
+                                 vocab_sizes, cfg, init_flat=init_flat)
+        _save_wdl_member(proc, i, cfg, res, num_names, cat_names,
+                         vocab_sizes, dense_specs, plan.cutoff, categories)

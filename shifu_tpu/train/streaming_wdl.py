@@ -1,0 +1,234 @@
+"""Larger-than-memory WDL: stream dense + code shards per epoch.
+
+Completes the streaming trio (NN: train/streaming.py, GBT/RF:
+train/streaming_tree.py): the WDL epoch gradient is the sum of per-shard
+gradients over (dense numeric slice, categorical code slice) pairs — the
+NormalizedData and CleanedData shards are row-aligned because `shifu norm`
+writes them in one pass. Full-batch BSP semantics match train_wdl exactly;
+peak host memory is one (dense, codes) shard pair.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from shifu_tpu.models.wdl import (
+    WDLParams,
+    flatten_wdl,
+    init_wdl_params,
+    unflatten_wdl,
+    unflatten_wdl_from_shapes,
+    wdl_forward,
+    wdl_shapes,
+)
+from shifu_tpu.norm.dataset import read_meta
+from shifu_tpu.train.updaters import make_updater
+from shifu_tpu.train.wdl_trainer import WDLTrainConfig, WDLTrainResult
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+_PROGRAMS: dict = {}
+
+
+class WDLShardFeed:
+    """Row-aligned (dense, codes) shard pairs, padded to one static shape;
+    per-shard sampling masks drawn once like the NN ShardFeed."""
+
+    def __init__(self, norm_dir: str, codes_dir: str, num_idx: List[int],
+                 cat_idx: List[int], cfg: WDLTrainConfig):
+        from shifu_tpu.train.nn_trainer import split_and_sample
+
+        self.norm_dir = norm_dir
+        self.codes_dir = codes_dir
+        self.num_idx = list(num_idx)
+        self.cat_idx = list(cat_idx)
+        self.meta = read_meta(norm_dir)
+        cmeta = read_meta(codes_dir)
+        if cmeta.shard_rows != self.meta.shard_rows:
+            raise ValueError(
+                "NormalizedData and CleanedData shards are not row-aligned "
+                "— re-run `shifu norm`"
+            )
+        self.n_shards = len(self.meta.shard_rows)
+        self.pad_rows = max(self.meta.shard_rows) if self.meta.shard_rows else 0
+        self._sig = []
+        for s, rows in enumerate(self.meta.shard_rows):
+            cfg_s = WDLTrainConfig(
+                **{**cfg.__dict__, "seed": cfg.seed * 100_003 + s}
+            )
+            sig, valid = split_and_sample(rows, cfg_s)
+            w = np.load(os.path.join(norm_dir, f"weights-{s:05d}.npy"),
+                        mmap_mode="r")
+            self._sig.append((
+                (sig * np.asarray(w)).astype(np.float32),
+                (valid.astype(np.float32) * np.asarray(w)).astype(np.float32),
+            ))
+        self.n_train_size = float(
+            max(sum(float((st > 0).sum()) for st, _ in self._sig), 1.0)
+        )
+
+    def _padded(self, a, pad, two_d=False):
+        if pad == 0:
+            return a
+        return (np.pad(a, ((0, pad), (0, 0))) if two_d
+                else np.pad(a, (0, pad)))
+
+    def _load(self, s: int):
+        import jax
+
+        rows = self.meta.shard_rows[s]
+        pad = self.pad_rows - rows
+        dense = np.asarray(np.load(
+            os.path.join(self.norm_dir, f"features-{s:05d}.npy"),
+            mmap_mode="r")[:, self.num_idx], np.float32)
+        codes = np.asarray(np.load(
+            os.path.join(self.codes_dir, f"codes-{s:05d}.npy"),
+            mmap_mode="r")[:, self.cat_idx], np.int32)
+        t = np.asarray(np.load(
+            os.path.join(self.norm_dir, f"tags-{s:05d}.npy"),
+            mmap_mode="r"), np.float32)
+        sig_t, sig_v = self._sig[s]
+        return (
+            jax.device_put(self._padded(dense, pad, True)),
+            jax.device_put(self._padded(codes, pad, True)),
+            jax.device_put(self._padded(t, pad)),
+            jax.device_put(self._padded(sig_t, pad)),
+            jax.device_put(self._padded(sig_v, pad)),
+        )
+
+    def __iter__(self):
+        # double buffered like the NN ShardFeed: shard s+1's host->device
+        # transfer rides under shard s's compute (device_put is async)
+        nxt = self._load(0) if self.n_shards else None
+        for s in range(self.n_shards):
+            cur = nxt
+            nxt = self._load(s + 1) if s + 1 < self.n_shards else None
+            yield cur
+
+
+def _get_shard_program(cfg: WDLTrainConfig, template: WDLParams):
+    import jax
+    import jax.numpy as jnp
+
+    shapes = wdl_shapes(template)
+    n_cat = len(template.embed)
+    key = ("wdl-shard", tuple(shapes), n_cat, tuple(cfg.activations))
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+
+    def loss_fn(flat, dense, codes, t, sig):
+        p = unflatten_wdl_from_shapes(flat, shapes, n_cat)
+        prob = wdl_forward(p, dense, codes, cfg.activations)
+        eps = 1e-7
+        pc = jnp.clip(prob, eps, 1 - eps)
+        ll = -(t * jnp.log(pc) + (1 - t) * jnp.log(1 - pc))
+        return jnp.sum(sig * ll), prob
+
+    grad_fn = jax.grad(loss_fn, has_aux=True)
+
+    @jax.jit
+    def shard_grad(flat, dense, codes, t, sig_t, sig_v):
+        g_neg, prob = grad_fn(flat, dense, codes, t, sig_t)
+        sq = (t - prob) ** 2
+        tr_w = jnp.sum(sig_t)
+        va_w = jnp.sum(sig_v)
+        tr = jnp.sum(sig_t * sq)
+        va = jnp.sum(sig_v * sq)
+        return -g_neg, tr, va, tr_w, va_w
+
+    _PROGRAMS[key] = shard_grad
+    return shard_grad
+
+
+def train_wdl_streamed(
+    norm_dir: str,
+    codes_dir: str,
+    num_idx: List[int],
+    cat_idx: List[int],
+    vocab_sizes: List[int],
+    cfg: WDLTrainConfig,
+    init_flat: Optional[np.ndarray] = None,
+) -> WDLTrainResult:
+    import jax.numpy as jnp
+
+    feed = WDLShardFeed(norm_dir, codes_dir, num_idx, cat_idx, cfg)
+    template = init_wdl_params(
+        len(num_idx), vocab_sizes, cfg.embed_dim, cfg.hidden, seed=cfg.seed
+    )
+    flat0 = flatten_wdl(template)
+    if init_flat is not None and init_flat.size == flat0.size:
+        flat0 = init_flat.astype(np.float32)
+
+    shard_grad = _get_shard_program(cfg, template)
+    init_state, apply_update = make_updater(
+        cfg.optimizer if cfg.optimizer != "GD" else "B",
+        momentum=0.0,
+        reg=cfg.l2_reg,
+        reg_level="L2" if cfg.l2_reg else "NONE",
+    )
+    flat = jnp.asarray(flat0)
+    opt = init_state(flat0.size)
+    nts = jnp.float32(feed.n_train_size)
+
+    best_val = math.inf
+    best_flat = np.asarray(flat)
+    bad = 0
+    tr_e = va_e = 0.0
+    it_done = 0
+    for it in range(cfg.num_epochs):
+        g_sum = tr_sum = va_sum = tr_w = va_w = None
+        for (dense, codes, t, sig_t, sig_v) in feed:
+            g, trs, vas, trw, vaw = shard_grad(flat, dense, codes, t,
+                                               sig_t, sig_v)
+            if g_sum is None:
+                g_sum, tr_sum, va_sum, tr_w, va_w = g, trs, vas, trw, vaw
+            else:
+                g_sum = g_sum + g
+                tr_sum, va_sum = tr_sum + trs, va_sum + vas
+                tr_w, va_w = tr_w + trw, va_w + vaw
+        tr_e = float(tr_sum / jnp.maximum(tr_w, 1.0))
+        va_e = float(va_sum / jnp.maximum(va_w, 1.0))
+        if va_e < best_val:
+            best_val = va_e
+            best_flat = np.asarray(flat)
+            bad = 0
+        else:
+            bad += 1
+        flat, opt = apply_update(opt, flat, g_sum,
+                                 jnp.float32(cfg.learning_rate),
+                                 jnp.int32(it + 1), nts)
+        it_done = it + 1
+        if cfg.checkpoint_every and it_done % cfg.checkpoint_every == 0:
+            if cfg.progress_cb:
+                cfg.progress_cb(it_done, tr_e, va_e)
+            if cfg.checkpoint_path:
+                np.save(cfg.checkpoint_path, np.asarray(flat))
+        if cfg.early_stop_window and bad >= cfg.early_stop_window:
+            log.info("streamed WDL early stop at epoch %d", it_done)
+            break
+
+    use_best = cfg.valid_set_rate > 0 and math.isfinite(best_val)
+    chosen = best_flat if use_best else np.asarray(flat)
+    params = unflatten_wdl(chosen, template)
+    params = WDLParams(
+        embed=[np.asarray(a) for a in params.embed],
+        wide=[np.asarray(a) for a in params.wide],
+        wide_dense=np.asarray(params.wide_dense),
+        dense_layers=[{k: np.asarray(v) for k, v in l.items()}
+                      for l in params.dense_layers],
+        bias=np.asarray(params.bias),
+    )
+    log.info("streamed WDL done: %d epochs over %d shards, train %.6f "
+             "valid %.6f", it_done, feed.n_shards, tr_e,
+             best_val if use_best else va_e)
+    return WDLTrainResult(
+        params=params, train_error=tr_e,
+        valid_error=best_val if use_best else va_e,
+        iterations=it_done,
+    )

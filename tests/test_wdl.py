@@ -245,3 +245,35 @@ class TestWDLFirstClass:
         drift = float(np.linalg.norm(f2 - f1))
         scratch_dist = float(np.linalg.norm(fresh - f1))
         assert drift < 0.25 * scratch_dist, (drift, scratch_dist)
+
+
+def test_wdl_streamed_training(tmp_path):
+    """train.trainOnDisk=true streams WDL from the shard pairs and still
+    learns (train/streaming_wdl.py)."""
+    from tests.helpers import make_model_set
+
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=400, algorithm="WDL")
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 30
+    mc.train.train_on_disk = True
+    mc.train.params.update({"NumHiddenNodes": [16],
+                            "ActivationFunc": ["relu"]})
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    assert TrainProcessor(root).run() == 0
+
+    from shifu_tpu.models.wdl import WDLModelSpec
+
+    spec = WDLModelSpec.load(os.path.join(root, "models", "model0.wdl"))
+    assert spec.valid_error is not None and spec.valid_error < 0.25
+    assert os.path.isfile(os.path.join(root, "tmp", "train",
+                                       "progress_0.log"))
