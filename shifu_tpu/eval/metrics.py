@@ -164,6 +164,42 @@ class PerformanceResult:
         }
 
 
+def sweep_from_histogram(
+    scores: np.ndarray,
+    pos: np.ndarray,
+    neg: np.ndarray,
+    wpos: np.ndarray,
+    wneg: np.ndarray,
+) -> ConfusionSweep:
+    """ConfusionSweep from per-unique-score tallies (descending scores).
+
+    The streamed perf path accumulates counts per DISTINCT written score
+    (the score file carries 3 decimals, so the tally is EXACT, not an
+    approximation); each distinct score is one tied block, which is
+    precisely the tie-aware sweep's unit."""
+    order = np.argsort(-np.asarray(scores, np.float64), kind="stable")
+    s = np.asarray(scores, np.float64)[order]
+    p = np.asarray(pos, np.float64)[order]
+    n = np.asarray(neg, np.float64)[order]
+    wp = np.asarray(wpos, np.float64)[order]
+    wn = np.asarray(wneg, np.float64)[order]
+    tp, fp = np.cumsum(p), np.cumsum(n)
+    wtp, wfp = np.cumsum(wp), np.cumsum(wn)
+    pos_total = float(tp[-1]) if len(tp) else 0.0
+    neg_total = float(fp[-1]) if len(fp) else 0.0
+    wpos_total = float(wtp[-1]) if len(wtp) else 0.0
+    wneg_total = float(wfp[-1]) if len(wfp) else 0.0
+    return ConfusionSweep(
+        scores=s,
+        tp=tp, fp=fp, fn=pos_total - tp, tn=neg_total - fp,
+        wtp=wtp, wfp=wfp, wfn=wpos_total - wtp, wtn=wneg_total - wfp,
+        block_end=np.ones(len(s), dtype=bool),
+        total=int(round(pos_total + neg_total)),
+        pos_total=pos_total, neg_total=neg_total,
+        wpos_total=wpos_total, wneg_total=wneg_total,
+    )
+
+
 def evaluate_performance(
     scores: np.ndarray,
     tags: np.ndarray,
@@ -173,7 +209,14 @@ def evaluate_performance(
     """Bucketed PR/ROC/gain lists + AUC (PerformanceEvaluator.bucketing
     crossing rules: emit a row the first time the tracked rate crosses each
     1/numBucket boundary)."""
-    cs = confusion_sweep(scores, tags, weights)
+    return evaluate_performance_from_sweep(
+        confusion_sweep(scores, tags, weights), n_buckets
+    )
+
+
+def evaluate_performance_from_sweep(
+    cs: ConfusionSweep, n_buckets: int = 10
+) -> PerformanceResult:
     res = PerformanceResult()
     if cs.total == 0:
         return res

@@ -389,31 +389,39 @@ class EvalProcessor(BasicProcessor):
         from shifu_tpu.eval.metrics import (
             confusion_matrix_rows,
             confusion_sweep,
-            evaluate_performance,
+            evaluate_performance_from_sweep,
         )
 
         mc = self.model_config
         if mc.is_multi_classification():
             self._multiclass_confusion(ec)
             return
-        df = self._read_scores(ec)
-        valid = df["tag"] >= 0
-        df = df[valid]
-        selector = (ec.performance_score_selector or "mean").lower()
-        score_col = selector if selector in df.columns else "mean"
-        scores = df[score_col].to_numpy(dtype=np.float64)
-        tags = df["tag"].to_numpy(dtype=np.float64)
-        weights = df["weight"].to_numpy(dtype=np.float64)
+        score_path = self.paths.eval_score_path(ec.name)
+        if not os.path.isfile(score_path):
+            self._score(ec)
+        from shifu_tpu.data.stream import memory_budget_bytes
 
-        perf = evaluate_performance(
-            scores, tags, weights, n_buckets=ec.performance_bucket_num or 10
+        if os.path.getsize(score_path) > memory_budget_bytes():
+            cs = self._streamed_sweep(ec, score_path)
+        else:
+            df = self._read_scores(ec)
+            df = df[df["tag"] >= 0]
+            selector = (ec.performance_score_selector or "mean").lower()
+            score_col = selector if selector in df.columns else "mean"
+            cs = confusion_sweep(
+                df[score_col].to_numpy(dtype=np.float64),
+                df["tag"].to_numpy(dtype=np.float64),
+                df["weight"].to_numpy(dtype=np.float64),
+            )
+
+        perf = evaluate_performance_from_sweep(
+            cs, n_buckets=ec.performance_bucket_num or 10
         )
         perf_path = self.paths.eval_performance_path(ec.name)
         self.paths.ensure(os.path.dirname(perf_path))
         with open(perf_path, "w") as fh:
             json.dump(perf.to_json(), fh, indent=2)
 
-        cs = confusion_sweep(scores, tags, weights)
         rows = confusion_matrix_rows(cs)
         cm_path = self.paths.eval_confusion_path(ec.name)
         with open(cm_path, "w") as fh:
@@ -431,6 +439,55 @@ class EvalProcessor(BasicProcessor):
             ec.name, perf.area_under_roc, perf.weighted_area_under_roc,
             perf_path, self.paths.gain_chart_path(ec.name),
         )
+
+    def _streamed_sweep(self, ec: EvalConfig, score_path: str):
+        """Tie-aware confusion sweep over a larger-than-memory score file:
+        chunked reads accumulate EXACT per-distinct-score tallies (the file
+        carries 3 decimals, so distinct scores are bounded), then one tiny
+        sort builds the sweep — the streaming answer to the reference's
+        externally-sorted buffered matrix
+        (ConfusionMatrix.bufferedComputeConfusionMatrixAndPerformance:248)."""
+        import pandas as pd
+
+        from shifu_tpu.data.stream import chunk_rows_setting
+        from shifu_tpu.eval.metrics import sweep_from_histogram
+
+        selector = (ec.performance_score_selector or "mean").lower()
+        with open(score_path) as fh:
+            header = fh.readline().strip().split("|")
+        score_col = selector if selector in header else "mean"
+        tally: dict = {}
+        for chunk in pd.read_csv(score_path, sep="|",
+                                 usecols=["tag", "weight", score_col],
+                                 chunksize=chunk_rows_setting()):
+            chunk = chunk[chunk["tag"] >= 0]
+            if not len(chunk):
+                continue
+            s = chunk[score_col].to_numpy(np.float64)
+            t = chunk["tag"].to_numpy(np.float64)
+            w = chunk["weight"].to_numpy(np.float64)
+            uniq, inv = np.unique(s, return_inverse=True)
+            pos = np.bincount(inv, weights=t, minlength=len(uniq))
+            neg = np.bincount(inv, weights=1.0 - t, minlength=len(uniq))
+            wpos = np.bincount(inv, weights=t * w, minlength=len(uniq))
+            wneg = np.bincount(inv, weights=(1.0 - t) * w,
+                               minlength=len(uniq))
+            for i, sv in enumerate(uniq):
+                acc = tally.get(sv)
+                if acc is None:
+                    tally[sv] = [pos[i], neg[i], wpos[i], wneg[i]]
+                else:
+                    acc[0] += pos[i]
+                    acc[1] += neg[i]
+                    acc[2] += wpos[i]
+                    acc[3] += wneg[i]
+        scores = np.asarray(list(tally.keys()), np.float64)
+        agg = np.asarray(list(tally.values()), np.float64)
+        if not len(scores):
+            agg = np.zeros((0, 4))
+        log.info("streamed perf sweep: %d distinct scores", len(scores))
+        return sweep_from_histogram(scores, agg[:, 0], agg[:, 1],
+                                    agg[:, 2], agg[:, 3])
 
     def _multiclass_confusion(self, ec: EvalConfig) -> None:
         """Multi-class eval: K x K confusion matrix + accuracy

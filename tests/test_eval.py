@@ -212,3 +212,53 @@ def test_eval_streaming_matches_in_memory(tmp_path):
                                  str(65536))
     streamed = open(score_file).read()
     assert streamed == in_memory
+
+
+def test_perf_streamed_sweep_matches_in_memory(tmp_path):
+    """Past the memory budget, the perf step accumulates exact
+    per-distinct-score tallies; AUC/perf output must equal the in-memory
+    sweep (the file carries 3 decimals, so the tally is exact)."""
+    import json as _json
+
+    from tests.helpers import make_model_set
+
+    root = str(tmp_path / "ms")
+    make_model_set(root, n_rows=400)
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.processor.evaluate import EvalProcessor
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+    from shifu_tpu.utils import environment
+
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 20
+    ev = mc.evals[0]
+    ev.data_set.data_path = mc.data_set.data_path
+    ev.data_set.header_path = mc.data_set.header_path
+    ev.data_set.data_delimiter = "|"
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    assert TrainProcessor(root).run() == 0
+    assert EvalProcessor(root, run_name="Eval1").run() == 0
+    import glob
+
+    perf_file = glob.glob(os.path.join(root, "**", "EvalPerformance.json"),
+                          recursive=True)[0]
+    with open(perf_file) as fh:
+        in_memory = _json.load(fh)
+
+    environment.set_property("shifu.ingest.memoryBudgetMB", "0")
+    try:
+        assert EvalProcessor(root, perf_name="Eval1").run() == 0
+    finally:
+        environment.set_property("shifu.ingest.memoryBudgetMB", "512")
+    with open(perf_file) as fh:
+        streamed = _json.load(fh)
+    assert streamed["areaUnderRoc"] == in_memory["areaUnderRoc"]
+    assert streamed["weightedAreaUnderRoc"] == in_memory["weightedAreaUnderRoc"]
+    assert streamed["roc"] == in_memory["roc"]
+    assert streamed["gains"] == in_memory["gains"]
