@@ -84,11 +84,7 @@ def _add_local_transformations(parent, spec: NNModelSpec):
     return lt
 
 
-def nn_to_pmml(spec: NNModelSpec, model_name: str = "shifu_tpu_model") -> str:
-    root = ET.Element("PMML", version="4.2", xmlns=PMML_NS)
-    header = _el(root, "Header", description="shifu-tpu exported model")
-    _el(header, "Application", name="shifu-tpu", version="0.1")
-
+def _nn_data_dictionary(root, spec: NNModelSpec):
     dd = _el(root, "DataDictionary")
     for cd in spec.norm_specs:
         optype = "categorical" if cd.get("categories") else "continuous"
@@ -96,11 +92,26 @@ def nn_to_pmml(spec: NNModelSpec, model_name: str = "shifu_tpu_model") -> str:
         _el(dd, "DataField", name=cd["name"], optype=optype, dataType=dtype)
     _el(dd, "DataField", name="TARGET", optype="categorical", dataType="string")
     dd.set("numberOfFields", str(len(spec.norm_specs) + 1))
+    return dd
 
+
+def nn_to_pmml(spec: NNModelSpec, model_name: str = "shifu_tpu_model") -> str:
+    root = ET.Element("PMML", version="4.2", xmlns=PMML_NS)
+    header = _el(root, "Header", description="shifu-tpu exported model")
+    _el(header, "Application", name="shifu-tpu", version="0.1")
+    _nn_data_dictionary(root, spec)
+    _nn_model_element(root, spec, model_name)
+    ET.indent(root)
+    return ET.tostring(root, encoding="unicode", xml_declaration=True)
+
+
+def _nn_model_element(parent, spec: NNModelSpec, model_name: str):
+    """The NeuralNetwork element itself — embeddable under a PMML root or
+    a MiningModel Segment (one-bagging export)."""
     act = (spec.activations[0] if spec.activations else "tanh").lower()
     pmml_act = {"tanh": "tanh", "sigmoid": "logistic", "relu": "rectifier",
                 "linear": "identity"}.get(act, "tanh")
-    nn = _el(root, "NeuralNetwork", modelName=model_name,
+    nn = _el(parent, "NeuralNetwork", modelName=model_name,
              functionName="regression", activationFunction=pmml_act)
 
     ms = _el(nn, "MiningSchema")
@@ -139,9 +150,7 @@ def nn_to_pmml(spec: NNModelSpec, model_name: str = "shifu_tpu_model") -> str:
     no = _el(outputs, "NeuralOutput", outputNeuron=prev_ids[0])
     df = _el(no, "DerivedField", dataType="double", optype="continuous")
     _el(df, "FieldRef", field="TARGET")
-
-    ET.indent(root)
-    return ET.tostring(root, encoding="unicode", xml_declaration=True)
+    return nn
 
 
 # ---------------------------------------------------------------------------
@@ -234,17 +243,7 @@ def _tree_nodes(tree, spec, parent, node_idx: int, node_id_prefix: str,
     return node
 
 
-def tree_to_pmml(spec, model_name: str = "shifu_tpu_model") -> str:
-    """TreeModelSpec -> PMML MiningModel with one TreeModel Segment per tree
-    (TreeEnsemblePmmlCreator.convert). GBT folds each tree's weight into its
-    leaf scores and sums segments (exact weighted-sum semantics); RF
-    averages equal-weight segments. Log-loss GBT emits RAW logits — the
-    sigmoid conversion happens scorer-side, like the reference's
-    gbtScoreConvertStrategy."""
-    root = ET.Element("PMML", version="4.2", xmlns=PMML_NS)
-    header = _el(root, "Header", description="shifu-tpu exported tree model")
-    _el(header, "Application", name="shifu-tpu", version="0.1")
-
+def _tree_data_dictionary(root, spec):
     dd = _el(root, "DataDictionary")
     for j, name in enumerate(spec.input_columns):
         cats = spec.categories[j] if j < len(spec.categories) else None
@@ -254,14 +253,11 @@ def tree_to_pmml(spec, model_name: str = "shifu_tpu_model") -> str:
     _el(dd, "DataField", name="TARGET", optype="categorical",
         dataType="string")
     dd.set("numberOfFields", str(len(spec.input_columns) + 1))
+    return dd
 
-    mm = _el(root, "MiningModel", modelName=model_name,
-             functionName="regression")
-    ms = _el(mm, "MiningSchema")
-    for name in spec.input_columns:
-        _el(ms, "MiningField", name=name, usageType="active")
-    _el(ms, "MiningField", name="TARGET", usageType="target")
 
+def _scaled_output(mm):
+    """RawResult + FinalResult 0..1 -> 0..1000 (golden golf0.pmml Output)."""
     out = _el(mm, "Output")
     _el(out, "OutputField", name="RawResult", optype="continuous",
         dataType="double", feature="predictedValue")
@@ -270,7 +266,13 @@ def tree_to_pmml(spec, model_name: str = "shifu_tpu_model") -> str:
     ncont = _el(fr, "NormContinuous", field="RawResult")
     _el(ncont, "LinearNorm", orig="0.0", norm="0.0")
     _el(ncont, "LinearNorm", orig="1.0", norm="1000.0")
+    return out
 
+
+def _tree_mining_model_element(parent, spec, model_name: str,
+                               with_output: bool = True):
+    """The tree-ensemble MiningModel element itself — embeddable under a
+    PMML root or a one-bagging Segment."""
     hybrid_cols = [
         name for j, name in enumerate(spec.input_columns)
         if (spec.categories[j] if j < len(spec.categories) else None)
@@ -282,6 +284,15 @@ def tree_to_pmml(spec, model_name: str = "shifu_tpu_model") -> str:
             "combined numeric+category bin axis has no faithful single "
             f"PMML predicate; columns: {hybrid_cols}"
         )
+
+    mm = _el(parent, "MiningModel", modelName=model_name,
+             functionName="regression")
+    ms = _el(mm, "MiningSchema")
+    for name in spec.input_columns:
+        _el(ms, "MiningField", name=name, usageType="active")
+    _el(ms, "MiningField", name="TARGET", usageType="target")
+    if with_output:
+        _scaled_output(mm)
 
     is_gbt = spec.algorithm.upper() == "GBT"
     seg = _el(mm, "Segmentation",
@@ -297,7 +308,104 @@ def tree_to_pmml(spec, model_name: str = "shifu_tpu_model") -> str:
         for name in spec.input_columns:
             _el(tms, "MiningField", name=name, usageType="active")
         fold = tree.weight if is_gbt else 1.0
-        _tree_nodes(tree, spec, tm, 0, f"t{k}n", fold)
+        _tree_nodes(tree, spec, tm, 0, f"{model_name}t{k}n", fold)
+    return mm
 
+
+def tree_to_pmml(spec, model_name: str = "shifu_tpu_model") -> str:
+    """TreeModelSpec -> PMML MiningModel with one TreeModel Segment per tree
+    (TreeEnsemblePmmlCreator.convert). GBT folds each tree's weight into its
+    leaf scores and sums segments (exact weighted-sum semantics); RF
+    averages equal-weight segments. Log-loss GBT emits RAW logits — the
+    sigmoid conversion happens scorer-side, like the reference's
+    gbtScoreConvertStrategy."""
+    root = ET.Element("PMML", version="4.2", xmlns=PMML_NS)
+    header = _el(root, "Header", description="shifu-tpu exported tree model")
+    _el(header, "Application", name="shifu-tpu", version="0.1")
+    _tree_data_dictionary(root, spec)
+    _tree_mining_model_element(root, spec, model_name)
+    ET.indent(root)
+    return ET.tostring(root, encoding="unicode", xml_declaration=True)
+
+
+def bagged_to_pmml(specs: List, model_name: str = "shifu_tpu_model") -> str:
+    """One-bagging PMML (ExportModelProcessor.java:173): every bagged model
+    becomes one Segment of a top-level averaging MiningModel, so a single
+    PMML document scores like `shifu eval`'s mean aggregation. NN segments
+    embed full NeuralNetwork elements (with their LocalTransformations,
+    sigmoid outputs included); tree bags embed nested MiningModels.
+
+    Constraints for a SELF-CONTAINED document: all bags must share one
+    model family and column set, and GBT bags must use RAW score
+    conversion — PMML has no sigmoid output transform, so a SIGMOID-
+    converting GBT cannot be averaged faithfully inside the document
+    (score it via `shifu eval` or per-model PMML + scorer-side
+    conversion instead)."""
+    from shifu_tpu.models.nn import NNModelSpec
+    from shifu_tpu.models.tree import TreeModelSpec
+
+    if not specs:
+        raise ValueError("no models to export")
+    first = specs[0]
+    if not isinstance(first, (NNModelSpec, TreeModelSpec)):
+        raise ValueError(
+            "one-bagging PMML needs NATIVE NN/LR/GBT/RF specs; "
+            f"got {type(first).__name__} (convert reference-format models "
+            "with `shifu convert -fromref` semantics first)")
+    same_type = all(isinstance(s, type(first)) for s in specs)
+    if not same_type:
+        raise ValueError(
+            "one-bagging PMML needs a single model family per document "
+            f"(got {sorted({type(s).__name__ for s in specs})})")
+    if isinstance(first, NNModelSpec):
+        cols = [cd["name"] for cd in first.norm_specs]
+        for s in specs[1:]:
+            if [cd["name"] for cd in s.norm_specs] != cols:
+                raise ValueError("one-bagging PMML needs identical input "
+                                 "columns across bags")
+    else:
+        cols = list(first.input_columns)
+        for s in specs[1:]:
+            if list(s.input_columns) != cols:
+                raise ValueError("one-bagging PMML needs identical input "
+                                 "columns across bags")
+        for s in specs:
+            if (s.algorithm.upper() == "GBT"
+                    and (s.loss == "log" or s.convert_to_prob == "SIGMOID")):
+                raise ValueError(
+                    "one-bagging PMML cannot express the GBT sigmoid score "
+                    "conversion inside the document; use squared-loss/RAW "
+                    "GBT, or export per-model PMML and convert scorer-side")
+
+    root = ET.Element("PMML", version="4.2", xmlns=PMML_NS)
+    header = _el(root, "Header",
+                 description="shifu-tpu one-bagging export")
+    _el(header, "Application", name="shifu-tpu", version="0.1")
+
+    if isinstance(first, NNModelSpec):
+        _nn_data_dictionary(root, first)
+        field_names = cols
+    else:
+        _tree_data_dictionary(root, first)
+        field_names = cols
+
+    mm = _el(root, "MiningModel", modelName=model_name,
+             functionName="regression")
+    ms = _el(mm, "MiningSchema")
+    for name in field_names:
+        _el(ms, "MiningField", name=name, usageType="active")
+    _el(ms, "MiningField", name="TARGET", usageType="target")
+    _scaled_output(mm)
+
+    seg = _el(mm, "Segmentation", multipleModelMethod="average")
+    for b, spec in enumerate(specs):
+        segment = _el(seg, "Segment", id=f"bag{b}")
+        _el(segment, "True")
+        if isinstance(spec, NNModelSpec):
+            _nn_model_element(segment, spec, f"{model_name}_bag{b}")
+        else:
+            _tree_mining_model_element(segment, spec,
+                                       f"{model_name}_bag{b}",
+                                       with_output=False)
     ET.indent(root)
     return ET.tostring(root, encoding="unicode", xml_declaration=True)

@@ -101,8 +101,31 @@ class ConvertProcessor(BasicProcessor):
                 blob = tree_spec_to_ref_bytes(spec)
                 out = self.output_path or self.input_path + f".ref{suffix}"
         else:
-            raise ShifuError(ErrorCode.MODEL_NOT_FOUND,
-                             f"cannot export {self.input_path} to reference format")
+            from shifu_tpu.models.wdl import WDLModelSpec
+
+            if isinstance(spec, WDLModelSpec):
+                # BinaryWDLSerializer container: needs ColumnConfig stats
+                # for the embedded NNColumnStats (compat/wdl.py)
+                from shifu_tpu.compat import wdl as cwdl
+
+                try:
+                    self.setup()
+                except Exception:
+                    raise ShifuError(
+                        ErrorCode.INVALID_COLUMN_CONFIG,
+                        "-toref for WDL needs ModelConfig/ColumnConfig in "
+                        "cwd (the container embeds per-column stats)",
+                    )
+                blob = cwdl.write_wdl_model(cwdl.wdl_spec_to_ref(
+                    spec, self.column_configs,
+                    cutoff=self.model_config.normalize.std_dev_cut_off
+                    or 4.0,
+                ))
+                out = self.output_path or self.input_path + ".ref.wdl"
+            else:
+                raise ShifuError(
+                    ErrorCode.MODEL_NOT_FOUND,
+                    f"cannot export {self.input_path} to reference format")
         with open(out, "wb") as fh:
             fh.write(blob)
         log.info("exported %s -> %s (reference %s format)",

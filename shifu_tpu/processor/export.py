@@ -29,6 +29,8 @@ class ExportProcessor(BasicProcessor):
         self.paths.ensure(self.paths.export_dir())
         if self.kind == "pmml":
             self._export_pmml()
+        elif self.kind in ("onebagging", "onebaggingpmml"):
+            self._export_onebagging()
         elif self.kind == "columnstats":
             self._export_columnstats()
         elif self.kind in ("corr", "correlation"):
@@ -65,6 +67,34 @@ class ExportProcessor(BasicProcessor):
             with open(out, "w") as fh:
                 fh.write(xml)
             log.info("PMML -> %s", out)
+
+    def _export_onebagging(self) -> None:
+        """One PMML document averaging every bagged model
+        (ExportModelProcessor.java:173 one-bagging PMML)."""
+        from shifu_tpu.eval.scorer import find_model_paths
+        from shifu_tpu.export.pmml import bagged_to_pmml
+        from shifu_tpu.models.nn import NNModelSpec
+        from shifu_tpu.models.tree import TreeModelSpec
+
+        paths = [p for p in find_model_paths(self.paths.models_dir())
+                 if p.endswith((".nn", ".lr", ".gbt", ".rf"))]
+        if not paths:
+            raise ShifuError(
+                ErrorCode.MODEL_NOT_FOUND,
+                "one-bagging PMML needs NN/LR/GBT/RF models under models/",
+            )
+        # native specs only (reference-format files in models/ would sniff
+        # into adapters the PMML writer cannot embed)
+        specs = [
+            TreeModelSpec.load(p) if p.endswith((".gbt", ".rf"))
+            else NNModelSpec.load(p)
+            for p in paths
+        ]
+        xml = bagged_to_pmml(specs, model_name=self.model_config.basic.name)
+        out = os.path.join(self.paths.export_dir(), "model_onebagging.pmml")
+        with open(out, "w") as fh:
+            fh.write(xml)
+        log.info("one-bagging PMML (%d models) -> %s", len(paths), out)
 
     def _export_columnstats(self) -> None:
         out = os.path.join(self.paths.export_dir(), "columnstats.csv")

@@ -231,3 +231,71 @@ def test_export_processor_writes_tree_pmml(tmp_path):
     assert hits
     xml = open(hits[0]).read()
     assert "MiningModel" in xml and "Segmentation" in xml
+
+
+def test_one_bagging_pmml_trees():
+    """One-bagging export: every bag is a Segment of an averaging
+    MiningModel (ExportModelProcessor.java:173); the independent evaluator
+    must reproduce the bagged MEAN score."""
+    from shifu_tpu.export.pmml import bagged_to_pmml
+    from shifu_tpu.models.tree import traverse_trees
+
+    spec_a, codes, rows = _mixed_spec(seed=1, trees=4)
+    spec_b, _, _ = _mixed_spec(seed=2, trees=4)
+    xml = bagged_to_pmml([spec_a, spec_b])
+    root = ET.fromstring(xml)
+    outer = root.find(f"{NS}MiningModel")
+    seg = outer.find(f"{NS}Segmentation")
+    assert seg.get("multipleModelMethod") == "average"
+    segments = seg.findall(f"{NS}Segment")
+    assert len(segments) == 2
+    # nested MiningModel per bag
+    assert all(s.find(f"{NS}MiningModel") is not None for s in segments)
+
+    # score: average of the two bags' GBT sums
+    import jax.numpy as jnp
+
+    def native(spec):
+        return np.asarray(
+            traverse_trees(spec.trees, jnp.asarray(codes))).sum(axis=1)
+
+    expect = (native(spec_a) + native(spec_b)) / 2.0
+    got = np.zeros(len(rows))
+    for s in segments:
+        inner_mm = s.find(f"{NS}MiningModel")
+        inner_seg = inner_mm.find(f"{NS}Segmentation")
+        part = np.zeros(len(rows))
+        for t in inner_seg.findall(f"{NS}Segment"):
+            tm = t.find(f"{NS}TreeModel")
+            top = tm.find(f"{NS}Node")
+            for i, row in enumerate(rows):
+                part[i] += _eval_tree_node(top, row)
+        got += part
+    got /= len(segments)
+    np.testing.assert_allclose(got, expect, atol=1e-5)
+
+
+def test_one_bagging_pmml_nn_structure():
+    from shifu_tpu.export.pmml import bagged_to_pmml
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+
+    specs = []
+    for seed in (1, 2, 3):
+        params = init_params([3, 4, 1], seed=seed)
+        specs.append(NNModelSpec(
+            layer_sizes=[3, 4, 1], activations=["tanh"],
+            input_columns=["a", "b", "c"],
+            norm_specs=[{"name": n, "kind": "value", "outNames": [n],
+                         "mean": 0.0, "std": 1.0, "fill": 0.0,
+                         "zscore": True} for n in ("a", "b", "c")],
+            params=params,
+        ))
+    xml = bagged_to_pmml(specs)
+    root = ET.fromstring(xml)
+    seg = root.find(f"{NS}MiningModel").find(f"{NS}Segmentation")
+    segments = seg.findall(f"{NS}Segment")
+    assert len(segments) == 3
+    nets = [s.find(f"{NS}NeuralNetwork") for s in segments]
+    assert all(n is not None for n in nets)
+    # each net carries its own LocalTransformations
+    assert all(n.find(f"{NS}LocalTransformations") is not None for n in nets)
