@@ -513,24 +513,58 @@ class EvalProcessor(BasicProcessor):
         neg = ec.neg_tags if ec.neg_tags is not None else mc.data_set.neg_tags
         class_tags = [str(t) for t in list(pos or []) + list(neg or [])]
         K = len(class_tags)
-        df = self._read_scores(ec)
-        df = df[df["tag"] >= 0]
+        score_path = self.paths.eval_score_path(ec.name)
+        if not os.path.isfile(score_path):
+            self._score(ec)
         # exact score-column names only — a scoreMetaColumns echo that
         # happens to start with "model" must not leak into the matrix
         score_re = re.compile(r"^model\d+(_\d+)?$")
-        score_cols = [c for c in df.columns if score_re.match(str(c))]
-        scores = df[score_cols].to_numpy(dtype=np.float64)
-        tags = df["tag"].to_numpy(dtype=np.int64)
-
         priors = self._training_class_priors(K)
-        if priors is None:
-            priors = class_priors(tags, K)
-        if mc.train.is_one_vs_all():
-            pred = predict_one_vs_all(scores, priors,
-                                      scale=DEFAULT_SCORE_SCALE)
+
+        def predict(scores_arr, tags_arr, priors_arr):
+            if mc.train.is_one_vs_all():
+                return predict_one_vs_all(scores_arr, priors_arr,
+                                          scale=DEFAULT_SCORE_SCALE)
+            return predict_native(scores_arr, K)
+
+        from shifu_tpu.data.stream import (
+            chunk_rows_setting,
+            memory_budget_bytes,
+        )
+
+        if os.path.getsize(score_path) > memory_budget_bytes():
+            # K x K accumulation needs no global state beyond the matrix —
+            # stream the score file in chunks (priors must come from the
+            # norm meta; the eval set's own priors are unknowable one
+            # chunk at a time)
+            import pandas as pd
+
+            if priors is None:
+                priors = np.full(K, 1.0 / K)
+                log.warning("streamed multi-class confusion without "
+                            "training classPriors (re-run `shifu norm`); "
+                            "using uniform priors")
+            matrix = np.zeros((K, K), np.int64)
+            for chunk in pd.read_csv(score_path, sep="|",
+                                     chunksize=chunk_rows_setting()):
+                chunk = chunk[chunk["tag"] >= 0]
+                if not len(chunk):
+                    continue
+                cols = [c for c in chunk.columns if score_re.match(str(c))]
+                scores = chunk[cols].to_numpy(dtype=np.float64)
+                tags = chunk["tag"].to_numpy(dtype=np.int64)
+                matrix += confusion_matrix_multi(
+                    tags, predict(scores, tags, priors), K)
         else:
-            pred = predict_native(scores, K)
-        matrix = confusion_matrix_multi(tags, pred, K)
+            df = self._read_scores(ec)
+            df = df[df["tag"] >= 0]
+            score_cols = [c for c in df.columns if score_re.match(str(c))]
+            scores = df[score_cols].to_numpy(dtype=np.float64)
+            tags = df["tag"].to_numpy(dtype=np.int64)
+            if priors is None:
+                priors = class_priors(tags, K)
+            matrix = confusion_matrix_multi(tags, predict(scores, tags,
+                                                          priors), K)
         cm_path = self.paths.eval_confusion_path(ec.name)
         self.paths.ensure(os.path.dirname(cm_path))
         with open(cm_path, "w") as fh:

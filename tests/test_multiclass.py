@@ -310,3 +310,39 @@ def test_rf_native_multiclass_end_to_end(tmp_path):
     eval_acc, m = _accuracy_from_perf(root)
     assert eval_acc > 0.75, eval_acc
     assert m.sum() == 700
+
+
+def test_multiclass_confusion_streams_past_budget(tmp_path):
+    """The K x K confusion accumulates in score-file chunks past the
+    ingest memory budget, matching the in-memory matrix exactly."""
+    import glob
+    import json
+
+    root = str(tmp_path / "ms")
+    make_multiclass_model_set(root, n_rows=500, method="ONEVSALL")
+    from shifu_tpu.config.model_config import ModelConfig
+    from shifu_tpu.utils import environment
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 40
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    _run_pipeline(root)
+    _run_eval(root)
+    perf_file = glob.glob(os.path.join(root, "**", "EvalPerformance.json"),
+                          recursive=True)[0]
+    with open(perf_file) as fh:
+        in_memory = json.load(fh)
+
+    from shifu_tpu.processor.evaluate import EvalProcessor
+
+    environment.set_property("shifu.ingest.memoryBudgetMB", "0")
+    environment.set_property("shifu.ingest.chunkRows", "64")
+    try:
+        assert EvalProcessor(root, confmat_name="Eval1").run() == 0
+    finally:
+        environment.set_property("shifu.ingest.memoryBudgetMB", "512")
+        environment.set_property("shifu.ingest.chunkRows", str(65536))
+    with open(perf_file) as fh:
+        streamed = json.load(fh)
+    assert streamed["confusionMatrix"] == in_memory["confusionMatrix"]
+    assert streamed["accuracy"] == in_memory["accuracy"]
