@@ -32,11 +32,6 @@ def train_tree_models(proc, alg) -> None:
 
     stream = should_stream_training(codes_dir,
                                     force_attr=bool(mc.train.train_on_disk))
-    if (stream and mc.is_multi_classification()
-            and not mc.train.is_one_vs_all()):
-        log.warning("NATIVE multi-class RF is not streamed yet; using the "
-                    "in-memory trainer despite the memory budget")
-        stream = False
     if stream:
         # larger-than-memory: only tags materialize (tiny); the code
         # shards stream per level (train/streaming_tree.py)

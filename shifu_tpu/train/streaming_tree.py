@@ -88,11 +88,9 @@ def train_trees_streamed(
     import jax
     import jax.numpy as jnp
 
-    if cfg.n_classes >= 3:
-        raise ValueError(
-            "NATIVE multi-class RF is not streamed yet — raise "
-            "-Dshifu.train.memoryBudgetMB to use the in-memory trainer"
-        )
+    is_cls = cfg.n_classes >= 3
+    if is_cls and cfg.algorithm == "GBT":
+        raise ValueError("NATIVE multi-class tree training is RF-only")
     feed = CodesFeed(codes_dir)
     F = len(slots)
     lay = make_layout([int(s) for s in slots], [bool(c) for c in is_cat])
@@ -123,6 +121,8 @@ def train_trees_streamed(
             "base_w": jnp.asarray(w.astype(np.float32)),
             "valid": jnp.asarray(valid),
             "pred": jnp.zeros(rows, jnp.float32),
+            "votes": (jnp.zeros((rows, cfg.n_classes), jnp.float32)
+                      if is_cls else None),
         })
         offset += rows
 
@@ -131,6 +131,14 @@ def train_trees_streamed(
         sq = (y - score) ** 2
         v = jnp.sum(jnp.where(valid, sq, 0.0))
         t = jnp.sum(jnp.where(valid, 0.0, sq))
+        return t, v, jnp.sum(valid.astype(jnp.float32))
+
+    @jax.jit
+    def shard_cls_errors(votes, y, valid):
+        pred_class = jnp.argmax(votes, axis=1).astype(jnp.float32)
+        err = (pred_class != y).astype(jnp.float32)
+        v = jnp.sum(jnp.where(valid, err, 0.0))
+        t = jnp.sum(jnp.where(valid, 0.0, err))
         return t, v, jnp.sum(valid.astype(jnp.float32))
 
     trees: List[DenseTree] = []
@@ -263,6 +271,20 @@ def train_trees_streamed(
         drop_off = 0
         for wk, st in zip(work, shard_state):
             tree_pred = leaf_j[wk["resting"]]
+            if is_cls:
+                import jax.nn as jnn
+
+                st["votes"] = st["votes"] + jnn.one_hot(
+                    jnp.clip(tree_pred.astype(jnp.int32), 0,
+                             cfg.n_classes - 1),
+                    cfg.n_classes, dtype=jnp.float32)
+                ts, vs, vc = shard_cls_errors(st["votes"], st["y"],
+                                              st["valid"])
+                t_sum += float(ts)
+                v_sum += float(vs)
+                v_cnt += float(vc)
+                t_cnt += st["rows"] - float(vc)
+                continue
             if is_gbt:
                 if drop_all is not None:
                     keep = jnp.asarray(

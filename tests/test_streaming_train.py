@@ -229,3 +229,38 @@ class TestStreamedTrees:
 
         spec = TreeModelSpec.load(os.path.join(root, "models", "model0.gbt"))
         assert len(spec.trees) == 6
+
+
+def test_streamed_rf_native_multiclass(tmp_path):
+    """NATIVE multi-class RF streams too: per-shard vote accumulation,
+    forest identical to the in-memory trainer."""
+    from shifu_tpu.norm.dataset import write_codes
+    from shifu_tpu.train.streaming_tree import train_trees_streamed
+    from shifu_tpu.train.tree_trainer import TreeTrainConfig, train_trees
+
+    rng = np.random.default_rng(12)
+    n, f, bins, K = 1800, 5, 8, 3
+    codes = rng.integers(0, bins, size=(n, f)).astype(np.int16)
+    y = ((codes[:, 0] >= 5).astype(int)
+         + (codes[:, 1] >= 4).astype(int)).astype(np.int8)
+    w = np.ones(n, np.float32)
+    out = str(tmp_path / "CleanedData")
+    cols = [f"c{i}" for i in range(f)]
+    write_codes(out, codes, y, w, cols, [bins] * f, n_shards=4)
+
+    cfg = TreeTrainConfig(algorithm="RF", tree_num=6, max_depth=4,
+                          impurity="entropy", n_classes=K, seed=8,
+                          min_instances_per_node=2,
+                          feature_subset_strategy="TWOTHIRDS")
+    streamed = train_trees_streamed(out, [bins] * f, [False] * f, cols, cfg)
+    mem = train_trees(codes.astype(np.int32), y.astype(np.float32), w,
+                      [bins] * f, [False] * f, cols, cfg)
+    assert streamed.spec.n_classes == K
+    for ts, tm in zip(streamed.spec.trees, mem.spec.trees):
+        np.testing.assert_array_equal(ts.feature, tm.feature)
+        np.testing.assert_allclose(ts.leaf_value, tm.leaf_value, atol=1e-5)
+    assert streamed.valid_error == pytest.approx(mem.valid_error, abs=1e-6)
+    votes = streamed.spec.independent().compute(codes.astype(np.int32))
+    assert votes.shape == (n, K)
+    acc = float((np.argmax(votes, 1) == y).mean())
+    assert acc > 0.85, acc
