@@ -217,7 +217,7 @@ def train_trees_streamed(
                         la.clip,
                     )
                 for bi, (b0, Lb) in enumerate(ranges):
-                    hist_p = _get_hist_program(Lb, lay.T, lay.s_max,
+                    hist_p = _get_hist_program(Lb, lay,
                                                n_classes=cfg.n_classes)
                     in_batch = (wk["active"] & (wk["node"] >= b0)
                                 & (wk["node"] < b0 + Lb))

@@ -28,7 +28,10 @@ GBT parity (dt/DTWorker.java:1470-1486): tree 0 weight 1.0, later trees
 weight=learningRate; per-tree labels are -loss gradient. RF: per-tree
 Poisson bagging + feature subset (FeatureSubsetStrategy.java). Per-tree
 RNG streams are keyed by (seed, tree_index) so a checkpointed run resumes
-BIT-EQUAL (DTMaster.doCheckPoint:637, recovery :284-291); isContinuous
+BIT-EQUAL under the SAME framework version — resuming a checkpoint
+written by a build with a different histogram lowering may legitimately
+diverge in float-summation order
+(DTMaster.doCheckPoint:637, recovery :284-291); isContinuous
 keeps adding GBT trees up to TreeNum (TrainModelProcessor.java:1166-1184).
 Early stop: simple worsen-count OR the reference's windowed decider
 (dt/DTEarlyStopDecider.java:49) under EnableEarlyStop.
@@ -147,6 +150,7 @@ class FeatureLayout:
     is_cat_t: np.ndarray  # [T] bool
     clip_max: np.ndarray  # [F] slots-1
     s_max: int
+    key: tuple = ()  # static cache key (the make_layout interning key)
 
 
 _LAYOUTS: Dict[tuple, FeatureLayout] = {}
@@ -174,6 +178,7 @@ def make_layout(slots: List[int], is_cat: List[bool]) -> FeatureLayout:
         is_cat_t=np.asarray(is_cat, bool)[seg],
         clip_max=np.maximum(slots_np - 1, 0),
         s_max=int(slots_np.max()) if len(slots) else 1,
+        key=key,
     )
     _LAYOUTS[key] = lay
     return lay
@@ -185,13 +190,62 @@ def make_layout(slots: List[int], is_cat: List[bool]) -> FeatureLayout:
 
 _PROGRAMS: Dict[tuple, object] = {}
 
-# matmul histograms beat XLA's scatter (which serializes on TPU, ~100M
-# updates/s) whenever the padded per-node work L*s_max stays modest — the
-# one-hot contraction rides the MXU instead
-MATMUL_HIST_NODE_CAP = 8192
+# the one-hot contraction's lhs is [blk, C*L]; past this width the matmul's
+# L-fold redundancy stops paying for itself and the scatter path wins
+MATMUL_CL_CAP = 4096
+
+# target lane width of one flat-T chunk (feature one-hots are concatenated
+# at their STATIC column offsets, so a 10k-category feature just spans
+# several chunks instead of inflating every feature to its width)
+_T_CHUNK = 2048
 
 
-def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True,
+def _t_chunks(lay: FeatureLayout, target: int = _T_CHUNK):
+    """Split the flat T axis into chunks of ~`target` columns. Each chunk is
+    a list of (feature, slot_lo, slot_hi) pieces laid out back-to-back; the
+    concatenation of all chunks covers [0, T) in flat-slot order."""
+    chunks: List[list] = []
+    cur: list = []
+    cur_w = 0
+    for f, s in enumerate(int(x) for x in lay.slots):
+        lo = 0
+        while lo < s:
+            take = min(s - lo, target - cur_w)
+            if take == 0:
+                chunks.append(cur)
+                cur, cur_w = [], 0
+                continue
+            cur.append((f, lo, lo + take))
+            cur_w += take
+            lo += take
+            if cur_w >= target:
+                chunks.append(cur)
+                cur, cur_w = [], 0
+    if cur:
+        chunks.append(cur)
+    return chunks
+
+
+def _piece_runs(pieces: list, slots_np: np.ndarray) -> List[list]:
+    """Group a chunk's pieces into runs of CONSECUTIVE full features with
+    equal slot width (vectorizable as one [blk, m, w] one-hot); partial
+    pieces of wide features stay singleton runs."""
+    runs: List[list] = []
+    for piece in pieces:
+        (f, lo, hi) = piece
+        full = lo == 0 and hi == int(slots_np[f])
+        if (runs and full and len(runs[-1])
+                and runs[-1][-1][0] == f - 1
+                and runs[-1][-1][1] == 0
+                and runs[-1][-1][2] == int(slots_np[f - 1])
+                and hi - lo == runs[-1][-1][2] - runs[-1][-1][1]):
+            runs[-1].append(piece)
+        else:
+            runs.append([piece])
+    return runs
+
+
+def _make_hist_fn(L: int, lay: FeatureLayout, allow_matmul: bool = True,
                   n_classes: int = 0):
     """Traced histogram builder: [C, L, T] over the flat per-feature slot
     axis — the Impurity.featureUpdate hot loop (dt/DTWorker.java:851) fused
@@ -199,29 +253,28 @@ def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True,
     sqsum); NATIVE multi-class (n_classes >= 3, RF classification) uses one
     weighted COUNT PLANE PER CLASS (the reference's Entropy/Gini
     featureUpdate keeps per-class counts, dt/Impurity.java:368,553). Under
-    a `data`-sharded mesh each device reduces its row shard and XLA
-    all-reduces the replicated histogram (the psum replacing DTMaster's
-    NodeStats merge, DTMaster.java:297-310).
+    a `data`-sharded mesh each device reduces its row shard and the caller
+    psums the histogram (replacing DTMaster's NodeStats merge,
+    DTMaster.java:297-310).
 
     Two lowerings, chosen statically:
       * matmul (SURVEY §7.5's histogram-kernel obligation, MXU-shaped):
-        one-hot(node)ᵀ @ (one-hot(code) ⊙ component) per feature chunk —
-        f32 operands so counts/sums accumulate exactly;
-      * scatter-add fallback when L*s_max is too wide to pad (one
-        10k-category column must not inflate the contraction)."""
+        (component ⊙ one-hot(node))ᵀ @ one-hot(flat code) per T-chunk.
+        Feature one-hots sit at STATIC column offsets inside each chunk,
+        so the contraction width is always ~_T_CHUNK regardless of how
+        wide any single categorical column is. f32 operands so
+        counts/sums accumulate exactly.
+      * scatter-add fallback when C*L outgrows MATMUL_CL_CAP (the lhs
+        would be wider than the redundancy is worth).
+
+    The returned fn keeps the historical traced-layout signature
+    (off_f/clip_f/seg_t/pos_t) so scatter and matmul are drop-in
+    interchangeable; the matmul path bakes the static layout in."""
     import jax.numpy as jnp
 
     C = n_classes if n_classes >= 3 else 3
-
-    # bound BOTH the padded contraction width (L*s_max) and L itself — the
-    # per-block lhs [blk, C*L] scales with L alone, and deep trees (RF
-    # MaxDepth=10 -> L=1024) would blow past the stats budget even when
-    # every feature is narrow
-    # binary/regression keeps the measured L <= 128 gate (changing it
-    # would alter float summation order and break bit-equal resume against
-    # existing checkpoints); classification bounds the C*L lhs width
-    use_matmul = (allow_matmul and L * s_max <= MATMUL_HIST_NODE_CAP
-                  and (L <= 128 if n_classes < 3 else C * L <= 512))
+    T = lay.T
+    use_matmul = allow_matmul and C * L <= MATMUL_CL_CAP
 
     def comps_of(w, labels):
         if n_classes >= 3:
@@ -246,6 +299,18 @@ def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True,
         ]
         return jnp.stack(planes)
 
+    if not use_matmul:
+        return hist_scatter
+
+    chunks = _t_chunks(lay)
+    slots_np = lay.slots
+    clip_np = lay.clip_max
+    chunk_max = max(sum(hi - lo for _f, lo, hi in ch) for ch in chunks)
+    # bound the per-block working set (A [blk, C*L] + M [blk, chunk]) to
+    # ~32 MB so XLA keeps blocks cache-resident; round to a tile multiple
+    blk_target = (32 << 20) // (4 * max(chunk_max + C * L, 1))
+    BLK = max(256, min(131072, (blk_target // 256) * 256))
+
     def hist_matmul(codes, labels, weights, node_slot, active, off_f,
                     clip_f, seg_t, pos_t):
         import jax
@@ -255,53 +320,119 @@ def _make_hist_fn(L: int, T: int, s_max: int, allow_matmul: bool = True,
         nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
         comps = jnp.stack(comps_of(w, labels), 1)  # [n, C]
 
-        # row blocks bound every materialized one-hot; a lax.scan
-        # accumulates block partials into the [C*L, F, s_max] histogram
-        blk = min(131072, n)
+        blk = min(BLK, n)
         n_pad = -(-n // blk) * blk
         pad = n_pad - n
         codes_p = jnp.pad(codes, ((0, pad), (0, 0)))
         nl_p = jnp.pad(nl, (0, pad))
         comps_p = jnp.pad(comps, ((0, pad), (0, 0)))
-        # feature chunks bound the code one-hot to ~64 MB per block
-        fb = max(1, (64 << 20) // (4 * blk * max(s_max, 1)))
-        srange = jnp.arange(s_max)[None, None, :]
 
         def block(hist, i):
             sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * blk, blk, 0)
-            nl_b = sl(nl_p)
-            oh_node = (nl_b[:, None] == jnp.arange(L)[None, :]).astype(
-                jnp.float32)
-            # [blk, C*L]: component-weighted node one-hot, one matmul lhs
-            A = (sl(comps_p)[:, :, None] * oh_node[:, None, :]).reshape(
-                blk, C * L)
+            comps_b = sl(comps_p)
+            if L == 1:
+                A = comps_b  # [blk, C]
+            else:
+                oh_node = (sl(nl_p)[:, None]
+                           == jnp.arange(L)[None, :]).astype(jnp.float32)
+                A = (comps_b[:, :, None] * oh_node[:, None, :]).reshape(
+                    blk, C * L)
             code_b = sl(codes_p)
             parts = []
-            for f0 in range(0, F, fb):
-                code_c = jnp.clip(code_b[:, f0:f0 + fb], 0,
-                                  clip_f[None, f0:f0 + fb])
-                oh_code = (code_c[:, :, None] == srange).astype(jnp.float32)
-                parts.append(A.T @ oh_code.reshape(blk, -1))  # [C*L, fc*S]
-            contrib = jnp.concatenate(parts, axis=1).reshape(C, L, F, s_max)
+            for pieces in chunks:
+                cols = []
+                for run in _piece_runs(pieces, slots_np):
+                    if len(run) == 1:
+                        (f, lo, hi) = run[0]
+                        cw = hi - lo
+                        cf = jnp.clip(code_b[:, f], 0, int(clip_np[f]))
+                        # for a partial piece of a wide feature the
+                        # equality against the shifted range doubles as
+                        # the bound check
+                        oh = ((cf - lo)[:, None]
+                              == jnp.arange(cw)[None, :])
+                    else:  # consecutive full features of EQUAL width:
+                        # one vectorized [blk, m, w] one-hot keeps the
+                        # trace O(runs), not O(features)
+                        fs = [f for (f, _lo, _hi) in run]
+                        cw = run[0][2]
+                        cf = jnp.clip(code_b[:, fs[0]:fs[-1] + 1], 0,
+                                      cw - 1)
+                        oh = (cf[:, :, None]
+                              == jnp.arange(cw)[None, None, :]).reshape(
+                            blk, len(fs) * cw)
+                    cols.append(oh)
+                M = (cols[0] if len(cols) == 1
+                     else jnp.concatenate(cols, axis=1)).astype(jnp.float32)
+                parts.append(jnp.einsum("nk,nt->kt", A, M))
+            contrib = (parts[0] if len(parts) == 1
+                       else jnp.concatenate(parts, axis=1))  # [C*L, T]
             return hist + contrib, None
 
-        hist0 = jnp.zeros((C, L, F, s_max), jnp.float32)
-        hist_pad, _ = jax.lax.scan(block, hist0,
-                                   jnp.arange(n_pad // blk))
-        return hist_pad[:, :, seg_t, pos_t]  # flat ragged [C, L, T]
+        hist0 = jnp.zeros((C * L, T), jnp.float32)
+        hist, _ = jax.lax.scan(block, hist0, jnp.arange(n_pad // blk))
+        return hist.reshape(C, L, T)
 
-    return hist_matmul if use_matmul else hist_scatter
+    return hist_matmul
 
 
-def _get_hist_program(L: int, T: int, s_max: int,
+def _make_leaf_fn(L: int, n_classes: int = 0):
+    """Final-level aggregation: per-node (cnt, sum) — or per-class counts —
+    WITHOUT building the full [C, L, T] histogram (leaf values only need
+    node totals, so the deepest level skips the per-slot work entirely).
+    Returns the RAW accumulator [C, L] so a meshed caller can psum it
+    before the nonlinear ratio/argmax finalize step."""
+    import jax.numpy as jnp
+
+    def leaf_acc(labels, weights, node_slot, active):
+        import jax
+
+        n = labels.shape[0]
+        w = jnp.where(active, weights, 0.0)
+        nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
+        if n_classes >= 3:
+            cls = jnp.clip(labels.astype(jnp.int32), 0, n_classes - 1)
+            comps = jnp.stack(
+                [w * (cls == c).astype(jnp.float32)
+                 for c in range(n_classes)], 1)
+        else:
+            comps = jnp.stack([w, w * labels], 1)
+        C = comps.shape[1]
+
+        blk = min(131072, n)
+        n_pad = -(-n // blk) * blk
+        pad = n_pad - n
+        nl_p = jnp.pad(nl, (0, pad))
+        comps_p = jnp.pad(comps, ((0, pad), (0, 0)))
+
+        def block(acc, i):
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * blk, blk, 0)
+            oh = (sl(nl_p)[:, None]
+                  == jnp.arange(L)[None, :]).astype(jnp.float32)
+            return acc + jnp.einsum("nc,nl->cl", sl(comps_p), oh), None
+
+        acc0 = jnp.zeros((C, L), jnp.float32)
+        acc, _ = jax.lax.scan(block, acc0, jnp.arange(n_pad // blk))
+        return acc
+
+    def leaf_finalize(acc):
+        if n_classes >= 3:
+            return jnp.argmax(acc, axis=0).astype(jnp.float32)  # majority
+        cnt, s1 = acc[0], acc[1]
+        return s1 / jnp.maximum(cnt, 1e-12)
+
+    return leaf_acc, leaf_finalize
+
+
+def _get_hist_program(L: int, lay: FeatureLayout,
                       allow_matmul: bool = True, n_classes: int = 0):
-    key = ("hist", L, T, s_max, allow_matmul, n_classes)
+    key = ("hist", L, lay.key, allow_matmul, n_classes)
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
     import jax
 
-    prog = jax.jit(_make_hist_fn(L, T, s_max, allow_matmul, n_classes))
+    prog = jax.jit(_make_hist_fn(L, lay, allow_matmul, n_classes))
     _PROGRAMS[key] = prog
     return prog
 
@@ -633,113 +764,140 @@ def _scan_batched(hists, la, lay, cfg, L_level):
             cat(gains), cat(masks), cat(cnts))
 
 
-def _get_tree_program(D: int, T: int, s_max: int, impurity: str,
-                      min_inst: int, min_gain: float,
-                      allow_matmul: bool = True, n_classes: int = 0):
-    """ONE jit program for a whole level-wise tree: every level runs at the
-    padded width L_max = 2^D inside a lax.fori_loop (inactive node slots
-    have empty histograms, so their gain is -inf and they never split).
-    Collapses the per-level dispatch chain (hist, scan, update per depth)
-    into a single device call — on a tunneled/remote TPU the per-dispatch
-    round-trip otherwise dominates tree building wall-clock."""
-    key = ("tree", D, T, s_max, impurity, min_inst, float(min_gain),
-           allow_matmul, n_classes)
+def _mesh_key(mesh) -> Optional[tuple]:
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
+def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
+                      min_inst: int, min_gain: float, n_classes: int = 0,
+                      mesh=None):
+    """ONE jit program for a whole level-wise tree, levels UNROLLED at
+    their exact widths: level d builds a [C, 2^d, T] histogram (≈3.5x less
+    padded-node work than running every level at 2^D) and the final level
+    skips the per-slot histogram entirely (leaf values only need node
+    totals). Collapses the per-level dispatch chain into a single device
+    call — on a tunneled/remote TPU the per-dispatch round-trip otherwise
+    dominates tree building wall-clock.
+
+    With a `mesh` the whole program runs under shard_map: rows stay local
+    per device, each level's histogram is psum'd over the `data` axis (the
+    DTMaster NodeStats merge, DTMaster.java:297-310), and the split scan
+    runs replicated — the BSP master/worker exchange as one SPMD program.
+
+    Signature: prog(codes, labels, weights, feat_ok_t) ->
+    (feat_flat, mask_flat, leaf_flat, resting, row_pred) — the flat arrays
+    ARE the DenseTree layout (level-order concatenation, final level
+    -1/zeros), so host assembly is three contiguous transfers instead of
+    ~3(D+1) per-level ones (each small transfer pays a full tunnel RTT).
+    Static layout arrays are baked in as constants; only the per-tree
+    feature subset stays an argument."""
+    key = ("tree", D, lay.key, impurity, min_inst, float(min_gain),
+           n_classes, _mesh_key(mesh))
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
     import jax
     import jax.numpy as jnp
 
-    L = 2**D
+    T, s_max = lay.T, lay.s_max
     min_inst_eff = max(min_inst, 1)
-    hist_fn = _make_hist_fn(L, T, s_max, allow_matmul, n_classes)
+    hist_fns = [_make_hist_fn(2**d, lay, n_classes=n_classes)
+                for d in range(D)]
+    scan_fns = [_get_scan_program(2**d, T, s_max, impurity, min_inst_eff,
+                                  min_gain, n_classes) for d in range(D)]
+    leaf_acc, leaf_finalize = _make_leaf_fn(2**D, n_classes)
 
-    def hist_of(codes, labels, weights, node_local, active, off_f, clip_f,
-                seg_t, pos_t):
-        return hist_fn(codes, labels, weights, node_local, active, off_f,
-                       clip_f, seg_t, pos_t)
+    # static layout constants (closed over; jit hoists them once)
+    off_c = jnp.asarray(lay.off)
+    clip_c = jnp.asarray(lay.clip_max)
+    is_cat_c = jnp.asarray(lay.is_cat_t)
+    seg_c = jnp.asarray(lay.seg_of_t)
+    pos_c = jnp.asarray(lay.pos_in_seg)
+    start_c = jnp.asarray(lay.seg_start_t)
+    size_c = jnp.asarray(lay.seg_size_t)
+    seg0 = int(lay.slots[0]) if len(lay.slots) else 1
+    on_mesh = mesh is not None
 
-    def scan_of(hist, la_tuple):
-        (feat_ok_t, is_cat_t, seg_t, pos_t, start_t, size_t, off_f, clip_f,
-         seg0_size) = la_tuple
-        scan = _get_scan_program(L, T, s_max, impurity, min_inst_eff,
-                                 min_gain, n_classes)
-        return scan(hist, feat_ok_t, is_cat_t, seg_t, pos_t, start_t,
-                    size_t, off_f, clip_f, seg0_size)
-
-    @jax.jit
-    def tree_program(codes, labels, weights, off_f, clip_f, feat_ok_t,
-                     is_cat_t, seg_t, pos_t, start_t, size_t, seg0_size):
+    def tree_body(codes, labels, weights, feat_ok_t):
         n = codes.shape[0]
-        node_local = jnp.zeros(n, jnp.int32)
+        node = jnp.zeros(n, jnp.int32)
         active = jnp.ones(n, bool)
         resting = jnp.zeros(n, jnp.int32)
-        feats = jnp.full((D + 1, L), -1, jnp.int32)
-        masks = jnp.zeros((D + 1, L, s_max), bool)
-        leaves = jnp.zeros((D + 1, L), jnp.float32)
-        la_tuple = (feat_ok_t, is_cat_t, seg_t, pos_t, start_t, size_t,
-                    off_f, clip_f, seg0_size)
-
-        def level_body(d, carry):
-            node_local, active, resting, feats, masks, leaves = carry
-            hist = hist_of(codes, labels, weights, node_local, active,
-                           off_f, clip_f, seg_t, pos_t)
-            (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = scan_of(
-                hist, la_tuple)
-            level_width = jnp.left_shift(1, d)
-            in_level = jnp.arange(L) < level_width
-            is_split = is_split & in_level
-            base = level_width - 1
-            nl = jnp.clip(node_local, 0, L - 1)
+        feats_l, masks_l, leaves_l = [], [], []
+        for d in range(D):
+            L = 2**d
+            hist = hist_fns[d](codes, labels, weights, node, active,
+                               off_c, clip_c, seg_c, pos_c)
+            if on_mesh:
+                hist = jax.lax.psum(hist, "data")
+            (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = scan_fns[d](
+                hist, feat_ok_t, is_cat_c, seg_c, pos_c, start_c, size_c,
+                off_c, clip_c, seg0)
+            base = L - 1
+            nl = jnp.clip(node, 0, L - 1)
             settled = active & ~is_split[nl]
             resting = jnp.where(settled, base + nl, resting)
             f = jnp.where(is_split, bf, 0)[nl]
             code = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
-            cf = off_f[f] + jnp.clip(code, 0, clip_f[f])
+            cf = off_c[f] + jnp.clip(code, 0, clip_c[f])
             goes_left = rank_flat[nl, cf] <= br[nl]
-            new_local = jnp.where(goes_left, 2 * nl, 2 * nl + 1)
             still = is_split[nl] & active
-            feats = feats.at[d].set(jnp.where(is_split, bf, -1))
-            masks = masks.at[d].set(lm & in_level[:, None])
-            leaves = leaves.at[d].set(lv)
-            return (jnp.where(still, new_local, 0), still, resting, feats,
-                    masks, leaves)
+            node = jnp.where(still, jnp.where(goes_left, 2 * nl, 2 * nl + 1),
+                             0)
+            active = still
+            feats_l.append(jnp.where(is_split, bf, -1))
+            masks_l.append(lm)
+            leaves_l.append(lv)
 
-        carry = (node_local, active, resting, feats, masks, leaves)
-        (node_local, active, resting, feats, masks, leaves) = jax.lax.fori_loop(
-            0, D, level_body, carry)
-
-        # final level: leaf values only + settle leftovers
-        hist = hist_of(codes, labels, weights, node_local, active, off_f,
-                       clip_f, seg_t, pos_t)
-        (_bf, _br, _rf, lv2, _sp, _g, _lm, _nc) = scan_of(hist, la_tuple)
-        leaves = leaves.at[D].set(lv2)
-        resting = jnp.where(active, (L - 1) + node_local, resting)
-        # per-row leaf prediction computed in-program (dense node ids index
-        # the concatenated level-leaf vector), so callers never need a
-        # host round-trip between trees
-        leaf_flat = jnp.concatenate(
-            [leaves[d][: 2**d] for d in range(D + 1)])
+        # final level: node totals only (no per-slot histogram)
+        L2 = 2**D
+        acc = leaf_acc(labels, weights, node, active)
+        if on_mesh:
+            acc = jax.lax.psum(acc, "data")
+        leaves_l.append(leaf_finalize(acc))
+        resting = jnp.where(active, (L2 - 1) + node, resting)
+        feat_flat = jnp.concatenate(
+            feats_l + [jnp.full(L2, -1, jnp.int32)])
+        mask_flat = jnp.concatenate(
+            masks_l + [jnp.zeros((L2, s_max), bool)], axis=0)
+        leaf_flat = jnp.concatenate(leaves_l)
         row_pred = leaf_flat[resting]
-        return feats, masks, leaves, resting, row_pred
+        return feat_flat, mask_flat, leaf_flat, resting, row_pred
 
-    _PROGRAMS[key] = tree_program
-    return tree_program
+    if on_mesh:
+        from jax.sharding import PartitionSpec as P
+
+        specs = dict(
+            mesh=mesh,
+            in_specs=(P("data"), P("data"), P("data"), P()),
+            out_specs=(P(), P(), P(), P("data"), P("data")),
+        )
+        try:
+            from jax import shard_map
+
+            body = shard_map(tree_body, check_vma=False, **specs)
+        except ImportError:  # older jax spells the replication check flag
+            from jax.experimental.shard_map import shard_map
+
+            body = shard_map(tree_body, check_rep=False, **specs)
+        prog = jax.jit(body)
+    else:
+        prog = jax.jit(tree_body)
+    _PROGRAMS[key] = prog
+    return prog
 
 
-def _assemble_dense_tree(feats, masks, leaves, D: int) -> DenseTree:
-    """Host assembly: level d contributes its first 2^d padded slots."""
-    f_parts, m_parts, l_parts = [], [], []
-    for d in range(D + 1):
-        w = 2**d
-        f_parts.append(np.asarray(feats[d][:w], np.int32) if d < D
-                       else np.full(w, -1, np.int32))
-        m_parts.append(np.asarray(masks[d][:w], bool))
-        l_parts.append(np.asarray(leaves[d][:w], np.float32))
+def _assemble_dense_tree(feat_flat, mask_flat, leaf_flat,
+                         D: int) -> DenseTree:
+    """Host assembly: the program's flat arrays already ARE the DenseTree
+    level-order layout."""
     return DenseTree(
-        feature=np.concatenate(f_parts),
-        left_mask=np.concatenate(m_parts, axis=0),
-        leaf_value=np.concatenate(l_parts),
+        feature=np.asarray(feat_flat, np.int32),
+        left_mask=np.asarray(mask_flat, bool),
+        leaf_value=np.asarray(leaf_flat, np.float32),
         weight=1.0,
     )
 
@@ -773,28 +931,29 @@ def build_tree(
         from shifu_tpu.parallel.mesh import replicate, shard_rows
 
         replicate_fn = lambda a: replicate(a, mesh)  # noqa: E731
-    la = _device_layout(lay, feat_ok, replicate_fn)
 
     # fused single-dispatch path: whole tree in ONE jit call when the
     # full-width [3, 2^D, T] histogram fits the stats-memory budget —
     # collapses ~3 dispatches/level into 1/tree (tunnel latency dominates
-    # per-level dispatch chains on remote TPU links)
+    # per-level dispatch chains on remote TPU links). The program bakes
+    # the layout in; only the feature-subset mask transfers.
     if 2**D <= batch_cap:
-        prog = _get_tree_program(D, lay.T, lay.s_max, cfg.impurity,
+        prog = _get_tree_program(D, lay, cfg.impurity,
                                  cfg.min_instances_per_node,
                                  cfg.min_info_gain,
-                                 allow_matmul=mesh is None,
-                                 n_classes=cfg.n_classes)
+                                 n_classes=cfg.n_classes, mesh=mesh)
+        fot = jnp.asarray(np.asarray(feat_ok, bool)[lay.seg_of_t])
+        if replicate_fn is not None:
+            fot = replicate_fn(fot)
         feats_d, masks_d, leaves_d, resting, _row_pred = prog(
-            codes, labels, weights, la.off, la.clip, la.feat_ok_t,
-            la.is_cat_t, la.seg_t, la.pos_t, la.start_t, la.size_t,
-            la.seg0_size,
-        )
+            codes, labels, weights, fot)
         import jax
 
         feats_h, masks_h, leaves_h = jax.device_get(
             (feats_d, masks_d, leaves_d))
         return _assemble_dense_tree(feats_h, masks_h, leaves_h, D), resting
+
+    la = _device_layout(lay, feat_ok, replicate_fn)
 
     if mesh is not None:
         from shifu_tpu.parallel.mesh import shard_rows
@@ -815,7 +974,7 @@ def build_tree(
         def hist_batches():
             for b0 in range(0, L, batch_cap):
                 Lb = min(batch_cap, L - b0)
-                hist_p = _get_hist_program(Lb, lay.T, lay.s_max,
+                hist_p = _get_hist_program(Lb, lay,
                                            allow_matmul=mesh is None,
                                            n_classes=cfg.n_classes)
                 in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
@@ -842,7 +1001,7 @@ def build_tree(
     def hist_batches_final():
         for b0 in range(0, L2, batch_cap):
             Lb = min(batch_cap, L2 - b0)
-            hist_p = _get_hist_program(Lb, lay.T, lay.s_max,
+            hist_p = _get_hist_program(Lb, lay,
                                        allow_matmul=mesh is None,
                                        n_classes=cfg.n_classes)
             in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
@@ -909,8 +1068,7 @@ def build_tree_leafwise(
     # candidate splits per leaf: id -> (gain, feat, cut_rank, rank_row, mask)
     candidates: Dict[int, tuple] = {}
 
-    hist1 = _get_hist_program(1, lay.T, lay.s_max,
-                              n_classes=cfg.n_classes)
+    hist1 = _get_hist_program(1, lay, n_classes=cfg.n_classes)
     scan1 = _get_scan_program(1, lay.T, lay.s_max, cfg.impurity,
                               cfg.min_instances_per_node, cfg.min_info_gain,
                               cfg.n_classes)
@@ -1073,6 +1231,56 @@ class TreeTrainResult:
     valid_error: float
 
 
+def _get_errors_program():
+    """Cached (score, y, valid_mask, real) -> (train_err, valid_err) —
+    defined at module level so repeated train_trees calls reuse ONE
+    compiled program instead of re-jitting a fresh closure per run."""
+    key = ("errors",)
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def errors_of(score, y, vm, real):
+        sq = (y - score) ** 2
+        vsel = vm & real
+        tsel = (~vm) & real
+        v = jnp.sum(jnp.where(vsel, sq, 0.0)) / jnp.maximum(
+            jnp.sum(vsel), 1.0)
+        t = jnp.sum(jnp.where(tsel, sq, 0.0)) / jnp.maximum(
+            jnp.sum(tsel), 1.0)
+        return t, v
+
+    _PROGRAMS[key] = errors_of
+    return errors_of
+
+
+def _get_cls_errors_program():
+    key = ("cls_errors",)
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def cls_errors_of(votes, y, vm, real):
+        pred_class = jnp.argmax(votes, axis=1).astype(jnp.float32)
+        err = (pred_class != y).astype(jnp.float32)
+        vsel = vm & real
+        tsel = (~vm) & real
+        v = (jnp.sum(jnp.where(vsel, err, 0.0))
+             / jnp.maximum(jnp.sum(vsel), 1.0))
+        t = (jnp.sum(jnp.where(tsel, err, 0.0))
+             / jnp.maximum(jnp.sum(tsel), 1.0))
+        return t, v
+
+    _PROGRAMS[key] = cls_errors_of
+    return cls_errors_of
+
+
 def _score_existing(trees: List[DenseTree], codes) -> "object":
     """Raw GBT prediction F(x) of an existing forest (continuous-training
     recovery: DTWorker.recoverGBTData:1452 re-derives predict state)."""
@@ -1088,13 +1296,20 @@ def _score_existing(trees: List[DenseTree], codes) -> "object":
 
 def _assemble_deferred(trees: List, deferred: List[tuple],
                        cfg: TreeTrainConfig) -> None:
-    """Materialize fused-path trees from their device results (one host
-    transfer for the whole backlog)."""
+    """Materialize fused-path trees from their device results. The backlog
+    is stacked on device first so the host pull is THREE contiguous
+    transfers total, not three per tree (small transfers pay a full tunnel
+    RTT each on remote TPU links)."""
     import jax
+    import jax.numpy as jnp
 
-    host = jax.device_get([(f, m, lv) for _k, _w, f, m, lv in deferred])
-    for (k, weight_k, _f, _m, _lv), (fh, mh, lh) in zip(deferred, host):
-        tree = _assemble_dense_tree(fh, mh, lh, cfg.max_depth)
+    f_all = jnp.stack([f for _k, _w, f, _m, _lv in deferred])
+    m_all = jnp.stack([m for _k, _w, _f, m, _lv in deferred])
+    l_all = jnp.stack([lv for _k, _w, _f, _m, lv in deferred])
+    fh_all, mh_all, lh_all = jax.device_get((f_all, m_all, l_all))
+    for i, (k, weight_k, _f, _m, _lv) in enumerate(deferred):
+        tree = _assemble_dense_tree(fh_all[i], mh_all[i], lh_all[i],
+                                    cfg.max_depth)
         tree.weight = weight_k
         trees[k] = tree  # trees list is indexed by global tree id
     deferred.clear()
@@ -1137,14 +1352,15 @@ def train_trees(
     # therefore every tree) is identical with and without a mesh
     valid_mask = np.random.default_rng([cfg.seed, 999_983]).random(n) \
         < cfg.valid_set_rate
-    codes_np = codes.astype(np.int32)
-    y_np = tags.astype(np.float32)
-    base_w_np = np.where(valid_mask, 0.0, weights).astype(np.float32)
-    real_np = np.ones(n, dtype=bool)
     if mesh is not None:
         from shifu_tpu.parallel.mesh import pad_rows, shard_rows
 
         row_put = lambda a: shard_rows(a, mesh)  # noqa: E731
+        codes_np = np.asarray(codes, np.int32)
+        y_np = np.asarray(tags, np.float32)
+        base_w_np = np.where(valid_mask, 0.0,
+                             np.asarray(weights)).astype(np.float32)
+        real_np = np.ones(n, dtype=bool)
         n_dev = mesh.devices.size
         (codes_np, y_np, base_w_np, valid_mask, real_np), _ = pad_rows(
             [codes_np, y_np, base_w_np, valid_mask, real_np], n_dev
@@ -1156,12 +1372,20 @@ def train_trees(
         base_w_j = shard_rows(base_w_np, mesh)
         real_j = shard_rows(real_np, mesh)
     else:
+        # device-resident inputs stay on device (a tunneled TPU pays
+        # ~13 MB/s for every host<->device byte; the code matrix is the
+        # big one and may already live in HBM from a previous run)
         row_put = jnp.asarray
-        codes_j = jnp.asarray(codes_np)
-        y_j = jnp.asarray(y_np)
+        codes_j = (codes.astype(jnp.int32) if isinstance(codes, jax.Array)
+                   else jnp.asarray(np.asarray(codes, np.int32)))
+        y_j = (tags.astype(jnp.float32) if isinstance(tags, jax.Array)
+               else jnp.asarray(np.asarray(tags, np.float32)))
+        w_j = (weights.astype(jnp.float32)
+               if isinstance(weights, jax.Array)
+               else jnp.asarray(np.asarray(weights, np.float32)))
         vm_j = jnp.asarray(valid_mask)
-        base_w_j = jnp.asarray(base_w_np)
-        real_j = jnp.asarray(real_np)
+        base_w_j = jnp.where(vm_j, 0.0, w_j)
+        real_j = jnp.ones(n, dtype=bool)
     slots_np = np.asarray(slots, dtype=np.int32)
     is_cat_np = np.asarray(is_cat, dtype=bool)
 
@@ -1176,14 +1400,8 @@ def train_trees(
     is_gbt = cfg.algorithm == "GBT"
     log_loss = cfg.loss == "log"
 
-    @jax.jit
-    def errors_of(score):
-        sq = (y_j - score) ** 2
-        vsel = vm_j & real_j
-        tsel = (~vm_j) & real_j
-        v = jnp.sum(jnp.where(vsel, sq, 0.0)) / jnp.maximum(jnp.sum(vsel), 1.0)
-        t = jnp.sum(jnp.where(tsel, sq, 0.0)) / jnp.maximum(jnp.sum(tsel), 1.0)
-        return t, v
+    reg_err = _get_errors_program()
+    errors_of = lambda score: reg_err(score, y_j, vm_j, real_j)  # noqa: E731
 
     is_cls = cfg.n_classes >= 3
     if is_cls and is_gbt:
@@ -1193,17 +1411,9 @@ def train_trees(
             "TrainModelProcessor.java:341-349)"
         )
     if is_cls:
-        @jax.jit
-        def cls_errors_of(votes):
-            pred_class = jnp.argmax(votes, axis=1).astype(jnp.float32)
-            err = (pred_class != y_j).astype(jnp.float32)
-            vsel = vm_j & real_j
-            tsel = (~vm_j) & real_j
-            v = (jnp.sum(jnp.where(vsel, err, 0.0))
-                 / jnp.maximum(jnp.sum(vsel), 1.0))
-            t = (jnp.sum(jnp.where(tsel, err, 0.0))
-                 / jnp.maximum(jnp.sum(tsel), 1.0))
-            return t, v
+        c_err = _get_cls_errors_program()
+        cls_errors_of = lambda votes: c_err(  # noqa: E731
+            votes, y_j, vm_j, real_j)
 
     # prediction state re-derived from loaded trees on resume (the workers'
     # recoverGBTData analog): GBT keeps the raw sum F(x), RF the running
@@ -1214,7 +1424,7 @@ def train_trees(
             from shifu_tpu.models.tree import traverse_trees
 
             per_tree = np.asarray(
-                traverse_trees(trees, jnp.asarray(codes_np)))  # [n, k] class
+                traverse_trees(trees, codes_j))  # [n, k] class
             votes_np = np.zeros((n, cfg.n_classes), np.float32)
             for col in range(per_tree.shape[1]):
                 cls_idx = np.clip(per_tree[:, col].astype(np.int64), 0,
@@ -1231,7 +1441,7 @@ def train_trees(
             from shifu_tpu.models.tree import traverse_trees
 
             per_tree = np.asarray(
-                traverse_trees(trees, jnp.asarray(codes_np)))  # [n, k]
+                traverse_trees(trees, codes_j))  # [n, k]
             s = np.zeros(n, np.float32)
             for col in range(per_tree.shape[1]):
                 contrib = per_tree[:, col]  # weight folded by traverse
@@ -1243,7 +1453,7 @@ def train_trees(
                     contrib = contrib * keep
                 s += contrib
         else:
-            s = np.asarray(_score_existing(trees, jnp.asarray(codes_np)))
+            s = np.asarray(_score_existing(trees, codes_j))
         pred = row_put((s if is_gbt else s / start_k).astype(np.float32))
     else:
         pred = row_put(jnp.zeros(n, dtype=jnp.float32))
@@ -1273,7 +1483,6 @@ def train_trees(
     batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
                                  cfg.n_classes)
     fused = (not leaf_wise) and 2**cfg.max_depth <= batch_cap
-    la = None
     if fused:
         replicate_fn = None
         if mesh is not None:
@@ -1281,12 +1490,20 @@ def train_trees(
 
             replicate_fn = lambda a: replicate(a, mesh)  # noqa: E731
         tree_prog = _get_tree_program(
-            cfg.max_depth, lay.T, lay.s_max, cfg.impurity,
+            cfg.max_depth, lay, cfg.impurity,
             cfg.min_instances_per_node, cfg.min_info_gain,
-            allow_matmul=mesh is None, n_classes=cfg.n_classes,
+            n_classes=cfg.n_classes, mesh=mesh,
         )
     deferred: List[tuple] = []  # (k, weight, feats_d, masks_d, leaves_d)
     err_pairs: List[tuple] = []  # device (train, valid) when deferred
+
+    # the ALL-features mask never changes: transfer it once instead of per
+    # tree (each tiny host->device put costs a full tunnel RTT)
+    fot_all_features = None
+    if fused and k_sub >= F:
+        fot_all_features = jnp.asarray(np.ones(lay.T, dtype=bool))
+        if replicate_fn is not None:
+            fot_all_features = replicate_fn(fot_all_features)
 
     for k in range(start_k, cfg.tree_num):
         # per-tree RNG stream: keyed by tree index, NOT a shared sequential
@@ -1320,16 +1537,14 @@ def train_trees(
             )
             tree_pred = jnp.asarray(tree.leaf_value)[resting]
         elif fused:
-            if la is None:
-                la = _device_layout(lay, feat_ok, replicate_fn)
-            else:  # only feat_ok changes per tree
+            if fot_all_features is not None:
+                fot = fot_all_features
+            else:
                 fot = jnp.asarray(np.asarray(feat_ok, bool)[lay.seg_of_t])
-                la.feat_ok_t = (replicate_fn(fot) if replicate_fn else fot)
+                if replicate_fn is not None:
+                    fot = replicate_fn(fot)
             feats_d, masks_d, leaves_d, _resting, tree_pred = tree_prog(
-                codes_j, labels_k, w_k, la.off, la.clip, la.feat_ok_t,
-                la.is_cat_t, la.seg_t, la.pos_t, la.start_t, la.size_t,
-                la.seg0_size,
-            )
+                codes_j, labels_k, w_k, fot)
             deferred.append(
                 (k, 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0),
                  feats_d, masks_d, leaves_d))
@@ -1404,7 +1619,8 @@ def train_trees(
     if deferred:
         _assemble_deferred(trees, deferred, cfg)
     if err_pairs:  # deferred error sync: one host transfer for the run
-        host = jax.device_get(err_pairs)
+        host = np.asarray(jax.device_get(
+            jnp.stack([jnp.stack(p) for p in err_pairs])))
         errs = [(float(t), float(v)) for t, v in host]
         terr, verr = errs[-1]
         j = 0
