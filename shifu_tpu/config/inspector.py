@@ -133,6 +133,15 @@ def _check_train(mc: ModelConfig, result: ValidateResult) -> None:
         depth = tr.get_param("MaxDepth", 10)
         if not (1 <= int(depth) <= 20):
             result.fail(f"tree MaxDepth must be in [1, 20], got {depth}")
+    if tr.algorithm == Algorithm.SVM:
+        # the TPU build trains the liblinear path: L2-regularized hinge,
+        # Const -> C (core/alg/SVMTrainer.java:38); kernel SVMs are not
+        # implemented — fail at validation, not silently mid-train
+        kernel = str(tr.get_param("Kernel", "linear") or "linear").lower()
+        if kernel != "linear":
+            result.fail(
+                f"SVM Kernel={kernel!r} unsupported (linear only); "
+                "use Kernel=linear or algorithm=NN")
 
 
 def _check_evals(mc: ModelConfig, result: ValidateResult, base_dir: str) -> None:

@@ -88,7 +88,11 @@ class TrainProcessor(BasicProcessor):
 
         if should_stream_training(norm_dir,
                                   force_attr=bool(mc.train.train_on_disk)):
-            self._train_nn_streamed(alg, norm_dir, norm_json, suffix)
+            # spill composes with the mesh: shards stream row-sharded and
+            # XLA all-reduces each shard gradient (the reference spills
+            # inside every distributed worker, AbstractNNWorker.java:485)
+            self._train_nn_streamed(alg, norm_dir, norm_json, suffix,
+                                    mesh=self._mesh())
             return
 
         meta, feats, tags, weights = load_normalized(norm_dir)
@@ -194,13 +198,16 @@ class TrainProcessor(BasicProcessor):
             fh.write(f"{result.valid_error}\n")
         log.info("model 0 -> %s (valid err %.6f)", path, result.valid_error)
 
-    def _train_nn_streamed(self, alg, norm_dir, norm_json, suffix) -> None:
+    def _train_nn_streamed(self, alg, norm_dir, norm_json, suffix,
+                           mesh=None) -> None:
         """Larger-than-memory path: the normalized matrix never concatenates
         into one host array; members stream the mmap'd shards through a
         double-buffered device feed (train/streaming.py; the reference's
         MemoryDiskFloatMLDataSet disk-spill analog). Bagging members /
-        one-vs-all classes run serially — each full run is itself one
-        chip-saturating program."""
+        one-vs-all classes / grid trials / folds run serially — each full
+        run is itself one chip-saturating program (the reference fans them
+        out as Guagua jobs over data of any size,
+        TrainModelProcessor.java:768-945)."""
         from shifu_tpu.train.grid_search import flatten_params
         from shifu_tpu.train.nn_trainer import NNTrainConfig
         from shifu_tpu.train.streaming import train_nn_streamed
@@ -211,13 +218,27 @@ class TrainProcessor(BasicProcessor):
             self.resolve(mc.train.grid_config_file)
             if mc.train.grid_config_file else None,
         )
-        if len(composites) > 1 or (mc.train.num_k_fold or -1) > 0:
-            raise ShifuError(
-                ErrorCode.INVALID_MODEL_CONFIG,
-                "grid search / k-fold need the in-memory trainer; raise "
-                "-Dshifu.train.memoryBudgetMB or disable train.trainOnDisk",
-            )
         multi = mc.is_multi_classification()
+        is_ova = multi and mc.train.is_one_vs_all()
+        if len(composites) > 1:
+            if is_ova:  # same rule as the in-memory path
+                raise ShifuError(
+                    ErrorCode.INVALID_MODEL_CONFIG,
+                    "grid search is not supported with ONEVSALL "
+                    "multi-class; pick one hyperparameter set",
+                )
+            best = self._grid_search_streamed(norm_dir, composites, mesh)
+            log.info("streamed grid search best params: %s", best)
+            mc.train.params = best
+        num_kfold = mc.train.num_k_fold or -1
+        if num_kfold > 0:
+            if is_ova:
+                log.warning("num_k_fold is ignored under ONEVSALL "
+                            "multi-class (one model per class)")
+            else:
+                self._k_fold_streamed(alg, num_kfold, norm_dir, norm_json,
+                                      suffix, mesh)
+                return
         ova = multi and mc.train.is_one_vs_all()
         class_tags = [str(t) for t in mc.tags()] if multi else None
         n_members = (len(class_tags) if ova
@@ -237,7 +258,8 @@ class TrainProcessor(BasicProcessor):
             init_flat = (self._continuous_init(i, suffix)
                          if mc.train.is_continuous else None)
             res = train_nn_streamed(norm_dir, cfg, init_flat=init_flat,
-                                    target_class=i if ova else None)
+                                    target_class=i if ova else None,
+                                    mesh=mesh)
             spec = self._make_spec(alg, cfg, res, meta_cols, norm_json,
                                    class_tags=class_tags)
             path = self.paths.model_path(i, suffix)
@@ -246,6 +268,68 @@ class TrainProcessor(BasicProcessor):
                 fh.write(f"{res.valid_error}\n")
             log.info("streamed model %d -> %s (valid err %.6f)", i, path,
                      res.valid_error)
+
+    def _grid_search_streamed(self, norm_dir, composites, mesh) -> dict:
+        """Serial grid trials over the streamed trainer — each trial is a
+        full shard-streamed run (an error here was a parity subtraction:
+        the reference fans trials out as Guagua jobs over data of any
+        size, TrainModelProcessor.java:768-945)."""
+        from shifu_tpu.train.nn_trainer import NNTrainConfig
+        from shifu_tpu.train.streaming import train_nn_streamed
+
+        mc = self.model_config
+        orig = mc.train.params
+        results = []
+        for gi, params in enumerate(composites):
+            mc.train.params = params
+            try:
+                cfg = NNTrainConfig.from_model_config(mc, trainer_id=gi)
+            finally:
+                mc.train.params = orig
+            res = train_nn_streamed(norm_dir, cfg, mesh=mesh)
+            results.append((res.valid_error, gi, params))
+            log.info("streamed grid trial %d/%d valid err %.6f params=%s",
+                     gi + 1, len(composites), res.valid_error, params)
+        results.sort(key=lambda r: r[0])
+        return results[0][2]
+
+    def _k_fold_streamed(self, alg, k, norm_dir, norm_json, suffix,
+                         mesh) -> None:
+        """Streamed k-fold: fold membership is global-row-index % k (same
+        fold geometry as the in-memory path), carried into each shard via
+        ShardFeed's sig_override; folds run serially."""
+        from shifu_tpu.train.nn_trainer import NNTrainConfig
+        from shifu_tpu.train.streaming import train_nn_streamed
+
+        mc = self.model_config
+        meta_cols = self._norm_meta_columns()
+        errors = []
+        for i in range(k):
+            cfg = NNTrainConfig.from_model_config(mc, trainer_id=i)
+            cfg.valid_set_rate = 0.0  # the fold drives the split
+            cfg.early_stop_window = 0
+
+            def sig_override(s, rows, offset, w, _i=i, _cfg=cfg):
+                idx = np.arange(offset, offset + rows)
+                fold = idx % k
+                rng = np.random.default_rng(_i * 1000 + 7 + s)
+                if _cfg.bagging_with_replacement:
+                    bag = rng.poisson(_cfg.bagging_sample_rate, size=rows)
+                else:
+                    bag = rng.random(rows) < _cfg.bagging_sample_rate
+                sig_t = np.where(fold == _i, 0.0, w * bag)
+                sig_v = np.where(fold == _i, w, 0.0)
+                return sig_t, sig_v
+
+            res = train_nn_streamed(norm_dir, cfg, mesh=mesh,
+                                    sig_override=sig_override)
+            spec = self._make_spec(alg, cfg, res, meta_cols, norm_json)
+            spec.save(self.paths.model_path(i, suffix))
+            errors.append(res.valid_error)
+            log.info("streamed fold %d/%d holdout err %.6f", i + 1, k,
+                     res.valid_error)
+        log.info("streamed k-fold avg validation error: %.6f",
+                 float(np.mean(errors)))
 
     def _train_one_vs_all(self, alg, feats, tags, weights, mesh, norm_json,
                           suffix) -> None:

@@ -244,7 +244,7 @@ def train_tree_models(proc, alg) -> None:
                 tags_override=(one_vs_all_tags[i]
                                if one_vs_all_tags is not None else None),
                 boundaries=boundaries, categories=categories,
-                progress_cb=progress,
+                progress_cb=progress, mesh=mesh,
             )
         else:
             result = train_trees(

@@ -301,9 +301,15 @@ def _train_wdl_streamed(proc) -> None:
     proc.paths.ensure(proc.paths.models_dir())
     proc.paths.ensure(proc.paths.train_dir())
     bagging = max(1, int(mc.train.bagging_num or 1))
-    log.info("WDL training STREAMED from %s + %s (%d member(s)); runs "
-             "single-device — tensor-parallel embedding sharding needs the "
-             "in-memory trainer", norm_dir, codes_dir, bagging)
+    import jax
+
+    from shifu_tpu.parallel.mesh import data_mesh
+
+    mesh = data_mesh() if len(jax.devices()) > 1 else None
+    log.info("WDL training STREAMED from %s + %s (%d member(s)); shards "
+             "stream row-sharded over the data mesh (tensor-parallel "
+             "embedding sharding needs the in-memory trainer)",
+             norm_dir, codes_dir, bagging)
 
     for i in range(bagging):
         cfg = WDLTrainConfig.from_model_config(mc, trainer_id=i)
@@ -324,6 +330,7 @@ def _train_wdl_streamed(proc) -> None:
                 except Exception as e:
                     log.warning("cannot resume from %s (%s)", path, e)
         res = train_wdl_streamed(norm_dir, codes_dir, num_idx, cat_idx,
-                                 vocab_sizes, cfg, init_flat=init_flat)
+                                 vocab_sizes, cfg, init_flat=init_flat,
+                                 mesh=mesh)
         _save_wdl_member(proc, i, cfg, res, num_names, cat_names,
                          vocab_sizes, dense_specs, plan.cutoff, categories)

@@ -234,7 +234,10 @@ class VarSelProcessor(BasicProcessor):
         tags = np.asarray(tags, np.float32)
         weights = np.asarray(weights, np.float32)
         params = vs.params or {}
-        cfg = VotedConfig(
+        # candidates train the model's CONFIGURED network, not a fixed
+        # surrogate (ValidationConductor.java trains the configured net)
+        cfg = VotedConfig.from_model_config(
+            self.model_config,
             expect_var_count=int(params.get(
                 "expect_variable_cnt", vs.wrapper_num or 20)),
             population_size=int(params.get("population_live_size", 30)),

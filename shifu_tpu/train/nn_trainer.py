@@ -92,6 +92,31 @@ class NNTrainConfig:
         acts = [str(a) for a in g("ActivationFunc", ["tanh"])]
         if alg == "LR":
             hidden, acts = [], []
+        if alg == "SVM":
+            # liblinear parity (core/alg/SVMTrainer.java:38): linear
+            # kernel only, L2-regularized hinge with Const -> C (reg=1/C).
+            kernel = str(g("Kernel", "linear")).lower()
+            if kernel != "linear":
+                raise ValueError(
+                    f"SVM Kernel={kernel!r} is not supported — the TPU "
+                    "build trains the liblinear path (linear kernel); use "
+                    "Kernel=linear or algorithm=NN")
+            c_const = float(g("Const", 1.0))
+            return cls(
+                n_classes=2,
+                hidden_nodes=[], activations=[], loss="hinge",
+                learning_rate=float(g("LearningRate", 0.1)),
+                propagation=str(g("Propagation", "Q")),
+                reg_level="L2",
+                regularized_constant=1.0 / max(c_const, 1e-12),
+                num_epochs=int(t.num_train_epochs or 100),
+                valid_set_rate=float(t.valid_set_rate or 0.0),
+                bagging_sample_rate=float(t.bagging_sample_rate or 1.0),
+                bagging_with_replacement=bool(t.bagging_with_replacement),
+                early_stop_window=int(g("EarlyStopWindowSize", 0)),
+                convergence_threshold=float(t.convergence_threshold or 0.0),
+                seed=trainer_id * 1000 + 7,
+            )
         # NATIVE multi-class: K output nodes, one-hot ideal (NNWorker.java:128
         # "ideal[ideaIndex] = 1f"); ONEVSALL stays binary per trainer.
         n_classes = 2
@@ -160,6 +185,11 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes):
     # output width comes from the final layer shape; >1 means NATIVE
     # multi-class (t holds class indices, ideal is one-hot)
     out_dim = shapes[-1][1]
+    # hinge = linear SVM (core/alg/SVMTrainer.java:38 trains liblinear):
+    # the forward value is the RAW decision w.x + b, the loss is
+    # max(0, 1 - y*f(x)) with y in {-1,+1}; L2 regularization carries
+    # liblinear's C via reg = 1/C (see NNTrainConfig.from_model_config)
+    hinge = cfg.loss == "hinge"
 
     def unflatten(flat):
         params, off = [], 0
@@ -191,7 +221,8 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes):
                 keep = jax.random.bernoulli(sub, 1.0 - dropout, h.shape)
                 h = jnp.where(keep, h / (1.0 - dropout), 0.0)
         out = matmul(h, params[-1]["W"]) + params[-1]["b"]
-        out = activation_fn("sigmoid")(out)
+        if not hinge:  # SVM keeps the raw decision value
+            out = activation_fn("sigmoid")(out)
         return out if out_dim > 1 else out[:, 0]
 
     def ideal_of(t):
@@ -204,6 +235,9 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes):
         return t
 
     def record_loss(p, ideal):
+        if hinge:
+            pm = 2.0 * ideal - 1.0  # {0,1} -> {-1,+1}
+            return jnp.maximum(0.0, 1.0 - pm * p)
         if cfg.loss == "log":
             eps = 1e-7
             pc = jnp.clip(p, eps, 1 - eps)
@@ -230,7 +264,11 @@ def _loss_and_errors(cfg: NNTrainConfig, shapes):
         else:
             p = p_train
         # reported errors are squared-error means like Encog calculateError
-        # (multi-class: mean over the K output neurons as well)
+        # (multi-class: mean over the K output neurons as well); the SVM
+        # decision value maps through sigmoid first so its error lives on
+        # the same [0,1] scale (saved models score sigmoid(w.x+b) too)
+        if hinge:
+            p = activation_fn("sigmoid")(p)
         sq = (ideal_of(t) - p) ** 2
         if out_dim > 1:
             sq = sq.mean(axis=-1)
@@ -331,10 +369,13 @@ def train_nn(
     cfg: NNTrainConfig,
     mesh=None,
     init_flat: Optional[np.ndarray] = None,
+    fetch_params: bool = True,
 ) -> TrainResult:
     """Train one model. features [n, d] float32 (normalized), tags [n] {0,1},
     weights [n] significance. `mesh` shards rows over its `data` axis;
-    None = single device."""
+    None = single device. `fetch_params=False` skips the device->host
+    weight transfer and returns params=None — steady-state benchmarking on
+    remote TPU links, where pulling a 25 MB weight vector costs seconds."""
     import jax
     import jax.numpy as jnp
 
@@ -400,11 +441,14 @@ def train_nn(
 
     (flat_f, _, it_f, _, best_val, best_flat, _, _, tr_e, va_e) = result
     it_n = int(it_f)
-    best = np.asarray(best_flat)
     final_valid = float(best_val) if math.isfinite(float(best_val)) else float(va_e)
     use_best = cfg.valid_set_rate > 0 and math.isfinite(float(best_val))
-    chosen = best if use_best else np.asarray(flat_f)
-    params = unflatten_params(chosen, shapes)
+    if fetch_params:
+        chosen = (np.asarray(best_flat) if use_best
+                  else np.asarray(flat_f))
+        params = unflatten_params(chosen, shapes)
+    else:
+        params = None
     log.info(
         "train done: %d iterations, train_err %.6f valid_err %.6f",
         it_n, float(tr_e), final_valid,

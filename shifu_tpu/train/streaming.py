@@ -73,7 +73,11 @@ class ShardFeed:
     (AbstractNNWorker samples once at load time, not per epoch)."""
 
     def __init__(self, data_dir: str, cfg: NNTrainConfig,
-                 prefix: str = "features"):
+                 prefix: str = "features", mesh=None, sig_override=None):
+        """`sig_override(s, rows, global_offset, weights) -> (sig_t,
+        sig_v)` replaces the per-shard bagging/validation draw — the
+        k-fold case, where fold membership is a function of the GLOBAL
+        row index (TrainModelProcessor.java:947-969)."""
         import jax
 
         self.data_dir = data_dir
@@ -81,6 +85,13 @@ class ShardFeed:
         self.prefix = prefix
         self.n_shards = len(self.meta.shard_rows)
         self.pad_rows = max(self.meta.shard_rows) if self.meta.shard_rows else 0
+        self.mesh = mesh
+        if mesh is not None and self.pad_rows:
+            # rows shard over the mesh's data axis: pad every shard to a
+            # multiple of the axis size (padding carries zero significance)
+            n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+                "data", mesh.devices.size)
+            self.pad_rows = -(-self.pad_rows // n_data) * n_data
         self.cfg = cfg
         self._jax = jax
         # per-shard sampling masks (train significance / valid mask), drawn
@@ -88,17 +99,22 @@ class ShardFeed:
         self._sig: List[Tuple[np.ndarray, np.ndarray]] = []
         from shifu_tpu.train.nn_trainer import split_and_sample
 
+        offset = 0
         for s, rows in enumerate(self.meta.shard_rows):
-            cfg_s = NNTrainConfig(
-                **{**cfg.__dict__, "seed": cfg.seed * 100_003 + s}
-            )
-            sig, valid = split_and_sample(rows, cfg_s)
-            w = np.load(self._path("weights", s), mmap_mode="r")
-            sig_t = (sig * np.asarray(w)).astype(np.float32)
-            sig_v = (valid.astype(np.float32) * np.asarray(w)).astype(
-                np.float32
-            )
+            w = np.asarray(np.load(self._path("weights", s), mmap_mode="r"))
+            if sig_override is not None:
+                sig_t, sig_v = sig_override(s, rows, offset, w)
+                sig_t = np.asarray(sig_t, np.float32)
+                sig_v = np.asarray(sig_v, np.float32)
+            else:
+                cfg_s = NNTrainConfig(
+                    **{**cfg.__dict__, "seed": cfg.seed * 100_003 + s}
+                )
+                sig, valid = split_and_sample(rows, cfg_s)
+                sig_t = (sig * w).astype(np.float32)
+                sig_v = (valid.astype(np.float32) * w).astype(np.float32)
             self._sig.append((sig_t, sig_v))
+            offset += rows
         self.n_train_size = float(
             max(sum(float((st > 0).sum()) for st, _ in self._sig), 1.0)
         )
@@ -122,6 +138,11 @@ class ShardFeed:
             t = np.pad(t, (0, pad))
             sig_t = np.pad(sig_t, (0, pad))
             sig_v = np.pad(sig_v, (0, pad))
+        if self.mesh is not None:
+            from shifu_tpu.parallel.mesh import shard_rows as put
+
+            return (put(x, self.mesh), put(t, self.mesh),
+                    put(sig_t, self.mesh), put(sig_v, self.mesh))
         return (jax.device_put(x), jax.device_put(t),
                 jax.device_put(sig_t), jax.device_put(sig_v))
 
@@ -174,11 +195,20 @@ def train_nn_streamed(
     cfg: NNTrainConfig,
     init_flat: Optional[np.ndarray] = None,
     target_class: Optional[int] = None,
+    mesh=None,
+    sig_override=None,
 ) -> TrainResult:
     """Full-batch BSP training streamed from shards: per epoch, sum shard
     gradients (the NNMaster worker-sum), then ONE weight update. Matches
     train_nn's semantics for full-batch runs; mini_batchs is ignored (each
-    shard already bounds device memory)."""
+    shard already bounds device memory).
+
+    With a `mesh`, each streamed shard is placed row-sharded over the
+    `data` axis and XLA all-reduces the shard gradient across devices —
+    spill and distribution COMPOSE, like the reference running
+    MemoryDiskFloatMLDataSet inside every one of its 100 workers
+    (AbstractNNWorker.java:485-494): the host stream bounds memory, the
+    mesh divides the compute."""
     import jax
     import jax.numpy as jnp
 
@@ -186,7 +216,7 @@ def train_nn_streamed(
         log.warning("MiniBatchs=%d is ignored on the streamed path — each "
                     "epoch is one full-batch pass over the shards",
                     cfg.mini_batchs)
-    feed = ShardFeed(data_dir, cfg)
+    feed = ShardFeed(data_dir, cfg, mesh=mesh, sig_override=sig_override)
     d = len(feed.meta.columns)
     out_dim = cfg.n_classes if cfg.n_classes > 2 else 1
     layer_sizes = [d] + list(cfg.hidden_nodes) + [out_dim]
@@ -207,6 +237,11 @@ def train_nn_streamed(
 
     flat = jnp.asarray(flat0)
     opt = init_state(flat0.size)
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import replicate
+
+        flat = replicate(flat, mesh)
+        opt = replicate(opt, mesh)
     lr = cfg.learning_rate
     nts = jnp.float32(feed.n_train_size)
     key0 = jax.random.PRNGKey(cfg.seed)

@@ -71,6 +71,182 @@ class CodesFeed:
         )
 
 
+def _grow_levelwise_streamed(feed, work, la, lay, cfg, D, row_put,
+                             pad_to_mesh, mesh):
+    """One LEVEL-WISE tree with streamed histograms. pending = the previous
+    level's split decisions; each shard applies them the next time its
+    codes are resident, so exactly ONE shard's code matrix lives on device
+    at any moment and every level costs one transfer per shard. Node
+    batches honor the stats-memory budget exactly like the in-memory
+    per-level path (DTMaster.java:450-467). Mutates work[s]["resting"]."""
+    import jax
+    import jax.numpy as jnp
+
+    feat_levels, mask_levels, leaf_levels = [], [], []
+    batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
+                                 cfg.n_classes)
+    pending = None
+    for depth in range(D + 1):
+        L = 2**depth
+        base = L - 1
+        ranges = [(b0, min(batch_cap, L - b0))
+                  for b0 in range(0, L, batch_cap)]
+        hist_parts = [None] * len(ranges)
+        for s, wk in enumerate(work):
+            codes_s = row_put(pad_to_mesh(
+                np.asarray(feed.codes(s), np.int32)))
+            if pending is not None:
+                pbf, pbr, prank, psplit, pbase, pL = pending
+                upd = _get_update_program(pL, lay.T)
+                wk["resting"], wk["node"], wk["active"] = upd(
+                    codes_s, wk["node"], wk["active"], wk["resting"],
+                    pbf, pbr, prank, psplit, jnp.int32(pbase), la.off,
+                    la.clip,
+                )
+            for bi, (b0, Lb) in enumerate(ranges):
+                hist_p = _get_hist_program(Lb, lay,
+                                           n_classes=cfg.n_classes,
+                                           mesh=mesh)
+                in_batch = (wk["active"] & (wk["node"] >= b0)
+                            & (wk["node"] < b0 + Lb))
+                h = hist_p(codes_s, wk["labels"], wk["w"],
+                           wk["node"] - b0, in_batch, la.off, la.clip,
+                           la.seg_t, la.pos_t)
+                hist_parts[bi] = (h if hist_parts[bi] is None
+                                  else hist_parts[bi] + h)
+            del codes_s  # drop before the next shard loads
+        pending = None
+        (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = _scan_batched(
+            ((hist_parts[bi], Lb, b0)
+             for bi, (b0, Lb) in enumerate(ranges)),
+            la, lay, cfg, L,
+        )
+        if depth == D:  # final level: leaves only + settle leftovers
+            leaf_levels.append(lv)
+            feat_levels.append(jnp.full(L, -1, jnp.int32))
+            mask_levels.append(jnp.zeros((L, lay.s_max), bool))
+            for wk in work:
+                wk["resting"] = jnp.where(
+                    wk["active"], base + wk["node"], wk["resting"])
+            break
+        pending = (bf, br, rank_flat, is_split, base, L)
+        feat_levels.append(jnp.where(is_split, bf, -1))
+        mask_levels.append(lm)
+        leaf_levels.append(lv)
+
+    feature, left_mask, leaf_value = jax.device_get(
+        (jnp.concatenate(feat_levels),
+         jnp.concatenate(mask_levels, axis=0),
+         jnp.concatenate(leaf_levels))
+    )
+    return DenseTree(
+        feature=np.asarray(feature, np.int32),
+        left_mask=np.asarray(left_mask, bool),
+        leaf_value=np.asarray(leaf_value, np.float32),
+        weight=1.0,
+    )
+
+
+def _grow_leafwise_streamed(feed, work, la, lay, cfg, row_put, pad_to_mesh,
+                            mesh):
+    """LEAF-WISE growth with streamed histograms (DTMaster.java:137
+    toSplitQueue, :260-271): the split queue and the growing tree are tiny
+    host state; each iteration re-streams the code shards once to (a)
+    apply the previous split's row reroute and (b) accumulate the two new
+    frontier leaves' histograms. Cost per split = one pass over the
+    shards, at any data scale.
+
+    Mutates each work[s]["node"] to the final explicit node id (the
+    caller's resting state) and returns the DenseTree."""
+    import jax.numpy as jnp
+
+    from shifu_tpu.train.tree_trainer import _get_scan_program
+
+    hist1 = _get_hist_program(1, lay, n_classes=cfg.n_classes, mesh=mesh)
+    scan1 = _get_scan_program(1, lay.T, lay.s_max, cfg.impurity,
+                              cfg.min_instances_per_node, cfg.min_info_gain,
+                              cfg.n_classes)
+    max_leaves = cfg.max_leaves
+    max_nodes = 2 * max_leaves - 1
+    feature = [-1]
+    left_c = [-1]
+    right_c = [-1]
+    leaf_val = [0.0]
+    masks = [np.zeros(lay.s_max, bool)]
+    depth_of = {0: 0}
+    candidates = {}
+    pending = None  # (split node id, feat, cut, rank_row_dev, li, ri)
+
+    def sweep(leaf_ids):
+        """One pass over the shards: apply the pending reroute, then
+        accumulate each listed leaf's histogram across shards."""
+        nonlocal pending
+        hists = {lid: None for lid in leaf_ids}
+        for s, wk in enumerate(work):
+            codes_s = row_put(pad_to_mesh(
+                np.asarray(feed.codes(s), np.int32)))
+            if pending is not None:
+                best_id, bf, cut, rank_row, li, ri = pending
+                sel = wk["node"] == best_id
+                code = codes_s[:, bf]
+                cf = jnp.clip(code, 0, int(lay.clip_max[bf]))
+                goes_left = rank_row[int(lay.off[bf]) + cf] <= cut
+                wk["node"] = jnp.where(
+                    sel, jnp.where(goes_left, li, ri), wk["node"])
+            for lid in leaf_ids:
+                act = (wk["node"] == lid) & wk["active"]
+                h = hist1(codes_s, wk["labels"], wk["w"],
+                          jnp.zeros_like(wk["node"]), act, la.off, la.clip,
+                          la.seg_t, la.pos_t)
+                hists[lid] = h if hists[lid] is None else hists[lid] + h
+            del codes_s
+        pending = None
+        return hists
+
+    def evaluate(hists):
+        for lid, hist in hists.items():
+            (f, c, r, lv, sp, g, m, _nc) = scan1(
+                hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t,
+                la.start_t, la.size_t, la.off, la.clip, la.seg0_size,
+            )
+            leaf_val[lid] = float(lv[0])
+            if bool(sp[0]) and depth_of[lid] < cfg.max_depth:
+                candidates[lid] = (float(g[0]), int(f[0]), int(c[0]),
+                                   r[0], np.asarray(m[0]))
+
+    evaluate(sweep([0]))
+    n_leaves = 1
+    while n_leaves < max_leaves and candidates:
+        best_id = max(candidates, key=lambda k: candidates[k][0])
+        _gain, bf, cut, rank_row, mask_row = candidates.pop(best_id)
+        li, ri = len(feature), len(feature) + 1
+        if ri > max_nodes:
+            break
+        feature[best_id] = bf
+        left_c[best_id] = li
+        right_c[best_id] = ri
+        masks[best_id] = mask_row
+        for _ in range(2):
+            feature.append(-1)
+            left_c.append(-1)
+            right_c.append(-1)
+            leaf_val.append(0.0)
+            masks.append(np.zeros(lay.s_max, bool))
+        depth_of[li] = depth_of[ri] = depth_of[best_id] + 1
+        pending = (best_id, bf, cut, rank_row, li, ri)
+        n_leaves += 1
+        evaluate(sweep([li, ri]))  # also applies the reroute above
+
+    return DenseTree(
+        feature=np.asarray(feature, np.int32),
+        left_mask=np.stack(masks).astype(bool),
+        leaf_value=np.asarray(leaf_val, np.float32),
+        weight=1.0,
+        left=np.asarray(left_c, np.int32),
+        right=np.asarray(right_c, np.int32),
+    )
+
+
 def train_trees_streamed(
     codes_dir: str,
     slots: List[int],
@@ -81,10 +257,16 @@ def train_trees_streamed(
     boundaries: Optional[List] = None,
     categories: Optional[List] = None,
     progress_cb=None,
+    mesh=None,
 ) -> TreeTrainResult:
-    """Level-wise GBT/RF streamed from shards (single device; the in-memory
-    trainer owns the meshed path). `tags_override` supplies per-class
-    binary targets for ONEVSALL members."""
+    """Level-wise GBT/RF streamed from shards. `tags_override` supplies
+    per-class binary targets for ONEVSALL members.
+
+    With a `mesh`, each shard's rows are sharded over the `data` axis and
+    the per-level histogram is psum'd across devices (shard_map inside
+    `_get_hist_program`) — disk streaming composes with the device mesh
+    exactly like the reference's per-worker spill
+    (AbstractNNWorker.java:485-494)."""
     import jax
     import jax.numpy as jnp
 
@@ -96,11 +278,28 @@ def train_trees_streamed(
     lay = make_layout([int(s) for s in slots], [bool(c) for c in is_cat])
     la = _device_layout(lay, np.ones(F, bool))
     D = cfg.max_depth
-    if cfg.max_leaves and cfg.max_leaves > 0:
-        log.warning("leaf-wise growth is not streamed; using level-wise")
     is_gbt = cfg.algorithm == "GBT"
     log_loss = cfg.loss == "log"
     lr = cfg.learning_rate
+
+    n_data = 1
+    if mesh is not None:
+        n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+            "data", mesh.devices.size)
+
+        def row_put(a):
+            from shifu_tpu.parallel.mesh import shard_rows
+
+            return shard_rows(a, mesh)
+    else:
+        row_put = jnp.asarray
+
+    def pad_to_mesh(a):
+        rows = a.shape[0]
+        target = -(-rows // n_data) * n_data
+        if target == rows:
+            return a
+        return np.pad(a, [(0, target - rows)] + [(0, 0)] * (a.ndim - 1))
 
     # per-shard device state (small): labels/weights/valid stay resident
     rng_valid = np.random.default_rng([cfg.seed, 999_983])
@@ -115,31 +314,34 @@ def train_trees_streamed(
         if tags_override is not None:
             y = tags_override[offset:offset + rows].astype(np.float32)
         w = np.where(valid, 0.0, np.asarray(feed.weights(s), np.float32))
+        real = np.ones(rows, bool)
+        prows = pad_to_mesh(real).shape[0]
         shard_state.append({
             "rows": rows,
-            "y": jnp.asarray(y),
-            "base_w": jnp.asarray(w.astype(np.float32)),
-            "valid": jnp.asarray(valid),
-            "pred": jnp.zeros(rows, jnp.float32),
-            "votes": (jnp.zeros((rows, cfg.n_classes), jnp.float32)
+            "y": row_put(pad_to_mesh(y)),
+            "base_w": row_put(pad_to_mesh(w.astype(np.float32))),
+            "valid": row_put(pad_to_mesh(valid)),
+            "real": row_put(pad_to_mesh(real)),
+            "pred": row_put(np.zeros(prows, np.float32)),
+            "votes": (row_put(np.zeros((prows, cfg.n_classes), np.float32))
                       if is_cls else None),
         })
         offset += rows
 
     @jax.jit
-    def shard_errors(score, y, valid):
+    def shard_errors(score, y, valid, real):
         sq = (y - score) ** 2
-        v = jnp.sum(jnp.where(valid, sq, 0.0))
-        t = jnp.sum(jnp.where(valid, 0.0, sq))
-        return t, v, jnp.sum(valid.astype(jnp.float32))
+        v = jnp.sum(jnp.where(valid & real, sq, 0.0))
+        t = jnp.sum(jnp.where((~valid) & real, sq, 0.0))
+        return t, v, jnp.sum((valid & real).astype(jnp.float32))
 
     @jax.jit
-    def shard_cls_errors(votes, y, valid):
+    def shard_cls_errors(votes, y, valid, real):
         pred_class = jnp.argmax(votes, axis=1).astype(jnp.float32)
         err = (pred_class != y).astype(jnp.float32)
-        v = jnp.sum(jnp.where(valid, err, 0.0))
-        t = jnp.sum(jnp.where(valid, 0.0, err))
-        return t, v, jnp.sum(valid.astype(jnp.float32))
+        v = jnp.sum(jnp.where(valid & real, err, 0.0))
+        t = jnp.sum(jnp.where((~valid) & real, err, 0.0))
+        return t, v, jnp.sum((valid & real).astype(jnp.float32))
 
     trees: List[DenseTree] = []
     valid_errors: List[float] = []
@@ -172,9 +374,10 @@ def train_trees_streamed(
         offset = 0
         for s, st in enumerate(shard_state):
             rows = st["rows"]
+            prows = int(st["y"].shape[0])
             if cfg.algorithm == "RF":
-                w_k = st["base_w"] * jnp.asarray(
-                    bag_all[offset:offset + rows].astype(np.float32))
+                w_k = st["base_w"] * row_put(pad_to_mesh(
+                    bag_all[offset:offset + rows].astype(np.float32)))
                 labels = st["y"]
             else:
                 w_k = st["base_w"]
@@ -184,79 +387,23 @@ def train_trees_streamed(
                     labels = st["y"] - st["pred"]
             work.append({
                 "labels": labels, "w": w_k,
-                "node": jnp.zeros(rows, jnp.int32),
-                "active": jnp.ones(rows, bool),
-                "resting": jnp.zeros(rows, jnp.int32),
+                "node": row_put(np.zeros(prows, np.int32)),
+                "active": st["real"],
+                "resting": row_put(np.zeros(prows, np.int32)),
             })
             offset += rows
 
-        feat_levels, mask_levels, leaf_levels = [], [], []
-        # pending = the previous level's split decisions; each shard applies
-        # them the next time its codes are resident, so exactly ONE shard's
-        # code matrix lives on device at any moment and every level costs
-        # one transfer per shard. Node batches honor the stats-memory
-        # budget exactly like the in-memory per-level path
-        # (DTMaster.java:450-467).
-        batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
-                                     cfg.n_classes)
-        pending = None
-        for depth in range(D + 1):
-            L = 2**depth
-            base = L - 1
-            ranges = [(b0, min(batch_cap, L - b0))
-                      for b0 in range(0, L, batch_cap)]
-            hist_parts = [None] * len(ranges)
-            for s, wk in enumerate(work):
-                codes_s = jnp.asarray(np.asarray(feed.codes(s), np.int32))
-                if pending is not None:
-                    pbf, pbr, prank, psplit, pbase, pL = pending
-                    upd = _get_update_program(pL, lay.T)
-                    wk["resting"], wk["node"], wk["active"] = upd(
-                        codes_s, wk["node"], wk["active"], wk["resting"],
-                        pbf, pbr, prank, psplit, jnp.int32(pbase), la.off,
-                        la.clip,
-                    )
-                for bi, (b0, Lb) in enumerate(ranges):
-                    hist_p = _get_hist_program(Lb, lay,
-                                               n_classes=cfg.n_classes)
-                    in_batch = (wk["active"] & (wk["node"] >= b0)
-                                & (wk["node"] < b0 + Lb))
-                    h = hist_p(codes_s, wk["labels"], wk["w"],
-                               wk["node"] - b0, in_batch, la.off, la.clip,
-                               la.seg_t, la.pos_t)
-                    hist_parts[bi] = (h if hist_parts[bi] is None
-                                      else hist_parts[bi] + h)
-                del codes_s  # drop before the next shard loads
-            pending = None
-            (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = _scan_batched(
-                ((hist_parts[bi], Lb, b0)
-                 for bi, (b0, Lb) in enumerate(ranges)),
-                la, lay, cfg, L,
-            )
-            if depth == D:  # final level: leaves only + settle leftovers
-                leaf_levels.append(lv)
-                feat_levels.append(jnp.full(L, -1, jnp.int32))
-                mask_levels.append(jnp.zeros((L, lay.s_max), bool))
-                for wk in work:
-                    wk["resting"] = jnp.where(
-                        wk["active"], base + wk["node"], wk["resting"])
-                break
-            pending = (bf, br, rank_flat, is_split, base, L)
-            feat_levels.append(jnp.where(is_split, bf, -1))
-            mask_levels.append(lm)
-            leaf_levels.append(lv)
-
-        feature, left_mask, leaf_value = jax.device_get(
-            (jnp.concatenate(feat_levels),
-             jnp.concatenate(mask_levels, axis=0),
-             jnp.concatenate(leaf_levels))
-        )
-        tree = DenseTree(
-            feature=np.asarray(feature, np.int32),
-            left_mask=np.asarray(left_mask, bool),
-            leaf_value=np.asarray(leaf_value, np.float32),
-            weight=1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0),
-        )
+        weight_k = 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0)
+        if cfg.max_leaves and cfg.max_leaves > 0:
+            tree = _grow_leafwise_streamed(feed, work, la, lay, cfg,
+                                           row_put, pad_to_mesh, mesh)
+            tree.weight = weight_k
+            for wk in work:
+                wk["resting"] = wk["node"]  # explicit leaf node ids
+        else:
+            tree = _grow_levelwise_streamed(
+                feed, work, la, lay, cfg, D, row_put, pad_to_mesh, mesh)
+            tree.weight = weight_k
         trees.append(tree)
 
         # per-shard prediction/error updates (incl. DART per-row dropout,
@@ -279,7 +426,7 @@ def train_trees_streamed(
                              cfg.n_classes - 1),
                     cfg.n_classes, dtype=jnp.float32)
                 ts, vs, vc = shard_cls_errors(st["votes"], st["y"],
-                                              st["valid"])
+                                              st["valid"], st["real"])
                 t_sum += float(ts)
                 v_sum += float(vs)
                 v_cnt += float(vc)
@@ -287,9 +434,9 @@ def train_trees_streamed(
                 continue
             if is_gbt:
                 if drop_all is not None:
-                    keep = jnp.asarray(
+                    keep = row_put(pad_to_mesh(
                         drop_all[drop_off:drop_off + st["rows"]]
-                        .astype(np.float32))
+                        .astype(np.float32)))
                     tree_pred = tree_pred * keep
                 drop_off += st["rows"]
                 st["pred"] = st["pred"] + tree.weight * tree_pred
@@ -299,7 +446,8 @@ def train_trees_streamed(
                 st["pred"] = (tree_pred if k == 0
                               else (st["pred"] * k + tree_pred) / (k + 1))
                 score = jnp.clip(st["pred"], 0.0, 1.0)
-            ts, vs, vc = shard_errors(score, st["y"], st["valid"])
+            ts, vs, vc = shard_errors(score, st["y"], st["valid"],
+                                      st["real"])
             t_sum += float(ts)
             v_sum += float(vs)
             v_cnt += float(vc)

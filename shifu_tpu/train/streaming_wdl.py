@@ -40,7 +40,7 @@ class WDLShardFeed:
     per-shard sampling masks drawn once like the NN ShardFeed."""
 
     def __init__(self, norm_dir: str, codes_dir: str, num_idx: List[int],
-                 cat_idx: List[int], cfg: WDLTrainConfig):
+                 cat_idx: List[int], cfg: WDLTrainConfig, mesh=None):
         from shifu_tpu.train.nn_trainer import split_and_sample
 
         self.norm_dir = norm_dir
@@ -56,6 +56,11 @@ class WDLShardFeed:
             )
         self.n_shards = len(self.meta.shard_rows)
         self.pad_rows = max(self.meta.shard_rows) if self.meta.shard_rows else 0
+        self.mesh = mesh
+        if mesh is not None and self.pad_rows:
+            n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+                "data", mesh.devices.size)
+            self.pad_rows = -(-self.pad_rows // n_data) * n_data
         self._sig = []
         for s, rows in enumerate(self.meta.shard_rows):
             cfg_s = WDLTrainConfig(
@@ -93,6 +98,16 @@ class WDLShardFeed:
             os.path.join(self.norm_dir, f"tags-{s:05d}.npy"),
             mmap_mode="r"), np.float32)
         sig_t, sig_v = self._sig[s]
+        if self.mesh is not None:
+            from shifu_tpu.parallel.mesh import shard_rows as put
+
+            return (
+                put(self._padded(dense, pad, True), self.mesh),
+                put(self._padded(codes, pad, True), self.mesh),
+                put(self._padded(t, pad), self.mesh),
+                put(self._padded(sig_t, pad), self.mesh),
+                put(self._padded(sig_v, pad), self.mesh),
+            )
         return (
             jax.device_put(self._padded(dense, pad, True)),
             jax.device_put(self._padded(codes, pad, True)),
@@ -154,10 +169,16 @@ def train_wdl_streamed(
     vocab_sizes: List[int],
     cfg: WDLTrainConfig,
     init_flat: Optional[np.ndarray] = None,
+    mesh=None,
 ) -> WDLTrainResult:
+    """With a `mesh`, shards stream row-sharded over the `data` axis and
+    XLA all-reduces each shard gradient — disk spill composes with the
+    device mesh (AbstractNNWorker.java:485-494 runs the same spill inside
+    every distributed worker)."""
     import jax.numpy as jnp
 
-    feed = WDLShardFeed(norm_dir, codes_dir, num_idx, cat_idx, cfg)
+    feed = WDLShardFeed(norm_dir, codes_dir, num_idx, cat_idx, cfg,
+                        mesh=mesh)
     template = init_wdl_params(
         len(num_idx), vocab_sizes, cfg.embed_dim, cfg.hidden, seed=cfg.seed
     )
@@ -174,6 +195,11 @@ def train_wdl_streamed(
     )
     flat = jnp.asarray(flat0)
     opt = init_state(flat0.size)
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import replicate
+
+        flat = replicate(flat, mesh)
+        opt = replicate(opt, mesh)
     nts = jnp.float32(feed.n_train_size)
 
     best_val = math.inf

@@ -425,14 +425,43 @@ def _make_leaf_fn(L: int, n_classes: int = 0):
 
 
 def _get_hist_program(L: int, lay: FeatureLayout,
-                      allow_matmul: bool = True, n_classes: int = 0):
-    key = ("hist", L, lay.key, allow_matmul, n_classes)
+                      allow_matmul: bool = True, n_classes: int = 0,
+                      mesh=None):
+    """Standalone jitted histogram program. With a `mesh`, the builder runs
+    under shard_map on per-device row shards and psums the [C, L, T]
+    result — the per-level worker-merge for callers (streamed trainer)
+    that drive levels from the host."""
+    key = ("hist", L, lay.key, allow_matmul, n_classes, _mesh_key(mesh))
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
     import jax
 
-    prog = jax.jit(_make_hist_fn(L, lay, allow_matmul, n_classes))
+    fn = _make_hist_fn(L, lay, allow_matmul, n_classes)
+    if mesh is None:
+        prog = jax.jit(fn)
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        def meshed(codes, labels, weights, node, active, off, clip, seg,
+                   pos):
+            h = fn(codes, labels, weights, node, active, off, clip, seg,
+                   pos)
+            return jax.lax.psum(h, "data")
+
+        specs = dict(
+            mesh=mesh,
+            in_specs=(P("data"),) * 5 + (P(),) * 4,
+            out_specs=P(),
+        )
+        try:
+            from jax import shard_map
+
+            prog = jax.jit(shard_map(meshed, check_vma=False, **specs))
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
+
+            prog = jax.jit(shard_map(meshed, check_rep=False, **specs))
     _PROGRAMS[key] = prog
     return prog
 

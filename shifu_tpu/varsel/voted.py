@@ -31,45 +31,89 @@ log = get_logger(__name__)
 @dataclass
 class VotedConfig:
     """Knobs mirror CandidateGenerator's params (defaults follow
-    Constants.java / dvarsel defaults where the reference defines them)."""
+    Constants.java / dvarsel defaults where the reference defines them).
+
+    The candidate-model architecture/hyperparams come from the MODEL's
+    training config (ValidationConductor trains the CONFIGURED network per
+    seed, core/dvarsel/wrapper/ValidationConductor.java — not a fixed
+    surrogate); `from_model_config` wires them."""
 
     expect_var_count: int = 20  # EXPECT_VARIABLE_CNT (varSelect.wrapperNum)
     population_size: int = 30  # POPULATION_LIVE_SIZE
     generations: int = 5  # POPULATION_MULTIPLY_CNT
     cross_percent: int = 60  # HYBRID_PERCENT
     mutation_percent: int = 20  # MUTATION_PERCENT
-    hidden: int = 10
+    hidden_nodes: List[int] = field(default_factory=lambda: [10])
+    activations: List[str] = field(default_factory=lambda: ["tanh"])
     epochs: int = 30
     learning_rate: float = 0.05
     valid_rate: float = 0.2
     seed: int = 0
 
+    @classmethod
+    def from_model_config(cls, mc, **overrides) -> "VotedConfig":
+        """Candidates train the model's own architecture/params (reuse the
+        NN trainer's wiring so NumHiddenNodes/ActivationFunc/LearningRate
+        track the deliverable model exactly); epoch count is capped — the
+        probe needs ranking fidelity, not a converged deliverable."""
+        from shifu_tpu.train.nn_trainer import NNTrainConfig
+
+        ncfg = NNTrainConfig.from_model_config(mc)
+        return cls(
+            hidden_nodes=list(ncfg.hidden_nodes) or [10],
+            activations=list(ncfg.activations) or ["tanh"],
+            epochs=min(int(ncfg.num_epochs), 50),
+            learning_rate=float(ncfg.learning_rate),
+            valid_rate=float(ncfg.valid_set_rate or 0.2),
+            **overrides,
+        )
+
 
 _PROGRAMS: Dict[tuple, object] = {}
 
 
-def _get_eval_program(d: int, hidden: int, epochs: int, lr: float):
-    """Vmapped candidate evaluator: (flat0 [P, nw], masks [P, d], x, t,
-    sig_tr, sig_va) -> valid_error [P]."""
-    key = (d, hidden, epochs, lr)
+def _get_eval_program(d: int, hidden_nodes: tuple, activations: tuple,
+                      epochs: int, lr: float):
+    """Vmapped candidate evaluator over the CONFIGURED architecture:
+    (flat0 [P, nw], masks [P, d], x, t, sig_tr, sig_va) -> valid_error
+    [P]. The {0,1} mask multiplies the first dense layer, so masked
+    features get zero forward signal AND zero gradient."""
+    key = (d, tuple(hidden_nodes), tuple(activations), epochs, lr)
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
     import jax
     import jax.numpy as jnp
 
-    n_w1 = d * hidden
-    n_b1 = hidden
-    n_w2 = hidden
-    n_total = n_w1 + n_b1 + n_w2 + 1
+    from shifu_tpu.models.nn import activation_fn
+
+    sizes = [d] + list(hidden_nodes) + [1]
+    shapes = list(zip(sizes[:-1], sizes[1:]))
+    n_total = sum(fi * fo + fo for fi, fo in shapes)
+    acts = list(activations)
+
+    def unflatten(flat):
+        out, off = [], 0
+        for (fi, fo) in shapes:
+            w = flat[off:off + fi * fo].reshape(fi, fo)
+            off += fi * fo
+            b = flat[off:off + fo]
+            off += fo
+            out.append((w, b))
+        return out
 
     def fwd(flat, mask, x):
-        w1 = flat[:n_w1].reshape(d, hidden) * mask[:, None]
-        b1 = flat[n_w1:n_w1 + n_b1]
-        w2 = flat[n_w1 + n_b1:n_w1 + n_b1 + n_w2]
-        b2 = flat[-1]
-        h = jnp.tanh(x @ w1 + b1)
-        return 1.0 / (1.0 + jnp.exp(-(h @ w2 + b2)))
+        layers = unflatten(flat)
+        h = x
+        for i, (w, b) in enumerate(layers[:-1]):
+            if i == 0:
+                w = w * mask[:, None]
+            h = activation_fn(acts[i % len(acts)] if acts else "tanh")(
+                h @ w + b)
+        w, b = layers[-1]
+        if len(layers) == 1:
+            w = w * mask[:, None]
+        return 1.0 / (1.0 + jnp.exp(-(h @ w + b)[:, 0]))
 
     def loss(flat, mask, x, t, sig):
         p = fwd(flat, mask, x)
@@ -82,7 +126,8 @@ def _get_eval_program(d: int, hidden: int, epochs: int, lr: float):
             flat, m, v, step = carry
             g = grad(flat, mask, x, t, sig_tr)
             # Adam (fixed betas; the candidate model is a probe, not a
-            # deliverable — ValidationConductor trains a quick Encog net too)
+            # deliverable — ValidationConductor trains a quick net per
+            # seed too; the ARCHITECTURE is what must match the model)
             m2 = 0.9 * m + 0.1 * g
             v2 = 0.999 * v + 0.001 * g * g
             mh = m2 / (1.0 - 0.9 ** (step + 1.0))
@@ -154,8 +199,9 @@ def voted_selection(
     sig_tr = (np.where(valid, 0.0, weights)).astype(np.float32)
     sig_va = (np.where(valid, weights, 0.0)).astype(np.float32)
 
-    (prog, n_total) = _get_eval_program(d, cfg.hidden, cfg.epochs,
-                                        cfg.learning_rate)
+    (prog, n_total) = _get_eval_program(
+        d, tuple(cfg.hidden_nodes), tuple(cfg.activations), cfg.epochs,
+        cfg.learning_rate)
     x = jnp.asarray(feats.astype(np.float32))
     t = jnp.asarray(tags.astype(np.float32))
     sig_tr_j = jnp.asarray(sig_tr)
