@@ -144,7 +144,7 @@ def numpy_worker_gbt_row_trees_per_s(slots, n: int = 100_000,
     conservative lower bound on the real margin."""
     rng = np.random.default_rng(0)
     f = len(slots)
-    codes = np.stack([rng.integers(0, s - 1, size=n) for s in slots],
+    codes = np.stack([rng.integers(0, s, size=n) for s in slots],
                      1).astype(np.int32)
     y = rng.random(n)
     w = np.ones(n)

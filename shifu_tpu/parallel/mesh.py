@@ -2,13 +2,19 @@
 
 The reference runs master+workers as Hadoop mappers synchronized through
 ZooKeeper (SURVEY §5: guagua-mapreduce, NNParams Bytable exchange). Here the
-whole "cluster" is one SPMD program: rows are sharded over the mesh's `data`
-axis, weights are replicated, and XLA inserts the gradient all-reduce (the
+whole "cluster" is one SPMD program: rows are sharded over the mesh's row
+axes, weights are replicated, and XLA inserts the gradient all-reduce (the
 `psum` that replaces NNMaster.accumulateGradients) when the jitted train step
 consumes row-sharded inputs and produces replicated outputs.
 
 Axis names:
-    data   — row (batch) parallelism; every trainer uses it
+    dcn    — OUTER axis across slices/hosts connected by data-center
+             network (multi-slice pods). Present only when the device set
+             spans >1 slice (or when forced via dcn_slices). Row sharding
+             spans (dcn, data) so the heavy within-slice reduction rides
+             ICI and only the per-slice partial crosses DCN — XLA lowers
+             the psum hierarchically from the mesh topology.
+    data   — row (batch) parallelism within a slice; every trainer uses it
     model  — reserved for tensor-parallel WDL embedding shards
 """
 
@@ -19,8 +25,24 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 
-def data_mesh(n_devices: Optional[int] = None, model_axis: int = 1):
-    """1-or-2-axis mesh over available devices: (data, model)."""
+def _slice_count(devices) -> int:
+    """Distinct slice indices in the device set (1 on single-slice or when
+    the platform doesn't expose slice_index, e.g. CPU)."""
+    ids = set()
+    for d in devices:
+        ids.add(getattr(d, "slice_index", 0) or 0)
+    return max(1, len(ids))
+
+
+def data_mesh(n_devices: Optional[int] = None, model_axis: int = 1,
+              dcn_slices: Optional[int] = None):
+    """Mesh over the available devices.
+
+    Single slice: (data[, model]). Multi-slice (detected from the devices'
+    slice_index, or forced with `dcn_slices` for virtual-device tests):
+    (dcn, data[, model]) with `dcn` outermost, so collectives are
+    hierarchical — within-slice over ICI first, across slices over DCN
+    (SURVEY §5's comm-backend obligation)."""
     import jax
     from jax.sharding import Mesh
 
@@ -28,11 +50,58 @@ def data_mesh(n_devices: Optional[int] = None, model_axis: int = 1):
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)
+    n_dcn = dcn_slices if dcn_slices else _slice_count(devices)
+    if n_dcn > 1:
+        assert n % n_dcn == 0, (n, n_dcn)
+        per_slice = n // n_dcn
+        if dcn_slices:
+            dev = np.array(devices).reshape(n_dcn, per_slice)
+        else:  # group real devices by their slice
+            by_slice: dict = {}
+            for d in devices:
+                by_slice.setdefault(getattr(d, "slice_index", 0) or 0,
+                                    []).append(d)
+            sizes = {k: len(v) for k, v in by_slice.items()}
+            if len(set(sizes.values())) != 1:
+                raise ValueError(
+                    f"device set spans slices unevenly ({sizes}); a mesh "
+                    "needs equal devices per slice — pass n_devices as a "
+                    "multiple of the slice size")
+            dev = np.array([by_slice[k] for k in sorted(by_slice)])
+        if model_axis > 1:
+            assert per_slice % model_axis == 0, (per_slice, model_axis)
+            dev = dev.reshape(n_dcn, per_slice // model_axis, model_axis)
+            return Mesh(dev, ("dcn", "data", "model"))
+        return Mesh(dev, ("dcn", "data"))
     if model_axis > 1:
         assert n % model_axis == 0, (n, model_axis)
         dev = np.array(devices).reshape(n // model_axis, model_axis)
         return Mesh(dev, ("data", "model"))
     return Mesh(np.array(devices), ("data",))
+
+
+def row_axes(mesh) -> Tuple[str, ...]:
+    """Axis names rows shard over: ('dcn', 'data') on a multi-slice mesh,
+    ('data',) otherwise. Also the psum axes for gradient/histogram
+    all-reduces."""
+    return tuple(a for a in mesh.axis_names if a in ("dcn", "data"))
+
+
+def row_shard_count(mesh) -> int:
+    """Number of row shards = product of the row axes' sizes (what row
+    counts must pad to)."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n = 1
+    for a in row_axes(mesh):
+        n *= shape.get(a, 1)
+    return n
+
+
+def round_up_rows(n: int, mesh) -> int:
+    """Smallest row count >= n that splits evenly over the mesh's row
+    shards (padding rows must carry zero significance — see pad_rows)."""
+    m = row_shard_count(mesh)
+    return -(-n // m) * m
 
 
 def pad_rows(
@@ -52,11 +121,14 @@ def pad_rows(
 
 
 def shard_rows(array, mesh):
-    """Place an array on the mesh sharded along its leading (row) axis."""
+    """Place an array on the mesh sharded along its leading (row) axis —
+    over (dcn, data) on a multi-slice mesh."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    spec = P("data", *([None] * (array.ndim - 1)))
+    axes = row_axes(mesh)
+    spec = P(axes if len(axes) > 1 else axes[0],
+             *([None] * (array.ndim - 1)))
     return jax.device_put(array, NamedSharding(mesh, spec))
 
 

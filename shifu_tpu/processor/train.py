@@ -116,11 +116,14 @@ class TrainProcessor(BasicProcessor):
 
         if mc.is_multi_classification() and mc.train.is_one_vs_all():
             if is_grid:
-                raise ShifuError(
-                    ErrorCode.INVALID_MODEL_CONFIG,
-                    "grid search is not supported with ONEVSALL multi-class; "
-                    "pick one hyperparameter set",
-                )
+                # grid under OVA: each trial trains all K per-class members
+                # as one vmapped program; trial score = mean per-class
+                # holdout error (the reference fans out grid x class Guagua
+                # jobs, TrainModelProcessor.java:684-945)
+                best = self._grid_search_ova(alg, composites, feats, tags,
+                                             weights, mesh)
+                log.info("ONEVSALL grid search best params: %s", best)
+                mc.train.params = best
             if num_kfold > 0:
                 log.warning("num_k_fold is ignored under ONEVSALL "
                             "multi-class (one model per class)")
@@ -221,13 +224,9 @@ class TrainProcessor(BasicProcessor):
         multi = mc.is_multi_classification()
         is_ova = multi and mc.train.is_one_vs_all()
         if len(composites) > 1:
-            if is_ova:  # same rule as the in-memory path
-                raise ShifuError(
-                    ErrorCode.INVALID_MODEL_CONFIG,
-                    "grid search is not supported with ONEVSALL "
-                    "multi-class; pick one hyperparameter set",
-                )
-            best = self._grid_search_streamed(norm_dir, composites, mesh)
+            best = self._grid_search_streamed(
+                norm_dir, composites, mesh,
+                n_classes=len(mc.tags()) if is_ova else 0)
             log.info("streamed grid search best params: %s", best)
             mc.train.params = best
         num_kfold = mc.train.num_k_fold or -1
@@ -239,7 +238,7 @@ class TrainProcessor(BasicProcessor):
                 self._k_fold_streamed(alg, num_kfold, norm_dir, norm_json,
                                       suffix, mesh)
                 return
-        ova = multi and mc.train.is_one_vs_all()
+        ova = is_ova
         class_tags = [str(t) for t in mc.tags()] if multi else None
         n_members = (len(class_tags) if ova
                      else max(1, int(mc.train.bagging_num or 1)))
@@ -269,11 +268,45 @@ class TrainProcessor(BasicProcessor):
             log.info("streamed model %d -> %s (valid err %.6f)", i, path,
                      res.valid_error)
 
-    def _grid_search_streamed(self, norm_dir, composites, mesh) -> dict:
+    def _grid_search_ova(self, alg, composites, feats, tags, weights,
+                         mesh) -> dict:
+        """Grid x ONEVSALL: trials run serially, each trial's K per-class
+        binary members ride one vmapped program; the trial's score is the
+        mean class holdout error."""
+        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn_bagged
+
+        mc = self.model_config
+        K = len(mc.tags())
+        member_tags = np.stack(
+            [(tags == k).astype(np.float32) for k in range(K)]
+        )
+        orig = mc.train.params
+        results = []
+        for gi, params in enumerate(composites):
+            mc.train.params = params
+            try:
+                cfg = NNTrainConfig.from_model_config(mc, trainer_id=0)
+            finally:
+                mc.train.params = orig
+            trial = train_nn_bagged(feats, tags, weights, cfg, K, mesh=mesh,
+                                    member_tags=member_tags,
+                                    member_seed=lambda i, _g=gi:
+                                    (_g * 100 + i) * 1000 + 7)
+            err = float(np.mean([r.valid_error for r in trial]))
+            results.append((err, gi, params))
+            log.info("OVA grid trial %d/%d mean class err %.6f params=%s",
+                     gi + 1, len(composites), err, params)
+        results.sort(key=lambda r: r[0])
+        return results[0][2]
+
+    def _grid_search_streamed(self, norm_dir, composites, mesh,
+                              n_classes: int = 0) -> dict:
         """Serial grid trials over the streamed trainer — each trial is a
         full shard-streamed run (an error here was a parity subtraction:
         the reference fans trials out as Guagua jobs over data of any
-        size, TrainModelProcessor.java:768-945)."""
+        size, TrainModelProcessor.java:768-945). Under ONEVSALL
+        (n_classes > 0) each trial streams one run PER CLASS and scores
+        the mean class holdout error, mirroring _grid_search_ova."""
         from shifu_tpu.train.nn_trainer import NNTrainConfig
         from shifu_tpu.train.streaming import train_nn_streamed
 
@@ -286,10 +319,19 @@ class TrainProcessor(BasicProcessor):
                 cfg = NNTrainConfig.from_model_config(mc, trainer_id=gi)
             finally:
                 mc.train.params = orig
-            res = train_nn_streamed(norm_dir, cfg, mesh=mesh)
-            results.append((res.valid_error, gi, params))
+            if n_classes > 0:
+                errs = [
+                    train_nn_streamed(norm_dir, cfg, mesh=mesh,
+                                      target_class=k).valid_error
+                    for k in range(n_classes)
+                ]
+                err = float(np.mean(errs))
+            else:
+                err = train_nn_streamed(norm_dir, cfg,
+                                        mesh=mesh).valid_error
+            results.append((err, gi, params))
             log.info("streamed grid trial %d/%d valid err %.6f params=%s",
-                     gi + 1, len(composites), res.valid_error, params)
+                     gi + 1, len(composites), err, params)
         results.sort(key=lambda r: r[0])
         return results[0][2]
 

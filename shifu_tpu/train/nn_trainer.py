@@ -542,7 +542,9 @@ def train_nn_bagged(
 
         n_dev = mesh.devices.size
         (x,), _ = pad_rows([x], n_dev)
-        member_rows = NamedSharding(mesh, P(None, "data"))
+        from shifu_tpu.parallel.mesh import row_axes as _raxes
+
+        member_rows = NamedSharding(mesh, P(None, _raxes(mesh)))
         if t_batched:
             t = jax.device_put(np.pad(t, ((0, 0), (0, x.shape[0] - n))),
                                member_rows)

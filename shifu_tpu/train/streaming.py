@@ -89,9 +89,9 @@ class ShardFeed:
         if mesh is not None and self.pad_rows:
             # rows shard over the mesh's data axis: pad every shard to a
             # multiple of the axis size (padding carries zero significance)
-            n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
-                "data", mesh.devices.size)
-            self.pad_rows = -(-self.pad_rows // n_data) * n_data
+            from shifu_tpu.parallel.mesh import round_up_rows
+
+            self.pad_rows = round_up_rows(self.pad_rows, mesh)
         self.cfg = cfg
         self._jax = jax
         # per-shard sampling masks (train significance / valid mask), drawn

@@ -14,8 +14,21 @@ the mmap'd CleanedData shards once per level:
 The merged-histogram-then-split structure is exactly DTWorker partial
 stats -> DTMaster merge (dt/DTMaster.java:297-310) with disk shards
 standing in for workers. The same RNG streams as the in-memory trainer
-drive sampling, so forests match it up to histogram float-summation order
-(per-shard partial sums associate differently than one whole-array pass).
+drive sampling.
+
+EQUALITY CONTRACT vs the in-memory trainer (tests/test_streaming_train.py
+pins each clause):
+  * histogram COUNT planes are sums of integers in f32 — EXACT under any
+    summation order while total weighted counts stay < 2^24. Hence:
+      - multi-class RF (count-only histograms, integer bag weights):
+        forests are BIT-EQUAL;
+      - split structure (feature + categorical mask per node): equal in
+        practice, because count-based validity is exact and gain values
+        rarely tie; a regression-label gain tie across shard orders may
+        legitimately pick a different equal-gain split.
+  * label sum/sqsum planes and leaf values: equal up to float-summation
+    order (per-shard partials associate differently than one whole-array
+    pass) — compared with tolerance, never bit-asserted.
 """
 
 from __future__ import annotations
@@ -282,24 +295,23 @@ def train_trees_streamed(
     log_loss = cfg.loss == "log"
     lr = cfg.learning_rate
 
-    n_data = 1
     if mesh is not None:
-        n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
-            "data", mesh.devices.size)
+        from shifu_tpu.parallel.mesh import round_up_rows, shard_rows
 
         def row_put(a):
-            from shifu_tpu.parallel.mesh import shard_rows
-
             return shard_rows(a, mesh)
+
+        def pad_to_mesh(a):
+            rows = a.shape[0]
+            target = round_up_rows(rows, mesh)
+            if target == rows:
+                return a
+            return np.pad(a, [(0, target - rows)] + [(0, 0)] * (a.ndim - 1))
     else:
         row_put = jnp.asarray
 
-    def pad_to_mesh(a):
-        rows = a.shape[0]
-        target = -(-rows // n_data) * n_data
-        if target == rows:
+        def pad_to_mesh(a):
             return a
-        return np.pad(a, [(0, target - rows)] + [(0, 0)] * (a.ndim - 1))
 
     # per-shard device state (small): labels/weights/valid stay resident
     rng_valid = np.random.default_rng([cfg.seed, 999_983])

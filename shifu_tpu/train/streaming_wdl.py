@@ -58,9 +58,9 @@ class WDLShardFeed:
         self.pad_rows = max(self.meta.shard_rows) if self.meta.shard_rows else 0
         self.mesh = mesh
         if mesh is not None and self.pad_rows:
-            n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
-                "data", mesh.devices.size)
-            self.pad_rows = -(-self.pad_rows // n_data) * n_data
+            from shifu_tpu.parallel.mesh import round_up_rows
+
+            self.pad_rows = round_up_rows(self.pad_rows, mesh)
         self._sig = []
         for s, rows in enumerate(self.meta.shard_rows):
             cfg_s = WDLTrainConfig(

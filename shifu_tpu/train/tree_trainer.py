@@ -443,15 +443,20 @@ def _get_hist_program(L: int, lay: FeatureLayout,
     else:
         from jax.sharding import PartitionSpec as P
 
+        from shifu_tpu.parallel.mesh import row_axes
+
+        r_axes = row_axes(mesh)
+        rspec = P(r_axes if len(r_axes) > 1 else r_axes[0])
+
         def meshed(codes, labels, weights, node, active, off, clip, seg,
                    pos):
             h = fn(codes, labels, weights, node, active, off, clip, seg,
                    pos)
-            return jax.lax.psum(h, "data")
+            return jax.lax.psum(h, r_axes)
 
         specs = dict(
             mesh=mesh,
-            in_specs=(P("data"),) * 5 + (P(),) * 4,
+            in_specs=(rspec,) * 5 + (P(),) * 4,
             out_specs=P(),
         )
         try:
@@ -880,6 +885,10 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
     size_c = jnp.asarray(lay.seg_size_t)
     seg0 = int(lay.slots[0]) if len(lay.slots) else 1
     on_mesh = mesh is not None
+    if on_mesh:
+        from shifu_tpu.parallel.mesh import row_axes
+
+        r_axes = row_axes(mesh)
 
     def tree_body(codes, labels, weights, feat_ok_t):
         n = codes.shape[0]
@@ -892,7 +901,7 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
             hist = hist_fns[d](codes, labels, weights, node, active,
                                off_c, clip_c, seg_c, pos_c)
             if on_mesh:
-                hist = jax.lax.psum(hist, "data")
+                hist = jax.lax.psum(hist, r_axes)
             (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = scan_fns[d](
                 hist, feat_ok_t, is_cat_c, seg_c, pos_c, start_c, size_c,
                 off_c, clip_c, seg0)
@@ -916,7 +925,7 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
         L2 = 2**D
         acc = leaf_acc(labels, weights, node, active)
         if on_mesh:
-            acc = jax.lax.psum(acc, "data")
+            acc = jax.lax.psum(acc, r_axes)
         leaves_l.append(leaf_finalize(acc))
         resting = jnp.where(active, (L2 - 1) + node, resting)
         feat_flat = jnp.concatenate(
@@ -930,10 +939,11 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
     if on_mesh:
         from jax.sharding import PartitionSpec as P
 
+        rspec = P(r_axes if len(r_axes) > 1 else r_axes[0])
         specs = dict(
             mesh=mesh,
-            in_specs=(P("data"), P("data"), P("data"), P()),
-            out_specs=(P(), P(), P(), P("data"), P("data")),
+            in_specs=(rspec, rspec, rspec, P()),
+            out_specs=(P(), P(), P(), rspec, rspec),
         )
         try:
             from jax import shard_map

@@ -206,9 +206,9 @@ def train_wdl(
     if mesh is not None:
         from shifu_tpu.parallel.mesh import pad_rows, shard_rows
 
-        n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
-            "data", mesh.devices.size
-        )
+        from shifu_tpu.parallel.mesh import row_shard_count
+
+        n_data = row_shard_count(mesh)
         (d, c, t, sig_tr, sig_va), _ = pad_rows([d, c, t, sig_tr, sig_va], n_data)
         d = shard_rows(d, mesh)
         c = shard_rows(c, mesh)
@@ -332,16 +332,18 @@ def train_wdl_bagged(
 
         from shifu_tpu.parallel.mesh import pad_rows, shard_rows
 
-        n_data = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
-            "data", mesh.devices.size
-        )
+        from shifu_tpu.parallel.mesh import row_shard_count
+
+        n_data = row_shard_count(mesh)
         (d, c, t), _ = pad_rows([d, c, t], n_data)
         sig_t = np.pad(sig_t, ((0, 0), (0, d.shape[0] - n)))
         sig_v = np.pad(sig_v, ((0, 0), (0, d.shape[0] - n)))
         d = shard_rows(d, mesh)
         c = shard_rows(c, mesh)
         t = shard_rows(t, mesh)
-        member_rows = NamedSharding(mesh, P(None, "data"))
+        from shifu_tpu.parallel.mesh import row_axes as _raxes
+
+        member_rows = NamedSharding(mesh, P(None, _raxes(mesh)))
         sig_t = jax.device_put(sig_t, member_rows)
         sig_v = jax.device_put(sig_v, member_rows)
 

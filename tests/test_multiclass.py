@@ -346,3 +346,23 @@ def test_multiclass_confusion_streams_past_budget(tmp_path):
         streamed = json.load(fh)
     assert streamed["confusionMatrix"] == in_memory["confusionMatrix"]
     assert streamed["accuracy"] == in_memory["accuracy"]
+
+
+def test_onevsall_grid_search(tmp_path):
+    """Grid x ONEVSALL fans out instead of erroring: each trial trains all
+    K per-class members as one vmapped program, best params win
+    (TrainModelProcessor.java:684-945 runs grid x class Guagua jobs)."""
+    import json
+
+    root = str(tmp_path / "ms")
+    make_multiclass_model_set(root, n_rows=500, method="ONEVSALL")
+    from shifu_tpu.config.model_config import ModelConfig
+
+    mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+    mc.train.num_train_epochs = 20
+    mc.train.params["LearningRate"] = [0.05, 0.2]
+    mc.save(os.path.join(root, "ModelConfig.json"))
+    _run_pipeline(root)
+    models = [f for f in os.listdir(os.path.join(root, "models"))
+              if f.endswith(".nn")]
+    assert len(models) == len(CLASSES)  # one binary model per class
