@@ -351,13 +351,15 @@ def bench_nn(spec: dict, mixed_precision: bool, reps: int):
     )
     x_dev = jax.device_put(x)
     t_dev = jax.device_put(t)
+    w_dev = jax.device_put(w)
     # warmup compiles the program (epoch count is traced, so 2 epochs warm
     # the full run); fetch_params=False keeps the steady-state timing free
     # of the end-of-run weight pull (see module docstring)
     warm = NNTrainConfig(**{**cfg.__dict__, "num_epochs": 2})
-    train_nn(x_dev, t_dev, w, warm)
+    train_nn(x_dev, t_dev, w_dev, warm)
     med, lo, hi = _median_timed(
-        lambda: train_nn(x_dev, t_dev, w, cfg, fetch_params=False), reps)
+        lambda: train_nn(x_dev, t_dev, w_dev, cfg, fetch_params=False),
+        reps)
     row_epochs = n * spec["epochs"]
     return {
         "row_epochs_per_s": row_epochs / med,
