@@ -547,6 +547,7 @@ def train_nn_bagged(
     out_dim = base_cfg.n_classes if base_cfg.n_classes > 2 else 1
     layer_sizes = [d] + list(base_cfg.hidden_nodes) + [out_dim]
     shapes = None
+    device_sigs = member_sigs is None and mesh is None
     flat0s, sig_ts, sig_vs, ntss, seeds = [], [], [], [], []
     for i in range(n_members):
         seed_i = member_seed(i)
@@ -562,12 +563,21 @@ def train_nn_bagged(
             ntss.append(float(max((member_sigs[0][i] > 0).sum(), 1.0)))
         else:
             cfg_i = NNTrainConfig(**{**base_cfg.__dict__, "seed": seed_i})
-            sig, valid_mask = split_and_sample(n, cfg_i)
-            sig_ts.append((sig * weights).astype(np.float32))
-            sig_vs.append(
-                (valid_mask.astype(np.float32) * weights).astype(np.float32)
-            )
-            ntss.append(float(max(sig.sum(), 1.0)))
+            if device_sigs:
+                # per-member draws ride the device cache: a 5-member bag
+                # on 1M rows would otherwise transfer ~40 MB of masks
+                # per call over a remote TPU link
+                sig_d, valid_d, nts_i = _device_split_and_sample(n, cfg_i)
+                sig_ts.append(sig_d)
+                sig_vs.append(valid_d)
+                ntss.append(nts_i)
+            else:
+                sig, valid_mask = split_and_sample(n, cfg_i)
+                sig_ts.append((sig * weights).astype(np.float32))
+                sig_vs.append(
+                    (valid_mask.astype(np.float32) * weights)
+                    .astype(np.float32))
+                ntss.append(float(max(sig.sum(), 1.0)))
         flat0s.append(flat0)
 
     x = features if isinstance(features, jax.Array) else features.astype(np.float32)
@@ -576,8 +586,14 @@ def train_nn_bagged(
         t = np.asarray(member_tags, np.float32)  # [M, n]
     else:
         t = tags if isinstance(tags, jax.Array) else tags.astype(np.float32)
-    sig_t = np.stack(sig_ts)  # [M, n]
-    sig_v = np.stack(sig_vs)
+    if device_sigs:
+        w_d = (weights if isinstance(weights, jax.Array)
+               else jnp.asarray(np.asarray(weights, np.float32)))
+        sig_t = jnp.stack(sig_ts) * w_d[None, :]  # [M, n] on device
+        sig_v = jnp.stack(sig_vs) * w_d[None, :]
+    else:
+        sig_t = np.stack(sig_ts)  # [M, n]
+        sig_v = np.stack(sig_vs)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -252,15 +252,19 @@ def train_wdl(
     (flat_f, _, it_f, best_val, best_flat, _, _, tr_e, va_e) = result
     import math as _math
 
-    use_best = cfg.valid_set_rate > 0 and _math.isfinite(float(best_val))
+    # one host round-trip for all scalars (serial casts pay an RTT each on
+    # remote TPU links)
+    it_h, bv, tr_h, va_h = map(
+        lambda a: a.item(), jax.device_get((it_f, best_val, tr_e, va_e)))
+    use_best = cfg.valid_set_rate > 0 and _math.isfinite(bv)
     chosen = np.asarray(best_flat if use_best else flat_f)
     params = _to_host_params(chosen, template)
-    final_valid = float(best_val) if use_best else float(va_e)
+    final_valid = float(bv) if use_best else float(va_h)
     log.info("wdl train done: %d iterations, train_err %.6f valid_err %.6f",
-             int(it_f), float(tr_e), final_valid)
+             int(it_h), float(tr_h), final_valid)
     return WDLTrainResult(
-        params=params, train_error=float(tr_e), valid_error=final_valid,
-        iterations=int(it_f),
+        params=params, train_error=float(tr_h), valid_error=final_valid,
+        iterations=int(it_h),
     )
 
 
