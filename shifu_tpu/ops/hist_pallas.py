@@ -38,8 +38,6 @@ from __future__ import annotations
 import functools
 from typing import List
 
-import numpy as np
-
 # VMEM budget shaping: rows per grid step x max chunk columns. M [BLK, W]
 # f32 + A [BLK, C*L] f32 + out [C*L, W] f32 must sit well under ~16 MB.
 _BLK = 512

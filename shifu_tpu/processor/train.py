@@ -12,7 +12,6 @@ hit).
 
 from __future__ import annotations
 
-import json
 import os
 from typing import List, Optional
 

@@ -34,7 +34,6 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from shifu_tpu.models.nn import (
-    NNModelSpec,
     activation_fn,
     flatten_params,
     init_params,

@@ -40,7 +40,7 @@ Early stop: simple worsen-count OR the reference's windowed decider
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np

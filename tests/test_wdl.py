@@ -277,3 +277,42 @@ def test_wdl_streamed_training(tmp_path):
     assert spec.valid_error is not None and spec.valid_error < 0.25
     assert os.path.isfile(os.path.join(root, "tmp", "train",
                                        "progress_0.log"))
+
+
+def test_wdl_streamed_mesh_matches_single_device(tmp_path):
+    """Streamed WDL composes with the mesh: row-sharded shard pairs, shard
+    gradients psum'd — same trajectory as the single-device stream."""
+    import numpy as np
+
+    from shifu_tpu.norm.dataset import write_codes, write_normalized
+    from shifu_tpu.parallel.mesh import data_mesh
+    from shifu_tpu.train.streaming_wdl import train_wdl_streamed
+    from shifu_tpu.train.wdl_trainer import WDLTrainConfig
+
+    rng = np.random.default_rng(5)
+    n, nd, nc, vocab = 1200, 4, 2, 6
+    dense = rng.normal(size=(n, nd)).astype(np.float32)
+    codes = rng.integers(0, vocab, size=(n, nc)).astype(np.int16)
+    t = ((dense[:, 0] + (codes[:, 0] >= 3)) > 0.5).astype(np.int8)
+    w = np.ones(n, np.float32)
+    norm_dir = str(tmp_path / "NormalizedData")
+    codes_dir = str(tmp_path / "CleanedData")
+    cols = [f"d{i}" for i in range(nd)] + [f"c{i}" for i in range(nc)]
+    write_normalized(norm_dir, np.concatenate(
+        [dense, codes.astype(np.float32)], 1), t, w, cols, n_shards=3)
+    write_codes(codes_dir, np.concatenate(
+        [np.zeros((n, nd), np.int16), codes], 1), t, w, cols,
+        [1] * nd + [vocab] * nc, n_shards=3)
+    cfg = WDLTrainConfig(hidden=[8], activations=["relu"], embed_dim=4,
+                         num_epochs=10, valid_set_rate=0.2, seed=3)
+    num_idx = list(range(nd))
+    cat_idx = [nd, nd + 1]
+    single = train_wdl_streamed(norm_dir, codes_dir, num_idx, cat_idx,
+                                [vocab] * nc, cfg)
+    meshed = train_wdl_streamed(norm_dir, codes_dir, num_idx, cat_idx,
+                                [vocab] * nc, cfg, mesh=data_mesh())
+    assert meshed.iterations == single.iterations
+    assert meshed.valid_error == pytest.approx(single.valid_error,
+                                               abs=1e-4)
+    np.testing.assert_allclose(meshed.params.embed[0],
+                               single.params.embed[0], atol=1e-4)
