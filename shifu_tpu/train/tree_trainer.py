@@ -14,7 +14,12 @@ per-feature slot layout:
                the node-batch size L is sized from MaxStatsMemoryMB over
                the true T). Built by ONE scatter-add over the [n, F] code
                matrix; row-sharded inputs all-reduce (psum) the histogram
-               when run on a mesh.
+               when run on a mesh. On a single device, the code one-hot
+               ("M", [n, T] bf16 — 0/1 is exact in bf16) is HOISTED
+               ACROSS THE FOREST: it is node- and label-independent, so
+               one build serves every level of every tree and each
+               level's histogram is one blocked dot (gated by
+               _M_BUDGET_BYTES; falls back to the rebuild path).
     split scan ordered prefix sums per (node, feature segment): numeric
                segments keep code order, categorical segments sort by label
                mean (lexsort within static segment boundaries); gain by
@@ -194,6 +199,49 @@ _PROGRAMS: Dict[tuple, object] = {}
 # L-fold redundancy stops paying for itself and the scatter path wins
 MATMUL_CL_CAP = 4096
 
+
+def _make_comps_of(n_classes: int):
+    """Shared histogram component builder: [w, wy, wy^2] for
+    regression/binary, one weighted count plane per class for NATIVE
+    multi-class (dt/Impurity.java:368,553)."""
+    import jax.numpy as jnp
+
+    def comps_of(w, labels):
+        if n_classes >= 3:
+            cls = jnp.clip(labels.astype(jnp.int32), 0, n_classes - 1)
+            return [w * (cls == c).astype(jnp.float32)
+                    for c in range(n_classes)]
+        return [w, w * labels, w * labels * labels]
+
+    return comps_of
+
+
+def _onehot_cols(code_b, pieces, slots_np, clip_np, blk: int):
+    """One chunk's code one-hots as a list of [blk, *] bool columns in
+    flat-slot order (shared by the per-level rebuild path and the
+    forest-hoisted M builder — any change to the clip/piece semantics
+    lands in both)."""
+    import jax.numpy as jnp
+
+    cols = []
+    for run in _piece_runs(pieces, slots_np):
+        if len(run) == 1:
+            (f, lo, hi) = run[0]
+            cw = hi - lo
+            cf = jnp.clip(code_b[:, f], 0, int(clip_np[f]))
+            # for a partial piece of a wide feature the equality against
+            # the shifted range doubles as the bound check
+            cols.append((cf - lo)[:, None] == jnp.arange(cw)[None, :])
+        else:  # consecutive full features of EQUAL width: one vectorized
+            # [blk, m, w] one-hot keeps the trace O(runs), not O(features)
+            fs = [f for (f, _lo, _hi) in run]
+            cw = run[0][2]
+            cf = jnp.clip(code_b[:, fs[0]:fs[-1] + 1], 0, cw - 1)
+            cols.append((cf[:, :, None]
+                         == jnp.arange(cw)[None, None, :]).reshape(
+                blk, len(fs) * cw))
+    return cols
+
 # target lane width of one flat-T chunk (feature one-hots are concatenated
 # at their STATIC column offsets, so a 10k-category feature just spans
 # several chunks instead of inflating every feature to its width)
@@ -275,13 +323,7 @@ def _make_hist_fn(L: int, lay: FeatureLayout, allow_matmul: bool = True,
     C = n_classes if n_classes >= 3 else 3
     T = lay.T
     use_matmul = allow_matmul and C * L <= MATMUL_CL_CAP
-
-    def comps_of(w, labels):
-        if n_classes >= 3:
-            cls = jnp.clip(labels.astype(jnp.int32), 0, n_classes - 1)
-            return [w * (cls == c).astype(jnp.float32)
-                    for c in range(n_classes)]
-        return [w, w * labels, w * labels * labels]
+    comps_of = _make_comps_of(n_classes)
 
     def hist_scatter(codes, labels, weights, node_slot, active, off_f,
                      clip_f, seg_t, pos_t):
@@ -340,28 +382,7 @@ def _make_hist_fn(L: int, lay: FeatureLayout, allow_matmul: bool = True,
             code_b = sl(codes_p)
             parts = []
             for pieces in chunks:
-                cols = []
-                for run in _piece_runs(pieces, slots_np):
-                    if len(run) == 1:
-                        (f, lo, hi) = run[0]
-                        cw = hi - lo
-                        cf = jnp.clip(code_b[:, f], 0, int(clip_np[f]))
-                        # for a partial piece of a wide feature the
-                        # equality against the shifted range doubles as
-                        # the bound check
-                        oh = ((cf - lo)[:, None]
-                              == jnp.arange(cw)[None, :])
-                    else:  # consecutive full features of EQUAL width:
-                        # one vectorized [blk, m, w] one-hot keeps the
-                        # trace O(runs), not O(features)
-                        fs = [f for (f, _lo, _hi) in run]
-                        cw = run[0][2]
-                        cf = jnp.clip(code_b[:, fs[0]:fs[-1] + 1], 0,
-                                      cw - 1)
-                        oh = (cf[:, :, None]
-                              == jnp.arange(cw)[None, None, :]).reshape(
-                            blk, len(fs) * cw)
-                    cols.append(oh)
+                cols = _onehot_cols(code_b, pieces, slots_np, clip_np, blk)
                 M = (cols[0] if len(cols) == 1
                      else jnp.concatenate(cols, axis=1)).astype(jnp.float32)
                 parts.append(jnp.einsum("nk,nt->kt", A, M))
@@ -374,6 +395,111 @@ def _make_hist_fn(L: int, lay: FeatureLayout, allow_matmul: bool = True,
         return hist.reshape(C, L, T)
 
     return hist_matmul
+
+
+# hoisted code one-hot ("M"): the [n, T] one-hot of the flat bin codes is
+# NODE-INDEPENDENT — one build serves every level of every tree in the
+# forest. Stored bf16 (0/1 is exact) in row blocks so each level's
+# histogram is one blocked dot instead of a rebuild+materialize of M.
+_M_BLK = 8192
+# the hoisted-M path keeps A = [_M_BLK, C*L] f32 per scan step; beyond
+# this lhs width the rebuild path's budget-derived blocking is safer
+_M_CL_CAP = 1024
+
+
+def _m_budget_bytes() -> int:
+    """Hoist the forest one-hot only while it fits this budget
+    (-Dshifu.train.histCacheBudgetMB, default 4096 — the one memory knob
+    here that is NOT MaxStatsMemoryMB, because M is a per-RUN cache, not
+    a per-level working set)."""
+    from shifu_tpu.utils import environment
+
+    return environment.get_int("shifu.train.histCacheBudgetMB", 4096) << 20
+
+
+def _get_m_builder(lay: FeatureLayout):
+    key = ("mbuild", lay.key)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        prog = _make_m_builder(lay)
+        _PROGRAMS[key] = prog
+    return prog
+
+
+def _make_m_builder(lay: FeatureLayout):
+    """jit fn(codes [n, F] i32) -> M [nb, _M_BLK, T] bf16 (rows padded)."""
+    import jax
+    import jax.numpy as jnp
+
+    chunks = _t_chunks(lay)
+    slots_np = lay.slots
+    clip_np = lay.clip_max
+
+    def build(codes):
+        n, F = codes.shape
+        n_pad = -(-n // _M_BLK) * _M_BLK
+        codes_p = jnp.pad(codes, ((0, n_pad - n), (0, 0)))
+
+        def block(_, i):
+            code_b = jax.lax.dynamic_slice_in_dim(codes_p, i * _M_BLK,
+                                                  _M_BLK, 0)
+            cols = []
+            for pieces in chunks:
+                cols.extend(_onehot_cols(code_b, pieces, slots_np,
+                                         clip_np, _M_BLK))
+            M_b = (cols[0] if len(cols) == 1
+                   else jnp.concatenate(cols, axis=1))
+            return None, M_b.astype(jnp.bfloat16)
+
+        _, M = jax.lax.scan(block, None, jnp.arange(n_pad // _M_BLK))
+        return M  # [nb, _M_BLK, T]
+
+    return jax.jit(build)
+
+
+def _make_hist_m_fn(L: int, lay: FeatureLayout, n_classes: int = 0):
+    """Histogram from the hoisted M: fn(M, labels, weights, node, active)
+    -> [C, L, T]. Per block: A = comps ⊗ one-hot(node) in f32, one
+    dot_general against the bf16 M block (XLA upconverts the exact 0/1
+    values in-register, so counts/sums match the rebuild path bit-for-bit
+    in summation structure)."""
+    import jax
+    import jax.numpy as jnp
+
+    C = n_classes if n_classes >= 3 else 3
+    T = lay.T
+    comps_of = _make_comps_of(n_classes)
+
+    def hist_m(M, labels, weights, node_slot, active):
+        n = labels.shape[0]
+        w = jnp.where(active, weights, 0.0)
+        nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
+        comps = jnp.stack(comps_of(w, labels), 1)  # [n, C]
+        n_pad = M.shape[0] * _M_BLK
+        comps_p = jnp.pad(comps, ((0, n_pad - n), (0, 0)))
+        nl_p = jnp.pad(nl, (0, n_pad - n))
+
+        def block(hist, i):
+            sl = lambda a: jax.lax.dynamic_slice_in_dim(a, i * _M_BLK,
+                                                        _M_BLK, 0)
+            comps_b = sl(comps_p)
+            if L == 1:
+                A = comps_b
+            else:
+                oh_node = (sl(nl_p)[:, None]
+                           == jnp.arange(L)[None, :]).astype(jnp.float32)
+                A = (comps_b[:, :, None] * oh_node[:, None, :]).reshape(
+                    _M_BLK, C * L)
+            contrib = jax.lax.dot_general(
+                A, M[i], (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [C*L, T]
+            return hist + contrib, None
+
+        hist0 = jnp.zeros((C * L, T), jnp.float32)
+        hist, _ = jax.lax.scan(block, hist0, jnp.arange(M.shape[0]))
+        return hist.reshape(C, L, T)
+
+    return hist_m
 
 
 def _make_leaf_fn(L: int, n_classes: int = 0):
@@ -828,7 +954,7 @@ def _use_pallas_hist(mesh) -> bool:
 
 def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
                       min_inst: int, min_gain: float, n_classes: int = 0,
-                      mesh=None):
+                      mesh=None, with_m: bool = False):
     """ONE jit program for a whole level-wise tree, levels UNROLLED at
     their exact widths: level d builds a [C, 2^d, T] histogram (≈3.5x less
     padded-node work than running every level at 2^D) and the final level
@@ -850,7 +976,7 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
     Static layout arrays are baked in as constants; only the per-tree
     feature subset stays an argument."""
     key = ("tree", D, lay.key, impurity, min_inst, float(min_gain),
-           n_classes, _mesh_key(mesh))
+           n_classes, _mesh_key(mesh), with_m)
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
@@ -859,7 +985,11 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
 
     T, s_max = lay.T, lay.s_max
     min_inst_eff = max(min_inst, 1)
-    if _use_pallas_hist(mesh):
+    if with_m:
+        hist_m_fns = [_make_hist_m_fn(2**d, lay, n_classes)
+                      for d in range(D)]
+        hist_fns = None
+    elif _use_pallas_hist(mesh):
         from shifu_tpu.ops.hist_pallas import make_pallas_hist_fn
 
         pallas_fns = [make_pallas_hist_fn(2**d, lay, n_classes=n_classes)
@@ -890,7 +1020,7 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
 
         r_axes = row_axes(mesh)
 
-    def tree_body(codes, labels, weights, feat_ok_t):
+    def tree_body(codes, labels, weights, feat_ok_t, M=None):
         n = codes.shape[0]
         node = jnp.zeros(n, jnp.int32)
         active = jnp.ones(n, bool)
@@ -898,8 +1028,11 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
         feats_l, masks_l, leaves_l = [], [], []
         for d in range(D):
             L = 2**d
-            hist = hist_fns[d](codes, labels, weights, node, active,
-                               off_c, clip_c, seg_c, pos_c)
+            if with_m:
+                hist = hist_m_fns[d](M, labels, weights, node, active)
+            else:
+                hist = hist_fns[d](codes, labels, weights, node, active,
+                                   off_c, clip_c, seg_c, pos_c)
             if on_mesh:
                 hist = jax.lax.psum(hist, r_axes)
             (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = scan_fns[d](
@@ -1553,17 +1686,33 @@ def train_trees(
     batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
                                  cfg.n_classes)
     fused = (not leaf_wise) and 2**cfg.max_depth <= batch_cap
+    M_forest = None
     if fused:
         replicate_fn = None
         if mesh is not None:
             from shifu_tpu.parallel.mesh import replicate
 
             replicate_fn = lambda a: replicate(a, mesh)  # noqa: E731
+        # hoist the code one-hot across the WHOLE forest when it fits:
+        # node-independent, so one bf16 [n, T] build replaces a rebuild +
+        # HBM materialization per level of every tree
+        C_hist = cfg.n_classes if cfg.n_classes >= 3 else 3
+        n_pad_m = -(-n // _M_BLK) * _M_BLK
+        use_m = (mesh is None
+                 and n_pad_m * lay.T * 2 <= _m_budget_bytes()
+                 # deepest hist level is 2^(D-1) nodes; cap the A width
+                 and C_hist * 2 ** max(cfg.max_depth - 1, 0) <= _M_CL_CAP
+                 # resume-stable: depends on cfg only, never on start_k,
+                 # so a checkpoint-resumed run picks the SAME lowering as
+                 # the uninterrupted one (bit-equal resume contract)
+                 and cfg.tree_num * cfg.max_depth >= 2)
         tree_prog = _get_tree_program(
             cfg.max_depth, lay, cfg.impurity,
             cfg.min_instances_per_node, cfg.min_info_gain,
-            n_classes=cfg.n_classes, mesh=mesh,
+            n_classes=cfg.n_classes, mesh=mesh, with_m=use_m,
         )
+        if use_m:
+            M_forest = _get_m_builder(lay)(codes_j)
     deferred: List[tuple] = []  # (k, weight, feats_d, masks_d, leaves_d)
     err_pairs: List[tuple] = []  # device (train, valid) when deferred
 
@@ -1613,8 +1762,12 @@ def train_trees(
                 fot = jnp.asarray(np.asarray(feat_ok, bool)[lay.seg_of_t])
                 if replicate_fn is not None:
                     fot = replicate_fn(fot)
-            feats_d, masks_d, leaves_d, _resting, tree_pred = tree_prog(
-                codes_j, labels_k, w_k, fot)
+            if M_forest is not None:
+                feats_d, masks_d, leaves_d, _resting, tree_pred = tree_prog(
+                    codes_j, labels_k, w_k, fot, M_forest)
+            else:
+                feats_d, masks_d, leaves_d, _resting, tree_pred = tree_prog(
+                    codes_j, labels_k, w_k, fot)
             deferred.append(
                 (k, 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0),
                  feats_d, masks_d, leaves_d))
