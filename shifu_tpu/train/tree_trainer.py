@@ -1498,24 +1498,27 @@ def _score_existing(trees: List[DenseTree], codes) -> "object":
 
 
 def _assemble_deferred(trees: List, deferred: List[tuple],
-                       cfg: TreeTrainConfig) -> None:
+                       cfg: TreeTrainConfig, extra=None):
     """Materialize fused-path trees from their device results. The backlog
-    is stacked on device first so the host pull is THREE contiguous
-    transfers total, not three per tree (small transfers pay a full tunnel
-    RTT each on remote TPU links)."""
+    is stacked on device first so the host pull is ONE device_get of
+    three contiguous arrays (plus the caller's `extra` pytree, fetched in
+    the same round-trip), not three per tree — small transfers pay a full
+    tunnel RTT each on remote TPU links. Returns the fetched `extra`."""
     import jax
     import jax.numpy as jnp
 
     f_all = jnp.stack([f for _k, _w, f, _m, _lv in deferred])
     m_all = jnp.stack([m for _k, _w, _f, m, _lv in deferred])
     l_all = jnp.stack([lv for _k, _w, _f, _m, lv in deferred])
-    fh_all, mh_all, lh_all = jax.device_get((f_all, m_all, l_all))
+    fh_all, mh_all, lh_all, extra_h = jax.device_get(
+        (f_all, m_all, l_all, extra))
     for i, (k, weight_k, _f, _m, _lv) in enumerate(deferred):
         tree = _assemble_dense_tree(fh_all[i], mh_all[i], lh_all[i],
                                     cfg.max_depth)
         tree.weight = weight_k
         trees[k] = tree  # trees list is indexed by global tree id
     deferred.clear()
+    return extra_h
 
 
 def train_trees(
@@ -1839,11 +1842,14 @@ def train_trees(
             else:
                 bad_rounds = 0
 
-    if deferred:
-        _assemble_deferred(trees, deferred, cfg)
-    if err_pairs:  # deferred error sync: one host transfer for the run
-        host = np.asarray(jax.device_get(
-            jnp.stack([jnp.stack(p) for p in err_pairs])))
+    errs_d = (jnp.stack([jnp.stack(p) for p in err_pairs])
+              if err_pairs else None)
+    if deferred:  # trees + errors ride ONE host round-trip
+        errs_d = _assemble_deferred(trees, deferred, cfg, extra=errs_d)
+    elif errs_d is not None:
+        errs_d = jax.device_get(errs_d)
+    if err_pairs:  # deferred error sync
+        host = np.asarray(errs_d)
         errs = [(float(t), float(v)) for t, v in host]
         terr, verr = errs[-1]
         j = 0
