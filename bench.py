@@ -16,6 +16,8 @@ perf-blind, GBT needed a representative config):
   gbt        500k x 30 numeric, 5 trees (round-over-round continuity)
   gbt_wide   200k x 200 mixed (19 cat-64 + one 2000-category column),
              20 trees — the reference's wide-categorical envelope
+  rf         500k x 30 with 10 native categorical columns, Poisson
+             bagging + TWOTHIRDS subsets (north-star config #4)
   wdl        wide&deep: 20 dense + 10 wide vocab-100 columns
   streamed   the larger-than-memory NN path from disk shards
 
@@ -51,6 +53,7 @@ DENSE = dict(d=1024, hidden=[2048, 2048], n=131_072, epochs=30)
 GBT = dict(n=500_000, f=30, bins=32, trees=5, depth=6)
 GBT_WIDE = dict(n=200_000, numeric=180, cat64=19, wide_cat=2000, trees=20,
                 depth=6)
+RF = dict(n=500_000, numeric=20, cat65=10, trees=10, depth=8)
 WDL = dict(n=200_000, dense=20, wide=10, vocab=100, embed=8,
            hidden=[100, 50], epochs=20)
 STREAMED = dict(d=30, hidden=[50], n=250_000, epochs=2, shards=8)
@@ -82,6 +85,12 @@ def _gbt_wide_slots():
     slots = ([33] * spec["numeric"] + [65] * spec["cat64"]
              + [spec["wide_cat"] + 1])
     is_cat = [False] * spec["numeric"] + [True] * (spec["cat64"] + 1)
+    return slots, is_cat
+
+
+def _rf_slots():
+    slots = [33] * RF["numeric"] + [65] * RF["cat65"]
+    is_cat = [False] * RF["numeric"] + [True] * RF["cat65"]
     return slots, is_cat
 
 
@@ -258,7 +267,8 @@ def numpy_worker_wdl_row_epochs_per_s(n: int = 20_000,
 
 def load_or_measure_baseline(remeasure: bool = False) -> dict:
     configs = {"small": SMALL, "dense": DENSE, "gbt": GBT,
-               "gbt_wide": GBT_WIDE, "wdl": WDL, "streamed": STREAMED}
+               "gbt_wide": GBT_WIDE, "rf": RF, "wdl": WDL,
+               "streamed": STREAMED}
     exists = os.path.isfile(BASELINE_FILE)
     if remeasure and exists:
         with open(BASELINE_FILE) as fh:
@@ -307,6 +317,9 @@ def load_or_measure_baseline(remeasure: bool = False) -> dict:
             numpy_worker_gbt_row_trees_per_s(wide_slots, n=50_000,
                                              depth=GBT_WIDE["depth"],
                                              reps=2), 1),
+        "rf_row_trees_per_s": round(
+            numpy_worker_gbt_row_trees_per_s(_rf_slots()[0], n=50_000,
+                                             depth=RF["depth"], reps=2), 1),
         "wdl_row_epochs_per_s": round(numpy_worker_wdl_row_epochs_per_s(), 1),
         "streamed_row_epochs_per_s": round(
             numpy_worker_row_epochs_per_s(STREAMED["d"],
@@ -417,6 +430,42 @@ def bench_gbt_wide(reps: int):
                         GBT_WIDE["depth"], reps)
 
 
+def bench_rf(reps: int):
+    """RF with native categorical columns (north-star config #4): Poisson
+    bagging + TWOTHIRDS feature subsets per tree."""
+    import jax
+
+    from shifu_tpu.train.tree_trainer import TreeTrainConfig, train_trees
+
+    rng = np.random.default_rng(0)
+    slots, is_cat = _rf_slots()
+    n, F = RF["n"], len(slots)
+    codes = np.stack([rng.integers(0, s - 1, size=n) for s in slots],
+                     1).astype(np.int32)
+    y = ((codes[:, 0] >= 16).astype(np.int8)
+         | (codes[:, RF["numeric"]] >= 32).astype(np.int8))
+    w = np.ones(n, dtype=np.float32)
+    codes_dev = jax.device_put(codes)
+    y_dev = jax.device_put(y.astype(np.float32))
+    w_dev = jax.device_put(w)
+    cfg = TreeTrainConfig(algorithm="RF", tree_num=RF["trees"],
+                          max_depth=RF["depth"],
+                          feature_subset_strategy="TWOTHIRDS",
+                          valid_set_rate=0.1, seed=3)
+    cols = [f"f{i}" for i in range(F)]
+
+    def run():
+        train_trees(codes_dev, y_dev, w_dev, slots, is_cat, cols, cfg)
+
+    run()  # warmup/compile
+    med, lo, hi = _median_timed(run, reps)
+    return {
+        "row_trees_per_s": n * RF["trees"] / med,
+        "spread": [round(n * RF["trees"] / hi, 1),
+                   round(n * RF["trees"] / lo, 1)],
+    }
+
+
 def bench_wdl(reps: int):
     import jax
 
@@ -497,6 +546,7 @@ def main() -> None:
     dense = bench_nn(DENSE, mixed_precision=True, reps=2)
     gbt = bench_gbt(reps=3)
     gbt_wide = bench_gbt_wide(reps=2)
+    rf = bench_rf(reps=2)
     wdl = bench_wdl(reps=2)
     streamed = bench_streamed_nn(reps=1)
 
@@ -535,6 +585,7 @@ def main() -> None:
         "gbt": section(gbt, "row_trees_per_s", "gbt_row_trees_per_s"),
         "gbt_wide": section(gbt_wide, "row_trees_per_s",
                             "gbt_wide_row_trees_per_s"),
+        "rf": section(rf, "row_trees_per_s", "rf_row_trees_per_s"),
         "wdl": section(wdl, "row_epochs_per_s", "wdl_row_epochs_per_s"),
         "streamed_nn": {
             **section(streamed, "row_epochs_per_s",

@@ -1727,17 +1727,51 @@ def train_trees(
         if replicate_fn is not None:
             fot_all_features = replicate_fn(fot_all_features)
 
-    for k in range(start_k, cfg.tree_num):
-        # per-tree RNG stream: keyed by tree index, NOT a shared sequential
-        # stream — resume at tree k replays identically
-        rng_k = np.random.default_rng([cfg.seed, k])
-        if cfg.algorithm == "RF":
+    # ---- per-tree RNG draws, PREPASSED: each tree's stream is keyed by
+    # (seed, tree index) — resume at tree k replays identically — so the
+    # draws are known up front. RF bag counts ship as ONE [K, n] uint16
+    # transfer instead of a [n] f32 per tree (remote TPU links price every
+    # host->device byte); values are exact (Poisson counts nowhere near
+    # 65535). feat_ok stays host-side (tiny, drives layout masks). ----
+    draw_ks = list(range(start_k, cfg.tree_num))
+    feat_oks: Dict[int, np.ndarray] = {}
+    bags_j = None
+    if cfg.algorithm == "RF" and draw_ks:
+        bag_rows = []
+        for k in draw_ks:
+            rng_k = np.random.default_rng([cfg.seed, k])
             if cfg.bagging_with_replacement:
                 bag = rng_k.poisson(cfg.bagging_sample_rate, size=n_orig)
             else:
                 bag = rng_k.random(n_orig) < cfg.bagging_sample_rate
-            bag = np.pad(bag.astype(np.float32), (0, n - n_orig))
-            w_k = base_w_j * row_put(bag)
+            bag_rows.append(np.pad(bag.astype(np.uint16), (0, n - n_orig)))
+            feat_ok = np.zeros(F, dtype=bool)
+            if k_sub >= F:
+                feat_ok[:] = True
+            else:
+                feat_ok[rng_k.choice(F, size=k_sub, replace=False)] = True
+            feat_oks[k] = feat_ok
+        if mesh is None:
+            bags_j = jnp.asarray(np.stack(bag_rows))  # [K, n] u16, one put
+        else:
+            bags_j = [row_put(b.astype(np.float32)) for b in bag_rows]
+    else:
+        for k in draw_ks:
+            rng_k = np.random.default_rng([cfg.seed, k])
+            feat_ok = np.zeros(F, dtype=bool)
+            if k_sub >= F:
+                feat_ok[:] = True
+            else:
+                feat_ok[rng_k.choice(F, size=k_sub, replace=False)] = True
+            feat_oks[k] = feat_ok
+
+    for k in range(start_k, cfg.tree_num):
+        feat_ok = feat_oks[k]
+        if cfg.algorithm == "RF":
+            if mesh is None:
+                w_k = base_w_j * bags_j[k - start_k].astype(jnp.float32)
+            else:
+                w_k = base_w_j * bags_j[k - start_k]
             labels_k = y_j
         else:  # GBT: fit the negative loss gradient
             w_k = base_w_j
@@ -1745,12 +1779,6 @@ def train_trees(
                 labels_k = y_j - 1.0 / (1.0 + jnp.exp(-pred))
             else:
                 labels_k = y_j - pred
-
-        feat_ok = np.zeros(F, dtype=bool)
-        if k_sub >= F:
-            feat_ok[:] = True
-        else:
-            feat_ok[rng_k.choice(F, size=k_sub, replace=False)] = True
 
         tree = None
         if leaf_wise:
