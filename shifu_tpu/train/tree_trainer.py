@@ -1765,6 +1765,12 @@ def train_trees(
                 feat_ok[rng_k.choice(F, size=k_sub, replace=False)] = True
             feat_oks[k] = feat_ok
 
+    # NOTE (round 5, measured): building all K RF trees as ONE program
+    # with fat [blk, K*C*L] x [blk, T] contractions was tried and is
+    # SLOWER than the sequential hoisted-M path (8.2x vs 13.4x one numpy
+    # worker on the rf bench) — the K-times-larger A/one-hot
+    # materialization traffic outweighs the better MXU shape. See git
+    # history for the implementation.
     for k in range(start_k, cfg.tree_num):
         feat_ok = feat_oks[k]
         if cfg.algorithm == "RF":
