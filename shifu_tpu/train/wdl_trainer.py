@@ -193,16 +193,27 @@ def train_wdl(
     if init_flat is not None and init_flat.size == flat0.size:
         flat0 = init_flat.astype(np.float32)
 
-    from shifu_tpu.train.nn_trainer import split_and_sample
+    d = dense.astype(np.float32) if not isinstance(dense, jax.Array) else dense
+    c = codes.astype(jnp.int32) if isinstance(codes, jax.Array) else codes.astype(np.int32)
+    t = tags.astype(np.float32) if not isinstance(tags, jax.Array) else tags
+    if mesh is None:
+        # deterministic draw rides the NN trainer's device cache — repeat
+        # runs transfer zero sampling bytes (remote TPU links)
+        from shifu_tpu.train.nn_trainer import _device_split_and_sample
 
-    sig, valid = split_and_sample(n, cfg)
-    sig_tr = (sig * weights).astype(np.float32)
-    sig_va = (valid.astype(np.float32) * weights).astype(np.float32)
-    nts = float(max(sig.sum(), 1.0))
+        sig_d, valid_d, nts = _device_split_and_sample(n, cfg)
+        w_d = (weights if isinstance(weights, jax.Array)
+               else jnp.asarray(np.asarray(weights, np.float32)))
+        sig_tr = sig_d * w_d
+        sig_va = valid_d * w_d
+    else:
+        from shifu_tpu.train.nn_trainer import split_and_sample
 
-    d = dense.astype(np.float32)
-    c = codes.astype(np.int32)
-    t = tags.astype(np.float32)
+        sig, valid = split_and_sample(n, cfg)
+        sig_tr = (sig * np.asarray(weights)).astype(np.float32)
+        sig_va = (valid.astype(np.float32)
+                  * np.asarray(weights)).astype(np.float32)
+        nts = float(max(sig.sum(), 1.0))
     if mesh is not None:
         from shifu_tpu.parallel.mesh import pad_rows, shard_rows
 
