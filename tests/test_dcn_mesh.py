@@ -67,3 +67,21 @@ def test_trees_on_dcn_mesh_match_single_device():
     for ts, tm in zip(single.spec.trees, meshed.spec.trees):
         np.testing.assert_array_equal(ts.feature, tm.feature)
         np.testing.assert_allclose(ts.leaf_value, tm.leaf_value, atol=1e-4)
+
+
+def test_uneven_slice_grouping_fails_clearly():
+    """A device set spanning slices unevenly must error, not crash with a
+    ragged-array ValueError (review finding, round 5)."""
+    from unittest import mock
+
+    from shifu_tpu.parallel import mesh as mesh_mod
+
+    class FakeDev:
+        def __init__(self, i, sl):
+            self.id = i
+            self.slice_index = sl
+
+    devs = [FakeDev(0, 0), FakeDev(1, 0), FakeDev(2, 0), FakeDev(3, 1)]
+    with mock.patch("jax.devices", return_value=devs):
+        with pytest.raises(ValueError, match="unevenly"):
+            mesh_mod.data_mesh()
