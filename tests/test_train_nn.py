@@ -367,3 +367,36 @@ class TestSVM:
         res = probe(mc, ModelStep.TRAIN)
         assert not res.status
         assert any("Kernel" in m for m in res.causes)
+
+    def test_svm_spec_io_and_pmml(self, tmp_path):
+        """An SVM model flows through the NN spec format and PMML export
+        (scores sigmoid(w.x+b) — monotone in the decision value, so
+        ranking metrics are unchanged)."""
+        from shifu_tpu.export.pmml import nn_to_pmml
+        from shifu_tpu.models.nn import NNModelSpec, forward
+        from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
+
+        x, t, _ = self._separable(seed=21)
+        w = np.ones(len(t), np.float32)
+        cfg = NNTrainConfig(hidden_nodes=[], activations=[], loss="hinge",
+                            propagation="Q", learning_rate=0.05,
+                            reg_level="L2", regularized_constant=0.01,
+                            num_epochs=40, valid_set_rate=0.2, seed=3)
+        res = train_nn(x, t, w, cfg)
+        d = x.shape[1]
+        spec = NNModelSpec(
+            layer_sizes=[d, 1], activations=[],
+            input_columns=[f"c{i}" for i in range(d)],
+            norm_type="ZSCALE", algorithm="SVM", loss="hinge",
+            norm_specs=[], norm_cutoff=4.0, params=res.params,
+            train_error=res.train_error, valid_error=res.valid_error)
+        p = str(tmp_path / "model0.nn")
+        spec.save(p)
+        spec2 = NNModelSpec.load(p)
+        import jax.numpy as jnp
+
+        s1 = np.asarray(forward(spec.params, jnp.asarray(x), []))[:, 0]
+        s2 = np.asarray(forward(spec2.params, jnp.asarray(x), []))[:, 0]
+        np.testing.assert_array_equal(s1, s2)
+        doc = nn_to_pmml(spec, model_name="svm0")
+        assert doc is not None
