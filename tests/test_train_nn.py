@@ -372,6 +372,8 @@ class TestSVM:
         """An SVM model flows through the NN spec format and PMML export
         (scores sigmoid(w.x+b) — monotone in the decision value, so
         ranking metrics are unchanged)."""
+        import xml.etree.ElementTree as ET
+
         from shifu_tpu.export.pmml import nn_to_pmml
         from shifu_tpu.models.nn import NNModelSpec, forward
         from shifu_tpu.train.nn_trainer import NNTrainConfig, train_nn
@@ -384,19 +386,42 @@ class TestSVM:
                             num_epochs=40, valid_set_rate=0.2, seed=3)
         res = train_nn(x, t, w, cfg)
         d = x.shape[1]
+        cols = [f"c{i}" for i in range(d)]
         spec = NNModelSpec(
             layer_sizes=[d, 1], activations=[],
-            input_columns=[f"c{i}" for i in range(d)],
+            input_columns=cols,
             norm_type="ZSCALE", algorithm="SVM", loss="hinge",
-            norm_specs=[], norm_cutoff=4.0, params=res.params,
+            norm_specs=[{"name": n, "kind": "value", "outNames": [n],
+                         "mean": 0.0, "std": 1.0, "fill": 0.0,
+                         "zscore": True} for n in cols],
+            norm_cutoff=4.0, params=res.params,
             train_error=res.train_error, valid_error=res.valid_error)
         p = str(tmp_path / "model0.nn")
         spec.save(p)
         spec2 = NNModelSpec.load(p)
+        # header survives the roundtrip (not just the weights)
+        assert spec2.algorithm == "SVM"
+        assert spec2.loss == "hinge"
+        assert spec2.activations == []
+        assert spec2.layer_sizes == [d, 1]
         import jax.numpy as jnp
 
-        s1 = np.asarray(forward(spec.params, jnp.asarray(x), []))[:, 0]
-        s2 = np.asarray(forward(spec2.params, jnp.asarray(x), []))[:, 0]
+        s1 = np.asarray(forward(spec.params, jnp.asarray(x),
+                                spec.activations))[:, 0]
+        s2 = np.asarray(forward(spec2.params, jnp.asarray(x),
+                                spec2.activations))[:, 0]
         np.testing.assert_array_equal(s1, s2)
-        doc = nn_to_pmml(spec, model_name="svm0")
-        assert doc is not None
+        # the exported NeuralNetwork must actually carry the weights:
+        # the single output neuron gets one Con per input column (+bias)
+        NS = "{http://www.dmg.org/PMML-4_2}"
+        root = ET.fromstring(nn_to_pmml(spec, model_name="svm0"))
+        net = root.find(f"{NS}NeuralNetwork")
+        assert (net.find(f"{NS}NeuralInputs").get("numberOfInputs")
+                == str(d))
+        layers = net.findall(f"{NS}NeuralLayer")
+        neurons = layers[-1].findall(f"{NS}Neuron")
+        cons = neurons[-1].findall(f"{NS}Con")
+        assert len(cons) == d
+        got_w = sorted(float(c.get("weight")) for c in cons)
+        want_w = sorted(float(v) for v in res.params[0]["W"][:, 0])
+        np.testing.assert_allclose(got_w, want_w, atol=1e-6)
