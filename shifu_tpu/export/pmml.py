@@ -96,6 +96,13 @@ def _nn_data_dictionary(root, spec: NNModelSpec):
 
 
 def nn_to_pmml(spec: NNModelSpec, model_name: str = "shifu_tpu_model") -> str:
+    if not spec.norm_specs:
+        # the NeuralInputs/Con graph hangs off the norm columns: without
+        # them the export would be a weight-less NeuralNetwork that
+        # evaluators accept and score garbage with — fail loudly instead
+        raise ValueError(
+            "PMML export needs spec.norm_specs (the normalization plan "
+            "that defines the model's input fields); this spec has none")
     root = ET.Element("PMML", version="4.2", xmlns=PMML_NS)
     header = _el(root, "Header", description="shifu-tpu exported model")
     _el(header, "Application", name="shifu-tpu", version="0.1")

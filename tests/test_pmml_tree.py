@@ -299,3 +299,17 @@ def test_one_bagging_pmml_nn_structure():
     assert all(n is not None for n in nets)
     # each net carries its own LocalTransformations
     assert all(n.find(f"{NS}LocalTransformations") is not None for n in nets)
+
+
+def test_nn_pmml_requires_norm_specs():
+    """A spec without its normalization plan must fail loudly — the
+    alternative is a weight-less NeuralNetwork that evaluators score
+    garbage with (round-5 review finding)."""
+    from shifu_tpu.export.pmml import nn_to_pmml
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+
+    spec = NNModelSpec(layer_sizes=[3, 1], activations=[],
+                       input_columns=["a", "b", "c"], norm_specs=[],
+                       params=init_params([3, 1], seed=0))
+    with pytest.raises(ValueError, match="norm_specs"):
+        nn_to_pmml(spec)
