@@ -1,0 +1,255 @@
+"""Overlapped streaming pipeline: background chunk prefetch feeding
+shape-bucketed jit consumers.
+
+The serial chunked paths ran parse -> host bin-code -> device aggregate ->
+device->host sync strictly in sequence, one chunk at a time, so the device
+idled during every parse and the host idled during every device step. This
+module supplies the three pieces every chunked consumer shares (streaming
+stats, streaming norm, the NN/WDL/tree shard feeds, chunked scoring):
+
+  * ``prefetch_iter`` — a bounded-queue background producer. ONE worker
+    thread pulls the source iterator and applies the host-side transform
+    (CSV parse, bin-coding, shard load) while the consumer's device work
+    runs; up to ``shifu.ingest.prefetchChunks`` (default 2) transformed
+    chunks sit ready in the queue. A single thread plus a FIFO queue keeps
+    chunk order — and therefore every accumulated result — bit-identical
+    to the serial path; ``prefetchChunks=0`` degrades to a plain inline
+    loop for debugging.
+  * ``bucket_rows`` — power-of-two row buckets, so padded chunk shapes
+    take O(log max_chunk_rows) distinct values and jit consumers compile
+    a bounded set of programs regardless of the chunk-size sequence (the
+    old running-max padding recompiled every time a larger chunk arrived).
+  * ``DeviceAccumulator`` — keeps the flat BinAggregates fold resident on
+    device across chunks (one jitted elementwise combine per chunk), so
+    the only device->host transfer in a streamed aggregation is the final
+    fetch instead of a full sync per chunk.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from shifu_tpu.utils import environment
+from shifu_tpu.utils.timing import StageTimers
+
+DEFAULT_PREFETCH_CHUNKS = 2
+
+# Smallest row bucket: chunks below this all pad to one shape, so tiny
+# ragged tails don't each compile their own program.
+MIN_ROW_BUCKET = 256
+
+
+def prefetch_chunks_setting() -> int:
+    """shifu.ingest.prefetchChunks — queue depth of the background
+    prefetcher (0 = serial inline execution)."""
+    return environment.get_int("shifu.ingest.prefetchChunks",
+                               DEFAULT_PREFETCH_CHUNKS)
+
+
+def bucket_rows(n: int, minimum: int = MIN_ROW_BUCKET) -> int:
+    """Smallest power of two >= n (floored at `minimum`).
+
+    Padding chunks to bucketed row counts bounds the set of shapes a jit
+    consumer ever sees at O(log max_chunk_rows), whatever the chunk-size
+    sequence; padding waste is < 2x compute on the padded rows, which carry
+    zero weight/invalid tags and change no result."""
+    if n <= minimum:
+        return minimum
+    return 1 << int(n - 1).bit_length()
+
+
+def prefetch_iter(
+    source: Iterable[Any],
+    depth: Optional[int] = None,
+    transform: Optional[Callable[[Any], Any]] = None,
+    timers: Optional[StageTimers] = None,
+    stage: str = "parse",
+) -> Iterator[Any]:
+    """Iterate `source` with the pull + `transform` running on a background
+    thread, keeping up to `depth` transformed items ready.
+
+    `depth` defaults to shifu.ingest.prefetchChunks; depth <= 0 runs the
+    identical pull/transform inline (serial fallback). `timers`, when
+    given, accumulates the source-pull wall-clock under `stage` (the
+    transform times its own stages so none is double-counted) — time the
+    consumer does NOT wait for once the queue is warm. Up to depth + 2
+    items are in flight: the queue, one finished item in a blocked worker,
+    one in the consumer.
+
+    Guarantees: items arrive in source order (one worker, FIFO queue);
+    worker exceptions re-raise in the consumer at the failing position;
+    abandoning the iterator (break / close) stops the worker promptly.
+    """
+    if depth is None:
+        depth = prefetch_chunks_setting()
+
+    def _produce(it: Iterator[Any]):
+        if timers is not None:
+            with timers.timer(stage):
+                item = next(it)
+        else:
+            item = next(it)
+        if transform is not None:
+            item = transform(item)
+        return item
+
+    if depth <= 0:
+        def _serial() -> Iterator[Any]:
+            it = iter(source)
+            while True:
+                try:
+                    yield _produce(it)
+                except StopIteration:
+                    return
+
+        return _serial()
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def _put(msg) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(msg, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work() -> None:
+        try:
+            it = iter(source)
+        except BaseException as e:  # a failing __iter__ must not hang the consumer
+            _put(("error", e))
+            return
+        while not stop.is_set():
+            try:
+                item = _produce(it)
+            except StopIteration:
+                _put(("end", None))
+                return
+            except BaseException as e:  # re-raised consumer-side
+                _put(("error", e))
+                return
+            if not _put(("item", item)):
+                return
+
+    def _consume() -> Iterator[Any]:
+        worker = threading.Thread(target=_work, name="shifu-prefetch",
+                                  daemon=True)
+        worker.start()
+        try:
+            while True:
+                kind, val = q.get()
+                if kind == "end":
+                    return
+                if kind == "error":
+                    raise val
+                yield val
+        finally:
+            stop.set()
+            try:  # unblock a worker stuck on a full queue
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            worker.join(timeout=5.0)
+
+    return _consume()
+
+
+_COMBINE = None
+
+
+def _combine_program():
+    """Jitted elementwise fold of two BinAggregates (add everywhere, min
+    for vmin, max for vmax). Compiles once per (total_slots, n_numeric)."""
+    global _COMBINE
+    if _COMBINE is None:
+        import jax
+        import jax.numpy as jnp
+
+        from shifu_tpu.ops.binagg import BinAggregates
+
+        @jax.jit
+        def combine(acc, part):
+            out: List[Any] = [a + p for a, p in zip(acc, part)]
+            out[6] = jnp.minimum(acc.vmin, part.vmin)
+            out[7] = jnp.maximum(acc.vmax, part.vmax)
+            return BinAggregates(*out)
+
+        _COMBINE = combine
+    return _COMBINE
+
+
+# Device windows fold in f32; a slot's count stays exact below 2^24, so a
+# window is flushed to the host float64 fold before its ROW total can
+# reach that (2^23 leaves a whole 65536-row chunk of headroom, and a
+# slot's count is bounded by the window's row count).
+WINDOW_FLUSH_ROWS = 1 << 23
+
+
+class DeviceAccumulator:
+    """Device-resident fold of per-chunk BinAggregates, flushed to a host
+    float64 fold in bounded windows.
+
+    The serial path pulled every chunk's full aggregate back to host
+    (np.asarray per chunk — a blocking device->host sync that serialized
+    the pipeline); here chunks fold on device (one tiny jitted combine
+    dispatch each) and only every ~2^23 ROWS the window syncs into a host
+    float64 accumulator. Within a window the f32 fold is exact for counts
+    (slot counts are bounded by window rows < 2^24) and float-summation-
+    order-accurate for the moment sums; across windows everything
+    accumulates in float64 — arbitrarily long streams cannot saturate.
+    A 65536-row-chunk stream syncs once per ~128 chunks instead of per
+    chunk."""
+
+    def __init__(self, flush_rows: int = WINDOW_FLUSH_ROWS) -> None:
+        self._acc = None  # device window
+        self._host: Optional[List[np.ndarray]] = None  # f64 fold
+        self._rows = 0
+        self._flush_rows = flush_rows
+
+    @property
+    def empty(self) -> bool:
+        return self._acc is None and self._host is None
+
+    def _flush(self) -> None:
+        if self._acc is None:
+            return
+        import jax
+
+        part = [np.asarray(x, dtype=np.float64)
+                for x in jax.device_get(self._acc)]
+        self._acc = None
+        self._rows = 0
+        if self._host is None:
+            self._host = part
+        else:
+            self._host = [
+                np.minimum(h, p) if k == 6 else  # vmin
+                np.maximum(h, p) if k == 7 else  # vmax
+                h + p
+                for k, (h, p) in enumerate(zip(self._host, part))
+            ]
+
+    def add(self, agg, rows: int) -> None:
+        """Fold one chunk's aggregates in; `rows` is the chunk's REAL row
+        count (padding rows carry invalid tags and count nothing)."""
+        if self._acc is not None and self._rows + rows > self._flush_rows:
+            self._flush()
+        if self._acc is None:
+            self._acc = agg
+        else:
+            self._acc = _combine_program()(self._acc, agg)
+        self._rows += rows
+
+    def fetch(self) -> Optional[List[np.ndarray]]:
+        """Final sync: aggregates as float64 numpy arrays in BinAggregates
+        field order, or None if no chunk was ever added."""
+        self._flush()
+        return self._host

@@ -108,6 +108,28 @@ def _expand_paths(data_path: str) -> List[str]:
     raise ShifuError(ErrorCode.DATA_NOT_FOUND, data_path)
 
 
+def drop_stray_header_rows(df, names: List[str]):
+    """Drop stray header lines inside data (part files re-concatenated):
+    only rows where EVERY field equals its column name are headers — a
+    legitimate row whose first field happens to equal the first column's
+    name must survive. Shared by the whole-file and chunked readers so
+    both apply the identical rule."""
+    if not (len(df) and names):
+        return df
+    cand = (df[names[0]] == names[0]).to_numpy()
+    if not cand.any():
+        return df
+    sub = df[cand]
+    header_row = np.ones(len(sub), dtype=bool)
+    for c in names[1:]:
+        header_row &= (sub[c] == c).to_numpy()
+    if not header_row.any():
+        return df
+    drop = np.zeros(len(df), dtype=bool)
+    drop[np.nonzero(cand)[0][header_row]] = True
+    return df[~drop]
+
+
 class LazyColumns:
     """Mapping facade over a pandas DataFrame that materializes object
     arrays per column ON ACCESS. With pandas' arrow-backed string storage
@@ -150,6 +172,7 @@ class ColumnarData:
     n_rows: int
     missing_values: Sequence[str] = DEFAULT_MISSING
     _numeric_cache: Dict[str, np.ndarray] = field(default_factory=dict, repr=False)
+    _missing_cache: Dict[str, np.ndarray] = field(default_factory=dict, repr=False)
 
     @classmethod
     def from_frame(
@@ -185,16 +208,28 @@ class ColumnarData:
         ser = self._series(name)
         vals = pd.to_numeric(ser, errors="coerce").to_numpy(dtype=np.float64)
         if len(self.missing_values):
-            miss = ser.isin([m for m in self.missing_values if m != ""]).to_numpy()
+            # strip before the missing-set check, exactly like missing_mask —
+            # " NA " must count as missing in BOTH views ("" is excluded
+            # because to_numeric already coerces blank tokens to NaN)
+            miss = ser.str.strip().isin(
+                [m for m in self.missing_values if m != ""]
+            ).to_numpy()
             vals = np.where(miss, np.nan, vals)
         vals[~np.isfinite(vals)] = np.nan
         self._numeric_cache[name] = vals
         return vals
 
     def missing_mask(self, name: str) -> np.ndarray:
-        """True where the raw token is in the configured missing set."""
+        """True where the raw token is in the configured missing set.
+        Cached — stats touches the same column's mask in several stages
+        per chunk, and the prefetch thread warms it for the consumer."""
+        cached = self._missing_cache.get(name)
+        if cached is not None:
+            return cached
         ser = self._series(name).str.strip()
-        return ser.isin(list(self.missing_values)).to_numpy()
+        mask = ser.isin(list(self.missing_values)).to_numpy()
+        self._missing_cache[name] = mask
+        return mask
 
     def select_rows(self, mask: np.ndarray) -> "ColumnarData":
         """Row subset (boolean mask) or reorder (integer index array)."""
@@ -256,10 +291,7 @@ def read_columnar(
             if remaining <= 0:
                 break
     df = frames[0] if len(frames) == 1 else pd.concat(frames, ignore_index=True)
-    # A row whose first field equals the header name is a stray header line.
-    if len(df) and names:
-        first = names[0]
-        df = df[df[first] != first]
+    df = drop_stray_header_rows(df, names)
     raw = {name: df[name].to_numpy(dtype=object) for name in names}
     return ColumnarData(
         names=list(names), raw=raw, n_rows=len(df), missing_values=missing_values

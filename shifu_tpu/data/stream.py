@@ -11,6 +11,9 @@ The operational knobs mirror the reference's shifuconfig memory envelope:
     shifu.ingest.chunkRows        rows per chunk (default 65536)
     shifu.ingest.memoryBudgetMB   datasets whose files exceed this budget
                                   switch to the streaming path (default 512)
+    shifu.ingest.prefetchChunks   background prefetch depth for the
+                                  overlapped pipeline (data/pipeline.py;
+                                  default 2, 0 = serial)
 """
 
 from __future__ import annotations
@@ -24,6 +27,7 @@ from shifu_tpu.data.reader import (
     DEFAULT_MISSING,
     ColumnarData,
     _expand_paths,
+    drop_stray_header_rows,
 )
 from shifu_tpu.utils import environment
 
@@ -130,22 +134,9 @@ def iter_columnar_chunks(
         else:
             frames = _iter_csv_chunks(path, names, delimiter, chunk_rows)
         for df in frames:
-            if len(df) and names:
-                # stray header line inside data (part files re-concatenated):
-                # drop only rows where EVERY field equals its column name —
-                # a legitimate row whose first field happens to equal the
-                # first column's name must survive. Filter BEFORE the
-                # max_rows slice so dropped headers don't consume budget.
-                cand = (df[names[0]] == names[0]).to_numpy()
-                if cand.any():
-                    sub = df[cand]
-                    header_row = np.ones(len(sub), dtype=bool)
-                    for c in names[1:]:
-                        header_row &= (sub[c] == c).to_numpy()
-                    if header_row.any():
-                        drop = np.zeros(len(df), dtype=bool)
-                        drop[np.nonzero(cand)[0][header_row]] = True
-                        df = df[~drop]
+            # filter stray headers BEFORE the max_rows slice so dropped
+            # headers don't consume budget
+            df = drop_stray_header_rows(df, names)
             if remaining is not None:
                 if remaining <= 0:
                     return

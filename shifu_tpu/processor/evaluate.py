@@ -238,8 +238,10 @@ class EvalProcessor(BasicProcessor):
     def _score_streaming(self, ec: EvalConfig, paths: List[str]) -> None:
         """Bounded-memory scoring: raw records stream in ingest chunks, each
         chunk purifies/tags/scores independently, rows append to the score
-        file — peak host memory is one chunk regardless of eval-set size
-        (the Pig Eval.pig job's mapper-streaming memory envelope)."""
+        file — peak host memory is one chunk x (2 + prefetchChunks)
+        regardless of eval-set size (the Pig Eval.pig job's
+        mapper-streaming memory envelope)."""
+        from shifu_tpu.data.pipeline import prefetch_iter
         from shifu_tpu.data.stream import iter_columnar_chunks
         from shifu_tpu.eval.scorer import ModelRunner
 
@@ -267,11 +269,13 @@ class EvalProcessor(BasicProcessor):
         n_rows = n_pos = n_neg = 0
         wrote_header = False
         with open(out, "w") as fh:
-            for chunk in iter_columnar_chunks(
+            # chunk parse rides on the prefetch thread under the previous
+            # chunk's device scoring + row formatting
+            for chunk in prefetch_iter(iter_columnar_chunks(
                 self.resolve(ds.data_path or mc.data_set.data_path), names,
                 delimiter=ds.data_delimiter or mc.data_set.data_delimiter,
                 missing_values=tuple(mc.data_set.missing_or_invalid_values),
-            ):
+            )):
                 mask = combined_mask(ds.filter_expressions, chunk.raw,
                                      chunk.n_rows)
                 chunk = chunk.select_rows(mask)

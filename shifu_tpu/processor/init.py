@@ -117,6 +117,7 @@ class InitProcessor(BasicProcessor):
         # reference's HLL++ autotype MR job
         # (core/autotype/AutoTypeDistinctCountMapper.java:45) — bounded
         # memory regardless of dataset size or cardinality
+        from shifu_tpu.data.pipeline import prefetch_iter
         from shifu_tpu.data.stream import iter_columnar_chunks
         from shifu_tpu.stats.sketch import AutoTypeSketch
 
@@ -126,13 +127,14 @@ class InitProcessor(BasicProcessor):
         ]
         missing = tuple(ds.missing_or_invalid_values)
         sketches = {cc.column_name: AutoTypeSketch(missing) for cc in candidates}
-        for chunk in iter_columnar_chunks(
+        # parse overlaps the sketch folds via the prefetch thread
+        for chunk in prefetch_iter(iter_columnar_chunks(
             self.resolve(ds.data_path),
             names,
             delimiter=ds.data_delimiter,
             missing_values=missing,
             max_rows=AUTOTYPE_MAX_ROWS,
-        ):
+        )):
             for cc in candidates:
                 sketches[cc.column_name].update(chunk._series(cc.column_name))
 

@@ -161,9 +161,11 @@ class NormProcessor(BasicProcessor):
         (ShuffleShardWriter) produces a true uniform global permutation —
         the MR shuffle's contract (core/shuffle/MapReduceShuffle.java:47) —
         with peak memory of one bucket."""
+        from shifu_tpu.data.pipeline import prefetch_iter
         from shifu_tpu.data.stream import chunk_source, memory_budget_bytes
         from shifu_tpu.norm.dataset import ShardWriter, ShuffleShardWriter
         from shifu_tpu.stats.engine import _prepare_rows
+        from shifu_tpu.utils.timing import StageTimers
 
         mc = self.model_config
         ds = mc.data_set
@@ -213,23 +215,39 @@ class NormProcessor(BasicProcessor):
             delimiter=ds.data_delimiter,
             missing_values=tuple(ds.missing_or_invalid_values),
         )
+        timers = StageTimers()
+
+        def _normed(numbered):
+            """Prefetch-thread stage: parse + purify + norm + bin-code one
+            chunk; the consumer thread only appends to the shard writers."""
+            ci, chunk = numbered
+            with timers.timer("prepare"):
+                chunk, tags, weights = _prepare_rows(
+                    mc, chunk, [self.seed, ci], mc.normalize.sample_rate,
+                    mc.normalize.sample_neg_only,
+                )
+            if not chunk.n_rows:
+                return None
+            with timers.timer("bincode"):
+                code_cache: dict = {}
+                feats = apply_norm_plan(plan, chunk, code_cache=code_cache)
+                codes = bin_code_matrix(tree_cols, chunk, cache=code_cache)
+            return feats, codes, tags, weights
+
         n_rows = 0
         all_tag_counts: dict = {}
-        for ci, chunk in enumerate(factory()):
-            chunk, tags, weights = _prepare_rows(
-                mc, chunk, [self.seed, ci], mc.normalize.sample_rate,
-                mc.normalize.sample_neg_only,
-            )
-            if not chunk.n_rows:
+        for item in prefetch_iter(enumerate(factory()), transform=_normed,
+                                  timers=timers, stage="parse"):
+            if item is None:
                 continue
-            code_cache: dict = {}
-            feats = apply_norm_plan(plan, chunk, code_cache=code_cache)
-            feat_writer.add(feats, tags, weights)
-            codes = bin_code_matrix(tree_cols, chunk, cache=code_cache)
-            code_writer.add(codes, tags, weights)
-            n_rows += chunk.n_rows
+            feats, codes, tags, weights = item
+            with timers.timer("write"):
+                feat_writer.add(feats, tags, weights)
+                code_writer.add(codes, tags, weights)
+            n_rows += len(tags)
             for t, c in zip(*np.unique(tags, return_counts=True)):
                 all_tag_counts[int(t)] = all_tag_counts.get(int(t), 0) + int(c)
+        log.info("streaming norm pipeline: %s", timers.summary())
         if mc.is_multi_classification() and feat_writer.extra is not None:
             class_tags = [str(t) for t in mc.tags()]
             total = max(sum(all_tag_counts.values()), 1)

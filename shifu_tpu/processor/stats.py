@@ -109,12 +109,16 @@ class StatsProcessor(BasicProcessor):
             )
             from shifu_tpu.stats.psi import PsiAccumulator
 
+            from shifu_tpu.data.pipeline import prefetch_iter
+
             corr_acc = StreamingCorrelation() if self.correlation else None
             psi_acc = (
                 PsiAccumulator(self.column_configs, psi_col)
                 if self.psi and psi_col else None
             )
-            for chunk in factory():
+            # parse rides on the prefetch thread while this thread folds
+            # the correlation/PSI accumulators
+            for chunk in prefetch_iter(factory()):
                 if corr_acc is not None:
                     corr_acc.update(chunk, self.column_configs)
                 if psi_acc is not None:

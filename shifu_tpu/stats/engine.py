@@ -341,11 +341,30 @@ def compute_stats_streaming(
     sketch, core/binning/EqualPopulationBinning.java:34 — plus moments and a
     capped categorical counter). Pass 2 re-streams, bin-codes each chunk and
     accumulates the same flat aggregates the in-RAM path produces in one
-    shot (UpdateBinningInfo MR parity, mapper partial sums summed on host).
-    Peak memory = one chunk + sketches; nothing scales with the dataset.
+    shot (UpdateBinningInfo MR parity, mapper partial sums held on device).
+    Peak memory = one chunk x (2 + prefetch depth) + sketches; nothing
+    scales with the dataset.
+
+    Both passes run through the overlapped prefetch pipeline
+    (data/pipeline.py): parse + purify + bin-coding happen on a background
+    thread while this thread folds sketches (pass 1) or dispatches the
+    device aggregation (pass 2). Chunks are padded to power-of-two row
+    buckets so the jit aggregation compiles O(log max_chunk_rows) programs
+    whatever the chunk-size sequence, and the flat aggregate accumulator
+    stays device-resident across chunks — one combine dispatch per chunk,
+    one device->host sync per ~2^23-row window (the window flushes into a
+    host float64 fold, so arbitrarily long streams cannot saturate the f32
+    counts). Chunk order is preserved, so results are bit-identical to a
+    serial run (shifu.ingest.prefetchChunks=0).
     """
     from shifu_tpu.config.model_config import BinningMethod
+    from shifu_tpu.data.pipeline import (
+        DeviceAccumulator,
+        bucket_rows,
+        prefetch_iter,
+    )
     from shifu_tpu.stats.sketch import CategoricalSketch, NumericSketch
+    from shifu_tpu.utils.timing import StageTimers
 
     stats_cols = [
         c for c in columns if not (c.is_target() or c.is_meta() or c.is_weight())
@@ -375,28 +394,52 @@ def compute_stats_streaming(
         else:
             sketches[cc.column_name] = NumericSketch(max_bins=max_bins)
 
+    timers = StageTimers()
+
+    def _prep1(numbered):
+        """Background-thread transform: purify + tag + sample one chunk,
+        then warm the lazy column caches (to_numeric / missing-mask /
+        object materialization) the sketch folds will read — the expensive
+        pandas work runs on the prefetch thread, the consumer only merges
+        centroids. The chunk index rides along so both passes draw
+        identical samples."""
+        ci, chunk = numbered
+        with timers.timer("prepare"):
+            chunk, tags, weights = _prepare_rows(
+                mc, chunk, [seed, ci], mc.stats.sample_rate,
+                mc.stats.sample_neg_only, fold_multiclass=True,
+            )
+            if chunk.n_rows:
+                for cc in stats_cols:
+                    if cc.is_categorical():
+                        chunk.column(cc.column_name)
+                        chunk.missing_mask(cc.column_name)
+                    else:
+                        chunk.numeric(cc.column_name)
+        return chunk, tags, weights
+
     # ---- pass 1: sketches ----
     n_valid_rows = 0
     n_pos = n_neg = 0
-    for ci, chunk in enumerate(chunk_factory()):
-        chunk, tags, weights = _prepare_rows(
-            mc, chunk, [seed, ci], mc.stats.sample_rate,
-            mc.stats.sample_neg_only, fold_multiclass=True,
-        )
+    for chunk, tags, weights in prefetch_iter(
+        enumerate(chunk_factory()), transform=_prep1,
+        timers=timers, stage="parse1",
+    ):
         if not chunk.n_rows:
             continue
         n_valid_rows += chunk.n_rows
         n_pos += int((tags == 1).sum())
         n_neg += int((tags == 0).sum())
         bm = bin_subset(tags)
-        for cc in stats_cols:
-            sk = sketches[cc.column_name]
-            if cc.is_categorical():
-                sk.update(chunk.column(cc.column_name),
-                          chunk.missing_mask(cc.column_name))
-            else:
-                sk.update(chunk.numeric(cc.column_name), bm,
-                          weights if use_weights else None)
+        with timers.timer("sketch"):
+            for cc in stats_cols:
+                sk = sketches[cc.column_name]
+                if cc.is_categorical():
+                    sk.update(chunk.column(cc.column_name),
+                              chunk.missing_mask(cc.column_name))
+                else:
+                    sk.update(chunk.numeric(cc.column_name), bm,
+                              weights if use_weights else None)
     log.info("streaming stats pass 1 done: %d rows (%d pos / %d neg)",
              n_valid_rows, n_pos, n_neg)
 
@@ -426,52 +469,56 @@ def compute_stats_streaming(
             bn.bin_category = None
             bn.length = len(bounds)
 
-    # ---- pass 2: chunked aggregation, padded to a fixed shape ----
+    # ---- pass 2: chunked aggregation, padded to bucketed shapes ----
     import jax.numpy as jnp
 
-    acc = None
-    pad_n = 0
     numeric_cols: List[ColumnConfig] = []
     slots: List[int] = []
     col_offsets = np.zeros(0, dtype=np.int32)
-    for ci, chunk in enumerate(chunk_factory()):
-        chunk, tags, weights = _prepare_rows(
-            mc, chunk, [seed, ci], mc.stats.sample_rate,
-            mc.stats.sample_neg_only, fold_multiclass=True,
-        )
+
+    def _prep2(numbered):
+        """Background-thread stage: purify + bin-code + pad one chunk to
+        its power-of-two row bucket (padding rows carry invalid tags /
+        zero weight / NaN values, so they change nothing downstream)."""
+        ci, chunk = numbered
+        with timers.timer("prepare"):
+            chunk, tags, weights = _prepare_rows(
+                mc, chunk, [seed, ci], mc.stats.sample_rate,
+                mc.stats.sample_neg_only, fold_multiclass=True,
+            )
         if not chunk.n_rows:
+            return None
+        n_real = chunk.n_rows
+        with timers.timer("bincode"):
+            codes, offs, sl, values, ncols = build_codes(chunk, stats_cols)
+            extra = bucket_rows(codes.shape[0]) - codes.shape[0]
+            if extra:
+                codes = np.pad(codes, ((0, extra), (0, 0)))
+                tags = np.pad(tags, (0, extra), constant_values=-1)
+                weights = np.pad(weights, (0, extra))
+                values = np.pad(values, ((0, extra), (0, 0)),
+                                constant_values=np.nan)
+        return n_real, codes, tags, weights, values, offs, sl, ncols
+
+    acc_dev = DeviceAccumulator()
+    for item in prefetch_iter(enumerate(chunk_factory()), transform=_prep2,
+                              timers=timers, stage="parse2"):
+        if item is None:
             continue
-        codes, col_offsets, slots, values, numeric_cols = build_codes(
-            chunk, stats_cols
-        )
-        total_slots = int(sum(slots))
-        pad_n = max(pad_n, codes.shape[0])
-        extra = pad_n - codes.shape[0]
-        if extra:
-            codes = np.pad(codes, ((0, extra), (0, 0)))
-            tags = np.pad(tags, (0, extra), constant_values=-1)
-            weights = np.pad(weights, (0, extra))
-            values = np.pad(values, ((0, extra), (0, 0)),
-                            constant_values=np.nan)
-        agg = bin_aggregate_jit(
-            jnp.asarray(codes),
-            jnp.asarray(col_offsets),
-            total_slots,
-            jnp.asarray(tags.astype(np.int32)),
-            jnp.asarray(weights, dtype=jnp.float32),
-            jnp.asarray(values),
-        )
-        part = [np.asarray(x, dtype=np.float64) for x in agg]
-        if acc is None:
-            acc = part
-        else:
-            for k in range(len(acc)):
-                if k == 6:  # vmin
-                    acc[k] = np.minimum(acc[k], part[k])
-                elif k == 7:  # vmax
-                    acc[k] = np.maximum(acc[k], part[k])
-                else:
-                    acc[k] = acc[k] + part[k]
+        (n_real, codes, tags, weights, values,
+         col_offsets, slots, numeric_cols) = item
+        with timers.timer("device"):
+            acc_dev.add(bin_aggregate_jit(
+                jnp.asarray(codes),
+                jnp.asarray(col_offsets),
+                int(sum(slots)),
+                jnp.asarray(tags.astype(np.int32)),
+                jnp.asarray(weights, dtype=jnp.float32),
+                jnp.asarray(values),
+            ), rows=n_real)
+    with timers.timer("sync"):
+        acc = acc_dev.fetch()
+    log.info("streaming stats pipeline: %s", timers.summary())
     if acc is None:
         log.warning("streaming stats: no rows survived filtering")
         return

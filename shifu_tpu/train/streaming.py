@@ -4,11 +4,12 @@ The reference trains on datasets that exceed worker memory by spilling rows
 to disk (core/dtrain/dataset/MemoryDiskFloatMLDataSet.java — memory portion
 first, BufferedFloatMLDataSet overflow on disk, re-read every epoch). The
 TPU analog keeps the SAME on-disk artifact `shifu norm` already writes —
-row-sharded .npy files — and feeds them through a double-buffered
-`jax.device_put` pipeline:
+row-sharded .npy files — and feeds them through the overlapped prefetch
+pipeline (data/pipeline.py):
 
-    shard s is computing on device  |  shard s+1 is already in flight
-    (dispatch is async)             |  (device_put returns immediately)
+    shard s is computing on device  |  shard s+1 loads + pads on the
+    (dispatch is async)             |  prefetch thread, then device_put
+                                    |  rides under shard s's compute
 
 Every shard is padded to the max shard row count so ONE compiled per-shard
 gradient program serves the whole stream (padding rows carry zero
@@ -122,10 +123,9 @@ class ShardFeed:
     def _path(self, prefix: str, s: int) -> str:
         return os.path.join(self.data_dir, f"{prefix}-{s:05d}.npy")
 
-    def _load_padded(self, s: int):
-        """One shard, padded to pad_rows, as device arrays (transfer is
-        async — the caller overlaps it with the previous shard's compute)."""
-        jax = self._jax
+    def _load_host(self, s: int):
+        """One shard, padded to pad_rows, as host arrays — the prefetch
+        thread's half of the feed (disk read + pad off the compute thread)."""
         rows = self.meta.shard_rows[s]
         pad = self.pad_rows - rows
         x = np.load(self._path(self.prefix, s), mmap_mode="r")
@@ -138,22 +138,25 @@ class ShardFeed:
             t = np.pad(t, (0, pad))
             sig_t = np.pad(sig_t, (0, pad))
             sig_v = np.pad(sig_v, (0, pad))
+        return x, t, sig_t, sig_v
+
+    def _put_device(self, arrs):
+        jax = self._jax
         if self.mesh is not None:
             from shifu_tpu.parallel.mesh import shard_rows as put
 
-            return (put(x, self.mesh), put(t, self.mesh),
-                    put(sig_t, self.mesh), put(sig_v, self.mesh))
-        return (jax.device_put(x), jax.device_put(t),
-                jax.device_put(sig_t), jax.device_put(sig_v))
+            return tuple(put(a, self.mesh) for a in arrs)
+        return tuple(jax.device_put(a) for a in arrs)
 
     def __iter__(self):
-        nxt = self._load_padded(0) if self.n_shards else None
-        for s in range(self.n_shards):
-            cur = nxt
-            # kick off the next transfer BEFORE yielding: device_put returns
-            # immediately, so the copy rides under the caller's compute
-            nxt = self._load_padded(s + 1) if s + 1 < self.n_shards else None
-            yield cur
+        # shard s+1's disk read + pad runs on the prefetch thread while
+        # shard s computes; device_put dispatches async on consume, so the
+        # host->device copy still rides under the caller's compute
+        from shifu_tpu.data.pipeline import prefetch_iter
+
+        for arrs in prefetch_iter(range(self.n_shards),
+                                  transform=self._load_host):
+            yield self._put_device(arrs)
 
 
 # One compiled shard-gradient program per (arch, hyperparam) signature.

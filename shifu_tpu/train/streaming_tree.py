@@ -84,6 +84,18 @@ class CodesFeed:
         )
 
 
+def _iter_codes(feed: CodesFeed, work):
+    """work-aligned shard code matrices with the disk read on the prefetch
+    thread (data/pipeline.py): shard s+1 loads while shard s's histograms
+    dispatch. Host RAM holds at most prefetchChunks+2 code matrices; the
+    device still holds exactly one."""
+    from shifu_tpu.data.pipeline import prefetch_iter
+
+    return zip(work, prefetch_iter(
+        range(len(work)),
+        transform=lambda s: np.asarray(feed.codes(s), np.int32)))
+
+
 def _grow_levelwise_streamed(feed, work, la, lay, cfg, D, row_put,
                              pad_to_mesh, mesh):
     """One LEVEL-WISE tree with streamed histograms. pending = the previous
@@ -105,9 +117,8 @@ def _grow_levelwise_streamed(feed, work, la, lay, cfg, D, row_put,
         ranges = [(b0, min(batch_cap, L - b0))
                   for b0 in range(0, L, batch_cap)]
         hist_parts = [None] * len(ranges)
-        for s, wk in enumerate(work):
-            codes_s = row_put(pad_to_mesh(
-                np.asarray(feed.codes(s), np.int32)))
+        for wk, codes_host in _iter_codes(feed, work):
+            codes_s = row_put(pad_to_mesh(codes_host))
             if pending is not None:
                 pbf, pbr, prank, psplit, pbase, pL = pending
                 upd = _get_update_program(pL, lay.T)
@@ -195,9 +206,8 @@ def _grow_leafwise_streamed(feed, work, la, lay, cfg, row_put, pad_to_mesh,
         accumulate each listed leaf's histogram across shards."""
         nonlocal pending
         hists = {lid: None for lid in leaf_ids}
-        for s, wk in enumerate(work):
-            codes_s = row_put(pad_to_mesh(
-                np.asarray(feed.codes(s), np.int32)))
+        for wk, codes_host in _iter_codes(feed, work):
+            codes_s = row_put(pad_to_mesh(codes_host))
             if pending is not None:
                 best_id, bf, cut, rank_row, li, ri = pending
                 sel = wk["node"] == best_id

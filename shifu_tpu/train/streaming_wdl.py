@@ -83,9 +83,8 @@ class WDLShardFeed:
         return (np.pad(a, ((0, pad), (0, 0))) if two_d
                 else np.pad(a, (0, pad)))
 
-    def _load(self, s: int):
-        import jax
-
+    def _load_host(self, s: int):
+        """Disk read + column slice + pad on the prefetch thread."""
         rows = self.meta.shard_rows[s]
         pad = self.pad_rows - rows
         dense = np.asarray(np.load(
@@ -98,32 +97,32 @@ class WDLShardFeed:
             os.path.join(self.norm_dir, f"tags-{s:05d}.npy"),
             mmap_mode="r"), np.float32)
         sig_t, sig_v = self._sig[s]
-        if self.mesh is not None:
-            from shifu_tpu.parallel.mesh import shard_rows as put
-
-            return (
-                put(self._padded(dense, pad, True), self.mesh),
-                put(self._padded(codes, pad, True), self.mesh),
-                put(self._padded(t, pad), self.mesh),
-                put(self._padded(sig_t, pad), self.mesh),
-                put(self._padded(sig_v, pad), self.mesh),
-            )
         return (
-            jax.device_put(self._padded(dense, pad, True)),
-            jax.device_put(self._padded(codes, pad, True)),
-            jax.device_put(self._padded(t, pad)),
-            jax.device_put(self._padded(sig_t, pad)),
-            jax.device_put(self._padded(sig_v, pad)),
+            self._padded(dense, pad, True),
+            self._padded(codes, pad, True),
+            self._padded(t, pad),
+            self._padded(sig_t, pad),
+            self._padded(sig_v, pad),
         )
 
     def __iter__(self):
-        # double buffered like the NN ShardFeed: shard s+1's host->device
-        # transfer rides under shard s's compute (device_put is async)
-        nxt = self._load(0) if self.n_shards else None
-        for s in range(self.n_shards):
-            cur = nxt
-            nxt = self._load(s + 1) if s + 1 < self.n_shards else None
-            yield cur
+        # like the NN ShardFeed: shard s+1 loads on the prefetch thread
+        # while shard s computes; the async device_put on consume keeps the
+        # host->device copy under the caller's compute
+        import jax
+
+        from shifu_tpu.data.pipeline import prefetch_iter
+
+        if self.mesh is not None:
+            from shifu_tpu.parallel.mesh import shard_rows
+
+            def put(a):
+                return shard_rows(a, self.mesh)
+        else:
+            put = jax.device_put
+        for arrs in prefetch_iter(range(self.n_shards),
+                                  transform=self._load_host):
+            yield tuple(put(a) for a in arrs)
 
 
 def _get_shard_program(cfg: WDLTrainConfig, template: WDLParams):

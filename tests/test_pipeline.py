@@ -1,0 +1,449 @@
+"""Overlapped streaming pipeline tests (data/pipeline.py).
+
+Contract: the prefetch pipeline is a pure latency optimization — chunk
+order, results, and every accumulated statistic are bit-identical to the
+serial path; shape bucketing bounds the jit compile count at
+O(log max_chunk_rows) for ANY chunk-size sequence; the device-resident
+accumulator syncs exactly once per pass.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu.utils import environment
+from tests.helpers import make_binary_dataset, make_model_set, write_dataset
+
+
+def _set_props(**kv):
+    for k, v in kv.items():
+        environment.set_property(k, str(v))
+
+
+def _clear_props(*keys):
+    for k in keys:
+        environment.set_property(k, "")
+
+
+class TestPrefetchIter:
+    def test_order_and_transform(self):
+        from shifu_tpu.data.pipeline import prefetch_iter
+
+        got = list(prefetch_iter(range(50), depth=3,
+                                 transform=lambda x: x * 2))
+        assert got == [2 * i for i in range(50)]
+
+    def test_depth_zero_is_serial_inline(self):
+        from shifu_tpu.data.pipeline import prefetch_iter
+
+        import threading
+
+        main = threading.get_ident()
+        seen = []
+        list(prefetch_iter(range(5), depth=0,
+                           transform=lambda x: seen.append(
+                               threading.get_ident()) or x))
+        assert seen == [main] * 5
+
+    def test_worker_exception_reraises_in_consumer(self):
+        from shifu_tpu.data.pipeline import prefetch_iter
+
+        def boom(x):
+            if x == 3:
+                raise ValueError("chunk 3 bad")
+            return x
+
+        it = prefetch_iter(range(10), depth=2, transform=boom)
+        got = []
+        with pytest.raises(ValueError, match="chunk 3 bad"):
+            for v in it:
+                got.append(v)
+        assert got == [0, 1, 2]
+
+    def test_failing_source_iter_raises_not_hangs(self):
+        from shifu_tpu.data.pipeline import prefetch_iter
+
+        class BadSource:
+            def __iter__(self):
+                raise OSError("no such file")
+
+        with pytest.raises(OSError, match="no such file"):
+            list(prefetch_iter(BadSource(), depth=2))
+
+    def test_early_break_stops_worker(self):
+        import threading
+
+        from shifu_tpu.data.pipeline import prefetch_iter
+
+        before = threading.active_count()
+        it = prefetch_iter(range(10_000), depth=2)
+        for v in it:
+            if v == 5:
+                break
+        it.close()
+        deadline = time.time() + 5.0
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.01)
+        assert threading.active_count() <= before
+
+    def test_depth_from_environment_knob(self):
+        from shifu_tpu.data.pipeline import prefetch_chunks_setting
+
+        _set_props(**{"shifu.ingest.prefetchChunks": "5"})
+        try:
+            assert prefetch_chunks_setting() == 5
+        finally:
+            _clear_props("shifu.ingest.prefetchChunks")
+        assert prefetch_chunks_setting() == 2
+
+    def test_timers_accumulate_across_threads(self):
+        from shifu_tpu.data.pipeline import prefetch_iter
+        from shifu_tpu.utils.timing import StageTimers
+
+        timers = StageTimers()
+        n = 0
+        for _ in prefetch_iter(range(8), depth=2, timers=timers,
+                               stage="parse"):
+            with timers.timer("consume"):
+                n += 1
+        assert timers.calls("parse") == 9  # 8 items + the end pull
+        assert timers.calls("consume") == 8
+        assert "parse" in timers.summary()
+        d = timers.as_dict()
+        assert d["parse"]["calls"] == 9 and d["parse"]["seconds"] >= 0
+
+
+class TestBucketRows:
+    def test_powers_of_two_with_floor(self):
+        from shifu_tpu.data.pipeline import bucket_rows
+
+        assert bucket_rows(1) == 256
+        assert bucket_rows(256) == 256
+        assert bucket_rows(257) == 512
+        assert bucket_rows(65536) == 65536
+        assert bucket_rows(65537) == 131072
+
+    def test_bounded_shape_count_for_any_sequence(self):
+        from shifu_tpu.data.pipeline import bucket_rows
+
+        rng = np.random.default_rng(0)
+        sizes = rng.integers(1, 100_000, size=1000)
+        buckets = {bucket_rows(int(n)) for n in sizes}
+        # O(log max): [256 .. 131072] is 10 distinct powers of two
+        assert len(buckets) <= 10
+
+
+class TestBoundedJitShapes:
+    def test_aggregation_compiles_log_bounded_programs(self):
+        """57 distinct chunk sizes through the bucketed bin aggregation
+        must compile exactly one program per power-of-two bucket (probed
+        via the jit cache), not one per chunk size."""
+        import jax.numpy as jnp
+
+        from shifu_tpu.data.pipeline import bucket_rows
+        from shifu_tpu.ops.binagg import bin_aggregate_jit
+
+        total_slots = 7  # unique static arg so earlier tests can't collide
+        sizes = list(range(1, 400, 7))
+        before = bin_aggregate_jit._cache_size()
+        for n in sizes:
+            pad = bucket_rows(n)
+            codes = np.zeros((pad, 2), np.int32)
+            tags = np.full(pad, -1, np.int32)
+            tags[:n] = 1
+            bin_aggregate_jit(
+                jnp.asarray(codes),
+                jnp.asarray(np.array([0, 3], np.int32)),
+                total_slots,
+                jnp.asarray(tags),
+                jnp.asarray(np.ones(pad, np.float32)),
+                jnp.asarray(np.zeros((pad, 1), np.float32)),
+            )
+        compiled = bin_aggregate_jit._cache_size() - before
+        expect = len({bucket_rows(n) for n in sizes})
+        assert compiled == expect  # == 2: buckets {256, 512}
+        assert compiled <= int(np.ceil(np.log2(max(sizes)))) + 1
+
+    def test_streaming_stats_pass2_compile_count(self):
+        """End to end: a hand-built chunk factory with 12 different chunk
+        sizes (incl. sub-bucket and ragged ones) must add at most one
+        aggregation program per distinct row bucket."""
+        from shifu_tpu.config import ColumnConfig, ColumnType
+        from shifu_tpu.config.column_config import ColumnFlag
+        from shifu_tpu.config.model_config import Algorithm, new_model_config
+        from shifu_tpu.data.pipeline import bucket_rows
+        from shifu_tpu.data.reader import ColumnarData
+        from shifu_tpu.ops.binagg import bin_aggregate_jit
+        from shifu_tpu.stats.engine import compute_stats_streaming
+
+        rng = np.random.default_rng(5)
+        sizes = [37, 64, 100, 129, 256, 300, 333, 400, 480, 511, 513, 700]
+
+        def factory():
+            for i, n in enumerate(sizes):
+                y = (rng.random(n) < 0.4).astype(int)
+                yield ColumnarData(
+                    names=["target", "num_0"],
+                    raw={
+                        "target": np.array([str(v) for v in y], object),
+                        "num_0": np.array(
+                            [f"{v:.4f}" for v in
+                             rng.normal(loc=y, size=n)], object),
+                    },
+                    n_rows=n,
+                )
+
+        mc = new_model_config("JitProbe", Algorithm.NN)
+        mc.data_set.target_column_name = "target"
+        mc.data_set.pos_tags = ["1"]
+        mc.data_set.neg_tags = ["0"]
+        cols = [
+            ColumnConfig(column_num=0, column_name="target",
+                         column_flag=ColumnFlag.TARGET),
+            ColumnConfig(column_num=1, column_name="num_0",
+                         column_type=ColumnType.N),
+        ]
+        before = bin_aggregate_jit._cache_size()
+        compute_stats_streaming(mc, cols, factory)
+        compiled = bin_aggregate_jit._cache_size() - before
+        assert compiled <= len({bucket_rows(n) for n in sizes})  # <= 3
+        assert cols[1].column_stats.total_count == sum(sizes)
+
+
+class TestPrefetchParity:
+    """The acceptance contract: prefetch on vs off is bit-identical."""
+
+    @pytest.mark.parametrize("chunk_rows", [512, 700])
+    def test_streaming_stats_prefetch_bit_identical(self, tmp_path,
+                                                    chunk_rows):
+        """Full StatsProcessor run, serial vs prefetched, at chunk sizes
+        that leave a ragged final chunk — the written ColumnConfig.json
+        must match byte for byte."""
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=3000)
+        assert InitProcessor(root).run() == 0
+        cc_path = os.path.join(root, "ColumnConfig.json")
+
+        _set_props(**{"shifu.ingest.forceStreaming": "true",
+                      "shifu.ingest.chunkRows": str(chunk_rows),
+                      "shifu.ingest.prefetchChunks": "0"})
+        try:
+            assert StatsProcessor(root).run() == 0
+            with open(cc_path, "rb") as fh:
+                serial = fh.read()
+            _set_props(**{"shifu.ingest.prefetchChunks": "3"})
+            assert StatsProcessor(root).run() == 0
+            with open(cc_path, "rb") as fh:
+                prefetched = fh.read()
+        finally:
+            _clear_props("shifu.ingest.forceStreaming",
+                         "shifu.ingest.chunkRows",
+                         "shifu.ingest.prefetchChunks")
+        assert prefetched == serial
+
+    def test_streaming_matches_in_ram_compute_stats(self, tmp_path):
+        """With EqualInterval binning (sketch min/max is exact, so both
+        paths derive identical boundaries), streamed stats must reproduce
+        the in-RAM aggregation exactly: same bins, bit-equal counts and
+        count-derived metrics; moments match to float-summation order."""
+        from shifu_tpu.config import load_column_config_list
+        from shifu_tpu.config.model_config import (
+            BinningMethod,
+            ModelConfig,
+        )
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=2500)
+        mc = ModelConfig.load(os.path.join(root, "ModelConfig.json"))
+        mc.stats.binning_method = BinningMethod.EQUAL_INTERVAL
+        mc.save(os.path.join(root, "ModelConfig.json"))
+        assert InitProcessor(root).run() == 0
+        cc_path = os.path.join(root, "ColumnConfig.json")
+
+        assert StatsProcessor(root).run() == 0
+        exact = load_column_config_list(cc_path)
+
+        _set_props(**{"shifu.ingest.forceStreaming": "true",
+                      "shifu.ingest.chunkRows": "700"})
+        try:
+            assert StatsProcessor(root).run() == 0
+        finally:
+            _clear_props("shifu.ingest.forceStreaming",
+                         "shifu.ingest.chunkRows")
+        stream = load_column_config_list(cc_path)
+
+        for e, s in zip(exact, stream):
+            if e.is_target():
+                continue
+            assert s.column_binning.bin_boundary == \
+                e.column_binning.bin_boundary, e.column_name
+            assert s.column_binning.bin_category == \
+                e.column_binning.bin_category
+            assert s.column_binning.bin_count_pos == \
+                e.column_binning.bin_count_pos, e.column_name
+            assert s.column_binning.bin_count_neg == \
+                e.column_binning.bin_count_neg
+            assert s.column_stats.ks == pytest.approx(
+                e.column_stats.ks, abs=1e-9)
+            assert s.column_stats.iv == pytest.approx(
+                e.column_stats.iv, abs=1e-9)
+            assert s.column_stats.total_count == e.column_stats.total_count
+            assert s.column_stats.missing_count == \
+                e.column_stats.missing_count
+            if s.column_stats.mean is not None:
+                assert s.column_stats.mean == pytest.approx(
+                    e.column_stats.mean, rel=1e-5)
+                assert s.column_stats.std_dev == pytest.approx(
+                    e.column_stats.std_dev, rel=1e-4)
+
+    def test_streaming_norm_prefetch_bit_identical(self, tmp_path):
+        from shifu_tpu.norm.dataset import load_codes, load_normalized
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=1500)
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root).run() == 0
+
+        def run_norm(prefetch):
+            _set_props(**{"shifu.ingest.forceStreaming": "true",
+                          "shifu.ingest.chunkRows": "256",
+                          "shifu.ingest.prefetchChunks": str(prefetch)})
+            try:
+                assert NormProcessor(root).run() == 0
+            finally:
+                _clear_props("shifu.ingest.forceStreaming",
+                             "shifu.ingest.chunkRows",
+                             "shifu.ingest.prefetchChunks")
+            _, f, t, w = load_normalized(
+                os.path.join(root, "tmp", "norm", "NormalizedData"))
+            _, c, _, _ = load_codes(
+                os.path.join(root, "tmp", "norm", "CleanedData"))
+            return (np.asarray(f).copy(), np.asarray(t).copy(),
+                    np.asarray(w).copy(), np.asarray(c).copy())
+
+        f0, t0, w0, c0 = run_norm(0)
+        f2, t2, w2, c2 = run_norm(3)
+        np.testing.assert_array_equal(f2, f0)
+        np.testing.assert_array_equal(t2, t0)
+        np.testing.assert_array_equal(w2, w0)
+        np.testing.assert_array_equal(c2, c0)
+
+
+class TestDeviceAccumulator:
+    @pytest.mark.parametrize("flush_rows", [10**9, 100])
+    def test_fold_matches_host_fold(self, flush_rows):
+        """One device window (flush_rows huge) and forced multi-window
+        flushing (flush_rows=100 -> a f64 host fold every ~2 chunks) must
+        both reproduce the reference per-chunk host fold."""
+        import jax.numpy as jnp
+
+        from shifu_tpu.data.pipeline import DeviceAccumulator
+        from shifu_tpu.ops.binagg import bin_aggregate_jit
+
+        rng = np.random.default_rng(2)
+        acc = DeviceAccumulator(flush_rows=flush_rows)
+        assert acc.empty and acc.fetch() is None
+        host = None
+        for _ in range(4):
+            n = 64
+            codes = rng.integers(0, 3, size=(n, 1)).astype(np.int32)
+            tags = rng.integers(0, 2, size=n).astype(np.int32)
+            vals = rng.normal(size=(n, 1)).astype(np.float32)
+            agg = bin_aggregate_jit(
+                jnp.asarray(codes), jnp.asarray(np.zeros(1, np.int32)), 3,
+                jnp.asarray(tags), jnp.asarray(np.ones(n, np.float32)),
+                jnp.asarray(vals))
+            acc.add(agg, rows=n)
+            part = [np.asarray(x, np.float64) for x in agg]
+            if host is None:
+                host = part
+            else:
+                host = [
+                    np.minimum(h, p) if k == 6 else
+                    np.maximum(h, p) if k == 7 else h + p
+                    for k, (h, p) in enumerate(zip(host, part))
+                ]
+        got = acc.fetch()
+        for g, h in zip(got, host):
+            np.testing.assert_allclose(g, h, rtol=1e-6)
+
+
+class TestReaderRegressions:
+    """Satellite fixes: stray-header filtering + missing-token parity."""
+
+    def test_read_columnar_keeps_row_with_header_like_first_field(
+            self, tmp_path):
+        """read_columnar must apply the same all-fields-must-match header
+        rule as the chunked reader: a data row whose FIRST field collides
+        with the first column name survives, a full header row does not."""
+        from shifu_tpu.data.reader import read_columnar
+
+        p = str(tmp_path / "d.csv")
+        names = ["a", "b"]
+        with open(p, "w") as fh:
+            fh.write("a|b\n")    # stray full header: dropped
+            fh.write("a|1\n")    # legit row: first field happens to be 'a'
+            fh.write("x|2\n")
+        data = read_columnar(p, names)
+        assert list(data.column("a")) == ["a", "x"]
+        assert list(data.column("b")) == ["1", "2"]
+
+    def test_numeric_and_missing_mask_agree_on_padded_tokens(self):
+        """' NA ' must count as missing in BOTH views: missing_mask
+        strips before the set check, so numeric must too."""
+        from shifu_tpu.data.reader import ColumnarData
+
+        data = ColumnarData(
+            names=["v"],
+            raw={"v": np.array(["1.5", " NA ", "NA", " 2.5 ", "?"],
+                               object)},
+            n_rows=5,
+            missing_values=("", "NA", "?"),
+        )
+        mask = data.missing_mask("v")
+        vals = data.numeric("v")
+        np.testing.assert_array_equal(
+            mask, [False, True, True, False, True])
+        # every masked-missing token is NaN in the numeric view, and
+        # whitespace-padded real numbers still parse
+        np.testing.assert_array_equal(np.isnan(vals), mask)
+        assert vals[3] == 2.5
+
+
+class TestPipelineOverlap:
+    def test_prefetch_overlaps_producer_and_consumer(self):
+        """With producer and consumer each sleeping T per item, the
+        pipelined wall-clock must land well under the 2T-per-item serial
+        sum (the overlap the stage timers are meant to expose)."""
+        from shifu_tpu.data.pipeline import prefetch_iter
+        from shifu_tpu.utils.timing import StageTimers
+
+        n, t = 8, 0.03
+        timers = StageTimers()
+
+        def slow_source():
+            for i in range(n):
+                time.sleep(t)
+                yield i
+
+        t0 = time.perf_counter()
+        for _ in prefetch_iter(slow_source(), depth=2, timers=timers,
+                               stage="parse"):
+            with timers.timer("device"):
+                time.sleep(t)
+        wall = time.perf_counter() - t0
+        serial = 2 * n * t
+        assert wall < serial * 0.8
+        # the timers see the full per-stage cost even though it overlapped
+        assert timers.seconds("parse") >= n * t * 0.9
+        assert timers.seconds("device") >= n * t * 0.9
