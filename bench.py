@@ -617,19 +617,42 @@ def bench_streamed_stats(reps: int):
     }
 
 
+def _with_obs_metrics(fn):
+    """Run one scenario inside a fresh obs scope and embed the registry
+    snapshot (compile counts, d2h sync counts, stage seconds, ...) in its
+    result — so BENCH_*.json trajectories can EXPLAIN a regression (e.g.
+    "jax.compiles doubled") instead of only reporting it."""
+    from shifu_tpu import obs
+
+    obs.install_jax_probes()
+    obs.reset()
+    res = fn()
+    snap = obs.registry().snapshot()
+    res["metrics"] = {
+        "counters": {k: round(v, 1)
+                     for k, v in snap.get("counters", {}).items()},
+        "timers": {k: {"seconds": round(t["seconds"], 4),
+                       "calls": t["calls"]}
+                   for k, t in snap.get("timers", {}).items()},
+    }
+    return res
+
+
 def main() -> None:
     remeasure = "--remeasure-baseline" in sys.argv
     base = load_or_measure_baseline(remeasure)
     t_start = time.perf_counter()
 
-    small = bench_nn(SMALL, mixed_precision=True, reps=3)
-    dense = bench_nn(DENSE, mixed_precision=True, reps=2)
-    gbt = bench_gbt(reps=3)
-    gbt_wide = bench_gbt_wide(reps=2)
-    rf = bench_rf(reps=2)
-    wdl = bench_wdl(reps=2)
-    streamed = bench_streamed_nn(reps=1)
-    streamed_stats = bench_streamed_stats(reps=3)
+    small = _with_obs_metrics(
+        lambda: bench_nn(SMALL, mixed_precision=True, reps=3))
+    dense = _with_obs_metrics(
+        lambda: bench_nn(DENSE, mixed_precision=True, reps=2))
+    gbt = _with_obs_metrics(lambda: bench_gbt(reps=3))
+    gbt_wide = _with_obs_metrics(lambda: bench_gbt_wide(reps=2))
+    rf = _with_obs_metrics(lambda: bench_rf(reps=2))
+    wdl = _with_obs_metrics(lambda: bench_wdl(reps=2))
+    streamed = _with_obs_metrics(lambda: bench_streamed_nn(reps=1))
+    streamed_stats = _with_obs_metrics(lambda: bench_streamed_stats(reps=3))
 
     peak, chip = chip_peak_tflops()
     nw = base["n_reference_workers"]
@@ -641,6 +664,7 @@ def main() -> None:
             "vs_baseline": round(res[unit_key] / denom, 4),
             "vs_one_numpy_worker": round(res[unit_key] / base[base_key], 2),
             "spread": res["spread"],
+            "metrics": res.get("metrics"),
         }
 
     print(json.dumps({
@@ -651,6 +675,7 @@ def main() -> None:
             small["row_epochs_per_s"]
             / (base["small_row_epochs_per_s"] * nw), 4),
         "spread": small["spread"],
+        "metrics": small.get("metrics"),
         "baseline_pinned": True,
         "chip": chip,
         "dense": {
@@ -662,6 +687,7 @@ def main() -> None:
                 dense["row_epochs_per_s"]
                 / (base["dense_row_epochs_per_s"] * nw), 4),
             "spread": dense["spread"],
+            "metrics": dense.get("metrics"),
         },
         "gbt": section(gbt, "row_trees_per_s", "gbt_row_trees_per_s"),
         "gbt_wide": section(gbt_wide, "row_trees_per_s",
@@ -683,6 +709,7 @@ def main() -> None:
             "prefetch_speedup": round(
                 streamed_stats["prefetch_speedup"], 3),
             "spread": streamed_stats["spread"],
+            "metrics": streamed_stats.get("metrics"),
             "note": ("two-pass streaming stats rows/s through the "
                      "overlapped ingest pipeline; prefetch_speedup = "
                      "serial wall-clock / prefetched wall-clock on the "

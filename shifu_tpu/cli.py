@@ -114,6 +114,15 @@ def build_parser() -> argparse.ArgumentParser:
     p_switch.add_argument("version")
     sub.add_parser("show", help="show model-set versions")
 
+    p_runs = sub.add_parser(
+        "runs", help="list run-ledger manifests (.shifu/runs)")
+    p_runs.add_argument("--last", type=int, default=None,
+                        help="show only the N most recent runs")
+    p_runs.add_argument("--step", default=None,
+                        help="filter by lifecycle step (stats/norm/train/...)")
+    p_runs.add_argument("--json", action="store_true", dest="as_json",
+                        help="dump the selected manifests as JSON")
+
     sub.add_parser("version", help="print version")
     return parser
 
@@ -211,6 +220,17 @@ def dispatch(args: argparse.Namespace) -> int:
         from shifu_tpu.processor.analysis import AnalysisProcessor
 
         return AnalysisProcessor().run()
+    if cmd == "runs":
+        import json
+
+        from shifu_tpu.obs.ledger import format_runs, list_runs
+
+        manifests = list_runs(".", last=args.last, step=args.step)
+        if args.as_json:
+            print(json.dumps(manifests, indent=2, sort_keys=True))
+        else:
+            print(format_runs(manifests))
+        return 0
     if cmd in ("save", "switch", "show"):
         from shifu_tpu.processor.manage import ManageProcessor
 

@@ -95,6 +95,9 @@ def prefetch_iter(
             item = next(it)
         if transform is not None:
             item = transform(item)
+        from shifu_tpu.obs import registry
+
+        registry().counter("pipeline.chunks").inc()
         return item
 
     if depth <= 0:
@@ -223,6 +226,11 @@ class DeviceAccumulator:
             return
         import jax
 
+        from shifu_tpu.obs import registry
+
+        # every window flush IS a blocking device->host sync — the count is
+        # the pipeline's d2h budget (one per ~2^23 rows, was one per chunk)
+        registry().counter("device.d2h_syncs").inc()
         part = [np.asarray(x, dtype=np.float64)
                 for x in jax.device_get(self._acc)]
         self._acc = None

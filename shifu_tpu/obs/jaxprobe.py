@@ -1,0 +1,58 @@
+"""JAX runtime probes: compile counts/seconds via jax.monitoring listeners.
+
+XLA recompiles are the silent tax of a shape-unstable pipeline (PR 1's
+bucketed padding exists to bound them); these probes make every backend
+compile a registry counter so run manifests and bench output can say "this
+step compiled N programs for M seconds" instead of guessing from wall-clock.
+
+The listener resolves the CURRENT global registry at event time, so the
+per-step registry reset in BasicProcessor.run() scopes compile counts to the
+step that caused them. Device-transfer counters have no monitoring event in
+jax; the explicit placement seams count themselves (parallel/mesh.py h2d,
+data/pipeline.py DeviceAccumulator d2h).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_installed = False
+_lock = threading.Lock()
+
+# event name -> (counter to inc, timer to accumulate); backend_compile is the
+# actual XLA compile, jaxpr_trace fires per cache-missing trace
+_DURATION_EVENTS = {
+    "/jax/core/compile/backend_compile_duration":
+        ("jax.compiles", "jax.compile"),
+    "/jax/core/compile/jaxpr_trace_duration":
+        ("jax.traces", "jax.trace"),
+}
+
+
+def install() -> bool:
+    """Idempotently register the monitoring listeners. Returns True if the
+    probes are active (False when jax lacks the monitoring API)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+        except ImportError:  # pragma: no cover - jax always present
+            return False
+        if not hasattr(monitoring, "register_event_duration_secs_listener"):
+            return False  # pragma: no cover - ancient jax
+
+        def _on_duration(name: str, duration: float, **_kw) -> None:
+            hit = _DURATION_EVENTS.get(name)
+            if hit is None:
+                return
+            from shifu_tpu.obs import registry
+
+            reg = registry()
+            reg.counter(hit[0]).inc()
+            reg.timer(hit[1]).add(duration)
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _installed = True
+        return True

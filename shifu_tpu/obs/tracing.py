@@ -1,0 +1,99 @@
+"""Span tracing: nested wall-clock spans serialized as a Chrome trace.
+
+`with tracer.span("stats.pass2", rows=n):` records start/end/duration and
+attributes; the collected events serialize to the Chrome-trace JSON format
+(`chrome://tracing` / Perfetto "traceEvents" with ph="X" complete events),
+one file per lifecycle step next to the run manifest (obs/ledger.py).
+
+Thread-safe: the streaming pipeline's prefetch worker opens spans on its own
+thread; events carry the recording thread id so overlap between the parse
+thread and the device thread is visible as parallel tracks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Tracer:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._local = threading.local()
+        # one wall-clock anchor so perf_counter offsets render as absolute-ish
+        self._t0 = time.perf_counter()
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = []
+            self._local.stack = st
+        return st
+
+    def current_path(self) -> str:
+        """Dotted path of the innermost open span on this thread ("" if none)."""
+        return "/".join(self._stack())
+
+    @contextmanager
+    def span(self, name: str, **attrs: Any) -> Iterator[Dict[str, Any]]:
+        """Record a nested span; yields the mutable attrs dict so callers can
+        attach results discovered mid-span (row counts, output paths)."""
+        stack = self._stack()
+        stack.append(name)
+        args = dict(attrs)
+        t0 = time.perf_counter()
+        try:
+            yield args
+        finally:
+            t1 = time.perf_counter()
+            stack.pop()
+            event = {
+                "name": name,
+                "ph": "X",
+                "ts": (t0 - self._t0) * 1e6,  # Chrome trace wants microseconds
+                "dur": (t1 - t0) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": {k: _jsonable(v) for k, v in args.items()},
+            }
+            if stack:
+                event["args"]["parent"] = "/".join(stack)
+            with self._lock:
+                self._events.append(event)
+
+    @property
+    def events(self) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def span_seconds(self, name: str) -> float:
+        """Total recorded duration of all spans with this name (seconds)."""
+        with self._lock:
+            return sum(e["dur"] for e in self._events
+                       if e["name"] == name) / 1e6
+
+    def to_chrome_trace(self) -> dict:
+        with self._lock:
+            events = sorted(self._events, key=lambda e: e["ts"])
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> Optional[str]:
+        """Write the Chrome-trace JSON; returns the path (None if no spans)."""
+        with self._lock:
+            if not self._events:
+                return None
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            json.dump(self.to_chrome_trace(), fh)
+        return path
+
+
+def _jsonable(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)

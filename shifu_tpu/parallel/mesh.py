@@ -46,10 +46,13 @@ def data_mesh(n_devices: Optional[int] = None, model_axis: int = 1,
     import jax
     from jax.sharding import Mesh
 
+    from shifu_tpu.obs import registry
+
     devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
     n = len(devices)
+    registry().gauge("mesh.devices").set(n)
     n_dcn = dcn_slices if dcn_slices else _slice_count(devices)
     if n_dcn > 1:
         assert n % n_dcn == 0, (n, n_dcn)
@@ -126,9 +129,16 @@ def shard_rows(array, mesh):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from shifu_tpu.obs import registry
+
     axes = row_axes(mesh)
     spec = P(axes if len(axes) > 1 else axes[0],
              *([None] * (array.ndim - 1)))
+    # collective-op accounting: every sharded placement seeds a program
+    # whose row-sharded consumption XLA closes with a psum over `axes`
+    reg = registry()
+    reg.counter("mesh.shard_rows", axes="x".join(axes)).inc()
+    reg.counter("mesh.h2d_bytes").inc(float(getattr(array, "nbytes", 0)))
     return jax.device_put(array, NamedSharding(mesh, spec))
 
 
@@ -136,5 +146,12 @@ def replicate(tree, mesh):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from shifu_tpu.obs import registry
+
     sharding = NamedSharding(mesh, P())
+    leaves = jax.tree_util.tree_leaves(tree)
+    reg = registry()
+    reg.counter("mesh.replicated_arrays").inc(len(leaves))
+    reg.counter("mesh.h2d_bytes").inc(
+        float(sum(getattr(a, "nbytes", 0) for a in leaves)))
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)

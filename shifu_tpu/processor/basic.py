@@ -68,27 +68,77 @@ class BasicProcessor:
             return path
         return os.path.normpath(os.path.join(self.root, path))
 
-    # ---- run wrapper with timing, reference-style step logging ----
+    # ---- run wrapper: ledger manifest, metrics/tracing scope, profiling ----
     def run(self) -> int:
-        t0 = time.time()
-        log.info("Step %s starts.", self.step)
-        profile_dir = self._profile_dir()
-        try:
-            if profile_dir:
-                # -Dshifu.profile=<dir>: wrap the step in a jax.profiler
-                # trace (the TPU answer to the reference's per-phase
-                # wall-clock logging + JMap introspection, SURVEY §5);
-                # inspect with TensorBoard or xprof
-                import jax
+        """Run the step inside the observability envelope: a fresh
+        metrics/tracing scope (outermost run only), a root span, optional
+        jax.profiler trace (-Dshifu.profile=<dir>), and — success OR
+        failure — a sequence-numbered run manifest under
+        <root>/.shifu/runs/<step>-<seq>.json carrying the registry
+        snapshot, trace path, config hashes and exit status
+        (obs/ledger.py). Exceptions re-raise after the manifest lands."""
+        import sys
 
-                os.makedirs(profile_dir, exist_ok=True)
-                with jax.profiler.trace(profile_dir):
-                    self.run_step()
-                log.info("profiler trace -> %s", profile_dir)
-            else:
-                self.run_step()
+        from shifu_tpu import obs
+        from shifu_tpu.obs.ledger import RunLedger
+
+        obs.install_jax_probes()
+        obs.begin_run()
+        t0 = time.time()
+        status, error = "ok", None
+        profile_dir = None
+        try:  # everything after begin_run pairs with end_run in finally —
+            # a leaked run depth would disable the per-step registry reset
+            # for the rest of the process
+            ledger = RunLedger(self.root)
+            seq = ledger.next_seq(self.step)
+            log.info("Step %s starts.", self.step)
+            profile_dir = self._profile_dir()
+            try:
+                with obs.span(f"step.{self.step}", seq=seq):
+                    if profile_dir:
+                        # -Dshifu.profile=<dir>: wrap the step in a
+                        # jax.profiler trace (the TPU answer to the
+                        # reference's per-phase wall-clock logging + JMap
+                        # introspection, SURVEY §5); inspect with
+                        # TensorBoard or xprof
+                        import jax
+
+                        os.makedirs(profile_dir, exist_ok=True)
+                        with jax.profiler.trace(profile_dir):
+                            self.run_step()
+                        log.info("profiler trace -> %s", profile_dir)
+                    else:
+                        self.run_step()
+            except BaseException as e:
+                status, error = "failed", f"{type(e).__name__}: {e}"
+                raise
+            finally:
+                elapsed = time.time() - t0
+                reg = obs.registry()
+                reg.gauge("step.columns_configured").set(
+                    len(self.column_configs))
+                reg.timer("step.elapsed", step=self.step).add(elapsed)
+                try:
+                    path = ledger.write(
+                        self.step, seq,
+                        status=status,
+                        exit_status=0 if status == "ok" else 1,
+                        started_at=t0,
+                        elapsed_seconds=elapsed,
+                        argv=list(sys.argv),
+                        registry=reg,
+                        tracer=obs.tracer(),
+                        error=error,
+                        extra=({"profileDir": profile_dir}
+                               if profile_dir else None),
+                    )
+                    log.info("run manifest -> %s", path)
+                except Exception as we:  # a broken ledger must not mask
+                    log.warning("cannot write run manifest: %s", we)
+                log.info("Step %s finished in %.1f s.", self.step, elapsed)
         finally:
-            log.info("Step %s finished in %.1f s.", self.step, time.time() - t0)
+            obs.end_run()
         return 0
 
     def _profile_dir(self):

@@ -232,6 +232,8 @@ class EvalProcessor(BasicProcessor):
                 fh.write(sep.join(row) + "\n")
         n_pos = int((tags == 1).sum())
         n_neg = int((tags == 0).sum())
+        self._record_score_metrics(ec.name, data.n_rows, n_pos, n_neg,
+                                   len(paths))
         log.info("eval %s scored %d records (%d pos / %d neg) with %d models -> %s",
                  ec.name, data.n_rows, n_pos, n_neg, len(paths), out)
 
@@ -325,9 +327,21 @@ class EvalProcessor(BasicProcessor):
                 fh.write(sep.join(
                     ["tag", "weight", "mean", "max", "min", "median"]
                     + score_names) + "\n")
+        self._record_score_metrics(ec.name, n_rows, n_pos, n_neg, len(paths))
         log.info("eval %s STREAMED %d records (%d pos / %d neg) with %d "
                  "models -> %s", ec.name, n_rows, n_pos, n_neg, len(paths),
                  out)
+
+    @staticmethod
+    def _record_score_metrics(name: str, n_rows: int, n_pos: int,
+                              n_neg: int, n_models: int) -> None:
+        from shifu_tpu.obs import registry
+
+        reg = registry()
+        reg.counter("eval.records", eval=name).inc(n_rows)
+        reg.counter("eval.records_pos", eval=name).inc(n_pos)
+        reg.counter("eval.records_neg", eval=name).inc(n_neg)
+        reg.gauge("eval.models", eval=name).set(n_models)
 
     @staticmethod
     def _spec_score_names(runner) -> List[str]:
@@ -438,6 +452,12 @@ class EvalProcessor(BasicProcessor):
         chart = render_gain_chart(ec.name, mc.basic.name, perf)
         with open(self.paths.gain_chart_path(ec.name), "w") as fh:
             fh.write(chart)
+        from shifu_tpu.obs import registry
+
+        reg = registry()
+        reg.gauge("eval.auc", eval=ec.name).set(perf.area_under_roc)
+        reg.gauge("eval.weighted_auc", eval=ec.name).set(
+            perf.weighted_area_under_roc)
         log.info(
             "eval %s: AUC %.6f (weighted %.6f); perf -> %s, chart -> %s",
             ec.name, perf.area_under_roc, perf.weighted_area_under_roc,
@@ -574,6 +594,14 @@ class EvalProcessor(BasicProcessor):
         with open(cm_path, "w") as fh:
             fh.write(confusion_matrix_text(matrix, class_tags))
         acc = multiclass_accuracy(matrix)
+        from shifu_tpu.obs import registry
+
+        reg = registry()
+        reg.gauge("eval.accuracy", eval=ec.name).set(acc)
+        reg.counter("eval.confusion_diagonal", eval=ec.name).inc(
+            float(np.trace(matrix)))
+        reg.counter("eval.confusion_offdiagonal", eval=ec.name).inc(
+            float(matrix.sum() - np.trace(matrix)))
         perf_path = self.paths.eval_performance_path(ec.name)
         with open(perf_path, "w") as fh:
             json.dump({

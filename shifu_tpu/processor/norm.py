@@ -98,9 +98,17 @@ class NormProcessor(BasicProcessor):
             tags = tags[perm]
             weights = weights[perm]
 
+        from shifu_tpu.obs import registry, span
+
+        reg = registry()
+        timers = reg.stage_timers("norm.stage")
         plan = build_norm_plan(mc, self.column_configs)
         code_cache: dict = {}
-        feats = apply_norm_plan(plan, data, code_cache=code_cache)
+        with span("norm.normalize", rows=data.n_rows), \
+                timers.timer("normalize"):
+            feats = apply_norm_plan(plan, data, code_cache=code_cache)
+        reg.counter("norm.rows").inc(int(feats.shape[0]))
+        reg.gauge("norm.columns").set(int(feats.shape[1]))
         n_shards = default_shards()
         out_dir = self.paths.normalized_data_dir()
         # persist the output-name -> source-column mapping so later steps
@@ -108,16 +116,17 @@ class NormProcessor(BasicProcessor):
         # the plan against possibly-changed ColumnConfigs
         extra = {"sourceOf": plan.source_of}
         self._add_class_meta(extra, tags)
-        write_normalized(
-            out_dir,
-            feats,
-            tags,
-            weights,
-            plan.out_names,
-            norm_type=mc.normalize.norm_type.value,
-            n_shards=n_shards,
-            extra=extra,
-        )
+        with span("norm.write", shards=n_shards), timers.timer("write"):
+            write_normalized(
+                out_dir,
+                feats,
+                tags,
+                weights,
+                plan.out_names,
+                norm_type=mc.normalize.norm_type.value,
+                n_shards=n_shards,
+                extra=extra,
+            )
         log.info(
             "normalized %d rows x %d cols (%s) -> %s [%d shards]",
             feats.shape[0], feats.shape[1], mc.normalize.norm_type.value,
@@ -126,16 +135,17 @@ class NormProcessor(BasicProcessor):
 
         # tree-model bin codes
         tree_cols = norm_columns(self.column_configs)
-        codes = bin_code_matrix(tree_cols, data, cache=code_cache)
-        write_codes(
-            self.paths.cleaned_data_dir(),
-            codes,
-            tags,
-            weights,
-            [c.column_name for c in tree_cols],
-            [_slots(c) for c in tree_cols],
-            n_shards=n_shards,
-        )
+        with span("norm.bincode"), timers.timer("bincode"):
+            codes = bin_code_matrix(tree_cols, data, cache=code_cache)
+            write_codes(
+                self.paths.cleaned_data_dir(),
+                codes,
+                tags,
+                weights,
+                [c.column_name for c in tree_cols],
+                [_slots(c) for c in tree_cols],
+                n_shards=n_shards,
+            )
         log.info("bin codes -> %s", self.paths.cleaned_data_dir())
 
     def _add_class_meta(self, extra: dict, tags: np.ndarray) -> None:
@@ -164,8 +174,8 @@ class NormProcessor(BasicProcessor):
         from shifu_tpu.data.pipeline import prefetch_iter
         from shifu_tpu.data.stream import chunk_source, memory_budget_bytes
         from shifu_tpu.norm.dataset import ShardWriter, ShuffleShardWriter
+        from shifu_tpu.obs import registry, span
         from shifu_tpu.stats.engine import _prepare_rows
-        from shifu_tpu.utils.timing import StageTimers
 
         mc = self.model_config
         ds = mc.data_set
@@ -215,7 +225,9 @@ class NormProcessor(BasicProcessor):
             delimiter=ds.data_delimiter,
             missing_values=tuple(ds.missing_or_invalid_values),
         )
-        timers = StageTimers()
+        # registry-backed: streaming-stage timings land in the run manifest
+        reg = registry()
+        timers = reg.stage_timers("norm.stage")
 
         def _normed(numbered):
             """Prefetch-thread stage: parse + purify + norm + bin-code one
@@ -236,17 +248,23 @@ class NormProcessor(BasicProcessor):
 
         n_rows = 0
         all_tag_counts: dict = {}
-        for item in prefetch_iter(enumerate(factory()), transform=_normed,
-                                  timers=timers, stage="parse"):
-            if item is None:
-                continue
-            feats, codes, tags, weights = item
-            with timers.timer("write"):
-                feat_writer.add(feats, tags, weights)
-                code_writer.add(codes, tags, weights)
-            n_rows += len(tags)
-            for t, c in zip(*np.unique(tags, return_counts=True)):
-                all_tag_counts[int(t)] = all_tag_counts.get(int(t), 0) + int(c)
+        with span("norm.stream", shuffle=self.shuffle) as sp:
+            for item in prefetch_iter(enumerate(factory()),
+                                      transform=_normed,
+                                      timers=timers, stage="parse"):
+                if item is None:
+                    continue
+                feats, codes, tags, weights = item
+                with timers.timer("write"):
+                    feat_writer.add(feats, tags, weights)
+                    code_writer.add(codes, tags, weights)
+                n_rows += len(tags)
+                for t, c in zip(*np.unique(tags, return_counts=True)):
+                    all_tag_counts[int(t)] = (
+                        all_tag_counts.get(int(t), 0) + int(c))
+            sp["rows"] = n_rows
+        reg.counter("norm.rows").inc(n_rows)
+        reg.gauge("norm.columns").set(len(plan.out_names))
         log.info("streaming norm pipeline: %s", timers.summary())
         if mc.is_multi_classification() and feat_writer.extra is not None:
             class_tags = [str(t) for t in mc.tags()]

@@ -120,6 +120,9 @@ def train_tree_models(proc, alg) -> None:
         progress_path = proc.paths.progress_path(i)
 
         def progress(k, tr, va, _p=progress_path, _i=i):
+            from shifu_tpu.processor.train_common import record_epoch
+
+            record_epoch(_i, k, tr, va)  # per-tree series -> run manifest
             if k % 10 == 0 or k == 1:
                 with open(_p, "a") as fh:
                     fh.write(f"Trainer {_i} Tree #{k} Train Error:{tr:.8f} "

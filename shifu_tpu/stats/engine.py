@@ -9,6 +9,7 @@ per-column bins -> bin-hit aggregation -> KS/IV/WOE -> ColumnConfig update.
 from __future__ import annotations
 
 import math
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -127,20 +128,30 @@ def compute_stats(
     seed: int = 0,
 ) -> None:
     """Fill stats + binning for every non-target/meta/weight column, in place."""
+    from shifu_tpu.obs import registry, span
+
     data, tags, weights = _prepare_rows(
         mc, data, seed, mc.stats.sample_rate, mc.stats.sample_neg_only,
         fold_multiclass=True,
     )
+    n_pos, n_neg = int((tags == 1).sum()), int((tags == 0).sum())
     log.info("stats over %d rows (%d pos / %d neg)", data.n_rows,
-             int((tags == 1).sum()), int((tags == 0).sum()))
+             n_pos, n_neg)
 
     stats_cols = [
         c for c in columns if not (c.is_target() or c.is_meta() or c.is_weight())
     ]
+    reg = registry()
+    reg.counter("stats.rows_valid").inc(data.n_rows)
+    reg.counter("stats.rows_pos").inc(n_pos)
+    reg.counter("stats.rows_neg").inc(n_neg)
+    reg.gauge("stats.columns").set(len(stats_cols))
+    timers = reg.stage_timers("stats.stage")
 
     # ---- pass 1: bin construction (host, exact quantiles) ----
     max_bins = mc.stats.max_num_bin
     cate_max = mc.stats.cate_max_num_bin or MAX_CATEGORY_SIZE
+    _t_bins = time.perf_counter()
     for cc in stats_cols:
         if cc.is_categorical():
             miss = data.missing_mask(cc.column_name)
@@ -175,19 +186,24 @@ def compute_stats(
             cc.column_binning.bin_category = None
             cc.column_binning.length = len(bounds)
 
-    # ---- pass 2: one jit aggregation over the code matrix ----
-    codes, col_offsets, slots, values, numeric_cols = build_codes(data, stats_cols)
-    total_slots = int(sum(slots))
-    import jax.numpy as jnp
+    timers.add("bins", time.perf_counter() - _t_bins)
 
-    agg = bin_aggregate_jit(
-        jnp.asarray(codes),
-        jnp.asarray(col_offsets),
-        total_slots,
-        jnp.asarray(tags),
-        jnp.asarray(weights, dtype=jnp.float32),
-        jnp.asarray(values),
-    )
+    # ---- pass 2: one jit aggregation over the code matrix ----
+    with span("stats.aggregate", rows=data.n_rows, columns=len(stats_cols)), \
+            timers.timer("aggregate"):
+        codes, col_offsets, slots, values, numeric_cols = build_codes(
+            data, stats_cols)
+        total_slots = int(sum(slots))
+        import jax.numpy as jnp
+
+        agg = bin_aggregate_jit(
+            jnp.asarray(codes),
+            jnp.asarray(col_offsets),
+            total_slots,
+            jnp.asarray(tags),
+            jnp.asarray(weights, dtype=jnp.float32),
+            jnp.asarray(values),
+        )
 
     medians = []
     for cc in numeric_cols:
@@ -363,8 +379,8 @@ def compute_stats_streaming(
         bucket_rows,
         prefetch_iter,
     )
+    from shifu_tpu.obs import registry, span
     from shifu_tpu.stats.sketch import CategoricalSketch, NumericSketch
-    from shifu_tpu.utils.timing import StageTimers
 
     stats_cols = [
         c for c in columns if not (c.is_target() or c.is_meta() or c.is_weight())
@@ -394,7 +410,11 @@ def compute_stats_streaming(
         else:
             sketches[cc.column_name] = NumericSketch(max_bins=max_bins)
 
-    timers = StageTimers()
+    # registry-backed: stage timings land in the run manifest, not just a
+    # log line (stats.stage{stage=parse1|prepare|sketch|parse2|bincode|
+    # device|sync})
+    reg = registry()
+    timers = reg.stage_timers("stats.stage")
 
     def _prep1(numbered):
         """Background-thread transform: purify + tag + sample one chunk,
@@ -421,25 +441,31 @@ def compute_stats_streaming(
     # ---- pass 1: sketches ----
     n_valid_rows = 0
     n_pos = n_neg = 0
-    for chunk, tags, weights in prefetch_iter(
-        enumerate(chunk_factory()), transform=_prep1,
-        timers=timers, stage="parse1",
-    ):
-        if not chunk.n_rows:
-            continue
-        n_valid_rows += chunk.n_rows
-        n_pos += int((tags == 1).sum())
-        n_neg += int((tags == 0).sum())
-        bm = bin_subset(tags)
-        with timers.timer("sketch"):
-            for cc in stats_cols:
-                sk = sketches[cc.column_name]
-                if cc.is_categorical():
-                    sk.update(chunk.column(cc.column_name),
-                              chunk.missing_mask(cc.column_name))
-                else:
-                    sk.update(chunk.numeric(cc.column_name), bm,
-                              weights if use_weights else None)
+    with span("stats.pass1") as sp1:
+        for chunk, tags, weights in prefetch_iter(
+            enumerate(chunk_factory()), transform=_prep1,
+            timers=timers, stage="parse1",
+        ):
+            if not chunk.n_rows:
+                continue
+            n_valid_rows += chunk.n_rows
+            n_pos += int((tags == 1).sum())
+            n_neg += int((tags == 0).sum())
+            bm = bin_subset(tags)
+            with timers.timer("sketch"):
+                for cc in stats_cols:
+                    sk = sketches[cc.column_name]
+                    if cc.is_categorical():
+                        sk.update(chunk.column(cc.column_name),
+                                  chunk.missing_mask(cc.column_name))
+                    else:
+                        sk.update(chunk.numeric(cc.column_name), bm,
+                                  weights if use_weights else None)
+        sp1["rows"] = n_valid_rows
+    reg.counter("stats.rows_valid").inc(n_valid_rows)
+    reg.counter("stats.rows_pos").inc(n_pos)
+    reg.counter("stats.rows_neg").inc(n_neg)
+    reg.gauge("stats.columns").set(len(stats_cols))
     log.info("streaming stats pass 1 done: %d rows (%d pos / %d neg)",
              n_valid_rows, n_pos, n_neg)
 
@@ -501,23 +527,29 @@ def compute_stats_streaming(
         return n_real, codes, tags, weights, values, offs, sl, ncols
 
     acc_dev = DeviceAccumulator()
-    for item in prefetch_iter(enumerate(chunk_factory()), transform=_prep2,
-                              timers=timers, stage="parse2"):
-        if item is None:
-            continue
-        (n_real, codes, tags, weights, values,
-         col_offsets, slots, numeric_cols) = item
-        with timers.timer("device"):
-            acc_dev.add(bin_aggregate_jit(
-                jnp.asarray(codes),
-                jnp.asarray(col_offsets),
-                int(sum(slots)),
-                jnp.asarray(tags.astype(np.int32)),
-                jnp.asarray(weights, dtype=jnp.float32),
-                jnp.asarray(values),
-            ), rows=n_real)
-    with timers.timer("sync"):
-        acc = acc_dev.fetch()
+    n_chunks = 0
+    with span("stats.pass2") as sp2:
+        for item in prefetch_iter(enumerate(chunk_factory()),
+                                  transform=_prep2,
+                                  timers=timers, stage="parse2"):
+            if item is None:
+                continue
+            (n_real, codes, tags, weights, values,
+             col_offsets, slots, numeric_cols) = item
+            n_chunks += 1
+            with timers.timer("device"):
+                acc_dev.add(bin_aggregate_jit(
+                    jnp.asarray(codes),
+                    jnp.asarray(col_offsets),
+                    int(sum(slots)),
+                    jnp.asarray(tags.astype(np.int32)),
+                    jnp.asarray(weights, dtype=jnp.float32),
+                    jnp.asarray(values),
+                ), rows=n_real)
+        with timers.timer("sync"):
+            acc = acc_dev.fetch()
+        sp2["chunks"] = n_chunks
+    reg.counter("stats.chunks").inc(n_chunks)
     log.info("streaming stats pipeline: %s", timers.summary())
     if acc is None:
         log.warning("streaming stats: no rows survived filtering")

@@ -491,6 +491,12 @@ def train_nn(
         params = unflatten_params(chosen, shapes)
     else:
         params = None
+    from shifu_tpu.obs import registry
+
+    reg = registry()
+    reg.gauge("train.train_error").set(float(tr_h))
+    reg.gauge("train.valid_error").set(final_valid)
+    reg.counter("train.iterations").inc(it_n)
     log.info(
         "train done: %d iterations, train_err %.6f valid_err %.6f",
         it_n, tr_h, final_valid,
@@ -706,8 +712,16 @@ def train_nn_bagged(
             valid_error=bv if use_best else float(np.asarray(va_e)[i]),
             iterations=int(np.asarray(it_f)[i]),
         ))
+    from shifu_tpu.obs import registry
+
+    avg_valid = float(np.mean([r.valid_error for r in results]))
+    reg = registry()
+    reg.gauge("train.valid_error").set(avg_valid)
+    reg.counter("train.members").inc(n_members)
+    reg.counter("train.iterations").inc(
+        sum(r.iterations for r in results))
     log.info("bagged train done: %d members in one program, avg valid %.6f",
-             n_members, float(np.mean([r.valid_error for r in results])))
+             n_members, avg_valid)
     return results
 
 

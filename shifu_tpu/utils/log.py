@@ -11,13 +11,20 @@ def get_logger(name: str) -> logging.Logger:
 
 
 def configure(verbose: bool = False) -> None:
+    """Idempotent and effective on REPEATED calls: bare logging.basicConfig
+    silently no-ops once the root logger has handlers, so a second
+    configure(verbose=True) (e.g. `-v` after a library call already
+    configured logging) used to change nothing. force=True replaces the
+    root handlers so the latest call always wins."""
     level = logging.DEBUG if verbose else logging.INFO
     logging.basicConfig(
         level=level,
         stream=sys.stderr,
         format="%(asctime)s %(levelname)-5s %(name)s - %(message)s",
         datefmt="%Y-%m-%d %H:%M:%S",
+        force=True,
     )
-    # JAX compilation chatter stays at WARNING unless verbose.
-    if not verbose:
-        logging.getLogger("jax").setLevel(logging.WARNING)
+    # JAX compilation chatter stays at WARNING unless verbose; verbose
+    # restores inheritance so a later non-verbose configure can be undone.
+    logging.getLogger("jax").setLevel(
+        logging.NOTSET if verbose else logging.WARNING)

@@ -1,67 +1,15 @@
-"""Stage wall-clock timers for the streaming pipeline.
+"""Stage wall-clock timers — compatibility seam over shifu_tpu.obs.
 
-Overlap is invisible in one end-to-end number: a pipelined run and a serial
-run produce the same log lines, just slower or faster. These counters make
-the overlap observable without a profiler — each stage (parse / bincode /
-device / sync) accumulates wall-clock seconds and a call count, and the
-pipeline logs one summary line per run. When the per-stage times sum to
-more than the elapsed wall-clock, the difference IS the overlap won.
-
-Thread-safe: the prefetch worker times parse/bincode while the consumer
-thread times device/sync against the same StageTimers instance.
+PR 1 introduced StageTimers here as a standalone ad-hoc accumulator; PR 2
+absorbed it into the unified metrics registry (shifu_tpu/obs/metrics.py) as
+the Timer kind, with StageTimers kept as the multi-stage facade. Importing
+from this module keeps working; registry-backed construction
+(`MetricsRegistry.stage_timers(prefix)`) additionally lands the timings in
+the step's run manifest.
 """
 
 from __future__ import annotations
 
-import threading
-import time
-from contextlib import contextmanager
-from typing import Dict, Iterator
+from shifu_tpu.obs.metrics import StageTimers
 
-
-class StageTimers:
-    """Named wall-clock accumulators (seconds + call counts)."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._seconds: Dict[str, float] = {}
-        self._calls: Dict[str, int] = {}
-
-    def add(self, stage: str, seconds: float, calls: int = 1) -> None:
-        with self._lock:
-            self._seconds[stage] = self._seconds.get(stage, 0.0) + seconds
-            self._calls[stage] = self._calls.get(stage, 0) + calls
-
-    @contextmanager
-    def timer(self, stage: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.add(stage, time.perf_counter() - t0)
-
-    def seconds(self, stage: str) -> float:
-        with self._lock:
-            return self._seconds.get(stage, 0.0)
-
-    def calls(self, stage: str) -> int:
-        with self._lock:
-            return self._calls.get(stage, 0)
-
-    def as_dict(self) -> Dict[str, Dict[str, float]]:
-        with self._lock:
-            return {
-                k: {"seconds": round(self._seconds[k], 4),
-                    "calls": self._calls.get(k, 0)}
-                for k in self._seconds
-            }
-
-    def summary(self) -> str:
-        """One log-friendly line: "parse 1.21s/12 | device 0.43s/12"."""
-        with self._lock:
-            if not self._seconds:
-                return "(no stages timed)"
-            return " | ".join(
-                f"{k} {self._seconds[k]:.2f}s/{self._calls.get(k, 0)}"
-                for k in self._seconds
-            )
+__all__ = ["StageTimers"]
