@@ -27,7 +27,12 @@ data in HBM (real deployments keep it there) and skip end-of-run weight
 pulls (fetch_params=False). The streamed bench deliberately KEEPS its
 per-shard host->device transfers — streaming from host is the thing it
 measures. GBT runs train_trees end to end including per-tree host
-assembly of the forest."""
+assembly of the forest.
+
+The gbt/gbt_wide/rf sections additionally time histogram subtraction
+on vs off on the identical workload (subtraction_speedup = off/on
+wall-clock, same pattern as streamed_stats serial-vs-prefetch) and embed
+the tree.hist.built/derived/fallback_rebuilds counters per mode."""
 
 from __future__ import annotations
 
@@ -386,6 +391,38 @@ def bench_nn(spec: dict, mixed_precision: bool, reps: int):
     }
 
 
+def _tree_hist_counters(fn):
+    """tree.hist.* counter DELTAS over one call (delta, not reset, so the
+    enclosing _with_obs_metrics scope keeps its scenario-wide snapshot)."""
+    from shifu_tpu import obs
+
+    def grab():
+        snap = obs.registry().snapshot().get("counters", {})
+        return {k.split(".")[-1]: v for k, v in snap.items()
+                if k.startswith("tree.hist.")}
+
+    before = grab()
+    fn()
+    return {k: round(v - before.get(k, 0.0), 1)
+            for k, v in grab().items()}
+
+
+def _sub_onoff(run, cfg_off, reps):
+    """Shared GBT/RF measurement protocol: one warmup+counter run per
+    subtraction mode, then timed medians for both. Returns
+    (med_on, lo_on, hi_on, extras) — extras is the off/on wall-clock
+    ratio (same pattern as streamed_stats serial-vs-prefetch) plus the
+    histogram build-vs-derive counters behind it."""
+    hist_on = _tree_hist_counters(run)
+    hist_off = _tree_hist_counters(lambda: run(cfg_off))
+    med, lo, hi = _median_timed(run, reps)
+    med_off, _lo_off, _hi_off = _median_timed(lambda: run(cfg_off), reps)
+    return med, lo, hi, {
+        "subtraction_speedup": med_off / med,
+        "hist_counters": {"on": hist_on, "off": hist_off},
+    }
+
+
 def _bench_trees(codes_np, slots, is_cat, trees, depth, reps):
     import jax
 
@@ -403,16 +440,17 @@ def _bench_trees(codes_np, slots, is_cat, trees, depth, reps):
     w_dev = jax.device_put(w)
     cfg = TreeTrainConfig(algorithm="GBT", tree_num=trees, max_depth=depth,
                           learning_rate=0.1, valid_set_rate=0.1, seed=3)
+    cfg_off = TreeTrainConfig(**{**cfg.__dict__, "hist_subtraction": False})
     cols = [f"f{i}" for i in range(F)]
 
-    def run():
-        train_trees(codes_dev, y_dev, w_dev, slots, is_cat, cols, cfg)
+    def run(c=cfg):
+        train_trees(codes_dev, y_dev, w_dev, slots, is_cat, cols, c)
 
-    run()  # warmup/compile
-    med, lo, hi = _median_timed(run, reps)
+    med, lo, hi, extras = _sub_onoff(run, cfg_off, reps)
     return {
         "row_trees_per_s": n * trees / med,
         "spread": [round(n * trees / hi, 1), round(n * trees / lo, 1)],
+        **extras,
     }
 
 
@@ -456,17 +494,18 @@ def bench_rf(reps: int):
                           max_depth=RF["depth"],
                           feature_subset_strategy="TWOTHIRDS",
                           valid_set_rate=0.1, seed=3)
+    cfg_off = TreeTrainConfig(**{**cfg.__dict__, "hist_subtraction": False})
     cols = [f"f{i}" for i in range(F)]
 
-    def run():
-        train_trees(codes_dev, y_dev, w_dev, slots, is_cat, cols, cfg)
+    def run(c=cfg):
+        train_trees(codes_dev, y_dev, w_dev, slots, is_cat, cols, c)
 
-    run()  # warmup/compile
-    med, lo, hi = _median_timed(run, reps)
+    med, lo, hi, extras = _sub_onoff(run, cfg_off, reps)
     return {
         "row_trees_per_s": n * RF["trees"] / med,
         "spread": [round(n * RF["trees"] / hi, 1),
                    round(n * RF["trees"] / lo, 1)],
+        **extras,
     }
 
 
@@ -659,13 +698,18 @@ def main() -> None:
 
     def section(res, unit_key, base_key):
         denom = base[base_key] * nw
-        return {
+        out = {
             unit_key: round(res[unit_key], 1),
             "vs_baseline": round(res[unit_key] / denom, 4),
             "vs_one_numpy_worker": round(res[unit_key] / base[base_key], 2),
             "spread": res["spread"],
             "metrics": res.get("metrics"),
         }
+        if "subtraction_speedup" in res:  # GBT/RF: hist-subtraction ratio
+            out["subtraction_speedup"] = round(
+                res["subtraction_speedup"], 3)
+            out["hist_counters"] = res["hist_counters"]
+        return out
 
     print(json.dumps({
         "metric": "nn_train_row_epochs_per_s",

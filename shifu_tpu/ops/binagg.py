@@ -104,12 +104,13 @@ def bin_aggregate_sharded(
             vmissing=psum(agg.vmissing),
         )
 
-    from jax import shard_map
+    from shifu_tpu.parallel.mesh import shard_map_compat
 
-    fn = shard_map(
+    fn = shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(axis), P(axis, None)),
         out_specs=BinAggregates(*([P()] * 10)),
+        check=True,  # keep the replication check this call always had
     )
     return fn(codes, tags, weights, values)

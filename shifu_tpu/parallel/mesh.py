@@ -155,3 +155,20 @@ def replicate(tree, mesh):
     reg.counter("mesh.h2d_bytes").inc(
         float(sum(getattr(a, "nbytes", 0) for a in leaves)))
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs, check: bool = False):
+    """shard_map across jax versions: newer jax exports `jax.shard_map`
+    (replication checking spelled `check_vma`), 0.4.x only has
+    `jax.experimental.shard_map.shard_map` (spelled `check_rep`). One
+    helper so every call site stays version-agnostic."""
+    try:
+        from jax import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check)

@@ -161,6 +161,12 @@ def train_tree_models(proc, alg) -> None:
             "baggingWithReplacement": cfg.bagging_with_replacement,
             "validSetRate": cfg.valid_set_rate, "seed": cfg.seed,
             "nClasses": cfg.n_classes,
+            # lowering-affecting knobs: bit-equal resume only holds when
+            # the resumed run picks the SAME histogram lowering (the
+            # subtraction plan + node-batch budget are cfg-static, so
+            # fingerprinting them records-and-replays the choice)
+            "histSubtraction": cfg.hist_subtraction,
+            "maxStatsMemoryMB": cfg.max_stats_memory_mb,
             "oneVsAll": bool(mc.train.is_one_vs_all()),
             "dataSignature": data_sig,
         }

@@ -45,10 +45,15 @@ from shifu_tpu.train.tree_trainer import (
     TreeTrainConfig,
     TreeTrainResult,
     _device_layout,
+    _get_derive_program,
     _get_hist_program,
     _get_update_program,
     _node_batch_size,
+    _record_hist_counters,
     _scan_batched,
+    _sub_acc64,
+    _sub_plan,
+    _sub_row_masks,
     make_layout,
     subset_count,
 )
@@ -110,12 +115,28 @@ def _grow_levelwise_streamed(feed, work, la, lay, cfg, D, row_put,
     feat_levels, mask_levels, leaf_levels = [], [], []
     batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
                                  cfg.n_classes)
+    sub_levels, acc64 = _sub_plan(cfg, batch_cap)
+    acc_dt = jnp.float64 if acc64 else jnp.float32
+    derive = _get_derive_program()
+    sub_on = cfg.hist_subtraction
+    n_built = n_derived = n_fallback = 0
     pending = None
+    prev = None  # retained parent level (hist_acc, is_split, lcnt, ncnt)
     for depth in range(D + 1):
         L = 2**depth
         base = L - 1
-        ranges = [(b0, min(batch_cap, L - b0))
-                  for b0 in range(0, L, batch_cap)]
+        use_sub = prev is not None  # sub_levels[depth] held at depth-1
+        retain_next = depth < D and sub_on and sub_levels[depth + 1]
+        if use_sub:
+            # shards accumulate only the SMALLER child of each parent as
+            # a half-width histogram; siblings derive after the merge
+            Lh = L // 2
+            p_hist, p_split, p_lcnt, p_ncnt = prev
+            left_small = p_lcnt <= p_ncnt - p_lcnt
+            ranges = [(0, Lh)]
+        else:
+            ranges = [(b0, min(batch_cap, L - b0))
+                      for b0 in range(0, L, batch_cap)]
         hist_parts = [None] * len(ranges)
         for wk, codes_host in _iter_codes(feed, work):
             codes_s = row_put(pad_to_mesh(codes_host))
@@ -131,19 +152,35 @@ def _grow_levelwise_streamed(feed, work, la, lay, cfg, D, row_put,
                 hist_p = _get_hist_program(Lb, lay,
                                            n_classes=cfg.n_classes,
                                            mesh=mesh)
-                in_batch = (wk["active"] & (wk["node"] >= b0)
-                            & (wk["node"] < b0 + Lb))
+                if use_sub:
+                    nd, in_batch = _sub_row_masks(wk["node"], wk["active"],
+                                                  left_small)
+                else:
+                    nd = wk["node"] - b0
+                    in_batch = (wk["active"] & (wk["node"] >= b0)
+                                & (wk["node"] < b0 + Lb))
                 h = hist_p(codes_s, wk["labels"], wk["w"],
-                           wk["node"] - b0, in_batch, la.off, la.clip,
+                           nd, in_batch, la.off, la.clip,
                            la.seg_t, la.pos_t)
                 hist_parts[bi] = (h if hist_parts[bi] is None
                                   else hist_parts[bi] + h)
             del codes_s  # drop before the next shard loads
         pending = None
-        (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = _scan_batched(
-            ((hist_parts[bi], Lb, b0)
-             for bi, (b0, Lb) in enumerate(ranges)),
-            la, lay, cfg, L,
+        hist_acc = None
+        if use_sub:
+            hist_f32, hist_acc = derive(p_hist, hist_parts[0], p_split,
+                                        left_small)
+            scan_parts = [(hist_f32, L, 0)]
+            n_built += Lh
+            n_derived += Lh
+        else:
+            scan_parts = [(hist_parts[bi], Lb, b0)
+                          for bi, (b0, Lb) in enumerate(ranges)]
+            n_built += L
+            if sub_on and depth >= 1:
+                n_fallback += len(ranges)
+        (bf, br, rank_flat, lv, is_split, _g, lm, nc, lc) = _scan_batched(
+            scan_parts, la, lay, cfg, L,
         )
         if depth == D:  # final level: leaves only + settle leftovers
             leaf_levels.append(lv)
@@ -153,10 +190,20 @@ def _grow_levelwise_streamed(feed, work, la, lay, cfg, D, row_put,
                 wk["resting"] = jnp.where(
                     wk["active"], base + wk["node"], wk["resting"])
             break
+        if retain_next:
+            if hist_acc is None:  # full-rebuild level kept whole (the
+                # next level's gate bounds this one to a single batch)
+                full = (hist_parts[0] if len(hist_parts) == 1
+                        else jnp.concatenate(hist_parts, axis=1))
+                hist_acc = full.astype(acc_dt) if acc64 else full
+            prev = (hist_acc, is_split, lc, nc)
+        else:
+            prev = None
         pending = (bf, br, rank_flat, is_split, base, L)
         feat_levels.append(jnp.where(is_split, bf, -1))
         mask_levels.append(lm)
         leaf_levels.append(lv)
+    _record_hist_counters(n_built, n_derived, n_fallback)
 
     feature, left_mask, leaf_value = jax.device_get(
         (jnp.concatenate(feat_levels),
@@ -200,6 +247,18 @@ def _grow_leafwise_streamed(feed, work, la, lay, cfg, row_put, pad_to_mesh,
     depth_of = {0: 0}
     candidates = {}
     pending = None  # (split node id, feat, cut, rank_row_dev, li, ri)
+    # parent-reuse: candidate histograms are retained (budget-gated) so a
+    # split's sweep accumulates ONE frontier histogram per shard (the
+    # smaller child) instead of two and derives the sibling as
+    # parent − built — the shard I/O pass count per split is unchanged
+    sub_on = cfg.hist_subtraction
+    acc64 = _sub_acc64()
+    acc_dt = jnp.float64 if acc64 else jnp.float32
+    batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
+                                 cfg.n_classes)
+    plane_cost = 2 if acc64 else 1
+    stored = {}  # leaf id -> [C, 1, T] hist in acc dtype
+    n_built = n_derived = n_fallback = 0
 
     def sweep(leaf_ids):
         """One pass over the shards: apply the pending reroute, then
@@ -228,20 +287,29 @@ def _grow_leafwise_streamed(feed, work, la, lay, cfg, row_put, pad_to_mesh,
 
     def evaluate(hists):
         for lid, hist in hists.items():
-            (f, c, r, lv, sp, g, m, _nc) = scan1(
-                hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t,
+            (f, c, r, lv, sp, g, m, nc, lc) = scan1(
+                (hist.astype(jnp.float32)
+                 if hist.dtype != jnp.float32 else hist),
+                la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t,
                 la.start_t, la.size_t, la.off, la.clip, la.seg0_size,
             )
             leaf_val[lid] = float(lv[0])
             if bool(sp[0]) and depth_of[lid] < cfg.max_depth:
                 candidates[lid] = (float(g[0]), int(f[0]), int(c[0]),
-                                   r[0], np.asarray(m[0]))
+                                   r[0], np.asarray(m[0]), float(lc[0]),
+                                   float(nc[0]))
+                if sub_on and (len(stored) + 1) * plane_cost <= batch_cap:
+                    stored[lid] = (hist.astype(acc_dt)
+                                   if hist.dtype != acc_dt else hist)
 
     evaluate(sweep([0]))
+    n_built += 1
     n_leaves = 1
     while n_leaves < max_leaves and candidates:
         best_id = max(candidates, key=lambda k: candidates[k][0])
-        _gain, bf, cut, rank_row, mask_row = candidates.pop(best_id)
+        (_gain, bf, cut, rank_row, mask_row, lcnt,
+         ncnt) = candidates.pop(best_id)
+        parent_hist = stored.pop(best_id, None)
         li, ri = len(feature), len(feature) + 1
         if ri > max_nodes:
             break
@@ -258,7 +326,22 @@ def _grow_leafwise_streamed(feed, work, la, lay, cfg, row_put, pad_to_mesh,
         depth_of[li] = depth_of[ri] = depth_of[best_id] + 1
         pending = (best_id, bf, cut, rank_row, li, ri)
         n_leaves += 1
-        evaluate(sweep([li, ri]))  # also applies the reroute above
+        if parent_hist is not None:
+            # the sweep (which also applies the reroute above) builds only
+            # the smaller child; the sibling derives from the parent free
+            smaller, larger = ((li, ri) if lcnt <= ncnt - lcnt
+                               else (ri, li))
+            built = sweep([smaller])[smaller]
+            derived = parent_hist - built.astype(parent_hist.dtype)
+            evaluate({smaller: built, larger: derived})
+            n_built += 1
+            n_derived += 1
+        else:
+            evaluate(sweep([li, ri]))  # also applies the reroute above
+            n_built += 2
+            if sub_on:
+                n_fallback += 1
+    _record_hist_counters(n_built, n_derived, n_fallback)
 
     return DenseTree(
         feature=np.asarray(feature, np.int32),

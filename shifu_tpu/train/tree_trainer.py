@@ -28,6 +28,20 @@ per-feature slot layout:
     growth     level-wise (default) or LEAF-WISE under maxLeaves
                (DTMaster.java:137, toSplitQueue :260-271): best-gain leaf
                splits first, explicit child pointers.
+    reuse      histogram SUBTRACTION (train.params.treeHistSubtraction,
+               default on): each split's children partition the parent's
+               rows, so every level >= 1 builds only the SMALLER child of
+               each split as a half-width histogram and derives the
+               sibling as parent − built (LightGBM/XGBoost recurrence);
+               leaf-wise growth derives the second frontier child from the
+               retained parent for free. RF planes under unit/integer
+               sample weights are integer-valued in f32 and subtract
+               BIT-EXACTLY; float planes (GBT residuals, fractional RF
+               significance) retain the parent chain in f64 when jax x64
+               is on. Memory-gated by
+               MaxStatsMemoryMB (fallback = full rebuild, counted);
+               `tree.hist.built/derived/fallback_rebuilds` counters land
+               in run ledgers and bench snapshots.
 
 GBT parity (dt/DTWorker.java:1470-1486): tree 0 weight 1.0, later trees
 weight=learningRate; per-tree labels are -loss gradient. RF: per-tree
@@ -75,6 +89,7 @@ class TreeTrainConfig:
     early_stop_rounds: int = 0  # GBT: stop when valid error worsens N rounds
     enable_early_stop: bool = False  # DTEarlyStopDecider windowed decider
     max_stats_memory_mb: int = 256  # histogram node-batch budget
+    hist_subtraction: bool = True  # build smaller child, derive the sibling
     n_classes: int = 0  # >= 3: NATIVE RF multi-class (majority-vote leaves)
     seed: int = 0
 
@@ -108,6 +123,7 @@ class TreeTrainConfig:
             early_stop_rounds=int(g("EarlyStopRounds", 0)),
             enable_early_stop=bool(g("EnableEarlyStop", False)),
             max_stats_memory_mb=int(g("MaxStatsMemoryMB", 256)),
+            hist_subtraction=bool(g("TreeHistSubtraction", True)),
             n_classes=(len(mc.tags())
                        if (mc.is_multi_classification()
                            and not t.is_one_vs_all()) else 0),
@@ -580,19 +596,11 @@ def _get_hist_program(L: int, lay: FeatureLayout,
                    pos)
             return jax.lax.psum(h, r_axes)
 
-        specs = dict(
-            mesh=mesh,
-            in_specs=(rspec,) * 5 + (P(),) * 4,
-            out_specs=P(),
-        )
-        try:
-            from jax import shard_map
+        from shifu_tpu.parallel.mesh import shard_map_compat
 
-            prog = jax.jit(shard_map(meshed, check_vma=False, **specs))
-        except ImportError:
-            from jax.experimental.shard_map import shard_map
-
-            prog = jax.jit(shard_map(meshed, check_rep=False, **specs))
+        prog = jax.jit(shard_map_compat(
+            meshed, mesh=mesh, in_specs=(rspec,) * 5 + (P(),) * 4,
+            out_specs=P()))
     _PROGRAMS[key] = prog
     return prog
 
@@ -626,7 +634,9 @@ def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
 
         Returns (feature [L], cut_rank [L], rank_flat [L, T], leaf_value
         [L], is_split [L], best_gain [L], left_mask_model [L, s_max],
-        node_cnt [L])."""
+        node_cnt [L], left_cnt [L]) — left_cnt is the best split's left
+        weighted count, the histogram-subtraction paths' smaller-child
+        selector (garbage where is_split is False)."""
         cnt, s1, s2 = hist[0], hist[1], hist[2]
         mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, 1e-12), jnp.inf)
         sec = jnp.where(is_cat_t[None, :], mean,
@@ -696,6 +706,7 @@ def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
 
         best = jnp.argmax(gain, axis=-1)  # ordered position
         best_gain = jnp.take_along_axis(gain, best[:, None], axis=-1)[:, 0]
+        left_cnt = jnp.take_along_axis(lcnt, best[:, None], axis=-1)[:, 0]
         feature = seg_t[best].astype(jnp.int32)
         cut_rank = pos_t[best].astype(jnp.int32)
         is_split = jnp.isfinite(best_gain)
@@ -723,7 +734,7 @@ def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
             & is_split[:, None]
         )
         return (feature, cut_rank, rank_flat, leaf_value, is_split,
-                best_gain, left_mask, node_cnt)
+                best_gain, left_mask, node_cnt, left_cnt)
 
     _PROGRAMS[key] = split_scan
     return split_scan
@@ -796,6 +807,7 @@ def _make_cls_scan(L: int, T: int, s_max: int, impurity: str, min_inst: int,
 
         best = jnp.argmax(gain, axis=-1)
         best_gain = jnp.take_along_axis(gain, best[:, None], axis=-1)[:, 0]
+        left_cnt = jnp.take_along_axis(lcnt, best[:, None], axis=-1)[:, 0]
         feature = seg_t[best].astype(jnp.int32)
         cut_rank = pos_t[best].astype(jnp.int32)
         is_split = jnp.isfinite(best_gain)
@@ -821,7 +833,7 @@ def _make_cls_scan(L: int, T: int, s_max: int, impurity: str, min_inst: int,
             & is_split[:, None]
         )
         return (feature, cut_rank, rank_flat, leaf_value, is_split,
-                best_gain, left_mask, node_cnt)
+                best_gain, left_mask, node_cnt, left_cnt)
 
     return cls_scan
 
@@ -865,6 +877,143 @@ def _node_batch_size(T: int, max_stats_memory_mb: int,
     return max(1, budget // (planes * 4 * max(T, 1)))
 
 
+# ---------------------------------------------------------------------------
+# histogram subtraction (build the smaller child, derive the sibling)
+# ---------------------------------------------------------------------------
+#
+# A split's two children partition their parent's rows exactly, so
+# H[sibling] = H[parent] - H[built child] (the LightGBM/XGBoost
+# histogram-subtraction recurrence; the same reduction-reuse DrJAX frames
+# for MapReduce-style aggregations). Every level >= 1 therefore builds
+# only the SMALLER child of each split — half the node-histograms per
+# level, and for the matmul/hoisted-M lowerings a half-width [C, L/2, T]
+# contraction — and reconstructs the full level by one fused elementwise
+# derive. RF histograms under unit/integer sample weights are integer
+# sums in f32 (exact under any order, counts < 2^24), so subtraction is
+# BIT-EXACT there; GBT moment planes — and RF under a FRACTIONAL
+# significance column — carry float values, so the retained parent chain
+# accumulates in f64 when jax x64 is enabled (exactly-rounded single f32
+# subtraction otherwise) and is only downcast to f32 at the split scan.
+
+
+def _sub_acc64() -> bool:
+    """f64 accumulator chain for the retained-parent recurrence — only
+    meaningful (and only requested, to avoid the x64 truncation warning)
+    when jax x64 is on. Applies to BOTH algorithms: GBT moment planes
+    always carry float residuals, and RF planes are only integer-valued
+    (exact in f32) when the sample-weight column is unit/integer — a
+    fractional significance column makes RF inexact too. For exact
+    integer planes the f64 chain is a bit-identical no-op."""
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
+def _sub_level_fits(L: int, batch_cap: int, acc64: bool) -> bool:
+    """Memory gate for subtraction at a level of L nodes, in units of
+    [C, 1, T] f32 node planes against the MaxStatsMemoryMB budget
+    (`batch_cap`, DTMaster.java:450-467): the retained parent [C, L/2, T]
+    (doubled when the accumulator chain is f64), the built smaller-child
+    histogram [C, L/2, T] f32, and the reconstructed level [C, L, T] in
+    accumulator dtype (plus its f32 scan view when that is f64) must fit
+    together; otherwise the level falls back to a full rebuild."""
+    f = 2 if acc64 else 1
+    half = max(L // 2, 1)
+    planes = half * (f + 1) + L * f + (L if acc64 else 0)
+    return planes <= batch_cap
+
+
+def _sub_plan(cfg: "TreeTrainConfig", batch_cap: int) -> Tuple[tuple, bool]:
+    """Static per-level subtraction decisions for a level-wise tree:
+    (sub_levels[d] for d in range(max_depth + 1), acc64). Depends only on
+    cfg + the layout-derived batch_cap, so a checkpoint-resumed run picks
+    the SAME plan as the uninterrupted one (bit-equal resume contract).
+    Index D (the final leaf level) matters only to the host-driven batched
+    path; the fused program's final level aggregates node totals without a
+    per-slot histogram."""
+    acc64 = _sub_acc64()
+    levels = tuple(
+        d >= 1 and cfg.hist_subtraction
+        and _sub_level_fits(2 ** d, batch_cap, acc64)
+        for d in range(cfg.max_depth + 1)
+    )
+    return levels, acc64
+
+
+def _get_derive_program():
+    """Fused sibling derivation: (parent [C, Lh, T] acc-dtype, built
+    [C, Lh, T] f32, parent is_split [Lh], left_small [Lh]) ->
+    (hist [C, 2*Lh, T] f32 for the split scan, hist_acc for the next
+    level's retained parent). Children of NON-split parents are zeroed so
+    the reconstructed level is elementwise identical in structure to a
+    full rebuild (a derived child of a non-split parent would otherwise
+    inherit the parent's histogram)."""
+    key = ("derive",)
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def derive(parent, built, psplit, left_small):
+        C, Lh, T = parent.shape
+        b = built.astype(parent.dtype)
+        pm = jnp.where(psplit[None, :, None], parent - b,
+                       jnp.zeros_like(parent))
+        lh = jnp.where(left_small[None, :, None], b, pm)
+        rh = jnp.where(left_small[None, :, None], pm, b)
+        # children interleave 2p / 2p+1 in level order
+        acc = jnp.stack([lh, rh], axis=2).reshape(C, 2 * Lh, T)
+        return acc.astype(jnp.float32), acc
+
+    _PROGRAMS[key] = derive
+    return derive
+
+
+def _sub_row_masks(node, active, left_small):
+    """Per-row restriction to the built (smaller) children: row's node is
+    built iff its low bit matches its parent's chosen side. Returns
+    (parent-slot node ids, build-row mask) for the half-width histogram."""
+    import jax.numpy as jnp
+
+    built_lsb = jnp.where(left_small, 0, 1)
+    return node >> 1, active & ((node & 1) == built_lsb[node >> 1])
+
+
+def _plan_counts(sub_levels: tuple, enabled: bool) -> Tuple[int, int, int]:
+    """(built, derived, fallback) node-histogram counts for one fused
+    level-wise tree under a static subtraction plan — one histogram batch
+    per level, and the final leaf level aggregates node totals without a
+    per-slot histogram, so it is not counted."""
+    built = derived = fallback = 0
+    for d, sub in enumerate(sub_levels):
+        L = 2 ** d
+        if sub:
+            built += L // 2
+            derived += L // 2
+        else:
+            built += L
+            if enabled and d >= 1:
+                fallback += 1
+    return built, derived, fallback
+
+
+def _record_hist_counters(built: int, derived: int, fallback: int) -> None:
+    """Run-ledger counters for the subtraction win (`tree.hist.built` /
+    `tree.hist.derived` / `tree.hist.fallback_rebuilds`, units =
+    node-histograms resp. fallback batch rebuilds)."""
+    from shifu_tpu.obs import registry
+
+    reg = registry()
+    if built:
+        reg.counter("tree.hist.built").inc(built)
+    if derived:
+        reg.counter("tree.hist.derived").inc(derived)
+    if fallback:
+        reg.counter("tree.hist.fallback_rebuilds").inc(fallback)
+
+
 @dataclass
 class _LayoutArrays:
     """Device copies of the static layout arrays."""
@@ -904,24 +1053,25 @@ def _device_layout(lay: FeatureLayout, feat_ok: np.ndarray, replicate_fn=None):
 def _scan_batched(hists, la, lay, cfg, L_level):
     """Run split_scan over node batches and concatenate to full-level
     arrays. `hists` yields ([3, Lb, T], Lb, batch_start)."""
-    feats, cuts, ranks, leaves, splits, gains, masks, cnts = (
-        [], [], [], [], [], [], [], []
+    feats, cuts, ranks, leaves, splits, gains, masks, cnts, lcnts = (
+        [], [], [], [], [], [], [], [], []
     )
     for hist, Lb, _b0 in hists:
         scan = _get_scan_program(Lb, lay.T, lay.s_max, cfg.impurity,
                                  cfg.min_instances_per_node,
                                  cfg.min_info_gain, cfg.n_classes)
-        (f, c, r, lv, sp, g, m, nc) = scan(
+        (f, c, r, lv, sp, g, m, nc, lc) = scan(
             hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t, la.start_t,
             la.size_t, la.off, la.clip, la.seg0_size,
         )
         feats.append(f); cuts.append(c); ranks.append(r); leaves.append(lv)
         splits.append(sp); gains.append(g); masks.append(m); cnts.append(nc)
+        lcnts.append(lc)
     import jax.numpy as jnp
 
     cat = lambda xs: jnp.concatenate(xs, axis=0)  # noqa: E731
     return (cat(feats), cat(cuts), cat(ranks), cat(leaves), cat(splits),
-            cat(gains), cat(masks), cat(cnts))
+            cat(gains), cat(masks), cat(cnts), cat(lcnts))
 
 
 def _mesh_key(mesh) -> Optional[tuple]:
@@ -954,7 +1104,8 @@ def _use_pallas_hist(mesh) -> bool:
 
 def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
                       min_inst: int, min_gain: float, n_classes: int = 0,
-                      mesh=None, with_m: bool = False):
+                      mesh=None, with_m: bool = False,
+                      sub_levels: tuple = (), acc64: bool = False):
     """ONE jit program for a whole level-wise tree, levels UNROLLED at
     their exact widths: level d builds a [C, 2^d, T] histogram (≈3.5x less
     padded-node work than running every level at 2^D) and the final level
@@ -974,9 +1125,20 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
     -1/zeros), so host assembly is three contiguous transfers instead of
     ~3(D+1) per-level ones (each small transfer pays a full tunnel RTT).
     Static layout arrays are baked in as constants; only the per-tree
-    feature subset stays an argument."""
+    feature subset stays an argument.
+
+    `sub_levels` (static, from `_sub_plan`) turns on histogram subtraction
+    per level: a True at index d builds only the SMALLER child of each
+    level-(d-1) split as a half-width [C, 2^(d-1), T] histogram and derives
+    every sibling from the retained parent level in one fused elementwise
+    step — the same recurrence inside the single-dispatch scan, so the
+    one-jit-per-tree path halves its per-level histogram work too."""
+    # normalize to exactly D entries so the default () means "subtraction
+    # off" rather than an IndexError in the level loop
+    sub_levels = tuple(bool(s) for s in sub_levels[:D])
+    sub_levels += (False,) * (D - len(sub_levels))
     key = ("tree", D, lay.key, impurity, min_inst, float(min_gain),
-           n_classes, _mesh_key(mesh), with_m)
+           n_classes, _mesh_key(mesh), with_m, sub_levels, acc64)
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
@@ -1020,24 +1182,41 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
 
         r_axes = row_axes(mesh)
 
+    acc_dt = jnp.float64 if acc64 else jnp.float32
+    derive = _get_derive_program()
+
     def tree_body(codes, labels, weights, feat_ok_t, M=None):
         n = codes.shape[0]
         node = jnp.zeros(n, jnp.int32)
         active = jnp.ones(n, bool)
         resting = jnp.zeros(n, jnp.int32)
         feats_l, masks_l, leaves_l = [], [], []
+        prev = None  # retained parent level (hist_acc, is_split, lcnt, ncnt)
+
+        def call_hist(idx, node_arg, act_arg):
+            if with_m:
+                h = hist_m_fns[idx](M, labels, weights, node_arg, act_arg)
+            else:
+                h = hist_fns[idx](codes, labels, weights, node_arg, act_arg,
+                                  off_c, clip_c, seg_c, pos_c)
+            return jax.lax.psum(h, r_axes) if on_mesh else h
+
         for d in range(D):
             L = 2**d
-            if with_m:
-                hist = hist_m_fns[d](M, labels, weights, node, active)
+            if prev is not None:  # sub_levels[d]: derive from the parent
+                p_hist, p_split, p_lcnt, p_ncnt = prev
+                left_small = p_lcnt <= p_ncnt - p_lcnt
+                nhalf, build_row = _sub_row_masks(node, active, left_small)
+                built = call_hist(d - 1, nhalf, build_row)
+                hist, hist_acc = derive(p_hist, built, p_split, left_small)
             else:
-                hist = hist_fns[d](codes, labels, weights, node, active,
-                                   off_c, clip_c, seg_c, pos_c)
-            if on_mesh:
-                hist = jax.lax.psum(hist, r_axes)
-            (bf, br, rank_flat, lv, is_split, _g, lm, _nc) = scan_fns[d](
+                hist = call_hist(d, node, active)
+                hist_acc = hist.astype(acc_dt) if acc64 else hist
+            (bf, br, rank_flat, lv, is_split, _g, lm, nc, lc) = scan_fns[d](
                 hist, feat_ok_t, is_cat_c, seg_c, pos_c, start_c, size_c,
                 off_c, clip_c, seg0)
+            prev = ((hist_acc, is_split, lc, nc)
+                    if d + 1 < D and sub_levels[d + 1] else None)
             base = L - 1
             nl = jnp.clip(node, 0, L - 1)
             settled = active & ~is_split[nl]
@@ -1073,19 +1252,12 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
         from jax.sharding import PartitionSpec as P
 
         rspec = P(r_axes if len(r_axes) > 1 else r_axes[0])
-        specs = dict(
-            mesh=mesh,
+        from shifu_tpu.parallel.mesh import shard_map_compat
+
+        body = shard_map_compat(
+            tree_body, mesh=mesh,
             in_specs=(rspec, rspec, rspec, P()),
-            out_specs=(P(), P(), P(), rspec, rspec),
-        )
-        try:
-            from jax import shard_map
-
-            body = shard_map(tree_body, check_vma=False, **specs)
-        except ImportError:  # older jax spells the replication check flag
-            from jax.experimental.shard_map import shard_map
-
-            body = shard_map(tree_body, check_rep=False, **specs)
+            out_specs=(P(), P(), P(), rspec, rspec))
         prog = jax.jit(body)
     else:
         prog = jax.jit(tree_body)
@@ -1135,6 +1307,8 @@ def build_tree(
 
         replicate_fn = lambda a: replicate(a, mesh)  # noqa: E731
 
+    sub_levels, acc64 = _sub_plan(cfg, batch_cap)
+
     # fused single-dispatch path: whole tree in ONE jit call when the
     # full-width [3, 2^D, T] histogram fits the stats-memory budget —
     # collapses ~3 dispatches/level into 1/tree (tunnel latency dominates
@@ -1144,7 +1318,8 @@ def build_tree(
         prog = _get_tree_program(D, lay, cfg.impurity,
                                  cfg.min_instances_per_node,
                                  cfg.min_info_gain,
-                                 n_classes=cfg.n_classes, mesh=mesh)
+                                 n_classes=cfg.n_classes, mesh=mesh,
+                                 sub_levels=sub_levels, acc64=acc64)
         fot = jnp.asarray(np.asarray(feat_ok, bool)[lay.seg_of_t])
         if replicate_fn is not None:
             fot = replicate_fn(fot)
@@ -1152,6 +1327,8 @@ def build_tree(
             codes, labels, weights, fot)
         import jax
 
+        _record_hist_counters(
+            *_plan_counts(sub_levels[:D], cfg.hist_subtraction))
         feats_h, masks_h, leaves_h = jax.device_get(
             (feats_d, masks_d, leaves_d))
         return _assemble_dense_tree(feats_h, masks_h, leaves_h, D), resting
@@ -1169,25 +1346,72 @@ def build_tree(
         active = jnp.ones(n, dtype=bool)
         resting = jnp.zeros(n, dtype=jnp.int32)
 
+    derive = _get_derive_program()
+    acc_dt = jnp.float64 if acc64 else jnp.float32
+    sub_on = cfg.hist_subtraction
+    n_built = n_derived = n_fallback = 0
     feat_levels, mask_levels, leaf_levels = [], [], []
-    for depth in range(D):
+    prev = None  # retained parent level (hist_acc, is_split, lcnt, ncnt)
+    for depth in range(D + 1):
         L = 2**depth
-        base = 2**depth - 1
+        base = L - 1
+        final = depth == D
+        # retention for the NEXT level's derivation implies that level
+        # passed the gate, so THIS level is at most cap/4 nodes: one batch
+        retain_next = (not final) and sub_on and sub_levels[depth + 1]
+        if prev is not None:  # sub_levels[depth]: half-width build + derive
+            Lh = L // 2
+            p_hist, p_split, p_lcnt, p_ncnt = prev
+            left_small = p_lcnt <= p_ncnt - p_lcnt
+            nhalf, build_row = _sub_row_masks(node_local, active, left_small)
+            hist_p = _get_hist_program(Lh, lay, allow_matmul=mesh is None,
+                                       n_classes=cfg.n_classes)
+            built = hist_p(codes, labels, weights, nhalf, build_row,
+                           la.off, la.clip, la.seg_t, la.pos_t)
+            hist_f32, hist_acc = derive(p_hist, built, p_split, left_small)
+            parts = [(hist_f32, L, 0)]
+            n_built += Lh
+            n_derived += Lh
+        elif retain_next:  # full rebuild, kept whole for the next level
+            hist_p = _get_hist_program(L, lay, allow_matmul=mesh is None,
+                                       n_classes=cfg.n_classes)
+            full = hist_p(codes, labels, weights, node_local, active,
+                          la.off, la.clip, la.seg_t, la.pos_t)
+            hist_acc = full.astype(acc_dt) if acc64 else full
+            parts = [(full, L, 0)]
+            n_built += L
+            if sub_on and depth >= 1:
+                n_fallback += 1
+        else:  # budget-batched full rebuild (lazy: scan drops each batch)
+            hist_acc = None
 
-        def hist_batches():
-            for b0 in range(0, L, batch_cap):
-                Lb = min(batch_cap, L - b0)
-                hist_p = _get_hist_program(Lb, lay,
-                                           allow_matmul=mesh is None,
-                                           n_classes=cfg.n_classes)
-                in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
-                yield hist_p(codes, labels, weights, node_local - b0,
-                             in_batch, la.off, la.clip, la.seg_t,
-                             la.pos_t), Lb, b0
+            def hist_batches(L=L, node_local=node_local, active=active):
+                for b0 in range(0, L, batch_cap):
+                    Lb = min(batch_cap, L - b0)
+                    hist_p = _get_hist_program(Lb, lay,
+                                               allow_matmul=mesh is None,
+                                               n_classes=cfg.n_classes)
+                    in_batch = (active & (node_local >= b0)
+                                & (node_local < b0 + Lb))
+                    yield hist_p(codes, labels, weights, node_local - b0,
+                                 in_batch, la.off, la.clip, la.seg_t,
+                                 la.pos_t), Lb, b0
 
-        (bf, br, rank_flat, lv, is_split, _gain, lm, _nc) = _scan_batched(
-            hist_batches(), la, lay, cfg, L
+            parts = hist_batches()
+            n_built += L
+            if sub_on and depth >= 1:
+                n_fallback += -(-L // batch_cap)
+
+        (bf, br, rank_flat, lv, is_split, _gain, lm, nc, lc) = _scan_batched(
+            parts, la, lay, cfg, L
         )
+        if final:  # leaf values for the deepest children + settle leftovers
+            leaf_levels.append(lv)
+            feat_levels.append(jnp.full(L, -1, jnp.int32))
+            mask_levels.append(jnp.zeros((L, lay.s_max), bool))
+            resting = jnp.where(active, base + node_local, resting)
+            break
+        prev = (hist_acc, is_split, lc, nc) if retain_next else None
         upd = _get_update_program(L, lay.T)
         resting, node_local, active = upd(
             codes, node_local, active, resting, bf, br, rank_flat, is_split,
@@ -1196,28 +1420,7 @@ def build_tree(
         feat_levels.append(jnp.where(is_split, bf, -1))
         mask_levels.append(lm)
         leaf_levels.append(lv)
-
-    # final level: leaf values for the deepest children + settle leftovers
-    L2 = 2**D
-    base2 = L2 - 1
-
-    def hist_batches_final():
-        for b0 in range(0, L2, batch_cap):
-            Lb = min(batch_cap, L2 - b0)
-            hist_p = _get_hist_program(Lb, lay,
-                                       allow_matmul=mesh is None,
-                                       n_classes=cfg.n_classes)
-            in_batch = active & (node_local >= b0) & (node_local < b0 + Lb)
-            yield hist_p(codes, labels, weights, node_local - b0, in_batch,
-                         la.off, la.clip, la.seg_t, la.pos_t), Lb, b0
-
-    (_f2, _c2, _r2, lv2, _s2, _g2, _m2, _nc2) = _scan_batched(
-        hist_batches_final(), la, lay, cfg, L2
-    )
-    leaf_levels.append(lv2)
-    feat_levels.append(jnp.full(L2, -1, jnp.int32))
-    mask_levels.append(jnp.zeros((L2, lay.s_max), bool))
-    resting = jnp.where(active, base2 + node_local, resting)
+    _record_hist_counters(n_built, n_derived, n_fallback)
 
     # ONE host sync for the whole tree
     import jax
@@ -1275,28 +1478,50 @@ def build_tree_leafwise(
     scan1 = _get_scan_program(1, lay.T, lay.s_max, cfg.impurity,
                               cfg.min_instances_per_node, cfg.min_info_gain,
                               cfg.n_classes)
+    # parent-reuse: each candidate's histogram is retained (budget-gated by
+    # the MaxStatsMemoryMB node-plane cap, f64 planes counting double) so a
+    # split builds ONE child and derives the sibling as parent − built —
+    # one frontier histogram per split instead of two
+    sub_on = cfg.hist_subtraction
+    acc64 = _sub_acc64()
+    acc_dt = jnp.float64 if acc64 else jnp.float32
+    batch_cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb,
+                                 cfg.n_classes)
+    plane_cost = 2 if acc64 else 1
+    stored: Dict[int, object] = {}  # leaf id -> [C, 1, T] hist, acc dtype
+    n_built = n_derived = n_fallback = 0
 
-    def evaluate(leaf_ids: List[int]):
-        """Candidate split for each listed leaf (a 1-slot program per leaf
-        keeps shapes static; at most 2 leaves per iteration)."""
-        for lid in leaf_ids:
-            act = node_id == lid
-            hist = hist1(codes, labels, weights, jnp.zeros(n, jnp.int32),
-                         act, la.off, la.clip, la.seg_t, la.pos_t)
-            (f, c, r, lv, sp, g, m, _nc) = scan1(
-                hist, la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t,
-                la.start_t, la.size_t, la.off, la.clip, la.seg0_size,
-            )
-            leaf_val[lid] = float(lv[0])
-            if bool(sp[0]) and depth_of[lid] < cfg.max_depth:
-                candidates[lid] = (float(g[0]), int(f[0]), int(c[0]),
-                                   r[0], np.asarray(m[0]))
+    def build_hist(lid: int):
+        act = node_id == lid
+        return hist1(codes, labels, weights, jnp.zeros(n, jnp.int32), act,
+                     la.off, la.clip, la.seg_t, la.pos_t)
 
-    evaluate([0])
+    def evaluate(lid: int, hist):
+        """Candidate split for one leaf from its (built or derived)
+        histogram; `hist` may arrive in the f64 accumulator dtype and is
+        downcast only for the scan."""
+        (f, c, r, lv, sp, g, m, nc, lc) = scan1(
+            hist.astype(jnp.float32) if hist.dtype != jnp.float32 else hist,
+            la.feat_ok_t, la.is_cat_t, la.seg_t, la.pos_t,
+            la.start_t, la.size_t, la.off, la.clip, la.seg0_size,
+        )
+        leaf_val[lid] = float(lv[0])
+        if bool(sp[0]) and depth_of[lid] < cfg.max_depth:
+            candidates[lid] = (float(g[0]), int(f[0]), int(c[0]),
+                               r[0], np.asarray(m[0]), float(lc[0]),
+                               float(nc[0]))
+            if sub_on and (len(stored) + 1) * plane_cost <= batch_cap:
+                stored[lid] = (hist.astype(acc_dt)
+                               if hist.dtype != acc_dt else hist)
+
+    evaluate(0, build_hist(0))
+    n_built += 1
     n_leaves = 1
     while n_leaves < max_leaves and candidates:
         best_id = max(candidates, key=lambda k: candidates[k][0])
-        _gain, bf, cut, rank_row, mask_row = candidates.pop(best_id)
+        (_gain, bf, cut, rank_row, mask_row, lcnt,
+         ncnt) = candidates.pop(best_id)
+        parent_hist = stored.pop(best_id, None)
         li, ri = len(feature), len(feature) + 1
         if ri > max_nodes:
             break
@@ -1318,7 +1543,23 @@ def build_tree_leafwise(
         goes_left = rank_row[cf] <= cut
         node_id = jnp.where(sel, jnp.where(goes_left, li, ri), node_id)
         n_leaves += 1
-        evaluate([li, ri])
+        if parent_hist is not None:
+            # build the smaller child, derive the sibling from the parent
+            smaller, larger = ((li, ri) if lcnt <= ncnt - lcnt
+                               else (ri, li))
+            built = build_hist(smaller)
+            derived = parent_hist - built.astype(parent_hist.dtype)
+            evaluate(smaller, built)
+            evaluate(larger, derived)
+            n_built += 1
+            n_derived += 1
+        else:
+            evaluate(li, build_hist(li))
+            evaluate(ri, build_hist(ri))
+            n_built += 2
+            if sub_on:
+                n_fallback += 1
+    _record_hist_counters(n_built, n_derived, n_fallback)
 
     tree = DenseTree(
         feature=np.asarray(feature, np.int32),
@@ -1494,7 +1735,15 @@ def _score_existing(trees: List[DenseTree], codes) -> "object":
     if not trees:
         return jnp.zeros(codes.shape[0], dtype=jnp.float32)
     per_tree = traverse_trees(trees, codes)
-    return jnp.sum(per_tree, axis=1)
+    # sequential left-to-right fold, NOT jnp.sum: the uninterrupted run
+    # accumulates `pred += weight_k * tree_pred` one tree at a time, and
+    # jnp.sum's pairwise reduction associates f32 differently — a resumed
+    # GBT run would see ~1e-7-shifted residual labels and drift off the
+    # bit-equal contract (tests/test_tree_parity.py::test_resume_is_bit_equal)
+    score = jnp.zeros(codes.shape[0], dtype=jnp.float32)
+    for t in range(per_tree.shape[1]):
+        score = score + per_tree[:, t]
+    return score
 
 
 def _assemble_deferred(trees: List, deferred: List[tuple],
@@ -1709,10 +1958,14 @@ def train_trees(
                  # so a checkpoint-resumed run picks the SAME lowering as
                  # the uninterrupted one (bit-equal resume contract)
                  and cfg.tree_num * cfg.max_depth >= 2)
+        sub_levels, acc64 = _sub_plan(cfg, batch_cap)
+        sub_counts = _plan_counts(sub_levels[:cfg.max_depth],
+                                  cfg.hist_subtraction)
         tree_prog = _get_tree_program(
             cfg.max_depth, lay, cfg.impurity,
             cfg.min_instances_per_node, cfg.min_info_gain,
             n_classes=cfg.n_classes, mesh=mesh, with_m=use_m,
+            sub_levels=sub_levels, acc64=acc64,
         )
         if use_m:
             M_forest = _get_m_builder(lay)(codes_j)
@@ -1805,6 +2058,7 @@ def train_trees(
             else:
                 feats_d, masks_d, leaves_d, _resting, tree_pred = tree_prog(
                     codes_j, labels_k, w_k, fot)
+            _record_hist_counters(*sub_counts)
             deferred.append(
                 (k, 1.0 if (is_gbt and k == 0) else (lr if is_gbt else 1.0),
                  feats_d, masks_d, leaves_d))

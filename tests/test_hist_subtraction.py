@@ -1,0 +1,219 @@
+"""Histogram subtraction (build the smaller child, derive the sibling).
+
+Contracts, per the train.params.treeHistSubtraction knob (default on):
+  * RF histograms are integer sums in f32 (integer Poisson bag weights x
+    0/1 labels), so derived = parent - built is EXACT and RF forests are
+    BIT-EQUAL subtraction-on vs -off — binary and NATIVE multi-class,
+    in-memory and streamed.
+  * GBT moment planes carry float residuals: subtraction re-associates
+    f32 summation, so GBT forests are TOLERANCE-equal (scores; a
+    knife-edge zero-gain deep node may legitimately flip split/no-split,
+    which the f64 accumulator chain removes when jax x64 is enabled).
+  * A level-wise tree of depth D derives 2^(D-1) - 1 = leaves/2 - 1
+    node-histograms (`tree.hist.derived`), builds 2^(D-1)
+    (`tree.hist.built`) — vs 2^D - 1 built with subtraction off.
+  * When the retained parent + child batch exceed the MaxStatsMemoryMB
+    node-plane budget, the level falls back to a full rebuild and counts
+    `tree.hist.fallback_rebuilds`; results must not change.
+"""
+
+import numpy as np
+import pytest
+
+from shifu_tpu import obs
+from shifu_tpu.train.tree_trainer import (
+    TreeTrainConfig,
+    _node_batch_size,
+    _sub_level_fits,
+    make_layout,
+    train_trees,
+)
+
+
+def _make_data(n=2500, f=5, bins=16, seed=0):
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, bins, size=(n, f)).astype(np.int32)
+    y = ((codes[:, 0] + codes[:, 1] + rng.integers(0, 8, n))
+         > bins + 2).astype(np.float32)
+    w = np.ones(n, np.float32)
+    return codes, y, w, [bins] * f
+
+
+def _cfg_off(cfg):
+    return TreeTrainConfig(**{**cfg.__dict__, "hist_subtraction": False})
+
+
+def _assert_forests_bit_equal(a, b):
+    assert len(a.spec.trees) == len(b.spec.trees)
+    for ta, tb in zip(a.spec.trees, b.spec.trees):
+        np.testing.assert_array_equal(ta.feature, tb.feature)
+        np.testing.assert_array_equal(ta.left_mask, tb.left_mask)
+        np.testing.assert_allclose(ta.leaf_value, tb.leaf_value, atol=0)
+
+
+def _hist_counters():
+    snap = obs.registry().snapshot().get("counters", {})
+    return {k.split(".")[-1]: v for k, v in snap.items()
+            if k.startswith("tree.hist.")}
+
+
+def test_rf_binary_bit_parity():
+    """Integer count/moment planes subtract exactly: identical forests."""
+    codes, y, w, slots = _make_data()
+    cols = [f"c{i}" for i in range(len(slots))]
+    cfg = TreeTrainConfig(algorithm="RF", tree_num=4, max_depth=4, seed=3,
+                          feature_subset_strategy="TWOTHIRDS")
+    on = train_trees(codes, y, w, slots, [False] * len(slots), cols, cfg)
+    off = train_trees(codes, y, w, slots, [False] * len(slots), cols,
+                      _cfg_off(cfg))
+    _assert_forests_bit_equal(on, off)
+
+
+def test_rf_multiclass_bit_parity():
+    """NATIVE multi-class count planes are pure counts: exact too."""
+    codes, y, w, slots = _make_data()
+    y3 = (codes[:, 0] // 6).astype(np.float32)
+    cols = [f"c{i}" for i in range(len(slots))]
+    cfg = TreeTrainConfig(algorithm="RF", tree_num=3, max_depth=3, seed=2,
+                          impurity="gini", n_classes=3)
+    on = train_trees(codes, y3, w, slots, [False] * len(slots), cols, cfg)
+    off = train_trees(codes, y3, w, slots, [False] * len(slots), cols,
+                      _cfg_off(cfg))
+    _assert_forests_bit_equal(on, off)
+
+
+def test_gbt_tolerance_parity():
+    """GBT derived moments re-associate f32: scores equal to tolerance."""
+    codes, y, w, slots = _make_data()
+    cols = [f"c{i}" for i in range(len(slots))]
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=5, max_depth=4,
+                          learning_rate=0.2, seed=3)
+    on = train_trees(codes, y, w, slots, [False] * len(slots), cols, cfg)
+    off = train_trees(codes, y, w, slots, [False] * len(slots), cols,
+                      _cfg_off(cfg))
+    s_on = on.spec.independent().compute(codes)
+    s_off = off.spec.independent().compute(codes)
+    np.testing.assert_allclose(s_on, s_off, atol=1e-3)
+    assert on.valid_error == pytest.approx(off.valid_error, abs=1e-4)
+
+
+def test_gbt_leafwise_tolerance_parity():
+    """Leaf-wise growth derives the second frontier child from the
+    retained parent histogram; scores must match the rebuild-both run."""
+    codes, y, w, slots = _make_data()
+    cols = [f"c{i}" for i in range(len(slots))]
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=3, max_depth=6,
+                          max_leaves=7, learning_rate=0.3, seed=5)
+    on = train_trees(codes, y, w, slots, [False] * len(slots), cols, cfg)
+    off = train_trees(codes, y, w, slots, [False] * len(slots), cols,
+                      _cfg_off(cfg))
+    s_on = on.spec.independent().compute(codes)
+    s_off = off.spec.independent().compute(codes)
+    np.testing.assert_allclose(s_on, s_off, atol=1e-3)
+
+
+def test_counters_levelwise():
+    """Per level-wise tree of depth D: derived = 2^(D-1) - 1 = leaves/2 - 1
+    histograms, built = 2^(D-1); subtraction-off builds all 2^D - 1."""
+    codes, y, w, slots = _make_data(n=1200)
+    cols = [f"c{i}" for i in range(len(slots))]
+    trees, depth = 3, 4
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=trees, max_depth=depth,
+                          seed=1)
+    obs.reset()
+    train_trees(codes, y, w, slots, [False] * len(slots), cols, cfg)
+    c_on = _hist_counters()
+    leaves = 2 ** depth
+    assert c_on["derived"] == trees * (leaves // 2 - 1)
+    assert c_on["built"] == trees * (leaves // 2)
+    assert "fallback_rebuilds" not in c_on
+
+    obs.reset()
+    train_trees(codes, y, w, slots, [False] * len(slots), cols,
+                _cfg_off(cfg))
+    c_off = _hist_counters()
+    assert c_off["built"] == trees * (leaves - 1)
+    assert "derived" not in c_off
+    # the acceptance ratio: subtraction builds ~half the node-histograms
+    assert c_on["built"] / c_off["built"] <= 0.55
+
+
+def test_counters_leafwise():
+    """Each leaf-wise split sweeps ONE child histogram and derives the
+    sibling: built = 1 root + n_splits, derived = n_splits."""
+    codes, y, w, slots = _make_data(n=1200)
+    cols = [f"c{i}" for i in range(len(slots))]
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=1, max_depth=6,
+                          max_leaves=6, seed=2)
+    obs.reset()
+    res = train_trees(codes, y, w, slots, [False] * len(slots), cols, cfg)
+    c = _hist_counters()
+    n_splits = int((res.spec.trees[0].feature >= 0).sum())
+    assert n_splits >= 1
+    assert c["derived"] == n_splits
+    assert c["built"] == 1 + n_splits
+
+
+def test_budget_pressure_fallback():
+    """A wide layout under a tiny MaxStatsMemoryMB forces the batched path
+    and the full-rebuild fallback; results must be unchanged and the
+    fallback counted."""
+    rng = np.random.default_rng(0)
+    n = 1500
+    slots = [4000, 16, 16]
+    codes = np.stack([rng.integers(0, 4000, n), rng.integers(0, 16, n),
+                      rng.integers(0, 16, n)], 1).astype(np.int32)
+    y = ((codes[:, 1] + codes[:, 2] + rng.integers(0, 8, n))
+         > 18).astype(np.float32)
+    w = np.ones(n, np.float32)
+    cols = [f"c{i}" for i in range(3)]
+    depth = 5
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=2, max_depth=depth,
+                          seed=1, max_stats_memory_mb=1,
+                          min_instances_per_node=2)
+    lay = make_layout(slots, [False] * 3)
+    cap = _node_batch_size(lay.T, cfg.max_stats_memory_mb)
+    assert 2 ** depth > cap  # pins the host-driven batched path
+    # the plan must be mixed: shallow levels subtract, deep levels fall back
+    fits = [_sub_level_fits(2 ** d, cap, False) for d in range(1, depth + 1)]
+    assert any(fits) and not all(fits)
+
+    obs.reset()
+    on = train_trees(codes, y, w, slots, [False] * 3, cols, cfg)
+    c = _hist_counters()
+    assert c["fallback_rebuilds"] >= 1
+    assert c["derived"] >= 1
+    off = train_trees(codes, y, w, slots, [False] * 3, cols, _cfg_off(cfg))
+    s_on = on.spec.independent().compute(codes)
+    s_off = off.spec.independent().compute(codes)
+    np.testing.assert_allclose(s_on, s_off, atol=1e-3)
+
+
+def test_streamed_levelwise_counters_and_rf_bit_parity(tmp_path):
+    """The streamed level-wise grower derives every level >= 1 including
+    the final leaf level; RF stays bit-equal across the knob."""
+    from shifu_tpu.norm.dataset import write_codes
+    from shifu_tpu.train.streaming_tree import train_trees_streamed
+
+    rng = np.random.default_rng(0)
+    n, f, bins = 2000, 5, 8
+    codes = rng.integers(0, bins, size=(n, f)).astype(np.int32)
+    y = ((codes[:, 0] + codes[:, 1] + rng.integers(0, 4, n))
+         > 9).astype(np.float32)
+    w = np.ones(n, np.float32)
+    cols = [f"c{i}" for i in range(f)]
+    out = str(tmp_path / "codes")
+    write_codes(out, codes, y, w, cols, [bins] * f, n_shards=3)
+
+    trees, depth = 2, 3
+    cfg = TreeTrainConfig(algorithm="RF", tree_num=trees, max_depth=depth,
+                          seed=3)
+    obs.reset()
+    on = train_trees_streamed(out, [bins] * f, [False] * f, cols, cfg)
+    c = _hist_counters()
+    # levels 1..D derive (incl. the final leaf level): 2^D - 1 per tree
+    assert c["derived"] == trees * (2 ** depth - 1)
+    assert c["built"] == trees * (2 ** depth)
+    off = train_trees_streamed(out, [bins] * f, [False] * f, cols,
+                               _cfg_off(cfg))
+    _assert_forests_bit_equal(on, off)

@@ -106,15 +106,22 @@ def test_leafwise_model_roundtrip(tmp_path):
     assert ((s1 > 0.5) == (y > 0.5)).mean() > 0.8
 
 
+@pytest.mark.parametrize("sub", [True, False], ids=["sub-on", "sub-off"])
 @pytest.mark.parametrize("alg", ["GBT", "RF"])
-def test_resume_is_bit_equal(alg):
+def test_resume_is_bit_equal(alg, sub):
     """Kill at tree 5 of 12, resume from the checkpointed forest — the
     resumed run must reproduce the uninterrupted forest BIT-EQUAL
-    (per-tree RNG streams keyed by (seed, tree index))."""
+    (per-tree RNG streams keyed by (seed, tree index); the GBT running
+    prediction re-derives via the same sequential f32 fold the live run
+    used, `_score_existing`). Holds under either histogram-subtraction
+    lowering — SAME lowering both sides; a checkpoint written under a
+    DIFFERENT lowering may legitimately diverge in float-summation order,
+    which the processor's checkpoint fingerprint guards against."""
     codes, y, w, slots = _make_data(n=1000, seed=4)
     cfg = TreeTrainConfig(algorithm=alg, tree_num=12, max_depth=3,
                           learning_rate=0.2, seed=7,
-                          feature_subset_strategy="TWOTHIRDS")
+                          feature_subset_strategy="TWOTHIRDS",
+                          hist_subtraction=sub)
     cols = [f"c{i}" for i in range(4)]
     full = train_trees(codes, y, w, slots, [False] * 4, cols, cfg)
 
