@@ -1,0 +1,10 @@
+"""`python -m shifu_tpu ...` — same entry as the `shifu` CLI (cli.py),
+so environments without the console script (CI lint jobs, bare
+checkouts) can still run e.g. `python -m shifu_tpu check shifu_tpu/`."""
+
+import sys
+
+from shifu_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""shifu_tpu.analysis — program-level checks for a jit-heavy pipeline.
+
+Two halves, one contract ("the pipeline stays honest without per-PR
+hand-audits", ISSUE 4 / DrJAX's no-host-round-trips discipline):
+
+  * static: an AST lint engine (`engine.py`) with JAX-aware rules
+    (`rules/jaxrules.py`: host syncs under trace, static-arg hazards,
+    jit-in-loop recompiles, f64 drift, side effects under jit) and
+    pipeline-hygiene rules (`rules/hygiene.py`). Exposed as
+    ``shifu check [--json] [--rules ...] [paths]`` and gated in CI.
+  * runtime: an opt-in sanitizer harness (`sanitize.py`),
+    ``-Dshifu.sanitize=transfer,nan,recompile`` — transfer guards around
+    declared traced stages, debug_nans on trainer steps, a recompile
+    watchdog on the obs/jaxprobe compile counters. Verdicts land in the
+    run-ledger manifests (obs/ledger.py) and bench scenario JSON.
+
+The static engine imports only the stdlib, so the CI lint job (and
+``python -m shifu_tpu check``) runs without jax installed.
+"""
+
+from shifu_tpu.analysis.engine import (  # noqa: F401 - public API
+    Finding,
+    analyze,
+    report_human,
+    report_json,
+    run_check,
+)

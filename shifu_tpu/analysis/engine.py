@@ -1,0 +1,541 @@
+"""`shifu check` — AST lint engine with a JAX-aware view of the package.
+
+The reference kept a ~99k-LoC pipeline honest with JVM-era program
+checkers (FindBugs et al.); the JAX rebuild's failure classes are
+different — host↔device syncs inside traced code, recompile storms,
+dtype drift — and no off-the-shelf linter sees them. This engine is the
+project-owned replacement: plain-stdlib AST analysis (no jax import, so
+the CI lint job runs it without an accelerator stack) over a whole
+package at once, so rules can reason about *reachability from jit sites*
+rather than single files.
+
+Pieces:
+  * ``Module``      — one parsed file: source, AST, parent links.
+  * ``PackageContext`` — the cross-file view: every function def, a
+    lightweight call graph seeded at trace roots (``@jax.jit`` /
+    ``jax.jit(f)`` / ``shard_map`` / ``lax.scan`` bodies, ...), and the
+    resulting *traced set*: defs whose bodies execute under a tracer.
+  * ``Rule``        — id + default severity + ``check(module, ctx)``;
+    rules self-register via ``@register`` (rules/jaxrules.py,
+    rules/hygiene.py).
+  * reporters       — human one-line-per-finding, and a JSON document
+    (``shifu.check/1``) for the CI gate and tooling.
+
+Suppression: a finding is suppressed by ``# shifu: noqa[RULE1,RULE2]``
+(or a blanket ``# shifu: noqa``) on the flagged line. Policy (see
+docs/ANALYSIS.md): every noqa carries a one-line justification.
+Exit code: 1 iff any unsuppressed error-severity finding remains.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+SCHEMA = "shifu.check/1"
+
+_NOQA_RE = re.compile(
+    r"#\s*shifu:\s*noqa(?:\s*\[(?P<rules>[A-Za-z0-9_,\s]+)\])?")
+
+# wrappers whose function argument is traced (decorator or call form)
+TRACE_WRAPPERS = {
+    "jit", "pjit", "pmap", "vmap", "grad", "value_and_grad",
+    "shard_map", "shard_map_compat", "checkify",
+}
+# jax.lax control flow: these call their function operands under trace
+TRACE_LAX = {"scan", "while_loop", "fori_loop", "cond", "switch", "map",
+             "associative_scan", "custom_root", "custom_linear_solve"}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str  # "error" | "warning"
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# parsed file + package context
+# ---------------------------------------------------------------------------
+
+
+class Module:
+    """One parsed source file with parent links and line access."""
+
+    def __init__(self, path: str, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing def (the scope whose trace status governs
+        `node`), or None at module level."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def segment(self, node: ast.AST) -> str:
+        try:
+            return ast.get_source_segment(self.source, node) or ""
+        except Exception:  # malformed positions on synthesized nodes
+            return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target / attribute chain
+    ("jax.lax.scan", "jnp.float64", "partial"); "" when not name-like."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_trace_wrapper(expr: ast.AST) -> bool:
+    """Does this expression evaluate to a tracing transform? Matches bare
+    names/attributes (jax.jit, shard_map) and partial(jax.jit, ...)."""
+    name = dotted_name(expr)
+    if name and name.split(".")[-1] in TRACE_WRAPPERS:
+        return True
+    if isinstance(expr, ast.Call):
+        fn = dotted_name(expr.func)
+        if fn.split(".")[-1] in TRACE_WRAPPERS:
+            return True
+        if fn.split(".")[-1] == "partial" and expr.args:
+            return _is_trace_wrapper(expr.args[0])
+    return False
+
+
+def _wrapped_function_names(call: ast.Call) -> List[str]:
+    """For a call to a tracing transform, the simple names of the function
+    operands it traces (jax.jit(f), lax.while_loop(cond, body, ...))."""
+    fn = dotted_name(call.func)
+    tail = fn.split(".")[-1]
+    out: List[str] = []
+    if tail in TRACE_WRAPPERS:
+        for arg in call.args[:1]:  # the transformed function
+            out.extend(_name_operands(arg))
+    elif tail in TRACE_LAX:
+        # every positional that looks like a function reference: lax
+        # control flow takes (cond, body) / (pred, true_fn, false_fn) /
+        # (f, init, xs) shapes — names beyond the first few are operands,
+        # but resolving a data operand to a def is harmless (it IS that
+        # function being traced if the name matches a def)
+        for arg in call.args:
+            out.extend(_name_operands(arg))
+    elif tail == "partial" and call.args and _is_trace_wrapper(call.args[0]):
+        for arg in call.args[1:2]:
+            out.extend(_name_operands(arg))
+    return out
+
+
+def _name_operands(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Call):  # jax.jit(vmap(f)) / partial(f, ...)
+        inner = dotted_name(node.func)
+        out = []
+        if inner.split(".")[-1] in TRACE_WRAPPERS | {"partial"}:
+            for a in node.args:
+                out.extend(_name_operands(a))
+        return out
+    return []
+
+
+def decorator_traces(dec: ast.AST) -> bool:
+    return _is_trace_wrapper(dec)
+
+
+def local_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound inside this function body: params, assignment/loop/
+    with/walrus targets, nested defs, imports. Used both for call-graph
+    resolution (a locally-bound name shadows any same-named def) and by
+    JX005 (mutating a local is not a side-effect hazard)."""
+    out: Set[str] = set()
+    a = fn.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        out.add(p.arg)
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets
+                       if isinstance(node, ast.Assign) else [node.target])
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            for n in ast.walk(node.optional_vars):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        elif isinstance(node, ast.NamedExpr):
+            out.add(node.target.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and node is not fn:
+            out.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+    return out
+
+
+class PackageContext:
+    """Cross-file view: defs, classes, trace roots, reachability.
+
+    The call graph is deliberately lightweight (the issue's "lightweight
+    intra-package call graph"): a traced function's *name references* are
+    resolved module-locally first, then package-wide when the name is
+    unique; `self.method()` resolves within the enclosing class. That is
+    enough to follow the codebase's idiom (closures named after the defs
+    they capture) without a full type analysis.
+    """
+
+    def __init__(self, modules: Sequence[Module]) -> None:
+        self.modules = list(modules)
+        # def name -> nodes, per module and package-wide
+        self._defs_by_module: Dict[Module, Dict[str, List[ast.AST]]] = {}
+        self._defs_global: Dict[str, List[ast.AST]] = {}
+        self._module_of: Dict[ast.AST, Module] = {}
+        self._class_methods: Dict[Module, Dict[str, List[ast.AST]]] = {}
+        for m in self.modules:
+            local: Dict[str, List[ast.AST]] = {}
+            classes: Dict[str, List[ast.AST]] = {}
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    local.setdefault(node.name, []).append(node)
+                    self._defs_global.setdefault(node.name, []).append(node)
+                    self._module_of[node] = m
+                elif isinstance(node, ast.ClassDef):
+                    classes[node.name] = [
+                        c for c in node.body
+                        if isinstance(c, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))]
+            self._defs_by_module[m] = local
+            self._class_methods[m] = classes
+        self.traced: Set[ast.AST] = set()
+        self.traced_via: Dict[ast.AST, str] = {}
+        self._mark_traced()
+
+    # -- trace roots + propagation --
+    def _mark_traced(self) -> None:
+        work: List[ast.AST] = []
+
+        def add(node: ast.AST, via: str) -> None:
+            if node not in self.traced:
+                self.traced.add(node)
+                self.traced_via[node] = via
+                work.append(node)
+
+        for m in self.modules:
+            local = self._defs_by_module[m]
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if decorator_traces(dec):
+                            add(node, f"@{dotted_name(dec) or 'jit'}")
+                elif isinstance(node, ast.Call) and (
+                        _is_trace_wrapper(node.func)
+                        or dotted_name(node.func).split(".")[-1]
+                        in TRACE_LAX):
+                    for name in _wrapped_function_names(node):
+                        for target in local.get(name, []):
+                            add(target,
+                                f"passed to {dotted_name(node.func)}")
+
+        while work:
+            fn = work.pop()
+            m = self._module_of.get(fn)
+            if m is None:
+                continue
+            via = f"called from traced `{getattr(fn, 'name', '?')}`"
+            for target in self._referenced_defs(m, fn):
+                add(target, via)
+
+    def _referenced_defs(self, m: Module, fn: ast.AST) -> List[ast.AST]:
+        """Defs this function's body references by name. A name bound
+        LOCALLY in `fn` shadows same-named defs (a `key = fold_in(...)`
+        variable must not mark an unrelated `def key`). Module-local defs
+        resolve on any load (closures are named after the defs they
+        capture); package-wide resolution is reserved for *called* names
+        with a unique match — bare variable names like `depth`/`active`
+        collide across files far too often."""
+        local = self._defs_by_module[m]
+        bound = local_bindings(fn)
+        out: List[ast.AST] = []
+        own_class = None
+        for anc in m.ancestors(fn):
+            if isinstance(anc, ast.ClassDef):
+                own_class = anc.name
+                break
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in bound:
+                    continue
+                hits = local.get(node.id)
+                if not hits:
+                    parent = m.parent.get(node)
+                    called = (isinstance(parent, ast.Call)
+                              and parent.func is node)
+                    g = self._defs_global.get(node.id, [])
+                    hits = g if called and len(g) == 1 else []
+                out.extend(h for h in hits if h is not fn)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id == "self" and own_class):
+                for meth in self._class_methods[m].get(own_class, []):
+                    if meth.name == node.func.attr and meth is not fn:
+                        out.append(meth)
+        return out
+
+    # -- public queries --
+    def node_traced(self, m: Module, node: ast.AST) -> bool:
+        """True when `node` executes under a jax tracer: its nearest
+        enclosing def is in the traced set."""
+        fn = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) else m.enclosing_function(node)
+        return fn is not None and fn in self.traced
+
+    def trace_reason(self, m: Module, node: ast.AST) -> str:
+        fn = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ) else m.enclosing_function(node)
+        if fn is None:
+            return ""
+        name = getattr(fn, "name", "?")
+        return f"`{name}` is traced ({self.traced_via.get(fn, '?')})"
+
+    def reference_closure(self, m: Module, fn: ast.AST) -> Set[str]:
+        """All simple names transitively referenced from `fn` through
+        module-local defs and classes (SH103's plumbing check)."""
+        seen_defs: Set[ast.AST] = set()
+        names: Set[str] = set()
+        classes = self._class_methods[m]
+        work = [fn]
+        while work:
+            cur = work.pop()
+            if cur in seen_defs:
+                continue
+            seen_defs.add(cur)
+            for node in ast.walk(cur):
+                if isinstance(node, ast.Name) and isinstance(
+                        node.ctx, ast.Load):
+                    names.add(node.id)
+                    for target in self._defs_by_module[m].get(node.id, []):
+                        work.append(target)
+                    for meth in classes.get(node.id, []):
+                        work.append(meth)
+                elif isinstance(node, ast.Attribute):
+                    names.add(node.attr)
+        return names
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    id: str = ""
+    severity: str = "error"
+    summary: str = ""
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, module: Module, node: ast.AST, message: str,
+                severity: Optional[str] = None) -> Finding:
+        return Finding(
+            rule=self.id,
+            severity=severity or self.severity,
+            path=module.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+        )
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls):
+    rule = cls()
+    assert rule.id and rule.id not in _REGISTRY, rule.id
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    # import for side effect: rule modules self-register
+    from shifu_tpu.analysis.rules import hygiene, jaxrules  # noqa: F401
+
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# running + reporting
+# ---------------------------------------------------------------------------
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterator[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            yield path
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith("."))
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+        else:
+            raise FileNotFoundError(path)
+
+
+def _suppressed(module: Module, finding: Finding) -> bool:
+    m = _NOQA_RE.search(module.line_text(finding.line))
+    if not m:
+        return False
+    rules = m.group("rules")
+    if rules is None:
+        return True
+    return finding.rule in {r.strip() for r in rules.split(",")}
+
+
+def analyze(paths: Sequence[str],
+            rule_ids: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the (selected) rules over every .py under `paths`. Findings
+    come back sorted, with noqa'd ones marked suppressed (not dropped —
+    reporters show suppression counts so a silent noqa sweep is visible
+    in review)."""
+    rules = all_rules()
+    if rule_ids is not None:
+        wanted = [r.strip() for r in rule_ids if r.strip()]
+        unknown = [r for r in wanted if r not in rules]
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(rules))})")
+        rules = {rid: rules[rid] for rid in wanted}
+
+    modules: List[Module] = []
+    findings: List[Finding] = []
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                modules.append(Module(path, fh.read()))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(Finding(
+                rule="PARSE", severity="error", path=path,
+                line=getattr(e, "lineno", None) or 1, col=1,
+                message=f"cannot analyze: {type(e).__name__}: {e}"))
+
+    ctx = PackageContext(modules)
+    for rule in rules.values():
+        for module in modules:
+            findings.extend(rule.check(module, ctx))
+    for f in findings:
+        for module in modules:
+            if module.path == f.path:
+                f.suppressed = _suppressed(module, f)
+                break
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def counts(findings: Sequence[Finding]) -> Dict[str, int]:
+    out = {"error": 0, "warning": 0, "suppressed": 0}
+    for f in findings:
+        if f.suppressed:
+            out["suppressed"] += 1
+        else:
+            out[f.severity] = out.get(f.severity, 0) + 1
+    return out
+
+
+def report_human(findings: Sequence[Finding]) -> str:
+    lines = []
+    for f in findings:
+        if f.suppressed:
+            continue
+        lines.append(f"{f.path}:{f.line}:{f.col}: "
+                     f"{f.rule} {f.severity}: {f.message}")
+    c = counts(findings)
+    lines.append(
+        f"shifu check: {c['error']} error(s), {c['warning']} warning(s), "
+        f"{c['suppressed']} suppressed")
+    return "\n".join(lines)
+
+
+def report_json(findings: Sequence[Finding],
+                rule_ids: Optional[Iterable[str]] = None) -> str:
+    rules = all_rules()
+    doc = {
+        "schema": SCHEMA,
+        "counts": counts(findings),
+        "findings": [f.as_dict() for f in findings],
+        "rules": {
+            rid: {"severity": r.severity, "summary": r.summary}
+            for rid, r in sorted(rules.items())
+            if rule_ids is None or rid in set(rule_ids)
+        },
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def run_check(paths: Sequence[str], rule_ids: Optional[List[str]] = None,
+              as_json: bool = False, emit=print) -> int:
+    """CLI entry: analyze, report, exit 1 on unsuppressed errors."""
+    if rule_ids is not None:  # normalize ONCE so the JSON rules catalog
+        # and the analyze() selection agree on e.g. "JX001, SH101"
+        rule_ids = [r.strip() for r in rule_ids if r.strip()]
+    findings = analyze(paths, rule_ids)
+    if as_json:
+        emit(report_json(findings, rule_ids))
+    else:
+        emit(report_human(findings))
+    return 1 if counts(findings)["error"] else 0

@@ -1,0 +1,2 @@
+"""Rule packs for `shifu check`. Importing a pack registers its rules
+(engine.all_rules triggers this); new packs just need an import there."""

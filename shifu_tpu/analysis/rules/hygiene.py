@@ -1,0 +1,175 @@
+"""SH rules: pipeline hygiene. Smaller-bore than the JX pack but the
+same motivation — the failure modes that creep into a long-lived
+pipeline (swallowed exceptions, shared mutable defaults, streaming entry
+points that silently ignore the chunk/prefetch plumbing).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from shifu_tpu.analysis.engine import (
+    Module,
+    PackageContext,
+    Rule,
+    dotted_name,
+    register,
+)
+from shifu_tpu.analysis.rules.jaxrules import _mutable_default
+
+_BLANKET = {"Exception", "BaseException"}
+
+# tool pragmas are not justifications: strip them and require that some
+# actual prose remains on the line
+_PRAGMA_RE = re.compile(
+    r"noqa(?::\s*[A-Za-z]+\d+(?:\s*,\s*[A-Za-z]+\d+)*)?"
+    r"|type:\s*ignore\S*|pragma:?\s*no\s*cover",
+    re.IGNORECASE)
+
+
+def _justified(line: str) -> bool:
+    """True when the line carries a human justification comment — a '#'
+    comment with prose beyond recognized tool pragmas (so a bare
+    `# type: ignore` or `# noqa: E722` does not silence SH101, but
+    `# pragma: no cover - jax absent in CI` does)."""
+    if "#" not in line:
+        return False
+    comment = line.split("#", 1)[1]
+    remainder = _PRAGMA_RE.sub("", comment)
+    return bool(re.search(r"[A-Za-z]{3,}", remainder))
+
+
+@register
+class BlanketExcept(Rule):
+    """SH101 — bare/blanket except.
+
+    bad:  except: pass                      # error: swallows everything
+    bad:  except Exception: return None     # warning unless justified
+    good: except ValueError: ...            # or a blanket except with a
+          re-raise, or a same-line justification comment / noqa.
+    """
+
+    id = "SH101"
+    severity = "error"
+    summary = ("bare `except:` (error) / blanket `except Exception` "
+               "without re-raise or justification comment (warning)")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield self.finding(
+                    module, node,
+                    "bare `except:` catches SystemExit/KeyboardInterrupt "
+                    "too — name the exception (or BaseException + raise)")
+                continue
+            names = {dotted_name(t).split(".")[-1]
+                     for t in (node.type.elts
+                               if isinstance(node.type, ast.Tuple)
+                               else [node.type])}
+            if not names & _BLANKET:
+                continue
+            swallows = all(isinstance(s, ast.Pass) for s in node.body)
+            reraises = any(isinstance(n, ast.Raise)
+                           for n in ast.walk(node))
+            justified = _justified(module.line_text(node.lineno))
+            if swallows:
+                yield self.finding(
+                    module, node,
+                    "blanket except with a bare `pass` silently swallows "
+                    "every failure — narrow it or justify with a comment")
+            elif not reraises and not justified:
+                yield self.finding(
+                    module, node,
+                    "blanket `except " + "/".join(sorted(names & _BLANKET))
+                    + "` without re-raise — narrow it, or add a same-line "
+                    "justification comment", severity="warning")
+
+
+@register
+class MutableDefaultArg(Rule):
+    """SH102 — mutable default argument.
+
+    bad:  def f(x, acc=[]): acc.append(x)   # shared across calls
+    good: def f(x, acc=None): acc = [] if acc is None else acc
+    """
+
+    id = "SH102"
+    severity = "error"
+    summary = "mutable default argument (list/dict/set shared across calls)"
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            a = node.args
+            pos = a.posonlyargs + a.args
+            pairs = list(zip(reversed(pos), reversed(a.defaults)))
+            pairs += [(p, d) for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                      if d is not None]
+            for param, default in pairs:
+                if _mutable_default(default):
+                    name = getattr(node, "name", "<lambda>")
+                    yield self.finding(
+                        module, default,
+                        f"mutable default for `{param.arg}` of `{name}` "
+                        f"is shared across calls — default to None and "
+                        f"construct inside")
+
+
+_STREAM_ENTRY_RE = re.compile(r"(_streamed|_streaming)$|^stream_")
+_PLUMBING_PARAM_RE = re.compile(r"chunk|prefetch|feed|source|factory")
+
+
+@register
+class StreamingPlumbing(Rule):
+    """SH103 — streaming entry point without chunk/prefetch plumbing.
+
+    Every streamed path must honor shifu.ingest.prefetchChunks and the
+    chunk sizing knobs — an entry point that hand-rolls its own loop
+    silently loses the overlapped-pipeline behavior (and its tests).
+
+    bad:  def train_foo_streamed(dir, cfg):
+              for shard in read_all(dir): ...   # no prefetch, no knobs
+    good: drive shifu_tpu.data.pipeline.prefetch_iter (directly or via a
+          feed/chunk_factory parameter), or accept chunk_rows/prefetch.
+    """
+
+    id = "SH103"
+    severity = "warning"
+    summary = ("streaming entry point neither drives prefetch_iter nor "
+               "accepts chunk/prefetch plumbing")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        for node in module.tree.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not _STREAM_ENTRY_RE.search(node.name):
+                continue
+            params = [p.arg for p in (node.args.posonlyargs + node.args.args
+                                      + node.args.kwonlyargs)]
+            if any(_PLUMBING_PARAM_RE.search(p) for p in params):
+                continue
+            closure = ctx.reference_closure(module, node)
+            if {"prefetch_iter", "chunk_source", "stream_columnar"} \
+                    & closure:
+                continue
+            # delegating to another streaming entry point (processor
+            # wrappers around train/*_streamed) inherits its plumbing
+            if any(_STREAM_ENTRY_RE.search(n)
+                   for n in closure - {node.name}):
+                continue
+            yield self.finding(
+                module, node,
+                f"streaming entry point `{node.name}` neither drives "
+                f"prefetch_iter/chunk_source nor accepts chunk/prefetch "
+                f"plumbing (chunk_rows=, prefetch=, feed=, *_factory=) — "
+                f"the overlapped-pipeline knobs will be silently ignored")

@@ -1,0 +1,414 @@
+"""JX rules: the JAX failure classes that wrecked PR-1/PR-3 perf work
+until hand-audited (silent host↔device syncs, recompile storms, dtype
+drift, trace-time side effects). Each rule documents the bad/good shape;
+docs/ANALYSIS.md carries the full catalog with examples.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from shifu_tpu.analysis.engine import (
+    Finding,
+    Module,
+    PackageContext,
+    Rule,
+    dotted_name,
+    local_bindings,
+    register,
+    _is_trace_wrapper,
+)
+
+# Attribute calls that force a blocking device->host sync on a tracer /
+# device value. (.item()/.tolist() materialize; block_until_ready inside
+# a traced region is a tracer error outright.)
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+# numpy conversions: np.asarray(tracer) is the classic silent d2h
+_NP_CONVERSIONS = {"asarray", "array", "ascontiguousarray"}
+_NP_NAMES = {"np", "numpy", "onp"}
+
+
+def _is_literal(node: ast.AST) -> bool:
+    """Constant-ish expressions that never hold a tracer."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return all(_is_literal(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _is_literal(node.operand)
+    return False
+
+
+def _is_shape_access(node: ast.AST) -> bool:
+    """len(...) / x.shape[...] / x.ndim / x.size are Python ints under
+    trace — casting those is legal and idiomatic."""
+    if isinstance(node, ast.Call) and dotted_name(node.func) == "len":
+        return True
+    cur = node
+    while isinstance(cur, (ast.Subscript, ast.BinOp)):
+        cur = cur.value if isinstance(cur, ast.Subscript) else cur.left
+    if isinstance(cur, ast.Attribute) and cur.attr in ("shape", "ndim",
+                                                       "size", "dtype"):
+        return True
+    return False
+
+
+@register
+class HostSyncUnderTrace(Rule):
+    """JX001 — host↔device sync inside jit-traced code.
+
+    bad:  @jax.jit
+          def f(x): return float(x.sum())     # materializes the tracer
+    good: keep the value on device; cast AFTER the jit boundary, in one
+          batched jax.device_get (see nn_trainer's single scalar pull).
+    """
+
+    id = "JX001"
+    severity = "error"
+    summary = ("host sync (.item()/float()/np.asarray/...) in code "
+               "reachable from a jax.jit/shard_map site")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not ctx.node_traced(module, node):
+                continue
+            why = ctx.trace_reason(module, node)
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                base = dotted_name(fn)
+                root = base.split(".")[0]
+                if fn.attr in _SYNC_ATTRS and root not in _NP_NAMES:
+                    yield self.finding(
+                        module, node,
+                        f"`.{fn.attr}()` forces a device->host sync "
+                        f"under trace — {why}")
+                elif (root in _NP_NAMES and fn.attr in _NP_CONVERSIONS
+                        and node.args
+                        and not _is_literal(node.args[0])):
+                    yield self.finding(
+                        module, node,
+                        f"`{base}(...)` on a traced value is a silent "
+                        f"device->host transfer — use jnp, or move the "
+                        f"conversion outside the jit boundary; {why}")
+                elif base == "jax.device_get":
+                    # (device_put under trace is a legal sharding hint,
+                    # so only the d2h direction is flagged)
+                    yield self.finding(
+                        module, node,
+                        f"`{base}` inside traced code forces a "
+                        f"host round-trip — {why}")
+            elif isinstance(fn, ast.Name) and fn.id in ("float", "bool"):
+                # int() is deliberately exempt: int(shape/size/stride
+                # arithmetic) on host closures is idiomatic under trace
+                # and drowns the signal
+                if (len(node.args) == 1 and not _is_literal(node.args[0])
+                        and not _is_shape_access(node.args[0])):
+                    yield self.finding(
+                        module, node,
+                        f"`{fn.id}(...)` on a traced value materializes "
+                        f"the tracer (ConcretizationTypeError at best, a "
+                        f"silent sync at worst) — {why}")
+
+
+def _static_names_from_jit(call_or_dec: ast.AST,
+                           params: List[str]) -> Set[str]:
+    """Declared static parameter names from a jit call/decorator:
+    static_argnames strings + static_argnums indices mapped to params."""
+    out: Set[str] = set()
+    if not isinstance(call_or_dec, ast.Call):
+        return out
+    for kw in call_or_dec.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    if 0 <= n.value < len(params):
+                        out.add(params[n.value])
+    return out
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+
+
+def _mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) in ("list", "dict", "set")
+    return False
+
+
+@register
+class StaticArgHazard(Rule):
+    """JX002 — unhashable or omitted static args on a jit boundary.
+
+    bad:  @partial(jax.jit, static_argnames=("cols",))
+          def f(x, cols=[]): ...            # unhashable static default
+    bad:  @jax.jit
+          def f(x, training):
+              if training: ...              # tracer bool -> trace error;
+                                            # should be static_argnames
+    good: hashable statics (tuples), and every Python-control-flow
+          parameter declared static.
+    """
+
+    id = "JX002"
+    severity = "error"
+    summary = ("unhashable static-arg default, or Python control flow on "
+               "a non-static parameter of a jit function")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator[Finding]:
+        defs = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, node)
+
+        for node in ast.walk(module.tree):
+            # decorator form
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_trace_wrapper(dec):
+                        # a Call decorator (partial(jax.jit, ...) /
+                        # jax.jit(...)) carries the static kwargs itself
+                        yield from self._check_pair(module, ctx, node, dec)
+            # call form: jax.jit(f, static_argnames=...)
+            elif isinstance(node, ast.Call) and _is_trace_wrapper(node.func):
+                if node.args and isinstance(node.args[0], ast.Name):
+                    target = defs.get(node.args[0].id)
+                    if target is not None:
+                        yield from self._check_pair(module, ctx, target,
+                                                    node)
+
+    def _check_pair(self, module: Module, ctx: PackageContext,
+                    fn: ast.AST, jit_node: ast.AST) -> Iterator[Finding]:
+        params = _param_names(fn)
+        statics = _static_names_from_jit(jit_node, params)
+        # (a) unhashable defaults on declared statics (defaults align to
+        # the tail of posonlyargs+args, same pairing as SH102)
+        a = fn.args
+        pos = a.posonlyargs + a.args
+        for param, default in list(
+                zip(reversed(pos), reversed(a.defaults))) + [
+                (p, d) for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                if d is not None]:
+            if param.arg in statics and _mutable_default(default):
+                yield self.finding(
+                    module, default,
+                    f"static arg `{param.arg}` of jit function "
+                    f"`{fn.name}` has an unhashable "
+                    f"{type(default).__name__.lower()} default — jit "
+                    f"will raise at call time; use a tuple")
+        # (b) Python control flow on non-static params (tracer bool)
+        only_jit = (dotted_name(
+            jit_node.func if isinstance(jit_node, ast.Call) else jit_node)
+            .split(".")[-1] in ("jit", "pjit")
+            or (isinstance(jit_node, ast.Call) and jit_node.args
+                and _is_trace_wrapper(jit_node.args[0])))
+        if not only_jit:
+            return  # vmap/grad operands may receive concrete values
+        nonstatic = set(params) - statics - {"self"}
+        own_defs = {n for n in ast.walk(fn)
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and n is not fn}
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            if any(node in ast.walk(d) for d in own_defs):
+                continue  # nested def: different parameter space
+            hits = sorted({
+                n.id for n in ast.walk(node.test)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load) and n.id in nonstatic})
+            if hits:
+                yield self.finding(
+                    module, node,
+                    f"`{'if' if isinstance(node, ast.If) else 'while'}` on "
+                    f"traced parameter(s) {', '.join(hits)} of jit "
+                    f"function `{fn.name}` — declare static via "
+                    f"static_argnames or use jnp.where/lax.cond")
+
+
+@register
+class JitInLoop(Rule):
+    """JX003 — jit program constructed inside a loop body.
+
+    bad:  for d in range(depth):
+              prog = jax.jit(make_level(d))  # recompiles every level
+    good: hoist construction out of the loop, or cache per static key
+          (the `_PROGRAMS` dict idiom in train/tree_trainer.py).
+    """
+
+    id = "JX003"
+    severity = "error"
+    summary = ("jax.jit/partial(jax.jit) constructed inside a for/while "
+               "body — per-iteration recompile hazard")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator[Finding]:
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if node is loop:
+                    continue
+                if (isinstance(node, ast.Call)
+                        and self._constructs_jit(node)):
+                    yield self.finding(
+                        module, node,
+                        f"`{dotted_name(node.func) or 'jit'}(...)` inside "
+                        f"a {'for' if isinstance(loop, ast.For) else 'while'}"
+                        f" body builds a fresh program every iteration — "
+                        f"hoist it or cache by static signature")
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if self._constructs_jit(dec) or (
+                                dotted_name(dec).split(".")[-1]
+                                in ("jit", "pjit", "pmap")):
+                            yield self.finding(
+                                module, node,
+                                f"jit-decorated `{node.name}` defined "
+                                f"inside a loop body — a fresh program "
+                                f"per iteration; hoist or cache it")
+
+    @staticmethod
+    def _constructs_jit(node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        tail = dotted_name(node.func).split(".")[-1]
+        if tail in ("jit", "pjit", "pmap"):
+            return True
+        if tail == "partial" and node.args:
+            return dotted_name(node.args[0]).split(".")[-1] in (
+                "jit", "pjit", "pmap")
+        return False
+
+
+_X64_GUARD_HINT = "64"  # acc64 / x64 / use_f64 / jax_enable_x64 all match
+
+
+@register
+class Float64Drift(Rule):
+    """JX004 — jnp.float64 not guarded by the x64 check.
+
+    Without jax_enable_x64, jnp.float64 silently truncates to f32 (with
+    a warning at best) — accumulator code that *believes* it is in f64
+    drifts. The codebase idiom is a *64-named guard:
+
+    bad:  acc = jnp.zeros(n, jnp.float64)
+    good: acc_dt = jnp.float64 if acc64 else jnp.float32   # acc64 from
+          bool(jax.config.jax_enable_x64)
+    """
+
+    id = "JX004"
+    severity = "error"
+    summary = ("jnp.float64 used without an x64-enablement guard — "
+               "silent f32 truncation when jax_enable_x64 is off")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            hit = None
+            if (isinstance(node, ast.Attribute) and node.attr == "float64"
+                    and dotted_name(node.value).split(".")[0]
+                    in ("jnp", "jax")):
+                hit = dotted_name(node)
+            elif (isinstance(node, ast.Constant)
+                  and node.value == "float64"):
+                call = module.parent.get(node)
+                while call is not None and not isinstance(call, ast.Call):
+                    call = module.parent.get(call)
+                if call is not None and dotted_name(
+                        getattr(call, "func", None)
+                        or ast.Name(id="")).split(".")[0] in ("jnp",):
+                    hit = '"float64"'
+            if hit is None:
+                continue
+            if self._guarded(module, node):
+                continue
+            yield self.finding(
+                module, node,
+                f"`{hit}` without an x64 guard — gate it on "
+                f"jax.config.jax_enable_x64 (a *64-named guard "
+                f"variable), or accumulate on the host in np.float64")
+
+    @staticmethod
+    def _guarded(module: Module, node: ast.AST) -> bool:
+        for anc in module.ancestors(node):
+            test = None
+            if isinstance(anc, ast.IfExp):
+                test = anc.test
+            elif isinstance(anc, ast.If):
+                test = anc.test
+            if test is not None and _X64_GUARD_HINT in (
+                    module.segment(test) or ast.dump(test)):
+                return True
+        return False
+
+
+_MUTATORS = {"append", "extend", "insert", "update", "setdefault", "add",
+             "remove", "clear", "write", "pop"}
+
+
+@register
+class SideEffectUnderJit(Rule):
+    """JX005 — Python side effects inside traced code.
+
+    Side effects run ONCE at trace time, then never again — the classic
+    "my print/accumulator only fired on the first step" bug.
+
+    bad:  @jax.jit
+          def step(x):
+              print("step", x)        # fires once, at trace
+              history.append(x)       # mutates the closure at trace only
+    good: jax.debug.print("step {}", x); return the value instead.
+    """
+
+    id = "JX005"
+    severity = "error"
+    summary = ("print / closure mutation / global statement under jit — "
+               "runs once at trace time, not per step")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator[Finding]:
+        locals_cache = {}
+        for node in ast.walk(module.tree):
+            if not ctx.node_traced(module, node):
+                continue
+            why = ctx.trace_reason(module, node)
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Name) and fn.id == "print":
+                    yield self.finding(
+                        module, node,
+                        f"`print` under trace fires once at trace time — "
+                        f"use jax.debug.print; {why}")
+                elif (isinstance(fn, ast.Attribute)
+                      and fn.attr in _MUTATORS
+                      and isinstance(fn.value, ast.Name)):
+                    owner = module.enclosing_function(node)
+                    if owner not in locals_cache:
+                        locals_cache[owner] = local_bindings(owner)
+                    if fn.value.id not in locals_cache[owner]:
+                        yield self.finding(
+                            module, node,
+                            f"`{fn.value.id}.{fn.attr}(...)` mutates a "
+                            f"captured object under trace — the mutation "
+                            f"happens once at trace time, not per call; "
+                            f"{why}")
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                yield self.finding(
+                    module, node,
+                    f"`{'global' if isinstance(node, ast.Global) else 'nonlocal'}`"
+                    f" under trace is a trace-time side effect — {why}")

@@ -1,0 +1,243 @@
+"""Runtime sanitizer harness: ``-Dshifu.sanitize=transfer,nan,recompile``.
+
+The static pass (engine.py) catches what the AST can see; this harness
+catches what only the runtime can — the ASan/TSan analog for a jit
+pipeline. Three opt-in modes, combined freely:
+
+  transfer   arms ``jax.transfer_guard("disallow")`` around *declared
+             traced stages* (the ``transfer_free(...)`` seams in
+             nn_trainer / streaming / data.pipeline). Explicit
+             ``jax.device_put``/``device_get`` stay legal; any IMPLICIT
+             host↔device transfer inside a seam raises, the trip is
+             recorded, and the step fails like a sanitizer trap. The
+             guard is scoped to seams, not whole steps, because host→
+             device staging (chunk feeds, scalar operand creation) is
+             legitimate *between* traced stages.
+  nan        arms ``jax.debug_nans`` for the step (the checkify-style
+             trap): the first NaN/Inf produced under jit raises
+             FloatingPointError at the producing primitive.
+  recompile  a watchdog on the obs/jaxprobe compile counters: each armed
+             stage gets a compile budget (``shifu.sanitize.recompileBudget``,
+             default 64); a breach is recorded and logged as a ledger
+             warning — recompile storms are a perf bug, not a
+             correctness trap, so the step still completes.
+
+Verdicts: ``Sanitizer.verdict()`` returns a ``shifu.sanitize/1`` dict —
+BasicProcessor.run() embeds it in the run-ledger manifest (success AND
+failure), bench.py embeds it per scenario. Trip/breach counts also land
+in the metrics registry (``sanitizer.*``), so `shifu runs` output and
+Prometheus exports see them too.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterable, List, Optional
+
+from shifu_tpu.utils import environment
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+SCHEMA = "shifu.sanitize/1"
+MODES = ("transfer", "nan", "recompile")
+DEFAULT_RECOMPILE_BUDGET = 64
+
+_lock = threading.Lock()
+_current: Optional["Sanitizer"] = None
+
+
+def modes_from_environment() -> List[str]:
+    """Parse -Dshifu.sanitize=transfer,nan,recompile (also accepts
+    'all'); unknown mode names raise so a typo cannot silently disarm
+    the run."""
+    raw = (environment.get_property("shifu.sanitize", "") or "").strip()
+    if not raw:
+        return []
+    if raw.lower() == "all":
+        return list(MODES)
+    modes = [m.strip().lower() for m in raw.split(",") if m.strip()]
+    unknown = [m for m in modes if m not in MODES]
+    if unknown:
+        raise ValueError(
+            f"shifu.sanitize: unknown mode(s) {', '.join(unknown)} "
+            f"(known: {', '.join(MODES)})")
+    return modes
+
+
+def recompile_budget() -> int:
+    return environment.get_int("shifu.sanitize.recompileBudget",
+                               DEFAULT_RECOMPILE_BUDGET)
+
+
+def _is_transfer_error(e: BaseException) -> bool:
+    return "transfer" in str(e).lower() and "isallowed" in str(e)
+
+
+class Sanitizer:
+    """One armed sanitizer scope (a lifecycle step or a bench scenario)."""
+
+    def __init__(self, modes: Iterable[str],
+                 budget: Optional[int] = None) -> None:
+        self.modes = frozenset(modes)
+        unknown = self.modes - set(MODES)
+        if unknown:
+            raise ValueError(f"unknown sanitizer mode(s): {sorted(unknown)}")
+        self.budget = recompile_budget() if budget is None else budget
+        self.transfer_trips = 0
+        self.nan_trips = 0
+        self.recompile_breaches = 0
+        self.stages_armed = 0
+        self.events: List[dict] = []
+
+    @property
+    def active(self) -> bool:
+        return bool(self.modes)
+
+    # ---- recording (also mirrored into the metrics registry so ledger
+    # tables/Prometheus see sanitizer activity without parsing verdicts)
+    def _record(self, kind: str, stage: str, detail: str) -> None:
+        self.events.append({"kind": kind, "stage": stage,
+                            "detail": detail})
+        from shifu_tpu.obs import registry
+
+        registry().counter(f"sanitizer.{kind}").inc()
+
+    def record_transfer_trip(self, stage: str, detail: str) -> None:
+        self.transfer_trips += 1
+        self._record("transfer.trips", stage, detail)
+        log.warning("sanitizer[transfer] trip in %s: %s", stage,
+                    detail[:200])
+
+    def record_nan_trip(self, stage: str, detail: str) -> None:
+        self.nan_trips += 1
+        self._record("nan.trips", stage, detail)
+        log.warning("sanitizer[nan] trap in %s: %s", stage, detail[:200])
+
+    def record_recompile_breach(self, stage: str, compiles: float) -> None:
+        self.recompile_breaches += 1
+        self._record("recompile.breaches", stage,
+                     f"{compiles:.0f} compiles > budget {self.budget}")
+        log.warning(
+            "sanitizer[recompile] budget breach in %s: %.0f compiles > "
+            "budget %d (shifu.sanitize.recompileBudget)", stage, compiles,
+            self.budget)
+
+    # ---- arming
+    @contextlib.contextmanager
+    def armed(self, stage: str):
+        """Arm the step-scoped modes around `stage`: debug_nans for the
+        whole region, the recompile watchdog over its compile-counter
+        delta. Transfer guarding happens at the finer transfer_free()
+        seams inside. Exceptions propagate (sanitizer-trap semantics) —
+        trips are recorded first, and the caller's ledger write still
+        sees the verdict because it runs in its own finally."""
+        if not self.active:
+            yield
+            return
+        self.stages_armed += 1
+        compiles0 = self._compile_count()
+        nan_cm = contextlib.nullcontext()
+        if "nan" in self.modes:
+            import jax
+
+            nan_cm = jax.debug_nans(True)
+        try:
+            with nan_cm:
+                yield
+        except FloatingPointError as e:
+            if "nan" in self.modes:
+                self.record_nan_trip(stage, f"{type(e).__name__}: {e}")
+            raise
+        finally:
+            if "recompile" in self.modes:
+                delta = self._compile_count() - compiles0
+                if delta > self.budget:
+                    self.record_recompile_breach(stage, delta)
+
+    @contextlib.contextmanager
+    def transfer_free(self, stage: str):
+        """Declare a region transfer-free. Under the `transfer` mode any
+        implicit host↔device transfer inside raises (explicit
+        device_put/device_get remain legal); the trip is recorded and
+        the error propagates."""
+        if "transfer" not in self.modes:
+            yield
+            return
+        import jax
+
+        try:
+            with jax.transfer_guard("disallow"):
+                yield
+        except Exception as e:
+            if _is_transfer_error(e):
+                self.record_transfer_trip(stage, str(e))
+            raise
+
+    # ---- verdict
+    def verdict(self) -> dict:
+        return {
+            "schema": SCHEMA,
+            "modes": sorted(self.modes),
+            "stagesArmed": self.stages_armed,
+            "transfer": {
+                "armed": "transfer" in self.modes,
+                "trips": self.transfer_trips,
+            },
+            "nan": {
+                "armed": "nan" in self.modes,
+                "trips": self.nan_trips,
+            },
+            "recompile": {
+                "armed": "recompile" in self.modes,
+                "budgetPerStage": self.budget,
+                "breaches": self.recompile_breaches,
+            },
+            "events": self.events,
+            "clean": not (self.transfer_trips or self.nan_trips
+                          or self.recompile_breaches),
+        }
+
+    @staticmethod
+    def _compile_count() -> float:
+        from shifu_tpu import obs
+
+        obs.install_jax_probes()
+        return obs.registry().counter("jax.compiles").value
+
+
+def from_environment() -> Sanitizer:
+    return Sanitizer(modes_from_environment())
+
+
+def current() -> Optional[Sanitizer]:
+    return _current
+
+
+@contextlib.contextmanager
+def activate(san: Sanitizer):
+    """Make `san` the process-current sanitizer so library seams
+    (transfer_free below) find it without plumbing. Nested activation
+    restores the previous one on exit."""
+    global _current
+    with _lock:
+        prev, _current = _current, san
+    try:
+        yield san
+    finally:
+        with _lock:
+            _current = prev
+
+
+@contextlib.contextmanager
+def transfer_free(stage: str):
+    """Library-side seam: no-op unless a sanitizer with the `transfer`
+    mode is active. Cheap enough for per-dispatch call sites (one global
+    read when disarmed)."""
+    san = _current
+    if san is None or "transfer" not in san.modes:
+        yield
+        return
+    with san.transfer_free(stage):
+        yield

@@ -9,6 +9,7 @@ convert/analysis, plus -Dk=v property overrides hoisted into the environment
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import List, Optional
 
@@ -113,6 +114,20 @@ def build_parser() -> argparse.ArgumentParser:
     p_switch = sub.add_parser("switch", help="switch model-set version")
     p_switch.add_argument("version")
     sub.add_parser("show", help="show model-set versions")
+
+    p_check = sub.add_parser(
+        "check", help="JAX-aware static analysis (lint) over source paths")
+    p_check.add_argument("paths", nargs="*",
+                         help="files/dirs to analyze (default: the "
+                              "installed shifu_tpu package)")
+    p_check.add_argument("--json", action="store_true", dest="as_json",
+                         help="emit the shifu.check/1 JSON document")
+    p_check.add_argument("--rules", default=None,
+                         help="comma-separated rule ids to run "
+                              "(default: all)")
+    p_check.add_argument("--list-rules", action="store_true",
+                         dest="list_rules",
+                         help="print the rule catalog and exit")
 
     p_runs = sub.add_parser(
         "runs", help="list run-ledger manifests (.shifu/runs)")
@@ -220,6 +235,21 @@ def dispatch(args: argparse.Namespace) -> int:
         from shifu_tpu.processor.analysis import AnalysisProcessor
 
         return AnalysisProcessor().run()
+    if cmd == "check":
+        from shifu_tpu.analysis.engine import all_rules, run_check
+
+        if args.list_rules:
+            for rid, rule in sorted(all_rules().items()):
+                print(f"{rid:<7} {rule.severity:<8} {rule.summary}")
+            return 0
+        paths = args.paths or [os.path.dirname(os.path.abspath(__file__))]
+        rule_ids = (args.rules.split(",") if args.rules else None)
+        try:
+            return run_check(paths, rule_ids=rule_ids,
+                             as_json=args.as_json)
+        except (FileNotFoundError, ValueError) as e:
+            log.error("check: %s", e)
+            return 2
     if cmd == "runs":
         import json
 

@@ -172,7 +172,7 @@ def _stats_from_column_configs(column_configs, cutoff: float
         try:
             wm, ws = woe_mean_std(cc, weighted=False)
             wwm, wws = woe_mean_std(cc, weighted=True)
-        except Exception:
+        except Exception:  # stats absent/degenerate: export zero WOE moments
             wm = ws = wwm = wws = 0.0
         out.append(
             egb.RefNNColumnStats(

@@ -428,7 +428,7 @@ def wdl_spec_to_ref(spec, column_configs, cutoff: float = 4.0) -> RefWDLModel:
         try:
             wm, ws = woe_mean_std(cc, weighted=False)
             wwm, wws = woe_mean_std(cc, weighted=True)
-        except Exception:
+        except Exception:  # stats absent/degenerate: export zero WOE moments
             wm = ws = wwm = wws = 0.0
         stats.append(RefNNColumnStats(
             column_num=cc.column_num,

@@ -253,7 +253,14 @@ class DeviceAccumulator:
         if self._acc is None:
             self._acc = agg
         else:
-            self._acc = _combine_program()(self._acc, agg)
+            # sanitizer seam: both operands are already device-resident
+            # (agg is a jit output), so the fold dispatch must not move
+            # bytes; the only sanctioned transfer is _flush's explicit
+            # device_get (-Dshifu.sanitize=transfer)
+            from shifu_tpu.analysis import sanitize
+
+            with sanitize.transfer_free("pipeline.device_fold"):
+                self._acc = _combine_program()(self._acc, agg)
         self._rows += rows
 
     def fetch(self) -> Optional[List[np.ndarray]]:

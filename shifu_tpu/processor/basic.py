@@ -76,13 +76,24 @@ class BasicProcessor:
         failure — a sequence-numbered run manifest under
         <root>/.shifu/runs/<step>-<seq>.json carrying the registry
         snapshot, trace path, config hashes and exit status
-        (obs/ledger.py). Exceptions re-raise after the manifest lands."""
+        (obs/ledger.py). Exceptions re-raise after the manifest lands.
+
+        -Dshifu.sanitize=transfer,nan,recompile additionally arms the
+        runtime sanitizer harness (analysis/sanitize.py) for the step;
+        its verdict (guard trips, nan traps, recompile-budget breaches)
+        is embedded in the manifest, success or failure."""
         import sys
 
         from shifu_tpu import obs
+        from shifu_tpu.analysis import sanitize
         from shifu_tpu.obs.ledger import RunLedger
 
         obs.install_jax_probes()
+        # parse the sanitizer config BEFORE begin_run: a bad
+        # -Dshifu.sanitize value raises here, while the obs run depth is
+        # still balanced (a raise between begin_run and its finally would
+        # disable the per-step registry reset for the whole process)
+        san = sanitize.from_environment()
         obs.begin_run()
         t0 = time.time()
         status, error = "ok", None
@@ -95,7 +106,9 @@ class BasicProcessor:
             log.info("Step %s starts.", self.step)
             profile_dir = self._profile_dir()
             try:
-                with obs.span(f"step.{self.step}", seq=seq):
+                with obs.span(f"step.{self.step}", seq=seq), \
+                        sanitize.activate(san), \
+                        san.armed(f"step.{self.step}"):
                     if profile_dir:
                         # -Dshifu.profile=<dir>: wrap the step in a
                         # jax.profiler trace (the TPU answer to the
@@ -119,6 +132,11 @@ class BasicProcessor:
                 reg.gauge("step.columns_configured").set(
                     len(self.column_configs))
                 reg.timer("step.elapsed", step=self.step).add(elapsed)
+                extra = {}
+                if profile_dir:
+                    extra["profileDir"] = profile_dir
+                if san.active:
+                    extra["sanitizer"] = san.verdict()
                 try:
                     path = ledger.write(
                         self.step, seq,
@@ -130,8 +148,7 @@ class BasicProcessor:
                         registry=reg,
                         tracer=obs.tracer(),
                         error=error,
-                        extra=({"profileDir": profile_dir}
-                               if profile_dir else None),
+                        extra=extra or None,
                     )
                     log.info("run manifest -> %s", path)
                 except Exception as we:  # a broken ledger must not mask

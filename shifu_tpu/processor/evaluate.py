@@ -190,7 +190,7 @@ class EvalProcessor(BasicProcessor):
                                  or mc.data_set.data_path)
         try:
             stream = should_stream(data_path)
-        except Exception:
+        except Exception:  # unreadable size probe: assume in-memory path
             stream = False
         if stream:
             self._score_streaming(ec, paths)
@@ -622,7 +622,7 @@ class EvalProcessor(BasicProcessor):
 
         try:
             meta = read_meta(self.paths.normalized_data_dir())
-        except Exception:
+        except Exception:  # no/old norm meta: priors simply unavailable
             return None
         priors = (meta.extra or {}).get("classPriors")
         if priors and len(priors) == n_classes:

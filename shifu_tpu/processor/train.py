@@ -422,7 +422,7 @@ class TrainProcessor(BasicProcessor):
 
         try:
             return list(read_meta(self.paths.normalized_data_dir()).columns)
-        except Exception:
+        except Exception:  # no norm meta yet: fall back to ColumnConfig order
             return []
 
     def _checkpoint_every(self) -> int:
@@ -535,7 +535,7 @@ class TrainProcessor(BasicProcessor):
             flat, _ = flatten_params(spec.params)
             log.info("continuous training: resuming model %d from %s", i, path)
             return flat
-        except Exception as e:
+        except Exception as e:  # corrupt/mismatched spec: fresh start, logged
             log.warning("cannot resume from %s (%s); fresh start", path, e)
             return None
 
@@ -570,7 +570,7 @@ class TrainProcessor(BasicProcessor):
             from shifu_tpu.parallel.mesh import data_mesh
 
             return data_mesh()
-        except Exception:  # pragma: no cover
+        except Exception:  # pragma: no cover - no mesh: single device
             return None
 
     # ---- trees / WDL: wired in by their engines ----

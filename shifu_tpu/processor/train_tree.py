@@ -212,7 +212,7 @@ def train_tree_models(proc, alg) -> None:
                         init_trees = old.trees
                         log.info("continuous training: model %d grows from "
                                  "%d trees", i, len(init_trees))
-                except Exception as e:
+                except Exception as e:  # corrupt model: fresh start, logged
                     log.warning("cannot continue from %s (%s)", model_path, e)
 
         def checkpoint(k, trees_now, val_errs, _ck=ck_path,

@@ -122,7 +122,7 @@ def train_wdl_models(proc) -> None:
             log.info("continuous training: resuming WDL model %d from %s",
                      i, path)
             return flat
-        except Exception as e:
+        except Exception as e:  # corrupt/mismatched spec: fresh start, logged
             log.warning("cannot resume from %s (%s); fresh start", path, e)
             return None
 
@@ -327,7 +327,7 @@ def _train_wdl_streamed(proc) -> None:
                 try:
                     init_flat = flatten_wdl(WDLModelSpec.load(path).params)
                     log.info("continuous: resuming WDL model %d", i)
-                except Exception as e:
+                except Exception as e:  # corrupt model: fresh start, logged
                     log.warning("cannot resume from %s (%s)", path, e)
         res = train_wdl_streamed(norm_dir, codes_dir, num_idx, cat_idx,
                                  vocab_sizes, cfg, init_flat=init_flat,

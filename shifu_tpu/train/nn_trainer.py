@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from shifu_tpu.analysis import sanitize
 from shifu_tpu.models.nn import (
     activation_fn,
     flatten_params,
@@ -441,10 +442,16 @@ def train_nn(
     else:
         # single device: the deterministic draw lives in a device cache and
         # the weight product happens on device — repeat runs transfer zero
-        # sampling bytes
+        # sampling bytes. Host inputs are placed EXPLICITLY here (one
+        # device_put, not an implicit per-dispatch transfer) so the
+        # program dispatch below is a transfer-free sanitizer seam.
+        if not isinstance(x, jax.Array):
+            x = jax.device_put(x)
+        if not isinstance(t, jax.Array):
+            t = jax.device_put(t)
         sig_d, valid_d, n_train_size = _device_split_and_sample(n, cfg)
         w_d = (weights if isinstance(weights, jax.Array)
-               else jnp.asarray(np.asarray(weights, np.float32)))
+               else jax.device_put(np.asarray(weights, np.float32)))
         sig_train = sig_d * w_d
         sig_valid = valid_d * w_d
 
@@ -469,8 +476,13 @@ def train_nn(
     nts = jnp.float32(n_train_size)
 
     def run_until(carry, limit):
-        return program(carry, jnp.int32(limit), x, t, sig_train, sig_valid,
-                       key0, nts)
+        # sanitizer seam: every operand is device-resident by here (the
+        # scalar conversion included), so the program dispatch itself
+        # must be transfer-free (-Dshifu.sanitize=transfer)
+        limit_j = jnp.int32(limit)
+        with sanitize.transfer_free("nn.program"):
+            return program(carry, limit_j, x, t, sig_train, sig_valid,
+                           key0, nts)
 
     if cfg.checkpoint_every and cfg.checkpoint_every > 0:
         result = _run_with_checkpoints(run_until, carry0, cfg, max_iters)

@@ -27,6 +27,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from shifu_tpu.analysis import sanitize
 from shifu_tpu.norm.dataset import NormMeta, read_meta
 from shifu_tpu.train.nn_trainer import NNTrainConfig, TrainResult, _loss_and_errors
 from shifu_tpu.train.updaters import make_updater
@@ -58,7 +59,7 @@ def should_stream_training(data_dir: str, force_attr: bool = False) -> bool:
         return True
     try:
         meta = read_meta(data_dir)
-    except Exception:
+    except Exception:  # no shard meta yet: nothing on disk to stream
         return False
     n_cols = len(meta.columns)
     return meta.n_rows * n_cols * 4 > train_memory_budget_bytes()
@@ -262,8 +263,12 @@ def train_nn_streamed(
         for s, (x, t, sig_t, sig_v) in enumerate(feed):
             # fold the shard index in so dropout masks differ per shard
             key_s = jax.random.fold_in(key, s)
-            g, trs, vas, trw, vaw = shard_grad(flat, x, t, sig_t, sig_v,
-                                               key_s, tclass)
+            # sanitizer seam: the shard feed device_put its arrays
+            # explicitly, so the gradient dispatch must be transfer-free
+            # (-Dshifu.sanitize=transfer, analysis/sanitize.py)
+            with sanitize.transfer_free("nn.shard_grad"):
+                g, trs, vas, trw, vaw = shard_grad(flat, x, t, sig_t,
+                                                   sig_v, key_s, tclass)
             if g_sum is None:
                 g_sum, tr_sum, va_sum, tr_w, va_w = g, trs, vas, trw, vaw
             else:

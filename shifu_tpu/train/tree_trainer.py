@@ -1098,7 +1098,7 @@ def _use_pallas_hist(mesh) -> bool:
         return False
     try:
         return jax.default_backend() in ("tpu", "axon")
-    except Exception:
+    except Exception:  # jax backend probe failed: assume not a TPU
         return False
 
 

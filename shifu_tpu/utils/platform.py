@@ -28,5 +28,7 @@ def force_platform(platform: Optional[str] = None, n_devices: Optional[int] = No
         import jax
 
         jax.config.update("jax_platforms", platform)
-    except Exception:
+    except (ImportError, RuntimeError, ValueError):
+        # best-effort: jax absent, or already initialized with a fixed
+        # platform — the env var set above still steers later imports
         pass

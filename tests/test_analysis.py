@@ -1,0 +1,584 @@
+"""`shifu check` + sanitizer harness: the ISSUE-4 acceptance contract.
+
+Covers: seeded positive/negative fixtures for every rule (JX001-JX005,
+SH101-SH103), noqa suppression, the shifu.check/1 JSON schema, the CLI
+entry, the self-check (the shipped tree must be clean), the runtime
+sanitizer's three modes, and the ledger integration (a sanitizer breach
+shows up in the step manifest).
+"""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from shifu_tpu.analysis.engine import analyze, report_json
+
+
+def check_snippet(tmp_path, src, rules=None, name="snippet.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(src))
+    return analyze([str(path)], rule_ids=rules)
+
+
+def rule_lines(findings, rule, suppressed=False):
+    return [f.line for f in findings
+            if f.rule == rule and f.suppressed == suppressed]
+
+
+# ---------------------------------------------------------------------------
+# engine: reporters, suppression, selection
+# ---------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_json_reporter_schema(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x.sum())
+        """)
+        doc = json.loads(report_json(findings))
+        assert doc["schema"] == "shifu.check/1"
+        assert set(doc["counts"]) >= {"error", "warning", "suppressed"}
+        assert doc["counts"]["error"] == 1
+        (f,) = doc["findings"]
+        assert {"rule", "severity", "path", "line", "col", "message",
+                "suppressed"} <= set(f)
+        assert f["rule"] == "JX001" and f["severity"] == "error"
+        # the rule catalog rides along for tooling
+        assert doc["rules"]["JX001"]["severity"] == "error"
+
+    def test_noqa_suppression(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                a = float(x.sum())  # shifu: noqa[JX001] - test fixture
+                b = float(x.max())  # shifu: noqa
+                c = float(x.min())  # shifu: noqa[JX004] - wrong rule id
+                return a + b + c
+        """)
+        assert rule_lines(findings, "JX001", suppressed=True) == [6, 7]
+        assert rule_lines(findings, "JX001") == [8]  # wrong id ≠ suppressed
+
+    def test_suppressed_errors_exit_zero(self, tmp_path):
+        from shifu_tpu.analysis.engine import run_check
+
+        path = tmp_path / "ok.py"
+        path.write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x.sum())  # shifu: noqa[JX001] - fixture
+        """))
+        emitted = []
+        assert run_check([str(path)], emit=emitted.append) == 0
+        assert "1 suppressed" in emitted[0]
+
+    def test_rule_selection_and_unknown_rule(self, tmp_path):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x, flags=[]):
+                return float(x.sum())
+        """
+        only_jx1 = check_snippet(tmp_path, src, rules=["JX001"])
+        assert {f.rule for f in only_jx1} == {"JX001"}
+        with pytest.raises(ValueError, match="unknown rule"):
+            check_snippet(tmp_path, src, rules=["JX999"])
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        findings = check_snippet(tmp_path, "def broken(:\n")
+        assert findings[0].rule == "PARSE"
+        assert findings[0].severity == "error"
+
+    def test_cli_check(self, tmp_path, capsys):
+        from shifu_tpu import cli
+
+        bad = tmp_path / "bad.py"
+        bad.write_text("import jax\n\n@jax.jit\ndef f(x):\n"
+                       "    return float(x.sum())\n")
+        assert cli.main(["check", str(bad)]) == 1
+        assert "JX001" in capsys.readouterr().out
+        good = tmp_path / "good.py"
+        good.write_text("def f():\n    return 1\n")
+        assert cli.main(["check", "--json", str(good)]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["findings"] == []
+        assert cli.main(["check", "--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("JX001", "JX002", "JX003", "JX004", "JX005",
+                    "SH101", "SH102", "SH103"):
+            assert rid in out
+
+
+# ---------------------------------------------------------------------------
+# JX rules: one positive + one negative fixture each
+# ---------------------------------------------------------------------------
+
+
+class TestJaxRules:
+    def test_jx001_host_sync_reachable_from_jit(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            import numpy as np
+
+            def helper(x):                    # traced: called from step
+                return np.asarray(x) + x.item()
+
+            @jax.jit
+            def step(x):
+                return helper(x) + float(x.sum())
+
+            def host_report(x):               # NOT traced: same calls ok
+                return np.asarray(x), x.item(), float(x.sum())
+        """)
+        assert rule_lines(findings, "JX001") == [6, 6, 10]
+
+    def test_jx001_call_form_and_lax_bodies(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+
+            def body(c, x):                   # traced via lax.scan below
+                c.tolist()
+                return c, x
+
+            def run(xs):
+                return jax.lax.scan(body, 0, xs)
+        """)
+        assert rule_lines(findings, "JX001") == [5]
+
+    def test_jx001_negative_shapes_and_device_code(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                n = int(x.shape[0])           # shapes are host ints
+                k = len(x)
+                return jnp.sum(x) * n * k
+        """)
+        assert rule_lines(findings, "JX001") == []
+
+    def test_jx002_unhashable_static_and_omitted_static(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("cols",))
+            def f(x, cols=[]):                # unhashable static default
+                return x
+
+            @jax.jit
+            def g(x, training):
+                if training:                  # tracer bool: omitted static
+                    return x * 2
+                return x
+
+            @partial(jax.jit, static_argnames=("training",))
+            def ok(x, training):
+                if training:                  # declared static: fine
+                    return x * 2
+                return x
+        """)
+        # line 6: the unhashable default node; line 11: the `if training`
+        assert rule_lines(findings, "JX002") == [6, 11]
+
+    def test_jx002_positional_only_static_default(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("cols",))
+            def f(x, /, cols=[]):
+                return x
+        """)
+        assert rule_lines(findings, "JX002") == [6]
+
+    def test_jx003_jit_in_loop(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            from functools import partial
+
+            def grow(levels):
+                progs = []
+                for d in range(levels):
+                    progs.append(jax.jit(lambda v: v * d))
+                while levels:
+                    p = partial(jax.jit, donate_argnums=0)
+                    levels -= 1
+                return progs
+
+            hoisted = jax.jit(lambda v: v + 1)   # module level: fine
+
+            def cached(key, table):
+                if key not in table:
+                    table[key] = jax.jit(lambda v: v)  # not in a loop
+                return table[key]
+        """)
+        assert rule_lines(findings, "JX003") == [8, 10]
+
+    def test_jx004_float64_guard(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            acc64 = bool(jax.config.jax_enable_x64)
+
+            bad = jnp.zeros(4, jnp.float64)
+            good = jnp.zeros(4, jnp.float64 if acc64 else jnp.float32)
+            host = np.zeros(4, np.float64)        # host f64: fine
+
+            if jax.config.jax_enable_x64:
+                also_good = jnp.ones(4, jnp.float64)
+        """)
+        assert rule_lines(findings, "JX004") == [8]
+
+    def test_jx005_side_effects_under_jit(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+
+            history = []
+
+            @jax.jit
+            def step(x):
+                print("step", x)              # trace-time only
+                history.append(x)             # captured mutation
+                local = []
+                local.append(x)               # local build-up: fine
+                return x
+
+            def host_loop(xs):
+                print("epoch", xs)            # host print: fine
+                history.append(xs)
+        """)
+        assert rule_lines(findings, "JX005") == [8, 9]
+
+
+# ---------------------------------------------------------------------------
+# SH rules
+# ---------------------------------------------------------------------------
+
+
+class TestHygieneRules:
+    def test_sh101_bare_blanket_and_justified(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            def f():
+                try:
+                    work()
+                except:
+                    return None
+                try:
+                    work()
+                except Exception:
+                    pass
+                try:
+                    work()
+                except Exception:
+                    return None
+                try:
+                    work()
+                except Exception:  # probing optional dep: absence is fine
+                    return None
+                try:
+                    work()
+                except Exception:
+                    raise
+                try:
+                    work()
+                except ValueError:
+                    return None
+        """)
+        errors = [f for f in findings if f.rule == "SH101"
+                  and f.severity == "error"]
+        warnings = [f for f in findings if f.rule == "SH101"
+                    and f.severity == "warning"]
+        assert [f.line for f in errors] == [5, 9]      # bare + swallow
+        assert [f.line for f in warnings] == [13]      # unjustified blanket
+
+    def test_sh101_pragma_only_comment_is_not_justification(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            def f():
+                try:
+                    work()
+                except Exception:  # noqa: E722
+                    return None
+                try:
+                    work()
+                except Exception:  # type: ignore
+                    return None
+                try:
+                    work()
+                except Exception:  # pragma: no cover - dep may be absent
+                    return None
+        """)
+        warnings = [f.line for f in findings if f.rule == "SH101"]
+        # tool pragmas alone don't justify; pragma + prose does
+        assert warnings == [5, 9]
+
+    def test_sh102_mutable_defaults(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            def bad(x, acc=[], table={}, seen=set()):
+                return x
+
+            def good(x, acc=None, names=()):
+                return x
+        """)
+        assert len(rule_lines(findings, "SH102")) == 3
+
+    def test_sh103_streaming_plumbing(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            def train_foo_streamed(data_dir, cfg):
+                for shard in open(data_dir):      # hand-rolled loop
+                    pass
+
+            def train_bar_streamed(data_dir, cfg, chunk_rows=65536):
+                return data_dir                   # plumbed kwarg
+
+            def compute_baz_streaming(mc, chunk_factory):
+                return mc                         # factory param
+
+            def train_qux_streamed(data_dir):
+                feed = prefetch_iter(range(3))    # drives the pipeline
+                return list(feed)
+
+            def prefetch_iter(it):
+                return it
+
+            def should_stream(path):              # predicate: not an entry
+                return False
+        """)
+        assert rule_lines(findings, "SH103") == [2]
+
+
+# ---------------------------------------------------------------------------
+# self-check: the shipped tree is clean (the at-merge acceptance bar)
+# ---------------------------------------------------------------------------
+
+
+class TestSelfCheck:
+    def test_shifu_tpu_tree_is_clean(self):
+        import shifu_tpu
+
+        pkg = os.path.dirname(os.path.abspath(shifu_tpu.__file__))
+        findings = analyze([pkg])
+        live = [f for f in findings if not f.suppressed]
+        assert [f"{f.path}:{f.line} {f.rule} {f.message}"
+                for f in live if f.severity == "error"] == []
+        # warnings are not gated, but the tree ships warning-free too
+        assert [f"{f.path}:{f.line} {f.rule}" for f in live] == []
+
+
+# ---------------------------------------------------------------------------
+# runtime sanitizer harness
+# ---------------------------------------------------------------------------
+
+
+class TestSanitizer:
+    def test_mode_parsing(self):
+        from shifu_tpu.analysis import sanitize
+        from shifu_tpu.utils import environment
+
+        environment.set_property("shifu.sanitize", "transfer, nan")
+        try:
+            assert sanitize.modes_from_environment() == ["transfer", "nan"]
+            environment.set_property("shifu.sanitize", "all")
+            assert set(sanitize.modes_from_environment()) == {
+                "transfer", "nan", "recompile"}
+            environment.set_property("shifu.sanitize", "transfr")
+            with pytest.raises(ValueError, match="unknown mode"):
+                sanitize.modes_from_environment()
+        finally:
+            environment.set_property("shifu.sanitize", "")
+        assert sanitize.modes_from_environment() == []
+
+    def test_transfer_trip_records_and_raises(self):
+        import jax
+
+        from shifu_tpu import obs
+        from shifu_tpu.analysis import sanitize
+
+        obs.reset()
+        san = sanitize.Sanitizer(["transfer"])
+        f = jax.jit(lambda a: a + 1)
+        f(np.zeros(3, np.float32))  # warm (compile outside the guard)
+        with pytest.raises(Exception, match="[Dd]isallowed"):
+            with sanitize.activate(san), san.transfer_free("stage.x"):
+                f(np.zeros(3, np.float32))  # implicit h2d
+        v = san.verdict()
+        assert v["transfer"] == {"armed": True, "trips": 1}
+        assert v["clean"] is False
+        assert v["events"][0]["kind"] == "transfer.trips"
+        assert v["events"][0]["stage"] == "stage.x"
+        assert obs.registry().counter("sanitizer.transfer.trips").value == 1
+
+    def test_transfer_seam_allows_explicit_and_device_ops(self):
+        import jax
+
+        from shifu_tpu.analysis import sanitize
+
+        san = sanitize.Sanitizer(["transfer"])
+        f = jax.jit(lambda a: a * 2)
+        x = jax.device_put(np.arange(4, dtype=np.float32))
+        f(x)
+        with sanitize.activate(san), san.transfer_free("stage.clean"):
+            y = f(x)
+            jax.device_get(y)  # explicit d2h stays legal
+        assert san.verdict()["clean"] is True
+
+    def test_transfer_free_noop_when_disarmed(self):
+        import jax
+
+        from shifu_tpu.analysis import sanitize
+
+        # no active sanitizer: the library seam must not guard anything
+        f = jax.jit(lambda a: a + 3)
+        with sanitize.transfer_free("anywhere"):
+            f(np.zeros(2, np.float32))  # implicit transfer, tolerated
+
+    def test_nan_trap(self):
+        import jax
+        import jax.numpy as jnp
+
+        from shifu_tpu import obs
+        from shifu_tpu.analysis import sanitize
+
+        obs.reset()
+        san = sanitize.Sanitizer(["nan"])
+        g = jax.jit(lambda a: jnp.log(a))
+        with pytest.raises(FloatingPointError):
+            with sanitize.activate(san), san.armed("train.step"):
+                g(-np.ones(2, np.float32))
+        v = san.verdict()
+        assert v["nan"] == {"armed": True, "trips": 1}
+        assert v["events"][0]["stage"] == "train.step"
+
+    def test_recompile_breach_is_nonfatal(self):
+        import jax
+
+        from shifu_tpu import obs
+        from shifu_tpu.analysis import sanitize
+
+        obs.reset()
+        san = sanitize.Sanitizer(["recompile"], budget=0)
+        with sanitize.activate(san), san.armed("stage.compile"):
+            jax.jit(lambda a: a - 7)(np.arange(5.0))  # fresh program
+        v = san.verdict()
+        assert v["recompile"]["breaches"] == 1
+        assert v["recompile"]["budgetPerStage"] == 0
+        assert v["clean"] is False
+        assert (obs.registry()
+                .counter("sanitizer.recompile.breaches").value == 1)
+
+    def test_verdict_schema(self):
+        from shifu_tpu.analysis import sanitize
+
+        v = sanitize.Sanitizer(["transfer", "nan", "recompile"]).verdict()
+        assert v["schema"] == "shifu.sanitize/1"
+        assert set(v) == {"schema", "modes", "stagesArmed", "transfer",
+                          "nan", "recompile", "events", "clean"}
+        assert v["clean"] is True
+
+
+# ---------------------------------------------------------------------------
+# ledger integration: verdicts land in the step manifest
+# ---------------------------------------------------------------------------
+
+
+def _processor(root, step, body):
+    from shifu_tpu.processor.basic import BasicProcessor
+
+    class P(BasicProcessor):
+        def run_step(self):
+            body()
+
+    P.step = step
+    return P(root)
+
+
+class TestLedgerIntegration:
+    def test_recompile_breach_in_manifest(self, tmp_path):
+        import jax
+
+        from shifu_tpu.utils import environment
+
+        environment.set_property("shifu.sanitize", "recompile")
+        environment.set_property("shifu.sanitize.recompileBudget", "0")
+        try:
+            proc = _processor(
+                str(tmp_path), "sanstep",
+                lambda: jax.jit(lambda a: a + 11)(np.arange(3.0)))
+            assert proc.run() == 0  # breach is a warning, not a trap
+        finally:
+            environment.set_property("shifu.sanitize", "")
+            environment.set_property("shifu.sanitize.recompileBudget", "")
+        m = json.load(open(os.path.join(
+            str(tmp_path), ".shifu", "runs", "sanstep-1.json")))
+        assert m["status"] == "ok"
+        san = m["sanitizer"]
+        assert san["schema"] == "shifu.sanitize/1"
+        assert san["modes"] == ["recompile"]
+        assert san["recompile"]["breaches"] >= 1
+        assert san["clean"] is False
+        assert any(e["kind"] == "recompile.breaches" for e in san["events"])
+        # the counters mirror into the manifest's metrics snapshot too
+        assert m["metrics"]["counters"]["sanitizer.recompile.breaches"] >= 1
+
+    def test_nan_trap_fails_step_and_lands_in_manifest(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from shifu_tpu.utils import environment
+
+        def bad_step():
+            jax.jit(lambda a: jnp.sqrt(a))(-np.ones(2, np.float32))
+
+        environment.set_property("shifu.sanitize", "nan")
+        try:
+            with pytest.raises(FloatingPointError):
+                _processor(str(tmp_path), "nanstep", bad_step).run()
+        finally:
+            environment.set_property("shifu.sanitize", "")
+        m = json.load(open(os.path.join(
+            str(tmp_path), ".shifu", "runs", "nanstep-1.json")))
+        assert m["status"] == "failed"
+        assert m["sanitizer"]["nan"]["trips"] == 1
+        assert m["sanitizer"]["clean"] is False
+
+    def test_unsanitized_step_has_no_verdict(self, tmp_path):
+        proc = _processor(str(tmp_path), "plainstep", lambda: None)
+        assert proc.run() == 0
+        m = json.load(open(os.path.join(
+            str(tmp_path), ".shifu", "runs", "plainstep-1.json")))
+        assert "sanitizer" not in m
+
+    def test_bad_sanitize_value_fails_before_run_depth_leaks(self, tmp_path):
+        from shifu_tpu import obs
+        from shifu_tpu.utils import environment
+
+        environment.set_property("shifu.sanitize", "transer")  # typo
+        try:
+            with pytest.raises(ValueError, match="unknown mode"):
+                _processor(str(tmp_path), "typostep", lambda: None).run()
+        finally:
+            environment.set_property("shifu.sanitize", "")
+        # the obs run depth stayed balanced: later steps still get a
+        # fresh registry each run (counter is per-run, not cumulative)
+        def count():
+            obs.registry().counter("depthprobe.n").inc()
+
+        for _ in range(2):
+            _processor(str(tmp_path), "depthprobe", count).run()
+        m = json.load(open(os.path.join(
+            str(tmp_path), ".shifu", "runs", "depthprobe-2.json")))
+        assert m["metrics"]["counters"]["depthprobe.n"] == 1
