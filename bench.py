@@ -66,6 +66,11 @@ STREAMED = dict(d=30, hidden=[50], n=250_000, epochs=2, shards=8)
 # so it carries no numpy one-worker unit and stays out of the pinned
 # BASELINE_MEASURED.json configs
 STREAMED_STATS = dict(n=120_000, numeric=8, cat=2, chunk_rows=8192)
+# serve_latency is also self-relative (latency/QPS of the online scoring
+# subsystem, no reference analog — the reference has no serving path at
+# all), so it too stays out of BASELINE_MEASURED.json
+SERVE = dict(cols=30, hidden=[50], bags=3, requests=240,
+             concurrency=(1, 4, 16), queue_depth=256)
 
 # public peak bf16 dense matmul TFLOP/s per chip, by device_kind substring
 PEAK_BF16_TFLOPS = {
@@ -656,6 +661,85 @@ def bench_streamed_stats(reps: int):
     }
 
 
+def bench_serve_latency():
+    """Online scoring (shifu_tpu/serve/): p50/p99 single-record latency +
+    QPS at several closed-loop concurrency levels, through the full
+    admission -> micro-batcher -> fused raw->score program path. The
+    registry snapshot in the output proves the steady-state compile bound:
+    every batch pads to a power-of-two row bucket, so `warmBuckets` (and
+    the jax.compiles counter beside it) stays O(log max_batch_rows) no
+    matter how many requests run. The transfer guard is armed on this
+    scenario — the scoring seam does ONE explicit device_put per batch and
+    must move nothing else."""
+    import shutil
+    import tempfile
+    import threading
+
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+    from shifu_tpu.serve.queue import AdmissionQueue
+    from shifu_tpu.serve.registry import ModelRegistry
+    from shifu_tpu.serve.server import Scorer
+
+    spec = SERVE
+    cols = [f"c{i}" for i in range(spec["cols"])]
+    tmp = tempfile.mkdtemp(prefix="bench-serve-")
+    try:
+        rng = np.random.default_rng(0)
+        sizes = [spec["cols"]] + list(spec["hidden"]) + [1]
+        for b in range(spec["bags"]):
+            norm_specs = [
+                {"name": c, "kind": "value", "outNames": [c],
+                 "mean": float(rng.normal()), "std": 1.0, "fill": 0.0,
+                 "zscore": True}
+                for c in cols
+            ]
+            NNModelSpec(
+                layer_sizes=sizes, activations=["tanh"],
+                input_columns=cols, norm_specs=norm_specs,
+                params=init_params(sizes, seed=b),
+            ).save(os.path.join(tmp, f"model{b}.nn"))
+        registry = ModelRegistry(tmp)
+        scorer = Scorer(registry, AdmissionQueue(spec["queue_depth"]))
+        # warm every bucket the concurrency sweep can produce (single-
+        # record requests coalesce to at most `concurrency` rows)
+        registry.warm([1, max(spec["concurrency"])])
+
+        def record(i):
+            return {c: f"{0.1 * (i % 7) - 0.3:.4f}" for c in cols}
+
+        out = {}
+        for conc in spec["concurrency"]:
+            per_thread = spec["requests"] // conc
+            lat = [[] for _ in range(conc)]
+
+            def run(ti):
+                for k in range(per_thread):
+                    t0 = time.perf_counter()
+                    scorer.score_batch([record(ti * per_thread + k)])
+                    lat[ti].append(time.perf_counter() - t0)
+
+            threads = [threading.Thread(target=run, args=(ti,))
+                       for ti in range(conc)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            flat = np.asarray([v for ts in lat for v in ts])
+            out[f"concurrency_{conc}"] = {
+                "requests": int(flat.size),
+                "p50_ms": round(float(np.percentile(flat, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(flat, 99)) * 1e3, 3),
+                "qps": round(flat.size / elapsed, 1),
+            }
+        scorer.close()
+        out["registry"] = registry.snapshot()
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _with_obs_metrics(fn, scenario="scenario", transfer_clean=False):
     """Run one scenario inside a fresh obs scope and embed the registry
     snapshot (compile counts, d2h sync counts, stage seconds, ...) in its
@@ -746,6 +830,8 @@ def main() -> None:
                                  "streamed_nn")
     streamed_stats = _with_obs_metrics(
         lambda: bench_streamed_stats(reps=3), "streamed_stats")
+    serve_latency = _with_obs_metrics(
+        bench_serve_latency, "serve_latency", transfer_clean=True)
 
     peak, chip = chip_peak_tflops()
     nw = base["n_reference_workers"]
@@ -816,6 +902,16 @@ def main() -> None:
                      "overlapped ingest pipeline; prefetch_speedup = "
                      "serial wall-clock / prefetched wall-clock on the "
                      "identical chunk stream (results bit-identical)"),
+        },
+        "serve_latency": {
+            **{k: v for k, v in serve_latency.items()
+               if k.startswith("concurrency_") or k == "registry"},
+            "metrics": serve_latency.get("metrics"),
+            "sanitizer": serve_latency.get("sanitizer"),
+            "note": ("closed-loop single-record requests through "
+                     "admission -> micro-batcher -> fused raw->score jit; "
+                     "registry.warmBuckets is the steady-state compile "
+                     "bound (transfer guard armed on the scoring seam)"),
         },
         "bench_seconds": round(time.perf_counter() - t_start, 1),
     }))

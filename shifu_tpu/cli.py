@@ -129,6 +129,30 @@ def build_parser() -> argparse.ArgumentParser:
                          dest="list_rules",
                          help="print the rule catalog and exit")
 
+    p_serve = sub.add_parser(
+        "serve", help="TPU-native online scoring server (HTTP JSONL: "
+                      "POST /score, GET /healthz, GET /metrics)")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=8080,
+                         help="listen port (0 = ephemeral, printed on "
+                              "stdout)")
+    p_serve.add_argument("--models-dir", default=None, dest="models_dir",
+                         help="model spec dir (default: <root>/models)")
+    p_serve.add_argument("--queue-depth", type=int, default=None,
+                         dest="queue_depth",
+                         help="admission queue depth "
+                              "(default -Dshifu.serve.queueDepth=128; "
+                              "beyond it requests shed with 429)")
+    p_serve.add_argument("--max-batch-rows", type=int, default=None,
+                         dest="max_batch_rows",
+                         help="micro-batch row cap (default 1024)")
+    p_serve.add_argument("--max-wait-ms", type=float, default=None,
+                         dest="max_wait_ms",
+                         help="micro-batch deadline in ms (default 2.0)")
+    p_serve.add_argument("--warm", default=None,
+                         help="comma-separated batch sizes to pre-compile "
+                              "at startup (e.g. 1,16,256)")
+
     p_runs = sub.add_parser(
         "runs", help="list run-ledger manifests (.shifu/runs)")
     p_runs.add_argument("--last", type=int, default=None,
@@ -250,6 +274,43 @@ def dispatch(args: argparse.Namespace) -> int:
         except (FileNotFoundError, ValueError) as e:
             log.error("check: %s", e)
             return 2
+    if cmd == "serve":
+        import signal
+
+        from shifu_tpu.serve.server import ScoringServer
+
+        try:
+            # parse --warm BEFORE binding the port so a typo fails the
+            # clean way, not with a traceback after "listening"
+            sizes = ([int(s) for s in args.warm.split(",") if s.strip()]
+                     if args.warm else [])
+            server = ScoringServer(
+                root=".", models_dir=args.models_dir, host=args.host,
+                port=args.port, queue_depth=args.queue_depth,
+                max_batch_rows=args.max_batch_rows,
+                max_wait_ms=args.max_wait_ms)
+        except (ValueError, OSError) as e:  # bad --warm / no models / port in use
+            log.error("serve: %s", e)
+            return 1
+        if sizes:
+            warmed = server.registry.warm(sizes)
+            log.info("warmed row buckets: %s", warmed)
+
+        def _stop(signum, frame):
+            log.info("signal %d: draining and shutting down", signum)
+            # drain + manifest happen on a helper thread so the handler
+            # returns promptly; serve_forever unblocks when it finishes
+            import threading
+
+            threading.Thread(target=server.shutdown, daemon=True).start()
+
+        signal.signal(signal.SIGINT, _stop)
+        signal.signal(signal.SIGTERM, _stop)
+        # the bound port on stdout is the contract for scripted callers
+        # (--port 0 smoke tests); logs go to stderr
+        print(f"listening on {server.host}:{server.port}", flush=True)
+        server.serve_forever()
+        return 0
     if cmd == "runs":
         import json
 

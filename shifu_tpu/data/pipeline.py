@@ -140,6 +140,10 @@ def prefetch_iter(
                 return
             if not _put(("item", item)):
                 return
+            # drop the local reference NOW: otherwise the handed-off chunk
+            # stays alive in this frame until the next _produce returns,
+            # keeping one extra chunk resident for the whole parse
+            item = None
 
     def _consume() -> Iterator[Any]:
         worker = threading.Thread(target=_work, name="shifu-prefetch",
@@ -153,6 +157,10 @@ def prefetch_iter(
                 if kind == "error":
                     raise val
                 yield val
+                # the consumer is done with the chunk once it re-enters the
+                # generator; release it before blocking on the queue or one
+                # extra chunk stays resident across the whole next wait
+                val = None
         finally:
             stop.set()
             try:  # unblock a worker stuck on a full queue

@@ -67,8 +67,23 @@ def _is_parquet(path: str) -> bool:
     return path.endswith(PARQUET_SUFFIXES)
 
 
+def _string_dtype():
+    """Chunk column dtype: pyarrow-backed strings when available (compact
+    contiguous buffers — a 500-byte padding field costs 500 bytes, not a
+    ~550-byte Python object per row), plain object strings otherwise. The
+    LazyColumns facade (data/reader.py) keeps columns in this storage until
+    a stage actually reads them, so the bounded-memory envelope holds."""
+    try:
+        import pyarrow  # noqa: F401
+
+        return "string[pyarrow]"
+    except ImportError:
+        return str
+
+
 def _iter_csv_chunks(
-    path: str, names: List[str], delimiter: str, chunk_rows: int
+    path: str, names: List[str], delimiter: str, chunk_rows: int,
+    usecols: Optional[List[str]] = None,
 ) -> Iterator["np.ndarray"]:
     import pandas as pd
 
@@ -78,7 +93,8 @@ def _iter_csv_chunks(
         sep=delimiter,
         header=None,
         names=names,
-        dtype=str,
+        usecols=usecols,
+        dtype=_string_dtype(),
         keep_default_na=False,
         compression=compression,
         engine="c",
@@ -91,26 +107,28 @@ def _iter_csv_chunks(
 
 
 def _iter_parquet_chunks(
-    path: str, names: List[str], chunk_rows: int
+    path: str, names: List[str], chunk_rows: int,
+    usecols: Optional[List[str]] = None,
 ) -> Iterator["np.ndarray"]:
     """Parquet ingestion (reference: ModelNormalizeConf.isParquet,
     udf/NormalizeParquetUDF.java) via pyarrow record batches."""
     import pandas as pd
     import pyarrow.parquet as pq
 
+    want = usecols if usecols is not None else names
     pf = pq.ParquetFile(path)
-    cols = [c for c in names if c in pf.schema_arrow.names]
+    cols = [c for c in want if c in pf.schema_arrow.names]
     for batch in pf.iter_batches(batch_size=chunk_rows, columns=cols or None):
         df = batch.to_pandas()
         # align to the expected header: missing columns become empty strings
-        for c in names:
+        for c in want:
             if c not in df.columns:
                 df[c] = ""
         # nulls must become the empty-string missing token BEFORE astype —
         # astype(str) would stringify them as "nan"/"None" and they'd dodge
         # the missing-value accounting the CSV path gets from
         # keep_default_na=False
-        df = df[names].fillna("").astype(str)
+        df = df[want].fillna("").astype(_string_dtype())
         yield df
 
 
@@ -121,22 +139,34 @@ def iter_columnar_chunks(
     missing_values: Sequence[str] = DEFAULT_MISSING,
     chunk_rows: Optional[int] = None,
     max_rows: Optional[int] = None,
+    columns: Optional[Sequence[str]] = None,
 ) -> Iterator[ColumnarData]:
     """Yield ColumnarData chunks of at most chunk_rows across all part files.
 
     Pandas frames are converted chunk-by-chunk; nothing beyond one chunk is
-    ever resident."""
+    ever resident. `columns`, when given, restricts parsing to that subset
+    of the header (pandas usecols): columns a stage never reads — fat meta/
+    padding fields — are discarded at tokenizer level and cost no memory at
+    all; the yielded chunks carry only the subset (original header order).
+    """
     chunk_rows = chunk_rows or chunk_rows_setting()
+    usecols = None
+    out_names = list(names)
+    if columns is not None:
+        keep = set(columns)
+        out_names = [n for n in names if n in keep]
+        usecols = out_names
     remaining = max_rows
     for path in _expand_paths(data_path):
         if _is_parquet(path):
-            frames = _iter_parquet_chunks(path, names, chunk_rows)
+            frames = _iter_parquet_chunks(path, names, chunk_rows, usecols)
         else:
-            frames = _iter_csv_chunks(path, names, delimiter, chunk_rows)
+            frames = _iter_csv_chunks(path, names, delimiter, chunk_rows,
+                                      usecols)
         for df in frames:
             # filter stray headers BEFORE the max_rows slice so dropped
             # headers don't consume budget
-            df = drop_stray_header_rows(df, names)
+            df = drop_stray_header_rows(df, out_names)
             if remaining is not None:
                 if remaining <= 0:
                     return
@@ -147,7 +177,7 @@ def iter_columnar_chunks(
             # frame-backed: columns stay in pandas' compact (arrow) string
             # storage until a stage actually reads them
             yield ColumnarData.from_frame(
-                df.reset_index(drop=True), names, missing_values
+                df.reset_index(drop=True), out_names, missing_values
             )
 
 
@@ -158,13 +188,15 @@ def chunk_source(
     missing_values: Sequence[str] = DEFAULT_MISSING,
     chunk_rows: Optional[int] = None,
     max_rows: Optional[int] = None,
+    columns: Optional[Sequence[str]] = None,
 ) -> Callable[[], Iterator[ColumnarData]]:
     """A re-iterable chunk factory — multi-pass algorithms (two-pass stats)
     call it once per pass."""
 
     def factory() -> Iterator[ColumnarData]:
         return iter_columnar_chunks(
-            data_path, names, delimiter, missing_values, chunk_rows, max_rows
+            data_path, names, delimiter, missing_values, chunk_rows,
+            max_rows, columns,
         )
 
     return factory

@@ -31,16 +31,26 @@ def find_model_paths(models_dir: str) -> List[str]:
     """models/model*.{nn,lr,gbt,rf,wdl} sorted by NUMERIC index
     (ModelSpecLoaderUtils.findModels). Numeric, not lexicographic: under
     ONEVSALL the column order is load-bearing (column k = class k), and
-    lexicographic order would put model10 before model2."""
+    lexicographic order would put model10 before model2.
+
+    Paths are DEDUPED (overlapping globs/symlinked dirs must not score a
+    model twice — duplicate columns skew the mean/median aggregates) and
+    the order is fully deterministic: numeric index first, then basename —
+    unindexed names land after every indexed one in basename order, never
+    in whatever order the per-suffix globs happened to run."""
     import re
 
-    out = []
+    out = set()
     for suf in MODEL_SUFFIXES:
-        out.extend(glob.glob(os.path.join(models_dir, f"model*{suf}")))
+        out.update(glob.glob(os.path.join(models_dir, f"model*{suf}")))
 
     def key(p: str):
-        m = re.search(r"model(\d+)", os.path.basename(p))
-        return (int(m.group(1)) if m else 1 << 30, os.path.basename(p))
+        base = os.path.basename(p)
+        m = re.search(r"model(\d+)", base)
+        # (indexed-first, index, basename): the basename tie-break keeps
+        # same-index files of different suffixes and ALL unindexed files
+        # in one stable order regardless of glob/filesystem enumeration
+        return (0, int(m.group(1)), base) if m else (1, 0, base)
 
     return sorted(out, key=key)
 

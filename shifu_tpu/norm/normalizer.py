@@ -402,30 +402,43 @@ def bin_code_matrix(
     return out
 
 
-def _make_kernels():
-    import jax
+def value_norm_traced(v, mean, std, zs, cutoff):
+    """Traced body of the per-column z-score norm: clamp to mean±cutoff*std
+    then (v-mean)/std, degenerate-std columns -> 0, non-zscore (ASIS)
+    columns pass through UNclamped (asIsNormalize parity: only invalid
+    values are touched, never clamped).
+
+    This is THE value-norm semantics — the standalone jit kernel below and
+    the serve registry's fused raw->score program both trace this one
+    function, so offline norm, eval scoring and online serving cannot
+    drift apart."""
     import jax.numpy as jnp
 
-    @jax.jit
-    def value_kernel(v, mean, std, zs, cutoff):
-        lo = mean - cutoff * std
-        hi = mean + cutoff * std
-        clamped = jnp.clip(v, lo[None, :], hi[None, :])
-        safe = jnp.where(std > MIN_STD, std, 1.0)
-        z = jnp.where(
-            std[None, :] > MIN_STD, (clamped - mean[None, :]) / safe[None, :], 0.0
-        )
-        # non-zscore (ASIS) columns pass through UNclamped (asIsNormalize
-        # parity: only invalid values are touched, never clamped)
-        return jnp.where(zs[None, :] > 0, z, v)
+    lo = mean - cutoff * std
+    hi = mean + cutoff * std
+    clamped = jnp.clip(v, lo[None, :], hi[None, :])
+    safe = jnp.where(std > MIN_STD, std, 1.0)
+    z = jnp.where(
+        std[None, :] > MIN_STD, (clamped - mean[None, :]) / safe[None, :], 0.0
+    )
+    return jnp.where(zs[None, :] > 0, z, v)
 
-    @jax.jit
-    def table_kernel(codes, tables):
-        return jnp.take_along_axis(
-            tables.T, jnp.clip(codes, 0, tables.shape[1] - 1), axis=0
-        )
 
-    return value_kernel, table_kernel
+def table_norm_traced(codes, tables):
+    """Traced body of the per-bin-slot lookup ([n, Ct] codes over padded
+    [Ct, maxS] tables) — shared with the serve fused program like
+    value_norm_traced above."""
+    import jax.numpy as jnp
+
+    return jnp.take_along_axis(
+        tables.T, jnp.clip(codes, 0, tables.shape[1] - 1), axis=0
+    )
+
+
+def _make_kernels():
+    import jax
+
+    return jax.jit(value_norm_traced), jax.jit(table_norm_traced)
 
 
 def _value_kernel_jit(*args):

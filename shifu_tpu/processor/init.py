@@ -127,13 +127,16 @@ class InitProcessor(BasicProcessor):
         ]
         missing = tuple(ds.missing_or_invalid_values)
         sketches = {cc.column_name: AutoTypeSketch(missing) for cc in candidates}
-        # parse overlaps the sketch folds via the prefetch thread
+        # parse overlaps the sketch folds via the prefetch thread; only the
+        # candidate columns are parsed at all — target/meta/weight (fat
+        # padding fields included) never leave the CSV tokenizer
         for chunk in prefetch_iter(iter_columnar_chunks(
             self.resolve(ds.data_path),
             names,
             delimiter=ds.data_delimiter,
             missing_values=missing,
             max_rows=AUTOTYPE_MAX_ROWS,
+            columns=[cc.column_name for cc in candidates],
         )):
             for cc in candidates:
                 sketches[cc.column_name].update(chunk._series(cc.column_name))

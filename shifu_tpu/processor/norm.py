@@ -220,10 +220,22 @@ class NormProcessor(BasicProcessor):
                 [c.column_name for c in tree_cols], "CODES",
                 extra={"slots": slots},
             )
+        if ds.filter_expressions:
+            needed = None  # expressions may reference any column
+        else:
+            keep = {s.cc.column_name for s in plan.specs}
+            keep.update(c.column_name for c in tree_cols)
+            keep.add(ds.target_column_name)
+            if ds.weight_column_name:
+                keep.add(ds.weight_column_name)
+            # parse only the columns this pass reads — meta/padding fields
+            # never leave the CSV tokenizer (bounded-memory envelope)
+            needed = [n for n in names if n in keep]
         factory = chunk_source(
             self.resolve(ds.data_path), names,
             delimiter=ds.data_delimiter,
             missing_values=tuple(ds.missing_or_invalid_values),
+            columns=needed,
         )
         # registry-backed: streaming-stage timings land in the run manifest
         reg = registry()

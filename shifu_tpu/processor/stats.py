@@ -45,6 +45,27 @@ class StatsProcessor(BasicProcessor):
             missing_values=tuple(ds.missing_or_invalid_values),
         )
 
+    def _streaming_columns(self, names):
+        """Columns the streaming stats passes actually read: target +
+        weight + every stats candidate. Meta/padding columns never leave
+        the CSV tokenizer — the bounded-memory envelope depends on it.
+        Returns None (parse everything) when filter expressions are set,
+        since those may reference any column."""
+        mc = self.model_config
+        if mc.data_set.filter_expressions:
+            return None
+        needed = {
+            c.column_name for c in self.column_configs
+            if not (c.is_meta() or c.is_weight())
+        }
+        needed.add(mc.data_set.target_column_name)
+        if mc.data_set.weight_column_name:
+            needed.add(mc.data_set.weight_column_name)
+        if self.psi and (mc.stats.psi_column_name or "").strip():
+            # the PSI unit column is often a meta column — keep it parsed
+            needed.add(mc.stats.psi_column_name.strip())
+        return [n for n in names if n in needed]
+
     def run_step(self) -> None:
         self.setup()
         mc = self.model_config
@@ -83,6 +104,7 @@ class StatsProcessor(BasicProcessor):
                 names,
                 delimiter=ds.data_delimiter,
                 missing_values=tuple(ds.missing_or_invalid_values),
+                columns=self._streaming_columns(names),
             )
             log.info("dataset exceeds the ingest memory budget; "
                      "streaming stats in chunks")

@@ -1,0 +1,37 @@
+"""shifu_tpu.serve — TPU-native online scoring.
+
+The training side of the lifecycle ends at `eval`/`export`; this package
+is the missing serving side: a model registry that loads a model set once
+and fuses raw-record normalization + forward + aggregation into one jit
+program (registry.py), a dynamic micro-batcher that coalesces concurrent
+requests into power-of-two shape buckets (batcher.py), a bounded admission
+queue with explicit load-shed rejections (queue.py), and a stdlib-only
+HTTP JSONL front end plus an in-process Scorer API (server.py).
+
+    from shifu_tpu.serve import ModelRegistry, ScoringServer
+
+    server = ScoringServer(root=".")      # models/ under the model set
+    server.start()                        # POST /score, /healthz, /metrics
+    ...
+    server.shutdown()                     # drain + run-ledger manifest
+
+Knobs (all `-Dk=v` properties):
+    shifu.serve.queueDepth     admission queue depth (default 128)
+    shifu.serve.maxBatchRows   micro-batch row cap (default 1024)
+    shifu.serve.maxWaitMs      batching deadline in ms (default 2.0)
+"""
+
+from shifu_tpu.serve.batcher import MicroBatcher, ScoreRequest
+from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
+from shifu_tpu.serve.registry import ModelRegistry
+from shifu_tpu.serve.server import Scorer, ScoringServer
+
+__all__ = [
+    "AdmissionQueue",
+    "MicroBatcher",
+    "ModelRegistry",
+    "RejectedError",
+    "ScoreRequest",
+    "Scorer",
+    "ScoringServer",
+]

@@ -1,0 +1,118 @@
+"""Admission control: bounded queue, explicit load-shed, drain-on-shutdown.
+
+The serving contract under overload is REJECT, not buffer: a request the
+backend cannot start within its deadline is worth more as an immediate
+429-style `RejectedError` (the client retries against another replica)
+than as a queue entry that times out after consuming its latency budget.
+Depth-bounded admission is what turns "heavy traffic" into a stable
+steady state — the micro-batcher (batcher.py) drains this queue as fast
+as the device scores, and everything beyond `depth` in-flight requests is
+shed at the door.
+
+Shutdown semantics: `close()` atomically flips the queue to rejecting;
+requests already admitted keep draining (the batcher's `get` loop only
+returns None once the queue is closed AND empty), so in-flight work
+completes and nothing is dropped mid-score.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from shifu_tpu.utils import environment
+
+DEFAULT_QUEUE_DEPTH = 128
+
+
+def queue_depth_setting() -> int:
+    """shifu.serve.queueDepth — admission bound (shed beyond it)."""
+    return environment.get_int("shifu.serve.queueDepth", DEFAULT_QUEUE_DEPTH)
+
+
+class RejectedError(RuntimeError):
+    """Request shed by admission control (HTTP 429 analog).
+
+    `reason` is "full" (depth saturated) or "closed" (shutdown in
+    progress); both are explicit rejections, never silent timeouts."""
+
+    def __init__(self, reason: str, depth: int = 0) -> None:
+        self.reason = reason
+        self.depth = depth
+        msg = ("admission queue full (depth %d) — load shed" % depth
+               if reason == "full"
+               else "server shutting down — request rejected")
+        super().__init__(msg)
+
+
+class AdmissionQueue:
+    """Bounded FIFO with shed-on-full admission and drain-aware close."""
+
+    def __init__(self, depth: Optional[int] = None) -> None:
+        self.depth = queue_depth_setting() if depth is None else int(depth)
+        if self.depth <= 0:
+            raise ValueError("admission queue depth must be positive")
+        self._items: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def _metrics(self):
+        from shifu_tpu.obs import registry
+
+        return registry()
+
+    def put(self, item: Any) -> None:
+        """Admit `item` or raise RejectedError — never blocks: a full
+        queue means the backend is already `depth` batches behind, and
+        waiting would only convert the rejection into a timeout."""
+        reg = self._metrics()
+        with self._cond:
+            if self._closed:
+                reg.counter("serve.queue.shed", reason="closed").inc()
+                raise RejectedError("closed")
+            if len(self._items) >= self.depth:
+                reg.counter("serve.queue.shed", reason="full").inc()
+                raise RejectedError("full", depth=self.depth)
+            self._items.append(item)
+            depth = len(self._items)
+            self._cond.notify()
+        reg.counter("serve.queue.admitted").inc()
+        reg.gauge("serve.queue.depth").set(depth)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Next admitted item; None when the queue is closed AND empty
+        (drain complete) or — with a timeout — when nothing arrived in
+        time. The two Nones are distinguishable via `closed`."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._items:
+                if self._closed:
+                    return None
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cond.wait(remaining):
+                        if not self._items:
+                            return None
+            item = self._items.popleft()
+            depth = len(self._items)
+        self._metrics().gauge("serve.queue.depth").set(depth)
+        return item
+
+    def close(self) -> None:
+        """Stop admitting; wake every waiter so drain can finish."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    @property
+    def closed(self) -> bool:
+        with self._cond:
+            return self._closed
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._items)

@@ -1,0 +1,432 @@
+"""Model registry: load a model set once, fuse raw→score into one program.
+
+The offline scorer (eval/scorer.py ModelRunner) dispatches per model —
+normalize (two jit kernels), forward (one jit per model), then aggregates
+on the host. Fine for a batch job; for online serving every extra
+dispatch is tail latency. The registry builds, per model SET, a single
+jit program that takes the host-featurized inputs (filled numeric values
++ bin codes, one pair per UNIQUE norm plan — bagged models usually share
+one) and computes normalization, every model's forward, the 0..1000
+scaling and the ModelRunner mean/max/min/median aggregation in one fused
+dispatch. TensorFlow's train/serve-shared-graph argument (Abadi et al.,
+2016) and the DrJAX jit map/reduce idiom both apply directly: the same
+compiled substrate that trains the models serves them.
+
+Shape discipline: batches pad to power-of-two row buckets (the PR-1
+`bucket_rows` idiom, floor 8), so steady-state serving compiles
+O(log max_batch_rows) programs total — the compiled-program cache is
+keyed by (model-set sha, row bucket) and `warm()` pre-compiles the
+buckets a deployment expects. The PR-4 recompile watchdog sees the same
+`jax.compiles` counters every other subsystem reports.
+
+Transfer discipline: `score_raw` stages the featurized inputs into device
+memory with ONE explicit `jax.device_put` per batch and dispatches the
+fused program inside a `transfer_free("serve.score")` sanitizer seam —
+under `-Dshifu.sanitize=transfer` any implicit host↔device copy on the
+hot path raises. Results come back via one explicit `jax.device_get`.
+
+Model sets that mix in tree/WDL/reference-format specs fall back to the
+ModelRunner path (still batched, still served) — `fused` reports which
+mode a registry runs in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from shifu_tpu.data.pipeline import bucket_rows
+from shifu_tpu.data.reader import ColumnarData
+from shifu_tpu.eval.scorer import (
+    DEFAULT_SCORE_SCALE,
+    ModelRunner,
+    ScoreResult,
+    find_model_paths,
+    load_model,
+)
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+# smallest serving bucket: single-record requests pad to 8 rows, keeping
+# the compiled-shape set tiny without inflating tiny batches 256x like the
+# ingest-side MIN_ROW_BUCKET would
+SERVE_MIN_ROW_BUCKET = 8
+
+
+def model_set_sha(paths: Sequence[str]) -> str:
+    """Content hash of the whole model set — the registry cache key's
+    stable half (a redeployed models/ dir yields a new sha, so stale
+    compiled programs can never serve new weights)."""
+    h = hashlib.sha256()
+    for p in sorted(paths):
+        h.update(os.path.basename(p).encode())
+        with open(p, "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()[:16]
+
+
+def records_to_columnar(
+    records: Sequence[dict], columns: Sequence[str],
+) -> ColumnarData:
+    """JSON records -> the raw columnar batch the scorers consume.
+    Absent/None fields become the empty missing token; everything else is
+    stringified so numeric JSON values and raw CSV fields normalize
+    identically."""
+    n = len(records)
+    raw: Dict[str, np.ndarray] = {}
+    for c in columns:
+        col = np.empty(n, dtype=object)
+        for i, r in enumerate(records):
+            v = r.get(c)
+            col[i] = "" if v is None else str(v)
+        raw[c] = col
+    return ColumnarData(names=list(columns), raw=raw, n_rows=n)
+
+
+class _PlanFeaturizer:
+    """Host half of one norm plan: raw batch -> (filled values, bin codes).
+
+    Mirrors apply_norm_plan's host prep exactly (float64 missing-fill
+    BEFORE the float32 cast, shared per-column code cache) but stops at
+    the device boundary — the fused program owns every FLOP after it."""
+
+    def __init__(self, plan) -> None:
+        self.plan = plan
+        self.value_specs = [s for s in plan.specs if s.kind == "value"]
+        self.coded_specs = [s for s in plan.specs
+                            if s.kind in ("table", "onehot")]
+        self._fill64 = np.asarray([s.fill for s in self.value_specs],
+                                  dtype=np.float64)
+
+    def __call__(self, data: ColumnarData,
+                 code_cache: Optional[dict] = None
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        from shifu_tpu.norm.normalizer import _bin_codes_for
+
+        n = data.n_rows
+        if self.value_specs:
+            vals64 = self._numeric_matrix(data)
+            vals = np.where(np.isfinite(vals64), vals64,
+                            self._fill64[None, :]).astype(np.float32)
+        else:
+            vals = np.zeros((n, 0), dtype=np.float32)
+        if self.coded_specs:
+            codes = np.stack(
+                [_bin_codes_for(s.cc, data, code_cache)
+                 for s in self.coded_specs],
+                axis=1).astype(np.int32)
+        else:
+            codes = np.zeros((n, 0), dtype=np.int32)
+        return vals, codes
+
+    def _numeric_matrix(self, data: ColumnarData) -> np.ndarray:
+        """[n, Cv] float64 with NaN for missing/invalid — ONE flattened
+        pandas parse instead of one per column. Semantics are exactly
+        ColumnarData.numeric's (strip + missing-token set, non-finite ->
+        NaN): online batches are a handful of rows, and per-column pandas
+        dispatch was ~25x the fused program's own latency."""
+        import pandas as pd
+
+        n = data.n_rows
+        flat = np.concatenate([
+            np.asarray(data.column(s.cc.column_name), dtype=object)
+            for s in self.value_specs
+        ])
+        ser = pd.Series(flat)
+        vals = pd.to_numeric(ser, errors="coerce").to_numpy(np.float64)
+        tokens = [m for m in data.missing_values if m != ""]
+        if tokens:
+            miss = ser.str.strip().isin(tokens).to_numpy()
+            vals[miss] = np.nan
+        vals[~np.isfinite(vals)] = np.nan
+        return vals.reshape(len(self.value_specs), n).T
+
+
+def _build_plan_device_consts(plan):
+    """Static per-plan tensors the fused program closes over, pre-staged
+    as jnp arrays so no constant crosses the host->device boundary at
+    call time."""
+    import jax.numpy as jnp
+
+    value_specs = [s for s in plan.specs if s.kind == "value"]
+    table_specs = [s for s in plan.specs if s.kind == "table"]
+    coded_specs = [s for s in plan.specs if s.kind in ("table", "onehot")]
+    consts = {
+        "mean": jnp.asarray([s.mean for s in value_specs], jnp.float32),
+        "std": jnp.asarray([s.std for s in value_specs], jnp.float32),
+        "zs": jnp.asarray([1.0 if s.zscore else 0.0 for s in value_specs],
+                          jnp.float32),
+        "cutoff": jnp.float32(plan.cutoff),
+    }
+    if table_specs:
+        max_s = max(s.table.size for s in table_specs)
+        tables = np.zeros((len(table_specs), max_s), dtype=np.float32)
+        for k, s in enumerate(table_specs):
+            tables[k, : s.table.size] = s.table
+        consts["tables"] = jnp.asarray(tables)
+        # static columns of the shared codes matrix that feed the table
+        # gather (the rest feed one-hot expansion)
+        consts["tab_positions"] = np.asarray(
+            [i for i, s in enumerate(coded_specs) if s.kind == "table"],
+            np.int32)
+    return consts
+
+
+def _plan_norm_device(plan, consts, vals, codes):
+    """Traced: one plan's normalized matrix [n, plan.n_out], assembled in
+    spec order (value / table / onehot interleave exactly like
+    apply_norm_plan's host concatenate). The value and table math is the
+    normalizer's OWN traced bodies (value_norm_traced/table_norm_traced)
+    — one semantics for offline norm, eval and serving."""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.norm.normalizer import (
+        table_norm_traced,
+        value_norm_traced,
+    )
+
+    out_vals = None
+    if vals.shape[1]:
+        out_vals = value_norm_traced(vals, consts["mean"], consts["std"],
+                                     consts["zs"], consts["cutoff"])
+    out_tab = None
+    if "tables" in consts:
+        out_tab = table_norm_traced(codes[:, consts["tab_positions"]],
+                                    consts["tables"])
+
+    pieces = []
+    vi = 0   # next value column in out_vals
+    ti = 0   # next table column in out_tab
+    ci = 0   # next coded column (table + onehot share the codes matrix)
+    for s in plan.specs:
+        if s.kind == "value":
+            pieces.append(out_vals[:, vi:vi + 1])
+            vi += 1
+        elif s.kind == "table":
+            pieces.append(out_tab[:, ti:ti + 1])
+            ti += 1
+            ci += 1
+        else:  # onehot
+            width = s.n_out
+            pieces.append(jax.nn.one_hot(
+                jnp.clip(codes[:, ci], 0, width - 1), width,
+                dtype=jnp.float32))
+            ci += 1
+    return jnp.concatenate(pieces, axis=1)
+
+
+class ModelRegistry:
+    """Loaded model set + fused raw->score program + warm-program cache."""
+
+    def __init__(self, models_dir: str,
+                 scale: float = DEFAULT_SCORE_SCALE,
+                 column_configs=None, model_config=None) -> None:
+        self.models_dir = models_dir
+        self.paths = find_model_paths(models_dir)
+        if not self.paths:
+            raise ValueError(f"no models under {models_dir}")
+        self.sha = model_set_sha(self.paths)
+        self.scale = float(scale)
+        self.model_names = [os.path.basename(p) for p in self.paths]
+        self.specs = [load_model(p, column_configs, model_config)
+                      for p in self.paths]
+        self.fused = self._fusable()
+        self._runner: Optional[ModelRunner] = None
+        self._warm_buckets: set = set()
+        if self.fused:
+            self._build_fused()
+        else:
+            # mixed/tree/WDL/reference sets: still served, via the offline
+            # scorer's per-model dispatch (one ModelRunner, loaded once)
+            self._runner = ModelRunner(
+                self.paths, scale=scale, column_configs=column_configs,
+                model_config=model_config)
+            self.input_columns = self._input_columns()
+            log.info("registry %s: %d models, ModelRunner fallback "
+                     "(non-NN spec present; %d input columns)", self.sha,
+                     len(self.paths), len(self.input_columns))
+
+    # ---- construction ----
+    def _fusable(self) -> bool:
+        from shifu_tpu.compat.adapters import RefModelAdapter
+        from shifu_tpu.models.nn import NNModelSpec
+
+        return all(
+            isinstance(s, NNModelSpec) and not isinstance(s, RefModelAdapter)
+            for s in self.specs
+        )
+
+    def _build_fused(self) -> None:
+        import jax
+
+        from shifu_tpu.norm.normalizer import plan_from_json
+
+        # dedupe norm plans by full signature — bagged models nearly always
+        # share one plan, so the fused program normalizes once, not once
+        # per bag
+        import json
+
+        plan_keys: List[str] = []
+        self._plans = []
+        self._featurizers: List[_PlanFeaturizer] = []
+        self._model_plan_idx: List[int] = []
+        for spec in self.specs:
+            plan_json = {
+                "normType": spec.norm_type,
+                "cutoff": getattr(spec, "norm_cutoff", 4.0),
+                "columns": spec.norm_specs,
+            }
+            key = json.dumps(plan_json, sort_keys=True)
+            if key not in plan_keys:
+                plan_keys.append(key)
+                plan = plan_from_json(plan_json)
+                self._plans.append(plan)
+                self._featurizers.append(_PlanFeaturizer(plan))
+            self._model_plan_idx.append(plan_keys.index(key))
+
+        consts = [_build_plan_device_consts(p) for p in self._plans]
+        params = [
+            [{"W": jax.numpy.asarray(layer["W"]),
+              "b": jax.numpy.asarray(layer["b"])}
+             for layer in spec.params]
+            for spec in self.specs
+        ]
+        self.model_widths = [
+            spec.out_dim if spec.out_dim > 1 else 1 for spec in self.specs
+        ]
+        plans = self._plans
+        model_plan_idx = self._model_plan_idx
+        specs = self.specs
+        scale = self.scale
+
+        def fused(plan_inputs):
+            import jax.numpy as jnp
+
+            from shifu_tpu.models.nn import forward
+
+            normed = [
+                _plan_norm_device(plan, c, vals, codes)
+                for plan, c, (vals, codes)
+                in zip(plans, consts, plan_inputs)
+            ]
+            cols = []
+            for mi, spec in enumerate(specs):
+                x = normed[model_plan_idx[mi]]
+                out = forward(params[mi], x, spec.activations,
+                              spec.out_activation)
+                if spec.out_dim <= 1:
+                    out = out[:, :1]
+                cols.append(out * scale)
+            m = jnp.concatenate(cols, axis=1)
+            return (m, m.mean(axis=1), m.max(axis=1), m.min(axis=1),
+                    jnp.median(m, axis=1))
+
+        # ONE jit for the whole registry, constructed once (never inside
+        # the request loop); per-bucket executables cache underneath it
+        self._program = jax.jit(fused)
+        self.input_columns = self._input_columns()
+        log.info("registry %s: %d models fused (%d unique norm plans, "
+                 "%d input columns)", self.sha, len(self.specs),
+                 len(self._plans), len(self.input_columns))
+
+    def _input_columns(self) -> List[str]:
+        """Union of raw source columns across plans, first-seen order —
+        the record schema the HTTP front end accepts."""
+        seen: List[str] = []
+        if self.fused:
+            for plan in self._plans:
+                for s in plan.specs:
+                    if s.cc.column_name not in seen:
+                        seen.append(s.cc.column_name)
+            return seen
+        for spec in self.specs:
+            for cd in getattr(spec, "norm_specs", None) or []:
+                if cd["name"] not in seen:
+                    seen.append(cd["name"])
+            for name in getattr(spec, "input_columns", None) or []:
+                if name not in seen:
+                    seen.append(name)
+        return seen
+
+    # ---- serving ----
+    def bucket(self, n_rows: int) -> int:
+        return bucket_rows(n_rows, minimum=SERVE_MIN_ROW_BUCKET)
+
+    def warm(self, batch_sizes: Sequence[int]) -> List[int]:
+        """Pre-compile the buckets covering `batch_sizes`; returns the
+        bucket list actually warmed. Call at startup so the first real
+        request never pays a compile."""
+        warmed = []
+        for b in sorted({self.bucket(max(1, int(s))) for s in batch_sizes}):
+            rec = {c: "0" for c in self.input_columns}
+            self.score_records([rec] * b)
+            warmed.append(b)
+        return warmed
+
+    def score_records(self, records: Sequence[dict]) -> ScoreResult:
+        data = records_to_columnar(records, self.input_columns)
+        return self.score_raw(data)
+
+    def score_raw(self, data: ColumnarData) -> ScoreResult:
+        """Raw batch -> ScoreResult, padded to the row bucket and sliced
+        back; one explicit device_put in, one explicit device_get out."""
+        from shifu_tpu.obs import registry as obs_registry
+
+        reg = obs_registry()
+        if not self.fused:
+            reg.counter("serve.score.rows").inc(data.n_rows)
+            return self._runner.score_raw(data)
+        import jax
+
+        from shifu_tpu.analysis import sanitize
+
+        n = data.n_rows
+        bucket = self.bucket(n)
+        code_cache: dict = {}
+        plan_inputs = []
+        for feat in self._featurizers:
+            vals, codes = feat(data, code_cache)
+            extra = bucket - n
+            if extra:
+                vals = np.pad(vals, ((0, extra), (0, 0)))
+                codes = np.pad(codes, ((0, extra), (0, 0)))
+            plan_inputs.append((vals, codes))
+        key = (self.sha, bucket)
+        if key not in self._warm_buckets:
+            self._warm_buckets.add(key)
+            reg.counter("serve.program_compiles").inc()
+            reg.gauge("serve.registry.buckets").set(
+                len(self._warm_buckets))
+        # the hot seam: inputs staged with ONE explicit device_put, then
+        # the fused dispatch must move no other bytes
+        # (-Dshifu.sanitize=transfer)
+        dev_inputs = jax.device_put(tuple(plan_inputs))
+        with sanitize.transfer_free("serve.score"):
+            out = self._program(dev_inputs)
+        m, mean, mx, mn, med = jax.device_get(out)
+        reg.counter("serve.score.rows").inc(n)
+        return ScoreResult(
+            model_scores=np.asarray(m)[:n],
+            mean=np.asarray(mean)[:n],
+            max=np.asarray(mx)[:n],
+            min=np.asarray(mn)[:n],
+            median=np.asarray(med)[:n],
+            model_names=list(self.model_names),
+            model_widths=list(self.model_widths),
+        )
+
+    def snapshot(self) -> dict:
+        """Registry state for manifests/bench output: compiled buckets
+        prove the steady-state compile bound."""
+        return {
+            "sha": self.sha,
+            "models": list(self.model_names),
+            "fused": self.fused,
+            "inputColumns": len(self.input_columns),
+            "warmBuckets": sorted(b for (_s, b) in self._warm_buckets),
+        }

@@ -1,0 +1,293 @@
+"""`shifu serve` front end: stdlib HTTP JSONL server + in-process Scorer.
+
+Endpoints (http.server.ThreadingHTTPServer — no new dependencies):
+
+  POST /score    body is either {"records": [{col: value, ...}, ...]} or
+                 JSONL (one record object per line). Response:
+                 {"scores": [{"mean","max","min","median","models"}...]}.
+                 Shed requests get HTTP 429 + Retry-After — an explicit
+                 rejection, never a hung connection.
+  GET  /healthz  liveness + registry identity (model-set sha, mode).
+  GET  /metrics  the existing Prometheus exporter (obs/metrics.py) over
+                 the live serve counters/histograms/gauges.
+
+Embedding: `Scorer.score_batch(records)` is the same admission → batcher
+→ fused-program path without HTTP — the bench harness and tests drive it
+directly.
+
+Shutdown (`ScoringServer.shutdown()` / SIGINT in the CLI): admission
+closes first (new requests shed with reason=closed), the batcher drains
+every admitted request, the HTTP listener stops, and a run-ledger
+manifest (`.shifu/runs/serve-<seq>.json`) lands with the full metrics
+snapshot — the serving analog of the per-step manifests every lifecycle
+step writes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Sequence
+
+from shifu_tpu.eval.scorer import ScoreResult
+from shifu_tpu.serve.batcher import MicroBatcher
+from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
+from shifu_tpu.serve.registry import ModelRegistry, records_to_columnar
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+DEFAULT_SCORE_TIMEOUT_S = 30.0
+
+
+class Scorer:
+    """In-process scoring API over the admission queue + micro-batcher."""
+
+    def __init__(self, registry: ModelRegistry,
+                 admission: Optional[AdmissionQueue] = None,
+                 max_batch_rows: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None) -> None:
+        self.registry = registry
+        # explicit None-check: AdmissionQueue defines __len__, so an EMPTY
+        # queue is falsy and `admission or ...` would silently swap in a
+        # default-depth one
+        self.admission = AdmissionQueue() if admission is None else admission
+        self.batcher = MicroBatcher(
+            registry.score_raw, self.admission,
+            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms)
+
+    def score_batch(self, records: Sequence[dict],
+                    timeout: Optional[float] = DEFAULT_SCORE_TIMEOUT_S
+                    ) -> ScoreResult:
+        """Score raw records; blocks until the micro-batch containing
+        them completes. Raises RejectedError on shed (429 analog)."""
+        data = records_to_columnar(records, self.registry.input_columns)
+        req = self.batcher.submit(data)
+        return req.wait(timeout)
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop admitting and drain every in-flight request."""
+        self.admission.close()
+        self.batcher.join(timeout)
+
+
+def _result_rows(res: ScoreResult) -> List[dict]:
+    return [
+        {
+            "mean": round(float(res.mean[i]), 4),
+            "max": round(float(res.max[i]), 4),
+            "min": round(float(res.min[i]), 4),
+            "median": round(float(res.median[i]), 4),
+            "models": [round(float(v), 4) for v in res.model_scores[i]],
+        }
+        for i in range(len(res.mean))
+    ]
+
+
+def _parse_records(body: bytes) -> List[dict]:
+    """JSON document or JSONL lines -> list of record dicts."""
+    text = body.decode("utf-8")
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # JSONL: one record object per line
+        records = []
+        for line in text.splitlines():
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+        return _all_objects(records)
+    if isinstance(doc, list):
+        return _all_objects(doc)
+    if isinstance(doc, dict) and isinstance(doc.get("records"), list):
+        return _all_objects(doc["records"])
+    if isinstance(doc, dict):
+        return [doc]  # a single bare record object
+    raise ValueError("body must be a JSON record, a list of records, "
+                     'a {"records": [...]} document, or JSONL lines')
+
+
+def _all_objects(records: List) -> List[dict]:
+    """Every record must be a JSON object — anything else is a 400, not
+    an AttributeError dropping the connection mid-handler."""
+    for r in records:
+        if not isinstance(r, dict):
+            raise ValueError(
+                f"records must be JSON objects, got {type(r).__name__}")
+    return records
+
+
+class ScoringServer:
+    """Registry + Scorer + HTTP listener + shutdown manifest, in one."""
+
+    def __init__(self, root: str = ".",
+                 models_dir: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 queue_depth: Optional[int] = None,
+                 max_batch_rows: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 column_configs=None, model_config=None) -> None:
+        self.root = os.path.abspath(root)
+        self.registry = ModelRegistry(
+            models_dir or os.path.join(self.root, "models"),
+            column_configs=column_configs, model_config=model_config)
+        self.scorer = Scorer(
+            self.registry, AdmissionQueue(queue_depth),
+            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms)
+        self.started_at = time.time()
+        self._serve_thread: Optional[threading.Thread] = None
+        self._shutdown_lock = threading.Lock()
+        self._shutdown_started = False
+        self._shutdown_done = threading.Event()
+        self.httpd = ThreadingHTTPServer((host, port),
+                                         self._handler_class())
+        self.httpd.daemon_threads = True
+
+    # ---- HTTP ----
+    @property
+    def host(self) -> str:
+        return self.httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def _handler_class(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route to our logger
+                log.debug("http: " + fmt, *args)
+
+            def _reply(self, code: int, payload, content_type: str
+                       = "application/json", extra_headers=None) -> None:
+                body = (payload if isinstance(payload, bytes)
+                        else json.dumps(payload).encode("utf-8"))
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                from shifu_tpu.obs import registry as obs_registry
+
+                if self.path == "/healthz":
+                    self._reply(200, {
+                        "status": "ok",
+                        "models": len(server.registry.model_names),
+                        "sha": server.registry.sha,
+                        "fused": server.registry.fused,
+                        "queueDepth": len(server.scorer.admission),
+                        "uptimeSeconds": round(
+                            time.time() - server.started_at, 1),
+                    })
+                    return
+                if self.path == "/metrics":
+                    self._reply(
+                        200,
+                        obs_registry().to_prometheus().encode("utf-8"),
+                        content_type="text/plain; version=0.0.4")
+                    return
+                self._reply(404, {"error": f"unknown path {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/score":
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    records = _parse_records(self.rfile.read(length))
+                except ValueError as e:
+                    self._reply(400, {"error": f"bad request body: {e}"})
+                    return
+                if not records:
+                    self._reply(400, {"error": "no records in body"})
+                    return
+                try:
+                    res = server.scorer.score_batch(records)
+                except RejectedError as e:
+                    self._reply(429, {"error": str(e),
+                                      "reason": e.reason},
+                                extra_headers={"Retry-After": "1"})
+                    return
+                except TimeoutError as e:
+                    self._reply(503, {"error": str(e)})
+                    return
+                self._reply(200, {
+                    "models": server.registry.model_names,
+                    "scores": _result_rows(res),
+                })
+
+        return Handler
+
+    # ---- lifecycle ----
+    def start(self) -> "ScoringServer":
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, name="shifu-serve-http",
+            daemon=True)
+        self._serve_thread.start()
+        log.info("shifu serve listening on %s:%d (%d models, sha %s)",
+                 self.host, self.port, len(self.registry.model_names),
+                 self.registry.sha)
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground serving (the CLI path); returns after shutdown()."""
+        self.start()
+        self._shutdown_done.wait()
+
+    def shutdown(self, drain_timeout: float = 30.0) -> Optional[str]:
+        """Reject-new -> drain in-flight -> stop HTTP -> write manifest.
+        Returns the manifest path (None for every caller but the first —
+        the started-flag swap is atomic, so a double SIGINT during a long
+        drain cannot run shutdown twice or write duplicate manifests)."""
+        with self._shutdown_lock:
+            if self._shutdown_started:
+                return None
+            self._shutdown_started = True
+        try:
+            self.scorer.close(drain_timeout)
+            self.httpd.shutdown()
+            self.httpd.server_close()
+            if self._serve_thread is not None:
+                self._serve_thread.join(5.0)
+            return self._write_manifest()
+        finally:
+            # whatever happens above, serve_forever() must unblock — a
+            # shutdown that dies mid-drain must not leave the CLI parked
+            # forever on a listener that is already closed
+            self._shutdown_done.set()
+
+    def _write_manifest(self) -> Optional[str]:
+        import sys
+
+        from shifu_tpu import obs
+        from shifu_tpu.obs.ledger import RunLedger
+
+        ledger = RunLedger(self.root)
+        try:
+            seq = ledger.next_seq("serve")
+            path = ledger.write(
+                "serve", seq,
+                status="ok",
+                exit_status=0,
+                started_at=self.started_at,
+                elapsed_seconds=time.time() - self.started_at,
+                argv=list(sys.argv),
+                registry=obs.registry(),
+                tracer=obs.tracer(),
+                extra={"serve": self.registry.snapshot()},
+            )
+            log.info("serve manifest -> %s", path)
+            return path
+        except OSError as e:  # a broken ledger must not mask shutdown
+            log.warning("cannot write serve manifest: %s", e)
+            return None

@@ -10,9 +10,7 @@ tests/test_compat.py golden scoring)."""
 
 import json
 import os
-import shutil
 
-import numpy as np
 import pytest
 
 REF = "/root/reference/src/test/resources/example/cancer-judgement"
@@ -20,10 +18,41 @@ DATA = f"{REF}/DataStore/DataSet1"
 EVAL = f"{REF}/DataStore/EvalSet1"
 MS1 = f"{REF}/ModelStore/ModelSet1"
 
-pytestmark = pytest.mark.skipif(
+needs_reference_data = pytest.mark.skipif(
     not os.path.isdir(DATA), reason="reference tutorial data not present")
 
 
+def test_serve_subcommand_in_cli():
+    """`shifu serve` is part of the command table: parser accepts the
+    online-scoring knobs and `--help` exits 0 like every subcommand."""
+    from shifu_tpu.cli import build_parser
+
+    parser = build_parser()
+    args = parser.parse_args([
+        "serve", "--port", "0", "--queue-depth", "8",
+        "--max-batch-rows", "64", "--max-wait-ms", "1.5",
+        "--warm", "1,16", "--models-dir", "m",
+    ])
+    assert args.command == "serve"
+    assert args.port == 0 and args.queue_depth == 8
+    assert args.max_batch_rows == 64 and args.max_wait_ms == 1.5
+    assert args.warm == "1,16" and args.models_dir == "m"
+
+    with pytest.raises(SystemExit) as exc:
+        parser.parse_args(["serve", "--help"])
+    assert exc.value.code == 0
+
+
+def test_serve_help_text_mentions_endpoints(capsys):
+    from shifu_tpu.cli import build_parser
+
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--help"])
+    out = capsys.readouterr().out
+    assert "serve" in out and "scoring" in out
+
+
+@needs_reference_data
 def test_full_cli_golden_cancer_judgement(tmp_path):
     root = str(tmp_path / "CancerJudgement")
     os.makedirs(root)

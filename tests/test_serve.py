@@ -1,0 +1,627 @@
+"""Online scoring subsystem (shifu_tpu/serve/): registry fusion parity,
+shape-bucket compile bounds, micro-batching, admission backpressure, the
+HTTP front end, the shutdown run-ledger manifest, and PMML export parity
+against the fused scorer.
+
+The model set is trained once per module with HYBRID normalization so the
+fused program exercises BOTH device norm paths (numeric z-score-with-
+clamp value kernel + categorical woe table gather) and the PMML parity
+test pins both embedded LocalTransformations semantics (NormContinuous
+clamp, woe MapValues) against the same registry.
+"""
+
+import json
+import math
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_model_set
+
+NS = "{http://www.dmg.org/PMML-4_2}"
+
+
+@pytest.fixture(scope="module")
+def model_set(tmp_path_factory):
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    root = str(tmp_path_factory.mktemp("serve_ms"))
+    make_model_set(root, n_rows=400)
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["normalize"]["normType"] = "HYBRID"  # numeric z-score + cat woe
+    mc["train"]["numTrainEpochs"] = 40
+    json.dump(mc, open(mcp, "w"), indent=2)
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    assert TrainProcessor(root).run() == 0
+    return root
+
+
+@pytest.fixture(scope="module")
+def raw_data(model_set):
+    from shifu_tpu.data.reader import read_columnar, read_header
+
+    names = read_header(os.path.join(model_set, "data", "header.txt"))
+    return read_columnar(os.path.join(model_set, "data", "data.txt"),
+                         names)
+
+
+def _registry(model_set):
+    from shifu_tpu.serve.registry import ModelRegistry
+
+    return ModelRegistry(os.path.join(model_set, "models"))
+
+
+# ---------------------------------------------------------------------------
+# find_model_paths (satellite): dedupe + deterministic ordering
+# ---------------------------------------------------------------------------
+
+
+class TestFindModelPaths:
+    def test_mixed_numeric_and_unindexed_order(self, tmp_path):
+        from shifu_tpu.eval.scorer import find_model_paths
+
+        d = str(tmp_path)
+        for name in ("model10.nn", "model2.nn", "model.nn",
+                     "model_extra.gbt", "model1.rf"):
+            open(os.path.join(d, name), "w").close()
+        got = [os.path.basename(p) for p in find_model_paths(d)]
+        # numeric index order first (1 < 2 < 10, NOT lexicographic), then
+        # unindexed names in basename order — same answer whatever order
+        # the per-suffix globs enumerate
+        assert got == ["model1.rf", "model2.nn", "model10.nn",
+                       "model.nn", "model_extra.gbt"]
+        assert len(got) == len(set(got))  # deduped
+
+    def test_repeated_calls_identical(self, tmp_path):
+        from shifu_tpu.eval.scorer import find_model_paths
+
+        d = str(tmp_path)
+        for name in ("model.nn", "model_b.wdl", "model_a.lr"):
+            open(os.path.join(d, name), "w").close()
+        assert find_model_paths(d) == find_model_paths(d)
+        got = [os.path.basename(p) for p in find_model_paths(d)]
+        assert got == sorted(got)  # unindexed fallback: basename order
+
+
+# ---------------------------------------------------------------------------
+# registry: fused program parity + shape buckets
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_fused_scores_match_model_runner(self, model_set, raw_data):
+        from shifu_tpu.eval.scorer import ModelRunner, find_model_paths
+
+        reg = _registry(model_set)
+        assert reg.fused
+        runner = ModelRunner(
+            find_model_paths(os.path.join(model_set, "models")))
+        res_f = reg.score_raw(raw_data)
+        res_r = runner.score_raw(raw_data)
+        np.testing.assert_allclose(res_f.model_scores,
+                                   res_r.model_scores, atol=2e-3)
+        np.testing.assert_allclose(res_f.mean, res_r.mean, atol=2e-3)
+        np.testing.assert_allclose(res_f.median, res_r.median, atol=2e-3)
+        np.testing.assert_allclose(res_f.min, res_r.min, atol=2e-3)
+        np.testing.assert_allclose(res_f.max, res_r.max, atol=2e-3)
+        assert res_f.model_widths == res_r.model_widths
+        assert res_f.model_names == res_r.model_names
+
+    def test_records_missing_fields_score_like_missing_tokens(
+            self, model_set, raw_data):
+        reg = _registry(model_set)
+        # a record missing a numeric and a categorical field must score
+        # exactly like the same record with explicit missing tokens
+        base = {c: str(raw_data.column(c)[0]) for c in reg.input_columns}
+        with_tokens = dict(base, num_0="?", cat_0="")
+        without = {k: v for k, v in with_tokens.items()
+                   if k not in ("num_0", "cat_0")}
+        r1 = reg.score_records([with_tokens])
+        r2 = reg.score_records([without])
+        np.testing.assert_allclose(r1.model_scores, r2.model_scores,
+                                   atol=1e-6)
+
+    def test_shape_bucket_compile_bound(self, model_set, raw_data):
+        from shifu_tpu import obs
+
+        obs.reset()
+        reg = _registry(model_set)
+        # 25 distinct batch sizes; buckets must collapse to O(log n)
+        for n in list(range(1, 21)) + [33, 57, 100, 128, 250]:
+            reg.score_raw(raw_data.select_rows(np.arange(n)))
+        snap = reg.snapshot()
+        assert set(snap["warmBuckets"]) <= {8, 16, 32, 64, 128, 256}
+        compiles = obs.registry().snapshot()["counters"].get(
+            "serve.program_compiles", 0)
+        assert compiles == len(snap["warmBuckets"])
+
+    def test_warm_precompiles_buckets(self, model_set):
+        reg = _registry(model_set)
+        warmed = reg.warm([1, 3, 16])
+        assert warmed == [8, 16]
+        assert reg.snapshot()["warmBuckets"] == [8, 16]
+
+    def test_model_runner_fallback_serves_tree_sets(self, tmp_path):
+        """A non-NN model set is still served (batched ModelRunner path):
+        input_columns, warm(), score_records, snapshot and the batcher
+        all work with fused=False."""
+        from shifu_tpu.eval.scorer import ModelRunner
+        from shifu_tpu.serve.registry import ModelRegistry
+        from shifu_tpu.serve.registry import records_to_columnar
+        from shifu_tpu.serve.server import Scorer
+        from shifu_tpu.train.tree_trainer import (
+            TreeTrainConfig,
+            train_trees,
+        )
+
+        rng = np.random.default_rng(0)
+        n = 400
+        bounds = [-np.inf, -1.0, 0.0, 1.0]
+        cats = ["aa", "bb", "cc"]
+        x_num = rng.normal(size=n)
+        x_cat = rng.integers(0, 3, size=n)
+        codes = np.stack(
+            [np.searchsorted(bounds, x_num, side="right") - 1, x_cat],
+            axis=1).astype(np.int32)
+        y = ((x_num > 0) | (x_cat == 1)).astype(np.float32)
+        cfg = TreeTrainConfig(algorithm="GBT", tree_num=3, max_depth=3,
+                              learning_rate=0.3, valid_set_rate=0.1,
+                              seed=3, min_instances_per_node=1)
+        res = train_trees(codes, y, np.ones(n, np.float32), [5, 4],
+                          [False, True], ["num0", "cat0"], cfg,
+                          boundaries=[[float(b) for b in bounds], None],
+                          categories=[None, cats])
+        models_dir = str(tmp_path / "models")
+        os.makedirs(models_dir)
+        res.spec.save(os.path.join(models_dir, "model0.gbt"))
+
+        reg = ModelRegistry(models_dir)
+        assert not reg.fused
+        assert reg.input_columns == ["num0", "cat0"]
+        assert reg.warm([1]) == [8]
+        snap = reg.snapshot()
+        assert snap["fused"] is False and snap["models"] == ["model0.gbt"]
+
+        recs = [{"num0": f"{x_num[i]:.5f}", "cat0": cats[x_cat[i]]}
+                for i in range(10)]
+        got = reg.score_records(recs)
+        expect = ModelRunner(
+            [os.path.join(models_dir, "model0.gbt")]).score_raw(
+            records_to_columnar(recs, reg.input_columns))
+        np.testing.assert_allclose(got.mean, expect.mean, atol=1e-6)
+
+        scorer = Scorer(reg, max_wait_ms=1)
+        res_b = scorer.score_batch(recs[:2])
+        np.testing.assert_allclose(res_b.mean, expect.mean[:2], atol=1e-6)
+        scorer.close(10)
+
+    def test_sha_tracks_model_content(self, model_set, tmp_path):
+        import shutil
+
+        from shifu_tpu.serve.registry import model_set_sha
+
+        src = os.path.join(model_set, "models")
+        d1 = str(tmp_path / "a")
+        shutil.copytree(src, d1)
+        paths = sorted(
+            os.path.join(d1, f) for f in os.listdir(d1))
+        sha1 = model_set_sha(paths)
+        with open(paths[0], "ab") as fh:
+            fh.write(b"\0")
+        assert model_set_sha(paths) != sha1
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher + admission queue
+# ---------------------------------------------------------------------------
+
+
+def _fake_result(values):
+    from shifu_tpu.eval.scorer import ScoreResult
+
+    m = np.asarray(values, np.float64)[:, None]
+    return ScoreResult(model_scores=m, mean=m[:, 0], max=m[:, 0],
+                       min=m[:, 0], median=m[:, 0],
+                       model_names=["fake"], model_widths=[1])
+
+
+def _one_row(v):
+    from shifu_tpu.data.reader import ColumnarData
+
+    return ColumnarData(names=["v"],
+                        raw={"v": np.asarray([str(v)], object)}, n_rows=1)
+
+
+class TestBatcherQueue:
+    def test_coalescing_and_padding_aware_unpacking(self):
+        from shifu_tpu.serve.batcher import MicroBatcher
+        from shifu_tpu.serve.queue import AdmissionQueue
+
+        batch_sizes = []
+        gate = threading.Event()
+
+        def score(data):
+            gate.wait(10)
+            vals = [float(x) for x in data.column("v")]
+            batch_sizes.append(len(vals))
+            return _fake_result(vals)
+
+        batcher = MicroBatcher(score, AdmissionQueue(64),
+                               max_batch_rows=64, max_wait_ms=50)
+        reqs = [batcher.submit(_one_row(i)) for i in range(20)]
+        gate.set()
+        results = [r.wait(10) for r in reqs]
+        # every request got ITS OWN row back, whatever batch it rode in
+        for i, res in enumerate(results):
+            assert res.mean[0] == pytest.approx(float(i))
+        # the 20 requests coalesced into fewer dispatches
+        assert 1 <= len(batch_sizes) < 20
+        batcher.admission.close()
+        batcher.join(5)
+
+    def test_row_cap_bounds_batch_size(self):
+        from shifu_tpu.serve.batcher import MicroBatcher
+        from shifu_tpu.serve.queue import AdmissionQueue
+
+        batch_sizes = []
+        gate = threading.Event()
+
+        def score(data):
+            gate.wait(10)
+            vals = [float(x) for x in data.column("v")]
+            batch_sizes.append(len(vals))
+            return _fake_result(vals)
+
+        batcher = MicroBatcher(score, AdmissionQueue(64),
+                               max_batch_rows=4, max_wait_ms=200)
+        reqs = [batcher.submit(_one_row(i)) for i in range(12)]
+        gate.set()
+        for r in reqs:
+            r.wait(10)
+        assert max(batch_sizes) <= 4
+        batcher.admission.close()
+        batcher.join(5)
+
+    def test_scoring_error_fans_out_not_kills_worker(self):
+        from shifu_tpu.serve.batcher import MicroBatcher
+        from shifu_tpu.serve.queue import AdmissionQueue
+
+        calls = []
+
+        def score(data):
+            calls.append(data.n_rows)
+            if len(calls) == 1:
+                raise ValueError("boom")
+            return _fake_result([float(x) for x in data.column("v")])
+
+        batcher = MicroBatcher(score, AdmissionQueue(8),
+                               max_batch_rows=8, max_wait_ms=1)
+        bad = batcher.submit(_one_row(1))
+        with pytest.raises(ValueError, match="boom"):
+            bad.wait(10)
+        good = batcher.submit(_one_row(2))
+        assert good.wait(10).mean[0] == pytest.approx(2.0)
+        batcher.admission.close()
+        batcher.join(5)
+
+    def test_backpressure_sheds_fast_and_drains_clean(self):
+        """Acceptance: saturation -> explicit rejection (not a timeout);
+        close() -> every ADMITTED request still completes."""
+        from shifu_tpu.serve.batcher import MicroBatcher
+        from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
+
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def score(data):
+            entered.set()
+            gate.wait(10)
+            return _fake_result([float(x) for x in data.column("v")])
+
+        admission = AdmissionQueue(3)
+        batcher = MicroBatcher(score, admission,
+                               max_batch_rows=1, max_wait_ms=1)
+        # worker picks up the first request and blocks in score(); wait
+        # for it to actually arrive there, then saturate the queue
+        first = batcher.submit(_one_row(0))
+        assert entered.wait(10)
+        admitted = [batcher.submit(_one_row(i)) for i in range(1, 4)]
+        t0 = time.perf_counter()
+        with pytest.raises(RejectedError) as exc:
+            batcher.submit(_one_row(99))
+        shed_latency = time.perf_counter() - t0
+        assert exc.value.reason == "full"
+        assert shed_latency < 0.5  # an explicit shed, not a timeout
+        # drain: close admission, release the scorer, everything admitted
+        # completes with its own result
+        admission.close()
+        with pytest.raises(RejectedError) as exc2:
+            batcher.submit(_one_row(100))
+        assert exc2.value.reason == "closed"
+        gate.set()
+        assert first.wait(10).mean[0] == pytest.approx(0.0)
+        for i, req in enumerate(admitted):
+            assert req.wait(10).mean[0] == pytest.approx(float(i + 1))
+        batcher.join(5)
+        assert not batcher.draining
+
+
+# ---------------------------------------------------------------------------
+# HTTP front end + shutdown manifest
+# ---------------------------------------------------------------------------
+
+
+def _post(url, body, ctype="application/json"):
+    req = urllib.request.Request(
+        url, data=body if isinstance(body, bytes) else body.encode(),
+        headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestScoringServer:
+    def test_endpoints_scoring_and_shutdown_manifest(self, model_set,
+                                                     raw_data):
+        from shifu_tpu import obs
+        from shifu_tpu.serve.server import ScoringServer
+
+        obs.reset()
+        srv = ScoringServer(root=model_set, max_wait_ms=1).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        cols = srv.registry.input_columns
+        recs = [{c: str(raw_data.column(c)[i]) for c in cols}
+                for i in range(3)]
+
+        # JSON document form
+        status, out = _post(f"{base}/score", json.dumps({"records": recs}))
+        assert status == 200
+        assert len(out["scores"]) == 3
+        expect = srv.registry.score_records(recs)
+        got = [s["mean"] for s in out["scores"]]
+        np.testing.assert_allclose(got, expect.mean, atol=1e-2)
+
+        # JSONL form scores identically
+        jsonl = "\n".join(json.dumps(r) for r in recs)
+        status, out2 = _post(f"{base}/score", jsonl, "application/jsonl")
+        assert status == 200
+        assert [s["mean"] for s in out2["scores"]] == got
+
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        assert health["sha"] == srv.registry.sha
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            metrics = r.read().decode()
+        assert "serve_requests_total" in metrics
+        assert "serve_latency_seconds_bucket" in metrics
+        assert "serve_queue_depth" in metrics
+
+        with pytest.raises(urllib.error.HTTPError) as he:
+            _post(f"{base}/score", "not json [")
+        assert he.value.code == 400
+        # valid JSON whose records are not objects is a 400 too, never a
+        # dropped connection
+        with pytest.raises(urllib.error.HTTPError) as he:
+            _post(f"{base}/score", "[1, 2, 3]")
+        assert he.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as he:
+            _post(f"{base}/nope", "{}")
+        assert he.value.code == 404
+
+        manifest_path = srv.shutdown()
+        assert manifest_path and os.path.isfile(manifest_path)
+        m = json.load(open(manifest_path))
+        assert m["schema"] == "shifu.run/1"
+        assert m["step"] == "serve"
+        assert m["serve"]["sha"] == srv.registry.sha
+        assert m["metrics"]["counters"]["serve.requests"] >= 2
+        assert m["metrics"]["counters"]["serve.records"] >= 6
+        # post-shutdown: in-process scoring is an explicit rejection
+        from shifu_tpu.serve.queue import RejectedError
+
+        with pytest.raises(RejectedError):
+            srv.scorer.score_batch(recs[:1])
+
+    def test_http_429_under_saturation_then_clean_drain(self, model_set,
+                                                        raw_data):
+        """Acceptance over HTTP: saturated queue -> 429 with Retry-After,
+        in-flight requests drain on shutdown, manifest written."""
+        from shifu_tpu.serve.registry import records_to_columnar
+        from shifu_tpu.serve.server import ScoringServer
+
+        srv = ScoringServer(root=model_set, queue_depth=2,
+                            max_batch_rows=1, max_wait_ms=1).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        cols = srv.registry.input_columns
+        rec = {c: str(raw_data.column(c)[0]) for c in cols}
+
+        gate = threading.Event()
+        entered = threading.Event()
+        orig = srv.scorer.batcher.score_fn
+
+        def gated(data):
+            entered.set()
+            gate.wait(10)
+            return orig(data)
+
+        srv.scorer.batcher.score_fn = gated
+        # one request in the worker (wait until it actually picks it up —
+        # otherwise the queue-fillers below race it for the depth budget)
+        # + two in the queue = saturated
+        first = srv.scorer.batcher.submit(records_to_columnar([rec], cols))
+        assert entered.wait(10)
+        inflight = [first] + [
+            srv.scorer.batcher.submit(records_to_columnar([rec], cols))
+            for _ in range(2)
+        ]
+        with pytest.raises(urllib.error.HTTPError) as he:
+            _post(f"{base}/score", json.dumps(rec))
+        assert he.value.code == 429
+        assert he.value.headers.get("Retry-After")
+        body = json.loads(he.value.read())
+        assert body["reason"] == "full"
+
+        done = {}
+
+        def finish():
+            gate.set()
+            done["manifest"] = srv.shutdown()
+
+        t = threading.Thread(target=finish)
+        t.start()
+        # every admitted request completes despite the shutdown
+        for req in inflight:
+            assert req.wait(15).mean.shape == (1,)
+        t.join(15)
+        assert done["manifest"] and os.path.isfile(done["manifest"])
+
+
+# ---------------------------------------------------------------------------
+# PMML parity (satellite): exported LocalTransformations vs fused scorer
+# ---------------------------------------------------------------------------
+
+
+def _act(name, z):
+    if name == "tanh":
+        return math.tanh(z)
+    if name == "logistic":
+        return 1.0 / (1.0 + math.exp(-z))
+    if name == "rectifier":
+        return max(0.0, z)
+    return z  # identity
+
+
+def _eval_derived(df_el, value):
+    """PMML 4.2 DerivedField semantics, written against the spec (not our
+    writer): NormContinuous with outliers=asExtremeValues clamps to the
+    anchor norms; MapValues falls back to defaultValue/mapMissingTo."""
+    nc = df_el.find(f"{NS}NormContinuous")
+    if nc is not None:
+        if value is None:
+            return float(nc.get("mapMissingTo"))
+        x = float(value)
+        a1, a2 = nc.findall(f"{NS}LinearNorm")
+        o1, n1 = float(a1.get("orig")), float(a1.get("norm"))
+        o2, n2 = float(a2.get("orig")), float(a2.get("norm"))
+        if x <= o1:
+            return n1
+        if x >= o2:
+            return n2
+        return n1 + (x - o1) * (n2 - n1) / (o2 - o1)
+    mv = df_el.find(f"{NS}MapValues")
+    if mv is not None:
+        if value is None:
+            return float(mv.get("mapMissingTo"))
+        for row in mv.find(f"{NS}InlineTable").findall(f"{NS}row"):
+            if row.find(f"{NS}in").text == str(value):
+                return float(row.find(f"{NS}out").text)
+        return float(mv.get("defaultValue"))
+    raise AssertionError("unsupported DerivedField")
+
+
+def eval_pmml_nn(xml_text, rows):
+    """Independent mini NN evaluator: LocalTransformations -> NeuralInputs
+    -> NeuralLayers -> NeuralOutputs, per the PMML 4.2 spec."""
+    root = ET.fromstring(xml_text)
+    nn = root.find(f"{NS}NeuralNetwork")
+    default_act = nn.get("activationFunction")
+    lt = nn.find(f"{NS}LocalTransformations")
+    derived = {df.get("name"): df
+               for df in lt.findall(f"{NS}DerivedField")}
+    in_ids, in_fields = [], []
+    for ni in nn.find(f"{NS}NeuralInputs").findall(f"{NS}NeuralInput"):
+        in_ids.append(ni.get("id"))
+        ref = ni.find(f"{NS}DerivedField").find(f"{NS}FieldRef")
+        in_fields.append(ref.get("field"))
+    out_neuron = nn.find(f"{NS}NeuralOutputs").find(
+        f"{NS}NeuralOutput").get("outputNeuron")
+    outs = []
+    for row in rows:
+        acts = {}
+        for iid, field in zip(in_ids, in_fields):
+            col = field[len("norm_"):]
+            acts[iid] = _eval_derived(derived[field], row.get(col))
+        for layer in nn.findall(f"{NS}NeuralLayer"):
+            lact = layer.get("activationFunction") or default_act
+            fresh = {}
+            for neuron in layer.findall(f"{NS}Neuron"):
+                z = float(neuron.get("bias"))
+                for con in neuron.findall(f"{NS}Con"):
+                    z += acts[con.get("from")] * float(con.get("weight"))
+                fresh[neuron.get("id")] = _act(lact, z)
+            acts.update(fresh)
+        outs.append(acts[out_neuron])
+    return np.asarray(outs)
+
+
+class TestPmmlServeParity:
+    def test_exported_pmml_matches_fused_registry(self, model_set,
+                                                  raw_data):
+        import glob
+
+        from shifu_tpu.eval.scorer import DEFAULT_SCORE_SCALE
+        from shifu_tpu.processor.export import ExportProcessor
+
+        assert ExportProcessor(model_set, kind="pmml").run() == 0
+        hits = glob.glob(os.path.join(model_set, "**", "*.pmml"),
+                         recursive=True)
+        assert hits
+        xml = open(hits[0]).read()
+
+        reg = _registry(model_set)
+        n = 60
+        sub = raw_data.select_rows(np.arange(n))
+        rows = []
+        for i in range(n):
+            row = {}
+            for c in reg.input_columns:
+                row[c] = (None if sub.missing_mask(c)[i]
+                          else str(sub.column(c)[i]))
+            rows.append(row)
+        # synthetic edge rows: z-score CLAMP (huge magnitude numerics) and
+        # woe MapValues default routing (unseen category) must also agree
+        rows.append(dict(rows[0], num_0="1e9", num_1="-1e9"))
+        rows.append(dict(rows[1], cat_0="never-seen-category"))
+        rows.append({c: None for c in reg.input_columns})  # all missing
+
+        pmml_scores = eval_pmml_nn(xml, rows) * DEFAULT_SCORE_SCALE
+        recs = [{c: (v if v is not None else "") for c, v in r.items()}
+                for r in rows]
+        native = reg.score_records(recs)
+        np.testing.assert_allclose(pmml_scores,
+                                   native.model_scores[:, 0], atol=0.5)
+
+    def test_local_transformations_shapes(self, model_set):
+        """HYBRID export embeds BOTH transformation kinds: NormContinuous
+        (numeric z-score clamp) and MapValues over an InlineTable (woe)."""
+        import glob
+
+        from shifu_tpu.processor.export import ExportProcessor
+
+        assert ExportProcessor(model_set, kind="pmml").run() == 0
+        xml = open(glob.glob(os.path.join(model_set, "**", "*.pmml"),
+                             recursive=True)[0]).read()
+        root = ET.fromstring(xml)
+        lt = root.find(f"{NS}NeuralNetwork").find(
+            f"{NS}LocalTransformations")
+        kinds = {("nc" if df.find(f"{NS}NormContinuous") is not None
+                  else "mv" if df.find(f"{NS}MapValues") is not None
+                  else "other")
+                 for df in lt.findall(f"{NS}DerivedField")}
+        assert kinds == {"nc", "mv"}
+        # clamp anchors present on a numeric derived field
+        nc = lt.find(f"{NS}DerivedField/{NS}NormContinuous")
+        assert nc.get("outliers") == "asExtremeValues"
+        assert len(nc.findall(f"{NS}LinearNorm")) == 2

@@ -203,8 +203,12 @@ class TestBoundedMemoryPipeline:
             "shifu.ingest.chunkRows": "8192",
         })
         # warm jax/pandas before measuring so one-time import/compile
-        # allocations don't count against the ingest budget
+        # allocations don't count against the ingest budget (pandas and
+        # pyarrow alone allocate ~20 MB of module/code objects on first
+        # import — ingest cost zero of it is recurring)
         import jax.numpy as jnp
+        import pandas  # noqa: F401
+        import pyarrow  # noqa: F401
 
         (jnp.zeros((8, 8)) @ jnp.zeros((8, 8))).block_until_ready()
         tracemalloc.start()
