@@ -88,6 +88,7 @@ class Sanitizer:
         self.transfer_trips = 0
         self.nan_trips = 0
         self.recompile_breaches = 0
+        self.recompile_seconds = 0.0  # wall-clock of breached stages' compiles
         self.stages_armed = 0
         self.events: List[dict] = []
 
@@ -115,13 +116,17 @@ class Sanitizer:
         self._record("nan.trips", stage, detail)
         log.warning("sanitizer[nan] trap in %s: %s", stage, detail[:200])
 
-    def record_recompile_breach(self, stage: str, compiles: float) -> None:
+    def record_recompile_breach(self, stage: str, compiles: float,
+                                seconds: float = 0.0) -> None:
         self.recompile_breaches += 1
+        self.recompile_seconds += seconds
         self._record("recompile.breaches", stage,
-                     f"{compiles:.0f} compiles > budget {self.budget}")
+                     f"{compiles:.0f} compiles ({seconds:.2f}s wall-clock)"
+                     f" > budget {self.budget}")
         log.warning(
-            "sanitizer[recompile] budget breach in %s: %.0f compiles > "
-            "budget %d (shifu.sanitize.recompileBudget)", stage, compiles,
+            "sanitizer[recompile] budget breach in %s: %.0f compiles "
+            "costing %.2fs wall-clock > budget %d "
+            "(shifu.sanitize.recompileBudget)", stage, compiles, seconds,
             self.budget)
 
     # ---- arming
@@ -138,6 +143,7 @@ class Sanitizer:
             return
         self.stages_armed += 1
         compiles0 = self._compile_count()
+        seconds0 = self._compile_seconds()
         nan_cm = contextlib.nullcontext()
         if "nan" in self.modes:
             import jax
@@ -154,7 +160,11 @@ class Sanitizer:
             if "recompile" in self.modes:
                 delta = self._compile_count() - compiles0
                 if delta > self.budget:
-                    self.record_recompile_breach(stage, delta)
+                    # the jaxprobe duration events make the breach
+                    # actionable: N compiles AND the wall-clock they cost
+                    self.record_recompile_breach(
+                        stage, delta,
+                        self._compile_seconds() - seconds0)
 
     @contextlib.contextmanager
     def transfer_free(self, stage: str):
@@ -193,6 +203,7 @@ class Sanitizer:
                 "armed": "recompile" in self.modes,
                 "budgetPerStage": self.budget,
                 "breaches": self.recompile_breaches,
+                "breachedCompileSeconds": round(self.recompile_seconds, 3),
             },
             "events": self.events,
             "clean": not (self.transfer_trips or self.nan_trips
@@ -205,6 +216,13 @@ class Sanitizer:
 
         obs.install_jax_probes()
         return obs.registry().counter("jax.compiles").value
+
+    @staticmethod
+    def _compile_seconds() -> float:
+        from shifu_tpu import obs
+
+        obs.install_jax_probes()
+        return obs.registry().timer("jax.compile").seconds
 
 
 def from_environment() -> Sanitizer:

@@ -159,8 +159,48 @@ def build_parser() -> argparse.ArgumentParser:
                         help="show only the N most recent runs")
     p_runs.add_argument("--step", default=None,
                         help="filter by lifecycle step (stats/norm/train/...)")
+    p_runs.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                        help="diff two manifests' metric snapshots "
+                             "(counters/gauges); A/B are step-seq ids "
+                             "(train-3), step names (newest run), or "
+                             "manifest paths")
     p_runs.add_argument("--json", action="store_true", dest="as_json",
                         help="dump the selected manifests as JSON")
+
+    p_prof = sub.add_parser(
+        "profile", help="per-program XLA cost/roofline tables from "
+                        "run-ledger manifests; --diff gates on "
+                        "per-program regressions (exit 1 on breach)")
+    p_prof.add_argument("step", nargs="?", default=None,
+                        help="lifecycle step to show (default: all)")
+    p_prof.add_argument("--last", type=int, default=None,
+                        help="show only the N most recent runs "
+                             "(default 1 with a step, else 5)")
+    p_prof.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                        help="compare two runs program-by-program; A/B as "
+                             "in `shifu runs --diff`. Exit 1 when a "
+                             "per-dispatch cost metric regresses beyond "
+                             "its threshold")
+    p_prof.add_argument("--flops-pct", type=float, default=None,
+                        dest="flops_pct",
+                        help="max tolerated per-dispatch FLOPs increase %% "
+                             "(default 10; also -Dshifu.profile.diff."
+                             "flopsPct)")
+    p_prof.add_argument("--bytes-pct", type=float, default=None,
+                        dest="bytes_pct",
+                        help="max tolerated bytes-accessed increase %% "
+                             "(default 25)")
+    p_prof.add_argument("--hbm-pct", type=float, default=None,
+                        dest="hbm_pct",
+                        help="max tolerated peak-HBM increase %% "
+                             "(default 25)")
+    p_prof.add_argument("--seconds-pct", type=float, default=None,
+                        dest="seconds_pct",
+                        help="max tolerated device-seconds increase %% "
+                             "(default 0 = timing not gated)")
+    p_prof.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit profile sections (or the diff rows) "
+                             "as JSON")
 
     sub.add_parser("version", help="print version")
     return parser
@@ -316,11 +356,78 @@ def dispatch(args: argparse.Namespace) -> int:
 
         from shifu_tpu.obs.ledger import format_runs, list_runs
 
+        if args.diff:
+            from shifu_tpu.obs.profile import (
+                diff_metric_snapshots,
+                render_diff,
+                resolve_manifest,
+            )
+
+            try:
+                ma = resolve_manifest(".", args.diff[0])
+                mb = resolve_manifest(".", args.diff[1])
+            except (OSError, ValueError) as e:
+                log.error("runs --diff: %s", e)
+                return 2
+            rows = diff_metric_snapshots(ma, mb)
+            if args.as_json:
+                print(json.dumps(rows, indent=2, sort_keys=True))
+            else:
+                print(render_diff(
+                    f"metrics diff: {ma.get('step')}-{ma.get('seq')} -> "
+                    f"{mb.get('step')}-{mb.get('seq')}", rows))
+            return 0
         manifests = list_runs(".", last=args.last, step=args.step)
         if args.as_json:
             print(json.dumps(manifests, indent=2, sort_keys=True))
         else:
             print(format_runs(manifests))
+        return 0
+    if cmd == "profile":
+        import json
+
+        from shifu_tpu.obs.ledger import list_runs
+        from shifu_tpu.obs.profile import (
+            diff_profiles,
+            format_profile,
+            render_diff,
+            resolve_manifest,
+        )
+
+        if args.diff:
+            try:
+                ma = resolve_manifest(".", args.diff[0])
+                mb = resolve_manifest(".", args.diff[1])
+            except (OSError, ValueError) as e:
+                log.error("profile --diff: %s", e)
+                return 2
+            rows, breaches = diff_profiles(ma, mb, {
+                "flopsPct": args.flops_pct,
+                "bytesPct": args.bytes_pct,
+                "hbmPct": args.hbm_pct,
+                "secondsPct": args.seconds_pct,
+            })
+            if args.as_json:
+                print(json.dumps({"rows": rows, "breaches": breaches},
+                                 indent=2, sort_keys=True))
+            else:
+                print(render_diff(
+                    f"profile diff: {ma.get('step')}-{ma.get('seq')} -> "
+                    f"{mb.get('step')}-{mb.get('seq')}", rows, breaches))
+            return 1 if breaches else 0
+        last = args.last if args.last is not None else (
+            1 if args.step else 5)
+        manifests = list_runs(".", last=last, step=args.step)
+        if not manifests:
+            print("(no runs recorded under .shifu/runs)")
+            return 0
+        if args.as_json:
+            print(json.dumps(
+                [{"step": m.get("step"), "seq": m.get("seq"),
+                  "path": m.get("path"), "profile": m.get("profile")}
+                 for m in manifests], indent=2, sort_keys=True))
+        else:
+            print("\n\n".join(format_profile(m) for m in manifests))
         return 0
     if cmd in ("save", "switch", "show"):
         from shifu_tpu.processor.manage import ManageProcessor

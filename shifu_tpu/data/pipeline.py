@@ -264,11 +264,16 @@ class DeviceAccumulator:
             # sanitizer seam: both operands are already device-resident
             # (agg is a jit output), so the fold dispatch must not move
             # bytes; the only sanctioned transfer is _flush's explicit
-            # device_get (-Dshifu.sanitize=transfer)
+            # device_get (-Dshifu.sanitize=transfer). Profiled async
+            # (sync would reintroduce the per-chunk RTT wait this
+            # accumulator exists to remove).
             from shifu_tpu.analysis import sanitize
+            from shifu_tpu.obs import profile
 
             with sanitize.transfer_free("pipeline.device_fold"):
-                self._acc = _combine_program()(self._acc, agg)
+                self._acc = profile.dispatch(
+                    "pipeline.device_fold", _combine_program(),
+                    self._acc, agg, sync=False)
         self._rows += rows
 
     def fetch(self) -> Optional[List[np.ndarray]]:

@@ -233,10 +233,12 @@ class IndependentNNModel:
         if self._fwd is None:
             import jax
 
-            self._fwd = jax.jit(
+            from shifu_tpu.obs import profile
+
+            self._fwd = profile.wrap("nn.forward", jax.jit(
                 lambda inp: forward(
                     self.spec.params, inp, self.spec.activations,
                     self.spec.out_activation,
                 )
-            )
+            ), sync=True)
         return np.asarray(self._fwd(h))

@@ -438,7 +438,10 @@ def table_norm_traced(codes, tables):
 def _make_kernels():
     import jax
 
-    return jax.jit(value_norm_traced), jax.jit(table_norm_traced)
+    from shifu_tpu.obs import profile
+
+    return (profile.wrap("norm.value_kernel", jax.jit(value_norm_traced)),
+            profile.wrap("norm.table_kernel", jax.jit(table_norm_traced)))
 
 
 def _value_kernel_jit(*args):

@@ -20,16 +20,19 @@ from __future__ import annotations
 
 import threading
 
+from shifu_tpu.obs import profile as _profile
 from shifu_tpu.obs.ledger import RunLedger, format_runs, list_runs
 from shifu_tpu.obs.metrics import (
     MetricsRegistry,
     StageTimers,
     parse_prometheus,
 )
+from shifu_tpu.obs.profile import ProgramProfiler
 from shifu_tpu.obs.tracing import Tracer
 
 __all__ = [
     "MetricsRegistry",
+    "ProgramProfiler",
     "RunLedger",
     "StageTimers",
     "Tracer",
@@ -39,6 +42,7 @@ __all__ = [
     "install_jax_probes",
     "list_runs",
     "parse_prometheus",
+    "profiler",
     "registry",
     "reset",
     "span",
@@ -61,6 +65,12 @@ def tracer() -> Tracer:
     return _tracer
 
 
+def profiler() -> ProgramProfiler:
+    """The process-global program profiler (current step's scope) —
+    per-jit-program XLA cost accounting (obs/profile.py)."""
+    return _profile.profiler()
+
+
 def span(name: str, **attrs):
     """Open a span on the current global tracer (resolved at entry, so a
     registry/tracer reset between calls is transparent)."""
@@ -68,11 +78,14 @@ def span(name: str, **attrs):
 
 
 def reset() -> None:
-    """Fresh registry + tracer (step boundaries, bench scenarios, tests)."""
+    """Fresh registry + tracer + profiler scope (step boundaries, bench
+    scenarios, tests). The profiler's program-cost cache survives — the
+    compiled executables it mirrors do too."""
     global _registry, _tracer
     with _lock:
         _registry = MetricsRegistry()
         _tracer = Tracer()
+        _profile.reset()
 
 
 def begin_run() -> int:

@@ -19,14 +19,23 @@ import threading
 _installed = False
 _lock = threading.Lock()
 
-# event name -> (counter to inc, timer to accumulate); backend_compile is the
-# actual XLA compile, jaxpr_trace fires per cache-missing trace
+# event name -> (counter to inc, timer to accumulate, duration histogram);
+# backend_compile is the actual XLA compile, jaxpr_trace fires per
+# cache-missing trace. The histogram keeps PER-EVENT durations (not just
+# the aggregate the timer holds), so a manifest can show whether a step's
+# compile seconds were one monster program or a recompile storm of small
+# ones — and the sanitizer's recompile-watchdog breach can quote the
+# wall-clock the recompiles actually cost.
 _DURATION_EVENTS = {
     "/jax/core/compile/backend_compile_duration":
-        ("jax.compiles", "jax.compile"),
+        ("jax.compiles", "jax.compile", "jax.compile.duration_seconds"),
     "/jax/core/compile/jaxpr_trace_duration":
-        ("jax.traces", "jax.trace"),
+        ("jax.traces", "jax.trace", "jax.trace.duration_seconds"),
 }
+
+# exponential edges, 1 ms .. ~65 s: one XLA compile spans that whole
+# range depending on program size, so linear edges resolve nothing
+DURATION_BUCKETS = tuple(0.001 * 2 ** k for k in range(17)) + (float("inf"),)
 
 
 def install() -> bool:
@@ -52,6 +61,7 @@ def install() -> bool:
             reg = registry()
             reg.counter(hit[0]).inc()
             reg.timer(hit[1]).add(duration)
+            reg.histogram(hit[2], buckets=DURATION_BUCKETS).observe(duration)
 
         monitoring.register_event_duration_secs_listener(_on_duration)
         _installed = True

@@ -91,6 +91,7 @@ class RunLedger:
         argv: List[str],
         registry,
         tracer=None,
+        profile: Optional[dict] = None,
         error: Optional[str] = None,
         extra: Optional[dict] = None,
     ) -> str:
@@ -124,6 +125,7 @@ class RunLedger:
             },
             "jax": jax_runtime_info(),
             "metrics": registry.snapshot(),
+            "profile": profile,
             "tracePath": trace_rel,
         }
         if extra:

@@ -73,6 +73,17 @@ def bin_aggregate(
 
 bin_aggregate_jit = jax.jit(bin_aggregate, static_argnames=("total_slots",))
 
+# profiled seam for the stats engine (in-RAM pass 2 + streamed chunks):
+# same program, with per-dispatch FLOPs/bytes accounting in the obs scope.
+# Async — streamed chunks fold into the DeviceAccumulator without a
+# per-chunk wait. `bin_aggregate_jit` itself stays raw for direct/test use
+# (tests probe its _cache_size underneath this wrapper).
+from shifu_tpu.obs.profile import wrap as _profile_wrap  # noqa: E402
+
+bin_aggregate_profiled = _profile_wrap(
+    "stats.bin_aggregate", bin_aggregate_jit, sync=False,
+    static_argnums=(2,), static_argnames=("total_slots",))
+
 
 def bin_aggregate_sharded(
     mesh: Mesh,

@@ -71,11 +71,15 @@ class BasicProcessor:
     # ---- run wrapper: ledger manifest, metrics/tracing scope, profiling ----
     def run(self) -> int:
         """Run the step inside the observability envelope: a fresh
-        metrics/tracing scope (outermost run only), a root span, optional
-        jax.profiler trace (-Dshifu.profile=<dir>), and — success OR
-        failure — a sequence-numbered run manifest under
+        metrics/tracing/profiler scope (outermost run only), a root span,
+        optional deep XLA capture (-Dshifu.profile=xla traces the step
+        with jax.profiler into the ledger dir and links the Perfetto
+        trace from the manifest; -Dshifu.profile=<dir> keeps the
+        explicit-directory behavior), and — success OR failure — a
+        sequence-numbered run manifest under
         <root>/.shifu/runs/<step>-<seq>.json carrying the registry
-        snapshot, trace path, config hashes and exit status
+        snapshot, the per-program cost/roofline `profile` section
+        (obs/profile.py), trace path, config hashes and exit status
         (obs/ledger.py). Exceptions re-raise after the manifest lands.
 
         -Dshifu.sanitize=transfer,nan,recompile additionally arms the
@@ -104,17 +108,17 @@ class BasicProcessor:
             ledger = RunLedger(self.root)
             seq = ledger.next_seq(self.step)
             log.info("Step %s starts.", self.step)
-            profile_dir = self._profile_dir()
+            profile_dir = self._profile_dir(ledger, seq)
             try:
                 with obs.span(f"step.{self.step}", seq=seq), \
                         sanitize.activate(san), \
                         san.armed(f"step.{self.step}"):
                     if profile_dir:
-                        # -Dshifu.profile=<dir>: wrap the step in a
-                        # jax.profiler trace (the TPU answer to the
-                        # reference's per-phase wall-clock logging + JMap
+                        # deep capture: wrap the step in a jax.profiler
+                        # trace (the TPU answer to the reference's
+                        # per-phase wall-clock logging + JMap
                         # introspection, SURVEY §5); inspect with
-                        # TensorBoard or xprof
+                        # TensorBoard/xprof/Perfetto
                         import jax
 
                         os.makedirs(profile_dir, exist_ok=True)
@@ -135,8 +139,16 @@ class BasicProcessor:
                 extra = {}
                 if profile_dir:
                     extra["profileDir"] = profile_dir
+                    trace_file = self._find_xla_trace(profile_dir)
+                    if trace_file:
+                        extra["perfettoTrace"] = trace_file
                 if san.active:
                     extra["sanitizer"] = san.verdict()
+                try:
+                    profile_snap = obs.profiler().snapshot()
+                except Exception as pe:  # pragma: no cover - defensive
+                    log.warning("cannot snapshot profiler: %s", pe)
+                    profile_snap = None
                 try:
                     path = ledger.write(
                         self.step, seq,
@@ -147,6 +159,7 @@ class BasicProcessor:
                         argv=list(sys.argv),
                         registry=reg,
                         tracer=obs.tracer(),
+                        profile=profile_snap,
                         error=error,
                         extra=extra or None,
                     )
@@ -158,13 +171,33 @@ class BasicProcessor:
             obs.end_run()
         return 0
 
-    def _profile_dir(self):
+    def _profile_dir(self, ledger=None, seq=None):
         from shifu_tpu.utils import environment
 
         d = environment.get_property("shifu.profile", "")
         if not d:
             return None
+        if d.strip().lower() == "xla" and ledger is not None:
+            # -Dshifu.profile=xla: deep capture lands beside the run's
+            # manifest, so `shifu profile` output and the Perfetto trace
+            # share one ledger entry
+            return os.path.join(ledger.dir, f"{self.step}-{seq}-xla")
         return os.path.join(self.resolve(d), self.step)
+
+    @staticmethod
+    def _find_xla_trace(profile_dir: str):
+        """Newest Perfetto/Chrome trace file the jax profiler wrote under
+        `profile_dir` (plugins/profile/<ts>/*.trace.json.gz), if any."""
+        import glob
+
+        hits = sorted(
+            glob.glob(os.path.join(profile_dir, "**", "*.trace.json.gz"),
+                      recursive=True)
+            + glob.glob(os.path.join(profile_dir, "**", "*.trace.json"),
+                        recursive=True),
+            key=os.path.getmtime,
+        )
+        return hits[-1] if hits else None
 
     def run_step(self) -> None:  # pragma: no cover - abstract
         raise NotImplementedError

@@ -40,6 +40,17 @@ log = get_logger(__name__)
 DEFAULT_MAX_BATCH_ROWS = 1024
 DEFAULT_MAX_WAIT_MS = 2.0
 
+# Exponential histogram edges, pinned (tests/test_serve.py). The metrics
+# registry's DEFAULT_BUCKETS start at 5 ms — useless for a path whose p99
+# is single-digit milliseconds: every observation landed in the first two
+# buckets and the exported quantiles collapsed. Doubling edges from 100 µs
+# give ~equal relative resolution from sub-ms latencies to multi-second
+# stalls.
+LATENCY_BUCKETS = tuple(0.0001 * 2 ** k for k in range(16)) + (float("inf"),)
+# batch sizes are power-of-two-ish by construction (row buckets), so the
+# edges are exact powers of two up to the 8192 cap ambit
+BATCH_ROWS_BUCKETS = tuple(float(2 ** k) for k in range(14)) + (float("inf"),)
+
 
 def max_batch_rows_setting() -> int:
     return environment.get_int("shifu.serve.maxBatchRows",
@@ -175,9 +186,7 @@ class MicroBatcher:
             rows = sum(r.n_rows for r in batch)
             reg.counter("serve.batches").inc()
             reg.histogram(
-                "serve.batch.rows",
-                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
-                         float("inf")),
+                "serve.batch.rows", buckets=BATCH_ROWS_BUCKETS,
             ).observe(rows)
             try:
                 with reg.timer("serve.batch.score").time():
@@ -192,7 +201,8 @@ class MicroBatcher:
                 continue
             off = 0
             now = time.perf_counter()
-            lat = reg.histogram("serve.latency_seconds")
+            lat = reg.histogram("serve.latency_seconds",
+                                buckets=LATENCY_BUCKETS)
             for r in batch:
                 r.resolve(_slice_result(result, off, off + r.n_rows))
                 off += r.n_rows

@@ -404,10 +404,15 @@ class ModelRegistry:
                 len(self._warm_buckets))
         # the hot seam: inputs staged with ONE explicit device_put, then
         # the fused dispatch must move no other bytes
-        # (-Dshifu.sanitize=transfer)
+        # (-Dshifu.sanitize=transfer). Profiled sync: the device_get
+        # below blocks on the result anyway, so the wait costs nothing
+        # and serve manifests get real per-batch device seconds.
+        from shifu_tpu.obs import profile
+
         dev_inputs = jax.device_put(tuple(plan_inputs))
         with sanitize.transfer_free("serve.score"):
-            out = self._program(dev_inputs)
+            out = profile.dispatch("serve.fused_score", self._program,
+                                   dev_inputs, sync=True)
         m, mean, mx, mn, med = jax.device_get(out)
         reg.counter("serve.score.rows").inc(n)
         return ScoreResult(

@@ -274,6 +274,11 @@ class ScoringServer:
 
         ledger = RunLedger(self.root)
         try:
+            try:
+                profile_snap = obs.profiler().snapshot()
+            except Exception as pe:  # pragma: no cover - defensive
+                log.warning("cannot snapshot profiler: %s", pe)
+                profile_snap = None
             seq = ledger.next_seq("serve")
             path = ledger.write(
                 "serve", seq,
@@ -284,6 +289,7 @@ class ScoringServer:
                 argv=list(sys.argv),
                 registry=obs.registry(),
                 tracer=obs.tracer(),
+                profile=profile_snap,
                 extra={"serve": self.registry.snapshot()},
             )
             log.info("serve manifest -> %s", path)

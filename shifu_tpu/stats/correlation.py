@@ -74,7 +74,10 @@ def column_correlation(
     x, names = feature_matrix(data, columns)
     if not names:
         return np.zeros((0, 0)), []
-    return np.asarray(_corr_matrix(jnp.asarray(x))), names
+    from shifu_tpu.obs import profile
+
+    return np.asarray(profile.dispatch("stats.correlation", _corr_matrix,
+                                       jnp.asarray(x))), names
 
 
 @jax.jit
@@ -89,6 +92,13 @@ def _corr_moments(x: jax.Array):
     sq_x = (x0 * x0).T @ mask
     cross = x0.T @ x0
     return n_pair, s_x, sq_x, cross
+
+
+# profiled seam for the streamed path; async like every chunked consumer
+from shifu_tpu.obs.profile import wrap as _profile_wrap  # noqa: E402
+
+_profiled_moments = _profile_wrap("stats.correlation_moments",
+                                  _corr_moments, sync=False)
 
 
 class StreamingCorrelation:
@@ -118,7 +128,8 @@ class StreamingCorrelation:
                 shift = np.nanmean(x.astype(np.float64), axis=0)
             self._shift = np.nan_to_num(shift, nan=0.0).astype(np.float32)
         part = [np.asarray(a, dtype=np.float64)
-                for a in _corr_moments(jnp.asarray(x - self._shift[None, :]))]
+                for a in _profiled_moments(
+                    jnp.asarray(x - self._shift[None, :]))]
         if self._acc is None:
             self._acc = part
         else:

@@ -18,7 +18,7 @@ from shifu_tpu.config import ColumnConfig, ColumnType
 from shifu_tpu.config.model_config import ModelConfig
 from shifu_tpu.data.purify import combined_mask
 from shifu_tpu.data.reader import ColumnarData, make_tags, make_weights
-from shifu_tpu.ops.binagg import bin_aggregate_jit
+from shifu_tpu.ops.binagg import bin_aggregate_profiled
 from shifu_tpu.stats.binning import (
     categorical_bin_index,
     categorical_bins,
@@ -196,7 +196,7 @@ def compute_stats(
         total_slots = int(sum(slots))
         import jax.numpy as jnp
 
-        agg = bin_aggregate_jit(
+        agg = bin_aggregate_profiled(
             jnp.asarray(codes),
             jnp.asarray(col_offsets),
             total_slots,
@@ -538,7 +538,7 @@ def compute_stats_streaming(
              col_offsets, slots, numeric_cols) = item
             n_chunks += 1
             with timers.timer("device"):
-                acc_dev.add(bin_aggregate_jit(
+                acc_dev.add(bin_aggregate_profiled(
                     jnp.asarray(codes),
                     jnp.asarray(col_offsets),
                     int(sum(slots)),

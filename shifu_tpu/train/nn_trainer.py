@@ -40,6 +40,7 @@ from shifu_tpu.models.nn import (
     init_params,
     unflatten_params,
 )
+from shifu_tpu.obs import profile
 from shifu_tpu.train.updaters import make_updater
 from shifu_tpu.utils.log import get_logger
 
@@ -478,16 +479,20 @@ def train_nn(
     def run_until(carry, limit):
         # sanitizer seam: every operand is device-resident by here (the
         # scalar conversion included), so the program dispatch itself
-        # must be transfer-free (-Dshifu.sanitize=transfer)
+        # must be transfer-free (-Dshifu.sanitize=transfer). Profiled
+        # sync (the caller pulls scalars right after anyway); the
+        # enclosing scaled() context credits one loop body per epoch.
         limit_j = jnp.int32(limit)
         with sanitize.transfer_free("nn.program"):
-            return program(carry, limit_j, x, t, sig_train, sig_valid,
-                           key0, nts)
+            return profile.dispatch(
+                "nn.train_program", program, carry, limit_j, x, t,
+                sig_train, sig_valid, key0, nts, sync=True)
 
     if cfg.checkpoint_every and cfg.checkpoint_every > 0:
         result = _run_with_checkpoints(run_until, carry0, cfg, max_iters)
     else:
-        result = run_until(carry0, max_iters)
+        with profile.scaled(max_iters):
+            result = run_until(carry0, max_iters)
 
     (flat_f, _, it_f, _, best_val, best_flat, _, _, tr_e, va_e) = result
     # ONE host round-trip for all scalars (serial float()/int() casts each
@@ -674,8 +679,11 @@ def train_nn_bagged(
     max_iters = base_cfg.num_epochs
 
     def run_until(carry, limit):
-        return program_b(carry, jnp.int32(limit), x, t, sig_t, sig_v, keys,
-                         nts_j)
+        # the vmapped program's cost analysis already covers all M
+        # members per loop body, so scaled() credits epochs only
+        return profile.dispatch(
+            "nn.train_program_bagged", program_b, carry, jnp.int32(limit),
+            x, t, sig_t, sig_v, keys, nts_j, sync=True)
 
     if base_cfg.checkpoint_every and base_cfg.checkpoint_every > 0:
         # segmented run: per-member checkpoints + progress between segments
@@ -685,7 +693,8 @@ def train_nn_bagged(
         last_reported = [-1] * M
         while it < max_iters:
             limit = min(it + base_cfg.checkpoint_every, max_iters)
-            carry = run_until(carry, limit)
+            with profile.scaled(limit - it):
+                carry = run_until(carry, limit)
             it = int(np.asarray(carry[2]).max())
             trs, vas = np.asarray(carry[8]), np.asarray(carry[9])
             its = np.asarray(carry[2])
@@ -704,7 +713,8 @@ def train_nn_bagged(
                 break
         out = carry
     else:
-        out = run_until(carry0, max_iters)
+        with profile.scaled(max_iters):
+            out = run_until(carry0, max_iters)
     (flat_f, _, it_f, _, best_val, best_flat, _, _, tr_e, va_e) = out
 
     results = []
@@ -746,7 +756,8 @@ def _run_with_checkpoints(run_until, carry, cfg, max_iters):
     it = 0
     while it < max_iters:
         limit = min(it + every, max_iters)
-        carry = run_until(carry, jnp.int32(limit))
+        with profile.scaled(limit - it):  # loop bodies this segment runs
+            carry = run_until(carry, jnp.int32(limit))
         it = int(carry[2])
         tr, va = float(carry[8]), float(carry[9])
         if cfg.progress_cb:

@@ -29,6 +29,7 @@ import numpy as np
 
 from shifu_tpu.analysis import sanitize
 from shifu_tpu.norm.dataset import NormMeta, read_meta
+from shifu_tpu.obs import profile
 from shifu_tpu.train.nn_trainer import NNTrainConfig, TrainResult, _loss_and_errors
 from shifu_tpu.train.updaters import make_updater
 from shifu_tpu.models.nn import flatten_params, init_params, unflatten_params
@@ -265,10 +266,13 @@ def train_nn_streamed(
             key_s = jax.random.fold_in(key, s)
             # sanitizer seam: the shard feed device_put its arrays
             # explicitly, so the gradient dispatch must be transfer-free
-            # (-Dshifu.sanitize=transfer, analysis/sanitize.py)
+            # (-Dshifu.sanitize=transfer, analysis/sanitize.py). Profiled
+            # async: shard s+1's host load overlaps shard s's gradient,
+            # so a per-shard wait here would serialize the feed.
             with sanitize.transfer_free("nn.shard_grad"):
-                g, trs, vas, trw, vaw = shard_grad(flat, x, t, sig_t,
-                                                   sig_v, key_s, tclass)
+                g, trs, vas, trw, vaw = profile.dispatch(
+                    "nn.shard_grad", shard_grad, flat, x, t, sig_t,
+                    sig_v, key_s, tclass, sync=False)
             if g_sum is None:
                 g_sum, tr_sum, va_sum, tr_w, va_w = g, trs, vas, trw, vaw
             else:

@@ -433,20 +433,26 @@ def train_trees_streamed(
         })
         offset += rows
 
+    from shifu_tpu.obs import profile
+
     @jax.jit
-    def shard_errors(score, y, valid, real):
+    def _shard_errors(score, y, valid, real):
         sq = (y - score) ** 2
         v = jnp.sum(jnp.where(valid & real, sq, 0.0))
         t = jnp.sum(jnp.where((~valid) & real, sq, 0.0))
         return t, v, jnp.sum((valid & real).astype(jnp.float32))
 
     @jax.jit
-    def shard_cls_errors(votes, y, valid, real):
+    def _shard_cls_errors(votes, y, valid, real):
         pred_class = jnp.argmax(votes, axis=1).astype(jnp.float32)
         err = (pred_class != y).astype(jnp.float32)
         v = jnp.sum(jnp.where(valid & real, err, 0.0))
         t = jnp.sum(jnp.where((~valid) & real, err, 0.0))
         return t, v, jnp.sum((valid & real).astype(jnp.float32))
+
+    shard_errors = profile.wrap("tree.shard_errors", _shard_errors)
+    shard_cls_errors = profile.wrap("tree.shard_cls_errors",
+                                    _shard_cls_errors)
 
     trees: List[DenseTree] = []
     valid_errors: List[float] = []

@@ -26,6 +26,7 @@ from shifu_tpu.models.wdl import (
     wdl_shapes,
 )
 from shifu_tpu.norm.dataset import read_meta
+from shifu_tpu.obs import profile
 from shifu_tpu.train.updaters import make_updater
 from shifu_tpu.train.wdl_trainer import WDLTrainConfig, WDLTrainResult
 from shifu_tpu.utils.log import get_logger
@@ -209,8 +210,9 @@ def train_wdl_streamed(
     for it in range(cfg.num_epochs):
         g_sum = tr_sum = va_sum = tr_w = va_w = None
         for (dense, codes, t, sig_t, sig_v) in feed:
-            g, trs, vas, trw, vaw = shard_grad(flat, dense, codes, t,
-                                               sig_t, sig_v)
+            g, trs, vas, trw, vaw = profile.dispatch(
+                "wdl.shard_grad", shard_grad, flat, dense, codes, t,
+                sig_t, sig_v, sync=False)
             if g_sum is None:
                 g_sum, tr_sum, va_sum, tr_w, va_w = g, trs, vas, trw, vaw
             else:

@@ -64,6 +64,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from shifu_tpu.obs import profile
+
 from shifu_tpu.models.tree import DenseTree, TreeModelSpec
 from shifu_tpu.utils.log import get_logger
 
@@ -437,7 +439,7 @@ def _get_m_builder(lay: FeatureLayout):
     key = ("mbuild", lay.key)
     prog = _PROGRAMS.get(key)
     if prog is None:
-        prog = _make_m_builder(lay)
+        prog = profile.wrap("tree.m_builder", _make_m_builder(lay))
         _PROGRAMS[key] = prog
     return prog
 
@@ -601,6 +603,7 @@ def _get_hist_program(L: int, lay: FeatureLayout,
         prog = jax.jit(shard_map_compat(
             meshed, mesh=mesh, in_specs=(rspec,) * 5 + (P(),) * 4,
             out_specs=P()))
+    prog = profile.wrap("tree.hist", prog)
     _PROGRAMS[key] = prog
     return prog
 
@@ -616,8 +619,10 @@ def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
     import jax.numpy as jnp
 
     if n_classes >= 3:
-        prog = jax.jit(_make_cls_scan(L, T, s_max, impurity, min_inst,
-                                      min_gain, n_classes))
+        prog = profile.wrap(
+            "tree.split_scan",
+            jax.jit(_make_cls_scan(L, T, s_max, impurity, min_inst,
+                                   min_gain, n_classes)))
         _PROGRAMS[key] = prog
         return prog
 
@@ -736,8 +741,9 @@ def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
         return (feature, cut_rank, rank_flat, leaf_value, is_split,
                 best_gain, left_mask, node_cnt, left_cnt)
 
-    _PROGRAMS[key] = split_scan
-    return split_scan
+    prog = profile.wrap("tree.split_scan", split_scan)
+    _PROGRAMS[key] = prog
+    return prog
 
 
 def _make_cls_scan(L: int, T: int, s_max: int, impurity: str, min_inst: int,
@@ -862,8 +868,9 @@ def _get_update_program(L: int, T: int):
         still = is_split[nl] & active
         return resting2, jnp.where(still, new_local, 0), still
 
-    _PROGRAMS[key] = row_update
-    return row_update
+    prog = profile.wrap("tree.row_update", row_update)
+    _PROGRAMS[key] = prog
+    return prog
 
 
 def _node_batch_size(T: int, max_stats_memory_mb: int,
@@ -967,8 +974,9 @@ def _get_derive_program():
         acc = jnp.stack([lh, rh], axis=2).reshape(C, 2 * Lh, T)
         return acc.astype(jnp.float32), acc
 
-    _PROGRAMS[key] = derive
-    return derive
+    prog = profile.wrap("tree.hist_derive", derive)
+    _PROGRAMS[key] = prog
+    return prog
 
 
 def _sub_row_masks(node, active, left_small):
@@ -1261,6 +1269,7 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
         prog = jax.jit(body)
     else:
         prog = jax.jit(tree_body)
+    prog = profile.wrap("tree.whole_tree", prog)
     _PROGRAMS[key] = prog
     return prog
 
@@ -1697,8 +1706,9 @@ def _get_errors_program():
             jnp.sum(tsel), 1.0)
         return t, v
 
-    _PROGRAMS[key] = errors_of
-    return errors_of
+    prog = profile.wrap("tree.errors", errors_of)
+    _PROGRAMS[key] = prog
+    return prog
 
 
 def _get_cls_errors_program():
@@ -1721,8 +1731,9 @@ def _get_cls_errors_program():
              / jnp.maximum(jnp.sum(tsel), 1.0))
         return t, v
 
-    _PROGRAMS[key] = cls_errors_of
-    return cls_errors_of
+    prog = profile.wrap("tree.errors", cls_errors_of)
+    _PROGRAMS[key] = prog
+    return prog
 
 
 def _score_existing(trees: List[DenseTree], codes) -> "object":

@@ -24,6 +24,7 @@ from shifu_tpu.models.wdl import (
     wdl_forward,
     wdl_shapes,
 )
+from shifu_tpu.obs import profile
 from shifu_tpu.train.updaters import make_updater
 from shifu_tpu.utils.log import get_logger
 
@@ -242,14 +243,17 @@ def train_wdl(
     )
 
     def run_until(cr, limit):
-        return program(cr, jnp.int32(limit), d, c, t, sig_tr, sig_va,
-                       jnp.float32(nts), jnp.float32(cfg.learning_rate))
+        return profile.dispatch(
+            "wdl.train_program", program, cr, jnp.int32(limit), d, c, t,
+            sig_tr, sig_va, jnp.float32(nts),
+            jnp.float32(cfg.learning_rate), sync=True)
 
     if cfg.checkpoint_every and cfg.checkpoint_every > 0:
         it = 0
         while it < cfg.num_epochs:
-            carry = run_until(carry, min(it + cfg.checkpoint_every,
-                                         cfg.num_epochs))
+            limit = min(it + cfg.checkpoint_every, cfg.num_epochs)
+            with profile.scaled(limit - it):
+                carry = run_until(carry, limit)
             it = int(carry[2])
             if cfg.progress_cb:
                 cfg.progress_cb(it, float(carry[7]), float(carry[8]))
@@ -259,7 +263,8 @@ def train_wdl(
                 break
         result = carry
     else:
-        result = run_until(carry, cfg.num_epochs)
+        with profile.scaled(cfg.num_epochs):
+            result = run_until(carry, cfg.num_epochs)
     (flat_f, _, it_f, best_val, best_flat, _, _, tr_e, va_e) = result
     import math as _math
 
@@ -393,15 +398,20 @@ def train_wdl_bagged(
            else jnp.full(M, base_cfg.learning_rate, jnp.float32))
 
     def run_until(cr, limit):
-        return program_b(cr, jnp.int32(limit), d, c, t, sig_t, sig_v,
-                         nts_j, lrs)
+        # the vmapped program's cost analysis covers all M members per
+        # loop body already, so scaled() credits epochs only
+        return profile.dispatch(
+            "wdl.train_program_bagged", program_b, cr, jnp.int32(limit),
+            d, c, t, sig_t, sig_v, nts_j, lrs, sync=True)
 
     if base_cfg.checkpoint_every and base_cfg.checkpoint_every > 0:
         it = 0
         last_reported = [-1] * M
         while it < base_cfg.num_epochs:
-            carry = run_until(carry, min(it + base_cfg.checkpoint_every,
-                                         base_cfg.num_epochs))
+            limit = min(it + base_cfg.checkpoint_every,
+                        base_cfg.num_epochs)
+            with profile.scaled(limit - it):
+                carry = run_until(carry, limit)
             it = int(np.asarray(carry[2]).max())
             its = np.asarray(carry[2])
             trs, vas = np.asarray(carry[7]), np.asarray(carry[8])
@@ -420,7 +430,8 @@ def train_wdl_bagged(
                 break
         out = carry
     else:
-        out = run_until(carry, base_cfg.num_epochs)
+        with profile.scaled(base_cfg.num_epochs):
+            out = run_until(carry, base_cfg.num_epochs)
     (flat_f, _, it_f, best_val, best_flat, _, _, tr_e, va_e) = out
 
     import math as _math

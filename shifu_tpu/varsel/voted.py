@@ -142,7 +142,12 @@ def _get_eval_program(d: int, hidden_nodes: tuple, activations: tuple,
         sq = (t - p) ** 2
         return jnp.sum(sig_va * sq) / jnp.maximum(jnp.sum(sig_va), 1.0)
 
-    prog = jax.jit(jax.vmap(train_one, in_axes=(0, 0, None, None, None, None)))
+    from shifu_tpu.obs import profile
+
+    prog = profile.wrap(
+        "varsel.vmap_train",
+        jax.jit(jax.vmap(train_one, in_axes=(0, 0, None, None, None, None))),
+        sync=True)
     _PROGRAMS[key] = (prog, n_total)
     return _PROGRAMS[key]
 

@@ -625,3 +625,62 @@ class TestPmmlServeParity:
         nc = lt.find(f"{NS}DerivedField/{NS}NormContinuous")
         assert nc.get("outliers") == "asExtremeValues"
         assert len(nc.findall(f"{NS}LinearNorm")) == 2
+
+
+class TestLatencyHistogramBuckets:
+    """ISSUE-6 satellite: the serve latency/batch-rows histograms use
+    PINNED exponential buckets. The registry's DEFAULT_BUCKETS start at
+    5 ms, so a fused path whose p99 is single-digit milliseconds exported
+    every observation into its first two buckets — the Prometheus
+    quantiles collapsed. Doubling edges from 100 µs resolve the whole
+    sub-ms..seconds range at constant relative error."""
+
+    def test_bucket_edges_pinned(self):
+        from shifu_tpu.serve.batcher import (
+            BATCH_ROWS_BUCKETS,
+            LATENCY_BUCKETS,
+        )
+
+        assert LATENCY_BUCKETS[0] == pytest.approx(1e-4)
+        assert LATENCY_BUCKETS[-1] == float("inf")
+        finite = LATENCY_BUCKETS[:-1]
+        assert len(finite) == 16
+        for lo, hi in zip(finite[:-1], finite[1:]):
+            assert hi == pytest.approx(2 * lo)  # exponential, base 2
+        # ms-scale latencies land in distinct buckets (the old default
+        # linearish edges put 1ms and 4ms in the same first bucket)
+        import bisect
+
+        assert (bisect.bisect_left(finite, 0.001)
+                != bisect.bisect_left(finite, 0.004))
+        assert BATCH_ROWS_BUCKETS[0] == 1.0
+        assert BATCH_ROWS_BUCKETS[-1] == float("inf")
+        assert list(BATCH_ROWS_BUCKETS[:-1]) == [
+            float(2 ** k) for k in range(14)]
+
+    def test_batcher_observes_into_pinned_buckets(self, model_set):
+        from shifu_tpu import obs
+        from shifu_tpu.serve.batcher import LATENCY_BUCKETS, MicroBatcher
+        from shifu_tpu.serve.queue import AdmissionQueue
+        from shifu_tpu.serve.registry import ModelRegistry
+
+        obs.reset()
+        registry = ModelRegistry(os.path.join(model_set, "models"))
+        admission = AdmissionQueue(16)
+        batcher = MicroBatcher(registry.score_raw, admission,
+                               max_batch_rows=8, max_wait_ms=1)
+        rec = {c: "0.1" for c in registry.input_columns}
+        from shifu_tpu.serve.registry import records_to_columnar
+
+        req = batcher.submit(records_to_columnar([rec],
+                                                 registry.input_columns))
+        req.wait(30)
+        admission.close()
+        batcher.join(10)
+        snap = obs.registry().snapshot()["histograms"]
+        lat = snap["serve.latency_seconds"]
+        want = ["inf" if b == float("inf") else b for b in LATENCY_BUCKETS]
+        assert lat["buckets"] == want
+        assert lat["count"] == 1
+        rows = snap["serve.batch.rows"]
+        assert rows["buckets"][:3] == [1.0, 2.0, 4.0]
