@@ -701,14 +701,21 @@ def bench_streamed_stats(reps: int):
     factory = chunk_source(data_path, names, delimiter="|",
                            chunk_rows=spec["chunk_rows"])
 
-    def run(prefetch: int):
+    def run(prefetch: int, ckpt_root=None):
         environment.set_property("shifu.ingest.prefetchChunks",
                                  str(prefetch))
-        compute_stats_streaming(mc, fresh_cols(), factory)
+        compute_stats_streaming(mc, fresh_cols(), factory,
+                                checkpoint_root=ckpt_root)
 
+    # checkpointing-on pass: default cadence snapshots into a scratch
+    # ledger dir; the on/off wall-clock ratio is the overhead the
+    # preemption-safety layer costs (acceptance target <= 1.05x)
+    ck_root = os.path.join(tmp, "ckroot")
     try:
         run(2)  # warmup: compiles the bucketed shapes both modes share
         med_s, lo_s, hi_s = _median_timed(lambda: run(0), reps)
+        med_c, lo_c, hi_c = _median_timed(
+            lambda: run(2, ckpt_root=ck_root), reps)
         med_p, lo_p, hi_p, prof = _median_timed_profiled(
             lambda: run(2), reps)
     finally:
@@ -718,6 +725,8 @@ def bench_streamed_stats(reps: int):
         "rows_per_s": n / med_p,
         "serial_rows_per_s": n / med_s,
         "prefetch_speedup": med_s / med_p,
+        "checkpoint_overhead": med_c / med_p,
+        "ckpt_rows_per_s": n / med_c,
         "spread": [round(n / hi_p, 1), round(n / lo_p, 1)],
         "profile": prof,
     }
@@ -970,6 +979,10 @@ def main() -> None:
                 streamed_stats["serial_rows_per_s"], 1),
             "prefetch_speedup": round(
                 streamed_stats["prefetch_speedup"], 3),
+            "checkpoint_overhead": round(
+                streamed_stats["checkpoint_overhead"], 3),
+            "ckpt_rows_per_s": round(
+                streamed_stats["ckpt_rows_per_s"], 1),
             "spread": streamed_stats["spread"],
             "profile": streamed_stats.get("profile"),
             "metrics": streamed_stats.get("metrics"),

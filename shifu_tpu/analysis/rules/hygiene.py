@@ -7,6 +7,7 @@ points that silently ignore the chunk/prefetch plumbing).
 from __future__ import annotations
 
 import ast
+import os
 import re
 from typing import Iterator
 
@@ -121,6 +122,85 @@ class MutableDefaultArg(Rule):
                         f"mutable default for `{param.arg}` of `{name}` "
                         f"is shared across calls — default to None and "
                         f"construct inside")
+
+
+_CKPT_PATH_RE = re.compile(r"ckpt|checkpoint|manifest", re.IGNORECASE)
+_WRITE_MODES = {"w", "wb", "w+", "wb+"}
+# the one module allowed to open checkpoint paths directly: it IS the
+# atomic-write helper
+_ATOMIC_HELPER = os.path.join("resilience", "checkpoint.py")
+
+
+@register
+class NonAtomicCheckpointWrite(Rule):
+    """SH104 — torn-file-prone checkpoint write / jitterless retry sleep.
+
+    bad:  np.save(cfg.checkpoint_path, w)      # kill mid-write = torn file
+    bad:  open(manifest_path, "w")             # same failure mode
+    good: resilience.checkpoint.atomic_save_npy / atomic_write_json /
+          atomic_write (temp file + os.replace in the same directory).
+
+    bad:  while True:
+              try: fetch()
+              except OSError: time.sleep(1)    # fixed sleep: herd + no cap
+    good: resilience.retry.retry_call (exponential backoff, full jitter,
+          bounded budget) — or any computed, non-constant delay.
+    """
+
+    id = "SH104"
+    severity = "error"
+    summary = ("non-atomic write to a checkpoint/manifest-like path "
+               "(error) / constant time.sleep in a retry loop (warning)")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        if module.path.endswith(_ATOMIC_HELPER):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in ("np.save", "numpy.save") and node.args:
+                target = module.segment(node.args[0])
+                if _CKPT_PATH_RE.search(target):
+                    yield self.finding(
+                        module, node,
+                        f"np.save to checkpoint-like path `{target}` can "
+                        f"leave a torn file on kill — use "
+                        f"resilience.checkpoint.atomic_save_npy")
+            elif name == "open" and len(node.args) >= 2:
+                mode = node.args[1]
+                if not (isinstance(mode, ast.Constant)
+                        and isinstance(mode.value, str)
+                        and mode.value in _WRITE_MODES):
+                    continue
+                target = module.segment(node.args[0])
+                if _CKPT_PATH_RE.search(target):
+                    yield self.finding(
+                        module, node,
+                        f"direct open(..., \"{mode.value}\") write to "
+                        f"checkpoint/manifest-like path `{target}` — use "
+                        f"resilience.checkpoint.atomic_write/"
+                        f"atomic_write_json")
+            elif name == "time.sleep" and node.args:
+                arg = node.args[0]
+                if not (isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, (int, float))):
+                    continue  # computed delay: assume backoff/jitter
+                in_retry_loop = False
+                for anc in module.ancestors(node):
+                    if isinstance(anc, (ast.For, ast.While)):
+                        in_retry_loop = any(
+                            isinstance(n, ast.ExceptHandler)
+                            for n in ast.walk(anc))
+                        break
+                if in_retry_loop:
+                    yield self.finding(
+                        module, node,
+                        "constant time.sleep in a retry loop — no "
+                        "backoff, no jitter (thundering herd on shared "
+                        "backends); use resilience.retry.retry_call",
+                        severity="warning")
 
 
 _STREAM_ENTRY_RE = re.compile(r"(_streamed|_streaming)$|^stream_")

@@ -46,13 +46,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     sub.add_parser("init", help="initialize ColumnConfig.json from the data header")
 
+    _RESUME_HELP = ("resume a preempted streaming run from its last "
+                    "mid-stream checkpoint (.shifu/runs/ckpt; "
+                    "bit-identical to an uninterrupted run)")
     p_stats = sub.add_parser("stats", help="compute column statistics and binning")
     p_stats.add_argument("-correlation", "--correlation", action="store_true")
     p_stats.add_argument("-psi", "--psi", action="store_true")
     p_stats.add_argument("-rebin", "--rebin", action="store_true")
+    p_stats.add_argument("--resume", action="store_true", help=_RESUME_HELP)
 
     p_norm = sub.add_parser("norm", aliases=["normalize"], help="normalize training data")
     p_norm.add_argument("-shuffle", "--shuffle", action="store_true")
+    p_norm.add_argument("--resume", action="store_true", help=_RESUME_HELP)
 
     p_varsel = sub.add_parser(
         "varsel", aliases=["varselect"], help="variable selection"
@@ -63,6 +68,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_train = sub.add_parser("train", help="train model(s)")
     p_train.add_argument("-dry", "--dry", action="store_true", help="dry run")
+    p_train.add_argument("--resume", action="store_true", help=_RESUME_HELP)
 
     sub.add_parser("posttrain", help="post-train bin metrics and feature importance")
 
@@ -75,6 +81,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_eval.add_argument("-norm", dest="norm_name", nargs="?", const="", default=None)
     p_eval.add_argument("-confmat", dest="confmat_name", nargs="?", const="", default=None)
     p_eval.add_argument("-perf", dest="perf_name", nargs="?", const="", default=None)
+    p_eval.add_argument("--resume", action="store_true", help=_RESUME_HELP)
 
     p_export = sub.add_parser("export", help="export model (pmml, columnstats, ...)")
     p_export.add_argument("-t", "--type", default="pmml")
@@ -166,6 +173,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "manifest paths")
     p_runs.add_argument("--json", action="store_true", dest="as_json",
                         help="dump the selected manifests as JSON")
+    p_runs.add_argument("--resumable", action="store_true",
+                        help="list mid-stream checkpoints a preempted "
+                             "step left under .shifu/runs/ckpt (resume "
+                             "with `shifu <step> --resume`)")
 
     p_prof = sub.add_parser(
         "profile", help="per-program XLA cost/roofline tables from "
@@ -217,6 +228,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.print_help()
         return 1
 
+    resume = getattr(args, "resume", False)
+    if resume:
+        # the streaming paths read this through resilience.checkpoint.
+        # resume_requested(), same seam as -Dshifu.resume=true
+        environment.set_property("shifu.resume", "true")
     try:
         return dispatch(args)
     except ShifuError as e:
@@ -230,6 +246,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     except NotImplementedError as e:
         log.error("not implemented yet: %s", e)
         return 2
+    finally:
+        if resume:
+            # scoped to THIS command: an in-process caller driving a
+            # second step must not inherit resume mode
+            environment.set_property("shifu.resume", "")
 
 
 def dispatch(args: argparse.Namespace) -> int:
@@ -356,6 +377,27 @@ def dispatch(args: argparse.Namespace) -> int:
 
         from shifu_tpu.obs.ledger import format_runs, list_runs
 
+        if args.resumable:
+            from shifu_tpu.resilience.checkpoint import list_resumable
+
+            entries = list_resumable(".")
+            if args.as_json:
+                print(json.dumps(entries, indent=2, sort_keys=True))
+            elif not entries:
+                print("(no resumable stream checkpoints under "
+                      ".shifu/runs/ckpt)")
+            else:
+                print(f"{'STREAM':<24} {'CHUNK':>6} {'BYTES':>10} "
+                      f"CONFIG-SHA")
+                for e in entries:
+                    if e.get("corrupt"):
+                        print(f"{e['name']:<24} {'?':>6} "
+                              f"{e['bytes']:>10} (corrupt)")
+                    else:
+                        print(f"{e['name']:<24} {e['chunkIndex']:>6} "
+                              f"{e['bytes']:>10} {e['configSha']}")
+                print("resume with: shifu <step> --resume")
+            return 0
         if args.diff:
             from shifu_tpu.obs.profile import (
                 diff_metric_snapshots,

@@ -88,13 +88,40 @@ def prefetch_iter(
         depth = prefetch_chunks_setting()
 
     def _produce(it: Iterator[Any]):
+        from shifu_tpu.resilience import faults
+
+        # guarded like profile.dispatch's device seam: the unfaulted hot
+        # path pays one property lookup per chunk, nothing more
+        chaos = faults.plan_active()
+        if chaos:
+            from shifu_tpu.resilience import retry
+
+            # `io` fault seam BEFORE the pull, retried under the io
+            # budget. Only the injected fault is retryable here: an
+            # exception raised inside next(it) CLOSES a generator
+            # source, so "retrying" the pull would read as a clean
+            # end-of-stream and silently truncate the chunk stream —
+            # real read errors must stay loud.
+            retry.retry_call(lambda: faults.fault_point("io"), seam="io")
         if timers is not None:
             with timers.timer(stage):
                 item = next(it)
         else:
             item = next(it)
         if transform is not None:
-            item = transform(item)
+            if chaos:
+                from shifu_tpu.resilience import retry
+
+                # the per-chunk transform is pure host work (parse/
+                # bin-code/pad), so a crashed prefetch worker "restarts"
+                # by re-running it under the retry budget
+                def _apply(i=item):
+                    faults.fault_point("prefetch")
+                    return transform(i)
+
+                item = retry.retry_call(_apply, seam="prefetch")
+            else:
+                item = transform(item)
         from shifu_tpu.obs import registry
 
         registry().counter("pipeline.chunks").inc()
@@ -281,3 +308,39 @@ class DeviceAccumulator:
         field order, or None if no chunk was ever added."""
         self._flush()
         return self._host
+
+    # ---- checkpoint seam (resilience/checkpoint.py) ----
+    def snapshot(self) -> dict:
+        """Checkpointable state WITHOUT forcing a window flush: the f32
+        device window is pulled as-is (device_get is bit-exact), so a
+        resumed fold continues the identical f32 summation order and the
+        result stays bit-identical to an uninterrupted run — flushing
+        early here would regroup the f32 sums and break parity."""
+        out: dict = {"rows": self._rows}
+        if self._host is not None:
+            for k, a in enumerate(self._host):
+                out[f"host{k}"] = a
+        if self._acc is not None:
+            import jax
+
+            for k, a in enumerate(jax.device_get(self._acc)):
+                out[f"win{k}"] = np.asarray(a)
+        return out
+
+    def restore(self, arrays: dict) -> None:
+        """Rebuild from `snapshot` arrays (device window re-placed)."""
+        host = [arrays[f"host{k}"] for k in range(len(arrays))
+                if f"host{k}" in arrays]
+        self._host = [np.asarray(a, dtype=np.float64) for a in host] \
+            if host else None
+        win = [arrays[f"win{k}"] for k in range(len(arrays))
+               if f"win{k}" in arrays]
+        if win:
+            import jax.numpy as jnp
+
+            from shifu_tpu.ops.binagg import BinAggregates
+
+            self._acc = BinAggregates(*[jnp.asarray(a) for a in win])
+        else:
+            self._acc = None
+        self._rows = int(arrays["rows"])

@@ -105,6 +105,13 @@ class ShardWriter:
                 weights.astype(np.float32, copy=False))
         self.shard_rows.append(primary.shape[0])
 
+    def restore(self, shard_rows: List[int]) -> None:
+        """Resume after preemption: trust the first len(shard_rows) shards
+        on disk (the stream checkpoint recorded them as complete) and
+        continue appending — the next add() overwrites any shard the
+        killed run wrote past its last snapshot, torn or whole."""
+        self.shard_rows = [int(r) for r in shard_rows]
+
     def close(self) -> NormMeta:
         if not self.shard_rows:
             # every chunk filtered empty: write one empty shard so loaders

@@ -358,7 +358,32 @@ def dispatch(name: str, fn: Callable, *args, sync: bool = True,
     where the caller synchronizes right after anyway); sync=False leaves
     the dispatch asynchronous (streamed/overlapped seams) and flags the
     program `synced: false` in snapshots.
+
+    This is also the `device` fault/retry seam: with a fault plan armed
+    (-Dshifu.faults=device...) the whole dispatch runs under the
+    `shifu.retry.device.*` budget — a jit program is pure, so re-running
+    it on a transient runtime error is always safe. The guard keeps the
+    unfaulted hot path free of the extra frame.
     """
+    from shifu_tpu.resilience import faults as _faults
+
+    if _faults.plan_active():
+        from shifu_tpu.resilience import retry as _retry
+
+        def _attempt():
+            _faults.fault_point("device")
+            return _dispatch_inner(name, fn, args, kwargs, sync,
+                                   static_argnums, static_argnames)
+
+        return _retry.retry_call(
+            _attempt, seam="device",
+            retryable=_retry.DEFAULT_TRANSIENT + (RuntimeError,))
+    return _dispatch_inner(name, fn, args, kwargs, sync,
+                           static_argnums, static_argnames)
+
+
+def _dispatch_inner(name, fn, args, kwargs, sync,
+                    static_argnums, static_argnames):
     if _mode() == "off":
         return fn(*args, **kwargs)
     try:

@@ -91,6 +91,7 @@ class BasicProcessor:
         from shifu_tpu import obs
         from shifu_tpu.analysis import sanitize
         from shifu_tpu.obs.ledger import RunLedger
+        from shifu_tpu.resilience import faults
 
         obs.install_jax_probes()
         # parse the sanitizer config BEFORE begin_run: a bad
@@ -98,6 +99,11 @@ class BasicProcessor:
         # still balanced (a raise between begin_run and its finally would
         # disable the per-step registry reset for the whole process)
         san = sanitize.from_environment()
+        # fresh fault-injection counters per step (-Dshifu.faults), and
+        # SIGTERM -> PreemptionError so a real preemption unwinds through
+        # this frame and still writes its failure manifest below
+        faults.reset()
+        restore_sigterm = faults.install_preemption_handler()
         obs.begin_run()
         t0 = time.time()
         status, error = "ok", None
@@ -169,6 +175,8 @@ class BasicProcessor:
                 log.info("Step %s finished in %.1f s.", self.step, elapsed)
         finally:
             obs.end_run()
+            if restore_sigterm is not None:
+                restore_sigterm()
         return 0
 
     def _profile_dir(self, ledger=None, seq=None):

@@ -268,16 +268,52 @@ class EvalProcessor(BasicProcessor):
         out = self.paths.eval_score_path(ec.name)
         self.paths.ensure(os.path.dirname(out))
         sep = "|"
-        n_rows = n_pos = n_neg = 0
-        wrote_header = False
-        with open(out, "w") as fh:
-            # chunk parse rides on the prefetch thread under the previous
-            # chunk's device scoring + row formatting
-            for chunk in prefetch_iter(iter_columnar_chunks(
+
+        # ---- preemption safety: resume = (chunk index, score-file byte
+        # offset, partial row counters); the file is truncated back to
+        # the last snapshotted offset, so rows the killed run appended
+        # after its final checkpoint are dropped and re-scored ----
+        from shifu_tpu.resilience import checkpoint as ckpt_mod
+        from shifu_tpu.resilience import faults
+
+        ck = None
+        resume_ci = -1
+        resume_meta: dict = {}
+        if ckpt_mod.ckpt_stream_enabled():
+            ck = ckpt_mod.StreamCheckpoint(
+                ckpt_mod.ckpt_path(self.root, "eval", f"score-{ec.name}"),
+                self._eval_stream_sha(ec, paths))
+            if ckpt_mod.resume_requested():
+                loaded = ck.load()
+                if loaded is not None and os.path.isfile(out):
+                    resume_ci, _arrays, resume_meta, _blob = loaded
+                    faults.survived("preempt")
+                    log.info("resuming eval %s after chunk %d (offset %d)",
+                             ec.name, resume_ci, resume_meta["offset"])
+            else:
+                ck.clear()
+
+        n_rows = int(resume_meta.get("nRows", 0))
+        n_pos = int(resume_meta.get("nPos", 0))
+        n_neg = int(resume_meta.get("nNeg", 0))
+        wrote_header = bool(resume_meta.get("wroteHeader", False))
+
+        def _numbered_chunks():
+            source = iter_columnar_chunks(
                 self.resolve(ds.data_path or mc.data_set.data_path), names,
                 delimiter=ds.data_delimiter or mc.data_set.data_delimiter,
                 missing_values=tuple(mc.data_set.missing_or_invalid_values),
-            )):
+            )
+            return ckpt_mod.resume_slice(enumerate(source), resume_ci)
+
+        with open(out, "r+" if resume_ci >= 0 else "w") as fh:
+            if resume_ci >= 0:
+                fh.seek(int(resume_meta["offset"]))
+                fh.truncate()
+            # chunk parse rides on the prefetch thread under the previous
+            # chunk's device scoring + row formatting
+            for ci, chunk in prefetch_iter(_numbered_chunks()):
+                faults.fault_point("chunk")
                 mask = combined_mask(ds.filter_expressions, chunk.raw,
                                      chunk.n_rows)
                 chunk = chunk.select_rows(mask)
@@ -320,6 +356,15 @@ class EvalProcessor(BasicProcessor):
                 n_rows += chunk.n_rows
                 n_pos += int((tags == 1).sum())
                 n_neg += int((tags == 0).sum())
+                if ck is not None:
+                    def _state(_fh=fh):
+                        _fh.flush()
+                        os.fsync(_fh.fileno())
+                        return None, {
+                            "offset": _fh.tell(), "nRows": n_rows,
+                            "nPos": n_pos, "nNeg": n_neg,
+                            "wroteHeader": wrote_header}, None
+                    ck.maybe_save(ci, _state)
             if not wrote_header:
                 # empty eval set: header-only file so the perf step reads a
                 # well-formed (zero-row) score table like the in-memory path
@@ -327,10 +372,29 @@ class EvalProcessor(BasicProcessor):
                 fh.write(sep.join(
                     ["tag", "weight", "mean", "max", "min", "median"]
                     + score_names) + "\n")
+        if ck is not None:
+            ck.clear()
         self._record_score_metrics(ec.name, n_rows, n_pos, n_neg, len(paths))
         log.info("eval %s STREAMED %d records (%d pos / %d neg) with %d "
                  "models -> %s", ec.name, n_rows, n_pos, n_neg, len(paths),
                  out)
+
+    def _eval_stream_sha(self, ec: EvalConfig, paths: List[str]) -> str:
+        """Checkpoint-compatibility identity for a streamed eval score
+        run: the model set (paths + sizes) and the eval data source — a
+        snapshot from different models or data must not be resumed."""
+        from shifu_tpu.data.stream import chunk_rows_setting
+        from shifu_tpu.resilience.checkpoint import config_sha
+
+        return config_sha({
+            "eval": ec.name,
+            "models": [(os.path.basename(p), os.path.getsize(p))
+                       for p in paths],
+            "data": (ec.data_set.data_path
+                     or self.model_config.data_set.data_path),
+            # the chunk index is only meaningful under the same geometry
+            "chunkRows": chunk_rows_setting(),
+        })
 
     @staticmethod
     def _record_score_metrics(name: str, n_rows: int, n_pos: int,

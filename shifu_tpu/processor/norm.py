@@ -148,6 +148,25 @@ class NormProcessor(BasicProcessor):
             )
         log.info("bin codes -> %s", self.paths.cleaned_data_dir())
 
+    def _stream_config_sha(self, plan, slots) -> str:
+        """Checkpoint-compatibility identity for the streaming norm run:
+        the full norm plan (type, cutoff, every per-column table), the
+        code layout, and the sampling seed — a snapshot written under
+        different stats/norm config must not be resumed onto this one."""
+        from shifu_tpu.data.stream import chunk_rows_setting
+        from shifu_tpu.norm.normalizer import plan_to_json
+        from shifu_tpu.resilience.checkpoint import config_sha
+
+        return config_sha({
+            "plan": plan_to_json(plan),
+            "slots": [int(s) for s in slots],
+            "seed": self.seed,
+            "sampleRate": self.model_config.normalize.sample_rate,
+            # chunk geometry governs both the chunk index AND the
+            # shard-per-chunk layout — never resume across a change
+            "chunkRows": chunk_rows_setting(),
+        })
+
     def _add_class_meta(self, extra: dict, tags: np.ndarray) -> None:
         """Multi-class: record the tag list + training class priors in
         meta.json — the eval confusion matrix's binRatio source (the
@@ -256,17 +275,51 @@ class NormProcessor(BasicProcessor):
                 code_cache: dict = {}
                 feats = apply_norm_plan(plan, chunk, code_cache=code_cache)
                 codes = bin_code_matrix(tree_cols, chunk, cache=code_cache)
-            return feats, codes, tags, weights
+            return ci, feats, codes, tags, weights
 
+        # ---- preemption safety: the one-shard-per-chunk path resumes
+        # from (chunk index, shards written); the external-shuffle path
+        # appends to bucket files and is NOT resumable — it restarts ----
+        from shifu_tpu.resilience import checkpoint as ckpt_mod
+        from shifu_tpu.resilience import faults
+
+        ck = None
+        resume_ci = -1
         n_rows = 0
         all_tag_counts: dict = {}
+        if not self.shuffle and ckpt_mod.ckpt_stream_enabled():
+            ck = ckpt_mod.StreamCheckpoint(
+                ckpt_mod.ckpt_path(self.root, "norm", "stream"),
+                self._stream_config_sha(plan, slots))
+            if ckpt_mod.resume_requested():
+                loaded = ck.load()
+                if loaded is not None:
+                    resume_ci, _arrays, meta, _blob = loaded
+                    feat_writer.restore(meta["featShardRows"])
+                    code_writer.restore(meta["codeShardRows"])
+                    n_rows = int(meta["nRows"])
+                    all_tag_counts = {int(k): int(v) for k, v in
+                                      meta["tagCounts"].items()}
+                    faults.survived("preempt")
+                    log.info("resuming streaming norm after chunk %d "
+                             "(%d shards on disk)", resume_ci,
+                             len(feat_writer.shard_rows))
+            else:
+                ck.clear()
+        elif self.shuffle and ckpt_mod.resume_requested():
+            log.warning("--resume with -shuffle: the external-shuffle "
+                        "writer appends to bucket files and cannot "
+                        "resume mid-stream; restarting from row zero")
+
         with span("norm.stream", shuffle=self.shuffle) as sp:
-            for item in prefetch_iter(enumerate(factory()),
+            for item in prefetch_iter(ckpt_mod.resume_slice(
+                                          enumerate(factory()), resume_ci),
                                       transform=_normed,
                                       timers=timers, stage="parse"):
                 if item is None:
                     continue
-                feats, codes, tags, weights = item
+                faults.fault_point("chunk")
+                ci, feats, codes, tags, weights = item
                 with timers.timer("write"):
                     feat_writer.add(feats, tags, weights)
                     code_writer.add(codes, tags, weights)
@@ -274,7 +327,18 @@ class NormProcessor(BasicProcessor):
                 for t, c in zip(*np.unique(tags, return_counts=True)):
                     all_tag_counts[int(t)] = (
                         all_tag_counts.get(int(t), 0) + int(c))
+                if ck is not None:
+                    ck.maybe_save(ci, lambda: (
+                        None,
+                        {"featShardRows": list(feat_writer.shard_rows),
+                         "codeShardRows": list(code_writer.shard_rows),
+                         "nRows": n_rows,
+                         "tagCounts": {str(k): v for k, v in
+                                       all_tag_counts.items()}},
+                        None))
             sp["rows"] = n_rows
+        if ck is not None:
+            ck.clear()
         reg.counter("norm.rows").inc(n_rows)
         reg.gauge("norm.columns").set(len(plan.out_names))
         log.info("streaming norm pipeline: %s", timers.summary())

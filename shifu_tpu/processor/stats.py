@@ -108,7 +108,11 @@ class StatsProcessor(BasicProcessor):
             )
             log.info("dataset exceeds the ingest memory budget; "
                      "streaming stats in chunks")
-            compute_stats_streaming(mc, self.column_configs, factory)
+            from shifu_tpu.resilience.checkpoint import resume_requested
+
+            compute_stats_streaming(mc, self.column_configs, factory,
+                                    checkpoint_root=self.root,
+                                    resume=resume_requested())
             data = None
         else:
             data = self._load_data()

@@ -255,9 +255,11 @@ class TrainProcessor(BasicProcessor):
             cfg.progress_cb = progress_writer(self.paths.progress_path(i), i)
             init_flat = (self._continuous_init(i, suffix)
                          if mc.train.is_continuous else None)
+            from shifu_tpu.resilience.checkpoint import resume_requested
+
             res = train_nn_streamed(norm_dir, cfg, init_flat=init_flat,
                                     target_class=i if ova else None,
-                                    mesh=mesh)
+                                    mesh=mesh, resume=resume_requested())
             spec = self._make_spec(alg, cfg, res, meta_cols, norm_json,
                                    class_tags=class_tags)
             path = self.paths.model_path(i, suffix)

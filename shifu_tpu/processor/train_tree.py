@@ -219,7 +219,7 @@ def train_tree_models(proc, alg) -> None:
                        _state=ck_state_path, _every=ck_every,
                        _fp=fingerprint):
             if k % _every == 0:
-                import json as _json
+                from shifu_tpu.resilience.checkpoint import atomic_write_json
 
                 TreeModelSpec(
                     algorithm=cfg.algorithm, trees=list(trees_now),
@@ -228,9 +228,11 @@ def train_tree_models(proc, alg) -> None:
                     boundaries=boundaries, categories=categories,
                     loss=cfg.loss, learning_rate=cfg.learning_rate,
                 ).save(_ck)
-                with open(_state, "w") as fh:
-                    _json.dump({"fingerprint": _fp,
-                                "validErrors": list(val_errs)}, fh)
+                # atomic: a kill between the spec write and this state
+                # write already falls back to fresh-start (fingerprint
+                # check), but a TORN state file must never crash resume
+                atomic_write_json(_state, {"fingerprint": _fp,
+                                           "validErrors": list(val_errs)})
 
         tags_i = one_vs_all_tags[i] if one_vs_all_tags is not None else tags
         if stream:

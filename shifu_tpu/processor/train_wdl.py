@@ -329,8 +329,10 @@ def _train_wdl_streamed(proc) -> None:
                     log.info("continuous: resuming WDL model %d", i)
                 except Exception as e:  # corrupt model: fresh start, logged
                     log.warning("cannot resume from %s (%s)", path, e)
+        from shifu_tpu.resilience.checkpoint import resume_requested
+
         res = train_wdl_streamed(norm_dir, codes_dir, num_idx, cat_idx,
                                  vocab_sizes, cfg, init_flat=init_flat,
-                                 mesh=mesh)
+                                 mesh=mesh, resume=resume_requested())
         _save_wdl_member(proc, i, cfg, res, num_names, cat_names,
                          vocab_sizes, dense_specs, plan.cutoff, categories)

@@ -1,0 +1,54 @@
+"""shifu_tpu.resilience — preemption-safe lifecycle plumbing.
+
+The reference system inherited fault tolerance from its substrate: Guagua
+BSP runs inside a Hadoop MapReduce job, so failed workers are retried by
+MR and coordinated through ZooKeeper (PAPER.md layer map L3). The TPU
+rebuild dropped that substrate, so this package rebuilds the guarantees
+as a library, threaded through every long-running path:
+
+  faults.py      deterministic, seeded fault injection at the real seams
+                 (-Dshifu.faults=io:p=0.01:seed=7,preempt@chunk=40,...).
+                 The same harness CI and the chaos-parity tests drive, so
+                 recovery is proven, not assumed.
+  retry.py       bounded retry with exponential backoff + full jitter
+                 around transient seams (-Dshifu.retry.*); every attempt
+                 is ledgered as retry.* metrics.
+  checkpoint.py  atomic file writes (temp + os.replace) and mid-stream
+                 checkpoint/resume for the chunked fold paths: a
+                 preempted host resumes from (chunk_index, fold_state)
+                 instead of row zero, bit-identical to an uninterrupted
+                 run.
+
+All three record into the obs metrics registry, so every injected fault,
+retry attempt and checkpoint write lands in the run-ledger manifest.
+"""
+
+from shifu_tpu.resilience.checkpoint import (
+    StreamCheckpoint,
+    atomic_save_npy,
+    atomic_write,
+    atomic_write_json,
+)
+from shifu_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpecError,
+    InjectedFaultError,
+    PreemptionError,
+    fault_point,
+    plan_active,
+)
+from shifu_tpu.resilience.retry import retry_call
+
+__all__ = [
+    "FaultPlan",
+    "FaultSpecError",
+    "InjectedFaultError",
+    "PreemptionError",
+    "StreamCheckpoint",
+    "atomic_save_npy",
+    "atomic_write",
+    "atomic_write_json",
+    "fault_point",
+    "plan_active",
+    "retry_call",
+]

@@ -1,0 +1,342 @@
+"""Deterministic fault injection at the pipeline's real seams.
+
+`-Dshifu.faults=<spec>` arms seeded, schedule-based injectors at the
+seams where production actually fails — the chunk reader, the prefetch
+worker, compiled-program dispatch, checkpoint writes, and SIGTERM-style
+preemption at chunk boundaries. Because every injector is seeded (or
+pinned to an absolute event ordinal), a chaos run is REPRODUCIBLE: the
+same spec kills the same chunk every time, so tests can pin bit-identical
+resume instead of hoping.
+
+Spec grammar (comma-separated clauses)::
+
+    clause  := seam [ "@" counter "=" N ] ( ":" key "=" value )*
+    seam    := io | prefetch | device | ckpt | serve | preempt | slow
+    key     := p (probability, default 0.01; slow defaults to 1.0)
+             | seed (rng seed, default 0)
+             | ms (sleep milliseconds, slow only, default 50)
+             | max (max firings, 0 = unlimited; scheduled/preempt
+               clauses default to 1, probabilistic ones to 0)
+
+Examples::
+
+    -Dshifu.faults=io:p=0.01:seed=7,device,preempt@chunk=40,slow:ms=250
+
+  * `io:p=0.01:seed=7` — 1% of chunk-reader pulls raise a transient
+    `InjectedFaultError` (the retry layer's job to absorb).
+  * `device` — compiled-program dispatches fail at the default 1% rate.
+  * `preempt@chunk=40` — the 40th chunk boundary raises
+    `PreemptionError` (the SIGTERM analog): the step dies with a failure
+    manifest and must be resumable.
+  * `slow:ms=250` — every chunk pull stalls 250 ms (latency injection).
+
+Each seam calls `fault_point(counter)`; scheduled clauses fire when the
+1-based per-process event count reaches N. Counts are per process, so a
+RESUMED run counts only the chunks it actually re-processes — repeated
+preemption still makes forward progress whenever the checkpoint cadence
+is shorter than the preemption schedule. A caller may pass an absolute
+`index` instead (ordinal = index + 1); probabilistic draws then become a
+pure function of (seed, counter, index) rather than of how many events
+this process happened to see.
+
+Every firing increments `fault.injected{seam=...}`; recoveries count
+`fault.survived{seam=...}` (the retry layer and the resume loaders bump
+it). Both land in the run-ledger manifest with the rest of the registry.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from shifu_tpu.utils import environment
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+FAULTS_PROPERTY = "shifu.faults"
+
+SEAMS = ("io", "prefetch", "device", "ckpt", "serve", "preempt", "slow")
+
+DEFAULT_P = 0.01
+DEFAULT_SLOW_MS = 50.0
+
+
+class FaultSpecError(ValueError):
+    """Malformed -Dshifu.faults spec (raised at parse, not mid-run)."""
+
+
+class InjectedFaultError(RuntimeError):
+    """A transient injected failure — the retry layer must absorb it."""
+
+    def __init__(self, seam: str, ordinal: int) -> None:
+        self.seam = seam
+        self.ordinal = ordinal
+        super().__init__(f"injected {seam} fault at event {ordinal}")
+
+
+class PreemptionError(Exception):
+    """SIGTERM-style preemption: the step must die cleanly (failure
+    manifest written) and be resumable — it is NOT retryable in-process,
+    which is why this is not a subclass of InjectedFaultError."""
+
+
+class FaultClause:
+    """One parsed clause: which counter it listens on and what it does."""
+
+    __slots__ = ("seam", "counter", "at", "p", "seed", "ms", "max",
+                 "fired", "_rng")
+
+    def __init__(self, seam: str, counter: str, at: Optional[int],
+                 p: float, seed: int, ms: float, max_firings: int) -> None:
+        self.seam = seam
+        self.counter = counter
+        self.at = at
+        self.p = p
+        self.seed = seed
+        self.ms = ms
+        self.max = max_firings
+        self.fired = 0
+        self._rng = np.random.default_rng(seed)
+
+    def should_fire(self, ordinal: int, absolute: bool) -> bool:
+        if self.max and self.fired >= self.max:
+            return False
+        if self.at is not None:
+            return ordinal == self.at
+        if absolute:
+            # index-keyed draw: deterministic per event, immune to how
+            # many events this process (vs a resumed one) has seen
+            r = np.random.default_rng(
+                [self.seed, zlib.crc32(self.counter.encode()), ordinal]
+            ).random()
+        else:
+            r = self._rng.random()
+        return r < self.p
+
+    def describe(self) -> str:
+        trig = (f"@{self.counter}={self.at}" if self.at is not None
+                else f":p={self.p}")
+        return f"{self.seam}{trig}"
+
+
+def _parse_clause(text: str) -> FaultClause:
+    head, *params = text.strip().split(":")
+    if "@" in head:
+        seam, trigger = head.split("@", 1)
+        if "=" not in trigger:
+            raise FaultSpecError(
+                f"'{text}': scheduled trigger must be @counter=N")
+        counter, at_s = trigger.split("=", 1)
+        try:
+            at: Optional[int] = int(at_s)
+        except ValueError:
+            raise FaultSpecError(f"'{text}': trigger ordinal must be int")
+    else:
+        seam, counter, at = head, "", None
+    seam = seam.strip()
+    if seam not in SEAMS:
+        raise FaultSpecError(
+            f"'{text}': unknown seam '{seam}' (one of {', '.join(SEAMS)})")
+    if not counter:
+        # default listening counter: preempt fires at chunk boundaries,
+        # slow stalls the reader, everything else listens on its own seam
+        counter = {"preempt": "chunk", "slow": "io"}.get(seam, seam)
+    p = 1.0 if seam == "slow" else DEFAULT_P
+    seed = 0
+    ms = DEFAULT_SLOW_MS
+    max_firings = 1 if (at is not None or seam == "preempt") else 0
+    for param in params:
+        if "=" not in param:
+            raise FaultSpecError(f"'{text}': parameter '{param}' needs k=v")
+        k, v = param.split("=", 1)
+        try:
+            if k == "p":
+                p = float(v)
+            elif k == "seed":
+                seed = int(v)
+            elif k == "ms":
+                ms = float(v)
+            elif k == "max":
+                max_firings = int(v)
+            else:
+                raise FaultSpecError(
+                    f"'{text}': unknown parameter '{k}' (p/seed/ms/max)")
+        except ValueError as e:
+            if isinstance(e, FaultSpecError):
+                raise
+            raise FaultSpecError(f"'{text}': bad value for '{k}': {v}")
+    if not 0.0 <= p <= 1.0:
+        raise FaultSpecError(f"'{text}': p must be in [0, 1]")
+    return FaultClause(seam, counter.strip(), at, p, seed, ms, max_firings)
+
+
+class FaultPlan:
+    """Parsed spec + per-counter event state. Thread-safe: the prefetch
+    worker and the consumer hit fault points concurrently."""
+
+    def __init__(self, clauses: List[FaultClause], spec: str = "") -> None:
+        self.clauses = clauses
+        self.spec = spec
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        clauses = [_parse_clause(c) for c in spec.split(",") if c.strip()]
+        return cls(clauses, spec=spec)
+
+    def fire(self, counter: str, index: Optional[int] = None) -> None:
+        """Evaluate every clause listening on `counter` for this event.
+        Raises InjectedFaultError / PreemptionError or sleeps (slow).
+
+        Only ONE raising clause can act per event; `fired` budgets are
+        charged only on clauses that actually act, so a preempt clause
+        sharing a counter with a probabilistic clause is deferred to a
+        later event rather than silently consumed. Every slow clause due
+        on the event still sleeps (latency composes), and preemption
+        outranks transient faults (the more severe, usually explicitly
+        scheduled, action wins)."""
+        with self._lock:
+            if index is not None:
+                ordinal = index + 1
+            else:
+                ordinal = self._counts.get(counter, 0) + 1
+                self._counts[counter] = ordinal
+            due = [c for c in self.clauses
+                   if c.counter == counter
+                   and c.should_fire(ordinal, absolute=index is not None)]
+            sleeps = [c for c in due if c.seam == "slow"]
+            raisers = sorted((c for c in due if c.seam != "slow"),
+                             key=lambda c: c.seam != "preempt")
+            acting = sleeps + raisers[:1]
+            for c in acting:
+                c.fired += 1
+        from shifu_tpu.obs import registry
+
+        for c in acting:
+            registry().counter("fault.injected", seam=c.seam).inc()
+            if c.seam == "slow":
+                time.sleep(c.ms / 1000.0)
+                continue
+            if c.seam == "preempt":
+                log.warning("fault injection: preempting at %s event %d",
+                            counter, ordinal)
+                raise PreemptionError(
+                    f"injected preemption at {counter} event {ordinal}")
+            raise InjectedFaultError(c.seam, ordinal)
+
+
+# ---------------------------------------------------------------------------
+# process-global plan (environment-armed) + test override
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_plan_spec: Optional[str] = None
+_override: Optional[FaultPlan] = None
+
+
+def _current_plan() -> Optional[FaultPlan]:
+    global _plan, _plan_spec
+    if _override is not None:
+        return _override
+    spec = environment.get_property(FAULTS_PROPERTY, "") or ""
+    if not spec.strip():
+        return None
+    with _lock:
+        if spec != _plan_spec:
+            _plan = FaultPlan.parse(spec)
+            _plan_spec = spec
+            log.info("fault injection armed: %s",
+                     ", ".join(c.describe() for c in _plan.clauses))
+        return _plan
+
+
+def plan_active() -> bool:
+    """Cheap guard for hot paths: is any fault plan armed?"""
+    if _override is not None:
+        return True
+    spec = environment.get_property(FAULTS_PROPERTY, "") or ""
+    return bool(spec.strip())
+
+
+def fault_point(counter: str, index: Optional[int] = None) -> None:
+    """Seam hook: a no-op unless a plan is armed. `index` is the absolute
+    0-based event index when the caller tracks one (chunk loops) — it
+    makes scheduled triggers resume-safe and probabilistic draws a pure
+    function of the event."""
+    plan = _current_plan()
+    if plan is not None:
+        plan.fire(counter, index=index)
+
+
+def reset() -> None:
+    """Fresh event counters/firing state (each lifecycle step re-arms):
+    the cached plan is re-parsed on next use."""
+    global _plan, _plan_spec
+    with _lock:
+        _plan = None
+        _plan_spec = None
+
+
+class activate:
+    """Context manager pinning an explicit plan (tests): overrides the
+    environment spec for the duration."""
+
+    def __init__(self, plan: Optional[FaultPlan]) -> None:
+        self.plan = plan
+
+    def __enter__(self) -> Optional[FaultPlan]:
+        global _override
+        self._prev = _override
+        _override = self.plan
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        global _override
+        _override = self._prev
+
+
+def survived(seam: str, n: int = 1) -> None:
+    """Record that `n` injected faults at `seam` were absorbed (retry
+    recovered / resume loaded) — the proof half of every fault.* pair."""
+    from shifu_tpu.obs import registry
+
+    registry().counter("fault.survived", seam=seam).inc(n)
+
+
+# ---------------------------------------------------------------------------
+# real preemption: SIGTERM -> PreemptionError in the main thread
+# ---------------------------------------------------------------------------
+
+
+def install_preemption_handler():
+    """Convert SIGTERM into a PreemptionError so a preempted lifecycle
+    step unwinds through BasicProcessor.run and writes its failure
+    manifest (the PR-2 ledger contract) instead of dying silently.
+
+    Returns a restore() callable (or None when not installable — signal
+    handlers only work in the main thread, and `shifu serve` owns its
+    own SIGTERM for graceful drain)."""
+
+    def _handler(signum, frame):
+        raise PreemptionError(f"signal {signum}: host preempted")
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # not in the main thread: leave signals alone
+        return None
+
+    def restore() -> None:
+        try:
+            signal.signal(signal.SIGTERM, prev)
+        except ValueError:  # restored off the main thread: nothing to undo
+            pass
+
+    return restore

@@ -1,0 +1,122 @@
+"""Bounded retry with exponential backoff + full jitter.
+
+Wraps the transient seams — data-source / remote-source reads, the
+prefetch worker's per-chunk transform, compiled-program dispatch on
+non-deterministic runtime errors — in a budgeted retry loop. The policy
+is the standard one for shared backends: exponential backoff so a
+struggling source is not hammered, FULL jitter so a fleet of preempted
+hosts resuming together does not thundering-herd it, and a hard attempt
+budget so a persistent failure surfaces as the original exception
+instead of an infinite stall.
+
+Knobs (per-seam overrides take precedence over the globals)::
+
+    shifu.retry.max            attempt budget, default 3 (1 = no retry)
+    shifu.retry.baseMs         first backoff, default 25 ms
+    shifu.retry.capMs          backoff ceiling, default 2000 ms
+    shifu.retry.<seam>.max     e.g. -Dshifu.retry.io.max=5
+
+Every attempt is ledgered: `retry.attempts{seam=}` counts re-tries,
+`retry.recovered{seam=}` counts calls that eventually succeeded after
+failing, `retry.exhausted{seam=}` counts budget exhaustions (the
+original exception re-raises). Recovered injected faults additionally
+count `fault.survived{seam=}` — the proof that chaos runs actually
+exercise this path.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+from shifu_tpu.resilience.faults import InjectedFaultError, PreemptionError
+from shifu_tpu.utils import environment
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+T = TypeVar("T")
+
+DEFAULT_MAX_ATTEMPTS = 3
+DEFAULT_BASE_MS = 25.0
+DEFAULT_CAP_MS = 2000.0
+
+# Transient by default: injected faults (chaos harness) and the OS-level
+# errors remote/flaky sources actually throw. PreemptionError is NEVER
+# retryable — preemption means "die cleanly and resume", not "try again".
+DEFAULT_TRANSIENT: Tuple[Type[BaseException], ...] = (
+    InjectedFaultError, OSError, TimeoutError,
+)
+
+
+def max_attempts(seam: str) -> int:
+    return max(1, environment.get_int(
+        f"shifu.retry.{seam}.max",
+        environment.get_int("shifu.retry.max", DEFAULT_MAX_ATTEMPTS)))
+
+
+def backoff_ms(seam: str) -> Tuple[float, float]:
+    base = environment.get_float(
+        f"shifu.retry.{seam}.baseMs",
+        environment.get_float("shifu.retry.baseMs", DEFAULT_BASE_MS))
+    cap = environment.get_float(
+        f"shifu.retry.{seam}.capMs",
+        environment.get_float("shifu.retry.capMs", DEFAULT_CAP_MS))
+    return max(base, 0.0), max(cap, base)
+
+
+def backoff_delay(seam: str, attempt: int,
+                  rng: Optional[random.Random] = None) -> float:
+    """Seconds to sleep before retry number `attempt` (1-based): full
+    jitter over an exponentially growing, capped window."""
+    base, cap = backoff_ms(seam)
+    window = min(cap, base * (2.0 ** (attempt - 1)))
+    draw = (rng or random).random()
+    return (window * draw) / 1000.0
+
+
+def retry_call(
+    fn: Callable[[], T],
+    seam: str,
+    retryable: Tuple[Type[BaseException], ...] = DEFAULT_TRANSIENT,
+    sleeper: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+) -> T:
+    """Call `fn()` under the seam's retry budget. Non-retryable
+    exceptions (including PreemptionError, always) propagate untouched;
+    a retryable one re-raises only after the budget is exhausted."""
+    budget = max_attempts(seam)
+    from shifu_tpu.obs import registry
+
+    reg = registry()
+    failures = 0
+    injected = 0
+    while True:
+        try:
+            out = fn()
+        except PreemptionError:
+            raise
+        except retryable as e:
+            failures += 1
+            if isinstance(e, InjectedFaultError):
+                injected += 1
+            if failures >= budget:
+                reg.counter("retry.exhausted", seam=seam).inc()
+                log.warning("%s: retry budget (%d) exhausted: %s",
+                            seam, budget, e)
+                raise
+            reg.counter("retry.attempts", seam=seam).inc()
+            delay = backoff_delay(seam, failures, rng=rng)
+            log.debug("%s: attempt %d/%d failed (%s); retrying in %.0f ms",
+                      seam, failures, budget, e, delay * 1000)
+            # jittered, capped exponential backoff — never a fixed sleep
+            sleeper(delay)
+            continue
+        if failures:
+            reg.counter("retry.recovered", seam=seam).inc()
+            if injected:
+                from shifu_tpu.resilience import faults
+
+                faults.survived(seam, injected)
+        return out

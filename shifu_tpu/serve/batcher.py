@@ -19,18 +19,32 @@ boundaries to the batcher, and neither leaks into the other.
 
 One worker thread keeps ordering FIFO and the device queue depth at one
 batch; requests resolve through a per-request event (`ScoreRequest.wait`).
+
+Self-healing (resilience layer): the worker runs under a supervisor —
+an unexpected crash fails the in-flight batch's requests INDIVIDUALLY
+(each client gets an error response, never a hang), preserves the
+admission queue, and restarts the worker up to
+`shifu.serve.maxWorkerRestarts` times (health flips to `degraded` until
+clean batches accumulate). Every admitted request also carries a
+deadline (`shifu.serve.deadlineMs`): a request that outlives it is shed
+with an explicit error before dispatch instead of wasting a wedged
+backend's time. The observed drain rate feeds the 429 Retry-After hint
+(`retry_after_seconds`, exported as the `serve.retry_after_seconds`
+gauge).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 from shifu_tpu.data.reader import ColumnarData
 from shifu_tpu.eval.scorer import ScoreResult
+from shifu_tpu.serve.health import HealthMonitor
 from shifu_tpu.serve.queue import AdmissionQueue
 from shifu_tpu.utils import environment
 from shifu_tpu.utils.log import get_logger
@@ -39,6 +53,13 @@ log = get_logger(__name__)
 
 DEFAULT_MAX_BATCH_ROWS = 1024
 DEFAULT_MAX_WAIT_MS = 2.0
+DEFAULT_MAX_WORKER_RESTARTS = 5
+DEFAULT_DEADLINE_MS = 30_000.0
+# Retry-After clamp: never tell a client "come back immediately" while
+# shedding, never park it longer than half a minute on a stale estimate
+RETRY_AFTER_MIN_S = 1.0
+RETRY_AFTER_MAX_S = 30.0
+DRAIN_WINDOW_S = 10.0
 
 # Exponential histogram edges, pinned (tests/test_serve.py). The metrics
 # registry's DEFAULT_BUCKETS start at 5 ms — useless for a path whose p99
@@ -65,19 +86,46 @@ def max_wait_ms_setting() -> float:
         return DEFAULT_MAX_WAIT_MS
 
 
+def max_worker_restarts_setting() -> int:
+    return environment.get_int("shifu.serve.maxWorkerRestarts",
+                               DEFAULT_MAX_WORKER_RESTARTS)
+
+
+def deadline_ms_setting() -> float:
+    """shifu.serve.deadlineMs — per-request budget from admission to
+    dispatch (0 disables). A request older than this is shed with an
+    explicit error instead of being scored for a client that gave up."""
+    raw = environment.get_property("shifu.serve.deadlineMs", "")
+    try:
+        return float(raw) if raw else DEFAULT_DEADLINE_MS
+    except ValueError:
+        return DEFAULT_DEADLINE_MS
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request outlived shifu.serve.deadlineMs before dispatch."""
+
+
 class ScoreRequest:
     """One admitted request: a raw columnar slice plus its completion."""
 
-    __slots__ = ("data", "n_rows", "enqueued_at", "_done", "result",
-                 "error")
+    __slots__ = ("data", "n_rows", "enqueued_at", "deadline", "_done",
+                 "result", "error")
 
-    def __init__(self, data: ColumnarData) -> None:
+    def __init__(self, data: ColumnarData,
+                 deadline_s: Optional[float] = None) -> None:
         self.data = data
         self.n_rows = data.n_rows
         self.enqueued_at = time.perf_counter()
+        self.deadline = (self.enqueued_at + deadline_s
+                         if deadline_s else None)
         self._done = threading.Event()
         self.result: Optional[ScoreResult] = None
         self.error: Optional[BaseException] = None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now or time.perf_counter()) > self.deadline)
 
     def resolve(self, result: ScoreResult) -> None:
         self.result = result
@@ -122,38 +170,106 @@ def _slice_result(res: ScoreResult, start: int, stop: int) -> ScoreResult:
 
 
 class MicroBatcher:
-    """Admission-queue consumer: coalesce -> score -> fan results out."""
+    """Admission-queue consumer: coalesce -> score -> fan results out,
+    supervised — a crashed scoring worker restarts (bounded) with the
+    queue preserved and the in-flight batch failed request-by-request."""
 
     def __init__(self, score_fn: Callable[[ColumnarData], ScoreResult],
                  admission: AdmissionQueue,
                  max_batch_rows: Optional[int] = None,
-                 max_wait_ms: Optional[float] = None) -> None:
+                 max_wait_ms: Optional[float] = None,
+                 health: Optional[HealthMonitor] = None,
+                 max_restarts: Optional[int] = None,
+                 deadline_ms: Optional[float] = None) -> None:
         self.score_fn = score_fn
         self.admission = admission
+        self.health = health if health is not None else HealthMonitor()
         self.max_batch_rows = (max_batch_rows_setting()
                                if max_batch_rows is None
                                else int(max_batch_rows))
         self.max_wait_s = (max_wait_ms_setting()
                            if max_wait_ms is None
                            else float(max_wait_ms)) / 1000.0
-        self._worker = threading.Thread(target=self._loop,
-                                        name="shifu-serve-batcher",
-                                        daemon=True)
-        self._worker.start()
+        self.max_restarts = (max_worker_restarts_setting()
+                             if max_restarts is None else int(max_restarts))
+        self.deadline_s = ((deadline_ms_setting()
+                            if deadline_ms is None else float(deadline_ms))
+                           / 1000.0)
+        self.restarts = 0
+        self._inflight: Optional[List[ScoreRequest]] = None
+        self._drained = threading.Event()  # set on clean drain OR give-up
+        # (t_done, n_requests) per completed batch; the lock covers the
+        # worker's append racing retry_after_seconds() on handler threads
+        self._drain_log: deque = deque(maxlen=64)
+        self._drain_lock = threading.Lock()
+        self._worker = self._spawn()
+
+    def _spawn(self) -> threading.Thread:
+        worker = threading.Thread(target=self._run,
+                                  name="shifu-serve-batcher",
+                                  daemon=True)
+        worker.start()
+        return worker
 
     def submit(self, data: ColumnarData) -> ScoreRequest:
         """Admit one request (raises queue.RejectedError on shed)."""
-        req = ScoreRequest(data)
+        req = ScoreRequest(data, deadline_s=self.deadline_s or None)
         self.admission.put(req)
         return req
 
     def join(self, timeout: Optional[float] = None) -> None:
-        """Wait for drain: meaningful only after admission.close()."""
-        self._worker.join(timeout)
+        """Wait for drain: meaningful only after admission.close().
+        Event-based, not thread-based — the worker thread may have been
+        replaced by the supervisor since this batcher was built."""
+        self._drained.wait(timeout)
 
     @property
     def draining(self) -> bool:
-        return self.admission.closed and self._worker.is_alive()
+        return self.admission.closed and not self._drained.is_set()
+
+    # ---- supervisor ----
+    def _run(self) -> None:
+        from shifu_tpu.obs import registry
+
+        try:
+            self._loop()
+            self._drained.set()  # clean drain (queue closed and empty)
+            return
+        except BaseException as e:  # supervisor: ANY worker death (incl.
+            # injected faults and non-Exception crashes) must be survived
+            reg = registry()
+            reg.counter("serve.worker.crashes").inc()
+            log.warning("serve scoring worker crashed: %s: %s",
+                        type(e).__name__, e)
+            # the batch being scored when the worker died: every request
+            # gets an individual error response — crashed != hung
+            inflight, self._inflight = self._inflight, None
+            for r in inflight or []:
+                r.fail(RuntimeError(
+                    f"scoring worker crashed mid-batch: {e}"))
+            self.health.note_crash(
+                f"scoring worker crashed: {type(e).__name__}")
+            if self.restarts >= self.max_restarts:
+                log.error("serve worker restart budget (%d) exhausted; "
+                          "draining", self.max_restarts)
+                self.health.set_draining("worker restart budget exhausted")
+                self.admission.close()
+                # answer everything still queued — zero requests may be
+                # left admitted-but-unanswered
+                while True:
+                    req = self.admission.get(timeout=0)
+                    if req is None:
+                        break
+                    req.fail(RuntimeError(
+                        "scoring worker unavailable (restart budget "
+                        "exhausted)"))
+                self._drained.set()
+                return
+            self.restarts += 1
+            reg.counter("serve.worker.restarts").inc()
+            log.info("restarting serve scoring worker (%d/%d)",
+                     self.restarts, self.max_restarts)
+            self._worker = self._spawn()
 
     def _gather(self) -> Optional[List[ScoreRequest]]:
         """Block for the next request, then coalesce until the row cap or
@@ -162,6 +278,11 @@ class MicroBatcher:
         if first is None:
             return None
         batch = [first]
+        # register with the supervisor IMMEDIATELY (same list object, so
+        # later appends are visible): a request popped from the queue is
+        # answerable only through _inflight if this worker dies while
+        # still coalescing
+        self._inflight = batch
         rows = first.n_rows
         deadline = time.perf_counter() + self.max_wait_s
         while rows < self.max_batch_rows:
@@ -177,12 +298,41 @@ class MicroBatcher:
 
     def _loop(self) -> None:
         from shifu_tpu.obs import registry
+        from shifu_tpu.resilience import faults
 
         while True:
             batch = self._gather()
             if batch is None:
                 return
             reg = registry()
+            # deadline shed BEFORE dispatch: a request that outlived its
+            # budget behind a wedged batch gets an explicit error now,
+            # not a result its client stopped waiting for
+            now = time.perf_counter()
+            live: List[ScoreRequest] = []
+            for r in batch:
+                if r.expired(now):
+                    reg.counter("serve.deadline.shed").inc()
+                    r.fail(DeadlineExceededError(
+                        "request exceeded shifu.serve.deadlineMs before "
+                        "dispatch"))
+                else:
+                    live.append(r)
+            batch = live
+            if not batch:
+                self._inflight = None
+                continue
+            # _inflight (registered in _gather) stays set until every
+            # request in the batch has an answer: if anything below
+            # escapes — e.g. the injected `serve` fault on the next line,
+            # or any real crash outside the per-batch guard — the
+            # supervisor (_run) reads it and fails each request
+            # individually; a finally-clear would hide the batch from the
+            # crash path. Re-point it at the post-shed batch (the live
+            # set is the honest one; double-failing an already-shed
+            # request is harmless).
+            self._inflight = batch
+            faults.fault_point("serve")
             rows = sum(r.n_rows for r in batch)
             reg.counter("serve.batches").inc()
             reg.histogram(
@@ -192,12 +342,13 @@ class MicroBatcher:
                 with reg.timer("serve.batch.score").time():
                     result = self.score_fn(_concat_batches(
                         [r.data for r in batch]))
-            except BaseException as e:  # fan the failure out per request
+            except Exception as e:  # fan the failure out per request
                 log.warning("serve batch of %d requests failed: %s",
                             len(batch), e)
                 reg.counter("serve.batch.errors").inc()
                 for r in batch:
                     r.fail(e)
+                self._inflight = None
                 continue
             off = 0
             now = time.perf_counter()
@@ -209,3 +360,33 @@ class MicroBatcher:
                 lat.observe(now - r.enqueued_at)
             reg.counter("serve.requests").inc(len(batch))
             reg.counter("serve.records").inc(rows)
+            self._inflight = None
+            with self._drain_lock:
+                self._drain_log.append((now, len(batch)))
+            self.health.note_ok()
+
+    # ---- load hints ----
+    def retry_after_seconds(self) -> float:
+        """429 Retry-After derived from the OBSERVED drain rate: queue
+        depth ÷ recently drained requests/s, clamped — a loaded server
+        tells clients how long the backlog actually is instead of a
+        fixed hint. Exported as the `serve.retry_after_seconds` gauge."""
+        from shifu_tpu.obs import registry
+
+        now = time.perf_counter()
+        with self._drain_lock:
+            drained = list(self._drain_log)
+        recent = [(t, n) for t, n in drained if now - t <= DRAIN_WINDOW_S]
+        depth = len(self.admission)
+        if len(recent) >= 2:
+            span = max(now - recent[0][0], 1e-3)
+            # depth counts REQUESTS, so the rate must too — batches/s
+            # alone would overestimate the backlog by the coalescing
+            # factor (requests per batch)
+            requests_per_s = sum(n for _, n in recent) / span
+            hint = depth / max(requests_per_s, 1e-3)
+        else:
+            hint = RETRY_AFTER_MIN_S  # no drain history: cheap optimism
+        hint = min(max(hint, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
+        registry().gauge("serve.retry_after_seconds").set(hint)
+        return hint

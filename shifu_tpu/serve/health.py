@@ -1,0 +1,92 @@
+"""Serve health state machine: ok | degraded | draining, with a reason.
+
+/healthz used to be a liveness ping; under the self-healing serve path it
+is the load balancer's routing signal, so it must distinguish three
+states the supervisor actually produces:
+
+  ok        scoring normally.
+  degraded  still scoring, but a worker crash was survived recently —
+            the state a router uses to de-prioritize (not eject) a
+            replica. Clears back to `ok` after `ok_after` consecutive
+            clean batches.
+  draining  not accepting new work (shutdown in progress, or the worker
+            restart budget is exhausted) — /healthz returns 503 so the
+            balancer stops routing here while in-flight work finishes.
+
+Transitions are monotone toward draining: once draining, crash/ok notes
+cannot resurrect the replica (a drained server restarts, it does not
+heal). Every transition lands in `serve.health.transitions{to=...}` so
+the run-ledger manifest carries the replica's health history.
+"""
+
+from __future__ import annotations
+
+import threading
+
+OK = "ok"
+DEGRADED = "degraded"
+DRAINING = "draining"
+
+DEFAULT_OK_AFTER = 3
+
+
+class HealthMonitor:
+    """Thread-safe tri-state health with crash-recovery hysteresis."""
+
+    def __init__(self, ok_after: int = DEFAULT_OK_AFTER) -> None:
+        self._lock = threading.Lock()
+        self._state = OK
+        self._reason = ""
+        self._ok_after = max(1, ok_after)
+        self._ok_streak = 0
+        self._crashes = 0
+
+    def _transition(self, state: str, reason: str) -> None:
+        # caller holds the lock
+        if self._state == state:
+            self._reason = reason
+            return
+        self._state = state
+        self._reason = reason
+        from shifu_tpu.obs import registry
+
+        registry().counter("serve.health.transitions", to=state).inc()
+
+    def note_crash(self, reason: str) -> None:
+        with self._lock:
+            self._crashes += 1
+            self._ok_streak = 0
+            if self._state != DRAINING:
+                self._transition(DEGRADED, reason)
+
+    def note_ok(self) -> None:
+        with self._lock:
+            if self._state != DEGRADED:
+                return
+            self._ok_streak += 1
+            if self._ok_streak >= self._ok_after:
+                self._transition(OK, "")
+
+    def set_draining(self, reason: str) -> None:
+        with self._lock:
+            self._transition(DRAINING, reason)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def reason(self) -> str:
+        with self._lock:
+            return self._reason
+
+    @property
+    def crashes(self) -> int:
+        with self._lock:
+            return self._crashes
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"status": self._state, "reason": self._reason,
+                    "workerCrashes": self._crashes}

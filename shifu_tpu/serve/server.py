@@ -26,6 +26,7 @@ step writes.
 from __future__ import annotations
 
 import json
+import math
 import os
 import threading
 import time
@@ -34,6 +35,7 @@ from typing import List, Optional, Sequence
 
 from shifu_tpu.eval.scorer import ScoreResult
 from shifu_tpu.serve.batcher import MicroBatcher
+from shifu_tpu.serve.health import DRAINING, HealthMonitor
 from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
 from shifu_tpu.serve.registry import ModelRegistry, records_to_columnar
 from shifu_tpu.utils.log import get_logger
@@ -49,15 +51,20 @@ class Scorer:
     def __init__(self, registry: ModelRegistry,
                  admission: Optional[AdmissionQueue] = None,
                  max_batch_rows: Optional[int] = None,
-                 max_wait_ms: Optional[float] = None) -> None:
+                 max_wait_ms: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 deadline_ms: Optional[float] = None) -> None:
         self.registry = registry
         # explicit None-check: AdmissionQueue defines __len__, so an EMPTY
         # queue is falsy and `admission or ...` would silently swap in a
         # default-depth one
         self.admission = AdmissionQueue() if admission is None else admission
+        self.health = HealthMonitor()
         self.batcher = MicroBatcher(
             registry.score_raw, self.admission,
-            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms)
+            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
+            health=self.health, max_restarts=max_restarts,
+            deadline_ms=deadline_ms)
 
     def score_batch(self, records: Sequence[dict],
                     timeout: Optional[float] = DEFAULT_SCORE_TIMEOUT_S
@@ -70,6 +77,7 @@ class Scorer:
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         """Stop admitting and drain every in-flight request."""
+        self.health.set_draining("shutdown")
         self.admission.close()
         self.batcher.join(timeout)
 
@@ -180,15 +188,22 @@ class ScoringServer:
                 from shifu_tpu.obs import registry as obs_registry
 
                 if self.path == "/healthz":
-                    self._reply(200, {
-                        "status": "ok",
+                    health = server.scorer.health.snapshot()
+                    # draining replies 503 so load balancers stop routing
+                    # here; ok AND degraded stay 200 (degraded still
+                    # scores — it is a de-prioritization hint, not an
+                    # ejection)
+                    code = 503 if health["status"] == DRAINING else 200
+                    health.update({
                         "models": len(server.registry.model_names),
                         "sha": server.registry.sha,
                         "fused": server.registry.fused,
                         "queueDepth": len(server.scorer.admission),
+                        "workerRestarts": server.scorer.batcher.restarts,
                         "uptimeSeconds": round(
                             time.time() - server.started_at, 1),
                     })
+                    self._reply(code, health)
                     return
                 if self.path == "/metrics":
                     self._reply(
@@ -214,9 +229,16 @@ class ScoringServer:
                 try:
                     res = server.scorer.score_batch(records)
                 except RejectedError as e:
+                    # Retry-After from the observed drain rate (queue
+                    # depth / recent batches-per-second, clamped) — a
+                    # real backlog estimate, not a fixed hint
+                    hint = server.scorer.batcher.retry_after_seconds()
                     self._reply(429, {"error": str(e),
-                                      "reason": e.reason},
-                                extra_headers={"Retry-After": "1"})
+                                      "reason": e.reason,
+                                      "retryAfterSeconds": round(hint, 3)},
+                                extra_headers={
+                                    "Retry-After":
+                                        str(int(math.ceil(hint)))})
                     return
                 except TimeoutError as e:
                     self._reply(503, {"error": str(e)})

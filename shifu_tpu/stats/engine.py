@@ -41,11 +41,13 @@ def build_codes(
     """Assign each row a bin code for every stats column.
 
     Returns (codes [n, C] int32, col_offsets [C], slots_per_col, values
-    [n, Cn] float32 numeric matrix, numeric_cols)."""
+    [n, Cn] float32 numeric matrix, numeric_cols). The slot layout comes
+    from _column_slot_layout — the one definition the resumable pass-2
+    fold shares, so the codes and the offsets they are aggregated under
+    cannot diverge."""
     n = data.n_rows
+    slots, col_offsets, numeric_cols = _column_slot_layout(stats_cols)
     codes = np.zeros((n, len(stats_cols)), dtype=np.int32)
-    slots: List[int] = []
-    numeric_cols: List[ColumnConfig] = []
     numeric_mat: List[np.ndarray] = []
     for j, cc in enumerate(stats_cols):
         if cc.is_categorical():
@@ -54,7 +56,6 @@ def build_codes(
             codes[:, j] = categorical_bin_index(
                 data.column(cc.column_name), cats, miss
             )
-            slots.append(len(cats) + 1)
         elif cc.is_hybrid():
             # hybrid: numeric bins then category bins then missing
             # (Normalizer.java:622-638); numeric moments come from the
@@ -67,19 +68,12 @@ def build_codes(
             codes[:, j] = hybrid_bin_index(
                 data.column(cc.column_name), bounds, cats, miss
             )
-            slots.append(len(bounds) + len(cats) + 1)
-            numeric_cols.append(cc)
             numeric_mat.append(data.numeric(cc.column_name).astype(np.float32))
         else:
             bounds = cc.column_binning.bin_boundary or [float("-inf")]
             vals = data.numeric(cc.column_name)
             codes[:, j] = numeric_bin_index(vals, bounds)
-            slots.append(len(bounds) + 1)
-            numeric_cols.append(cc)
             numeric_mat.append(vals.astype(np.float32))
-    col_offsets = np.zeros(len(stats_cols), dtype=np.int32)
-    if slots:
-        col_offsets[1:] = np.cumsum(slots[:-1])
     values = (
         np.stack(numeric_mat, axis=1)
         if numeric_mat
@@ -344,11 +338,61 @@ def _write_back(
                 st.mean = None
 
 
+def _column_slot_layout(
+    stats_cols: List[ColumnConfig],
+) -> Tuple[List[int], np.ndarray, List[ColumnConfig]]:
+    """(slots_per_col, col_offsets, numeric_cols) from finalized bins —
+    the same layout build_codes derives per chunk, but computable with
+    zero chunks in hand (a resumed pass 2 may have none left)."""
+    slots: List[int] = []
+    numeric_cols: List[ColumnConfig] = []
+    for cc in stats_cols:
+        if cc.is_categorical():
+            slots.append(len(cc.column_binning.bin_category or []) + 1)
+        elif cc.is_hybrid():
+            slots.append(
+                len(cc.column_binning.bin_boundary or [float("-inf")])
+                + len(cc.column_binning.bin_category or []) + 1)
+            numeric_cols.append(cc)
+        else:
+            slots.append(
+                len(cc.column_binning.bin_boundary or [float("-inf")]) + 1)
+            numeric_cols.append(cc)
+    col_offsets = np.zeros(len(stats_cols), dtype=np.int32)
+    if slots:
+        col_offsets[1:] = np.cumsum(slots[:-1])
+    return slots, col_offsets, numeric_cols
+
+
+def _stats_config_sha(mc: ModelConfig, stats_cols: List[ColumnConfig],
+                      seed: int) -> str:
+    """Identity of a streaming-stats run for checkpoint compatibility: a
+    snapshot folded under one config must never resume under another."""
+    from shifu_tpu.data.stream import chunk_rows_setting
+    from shifu_tpu.resilience.checkpoint import config_sha
+
+    return config_sha({
+        # the recorded chunk index only means anything under the SAME
+        # chunk geometry — resuming a 48-row-chunk snapshot under the
+        # 65536 default would silently skip/double-fold rows
+        "chunkRows": chunk_rows_setting(),
+        "method": str(mc.stats.binning_method),
+        "maxBins": mc.stats.max_num_bin,
+        "cateMax": mc.stats.cate_max_num_bin,
+        "sampleRate": mc.stats.sample_rate,
+        "sampleNegOnly": mc.stats.sample_neg_only,
+        "seed": seed,
+        "columns": [(c.column_name, str(c.column_type)) for c in stats_cols],
+    })
+
+
 def compute_stats_streaming(
     mc: ModelConfig,
     columns: List[ColumnConfig],
     chunk_factory,
     seed: int = 0,
+    checkpoint_root: Optional[str] = None,
+    resume: bool = False,
 ) -> None:
     """Bounded-memory stats: two passes over a re-iterable chunk stream.
 
@@ -372,6 +416,16 @@ def compute_stats_streaming(
     host float64 fold, so arbitrarily long streams cannot saturate the f32
     counts). Chunk order is preserved, so results are bit-identical to a
     serial run (shifu.ingest.prefetchChunks=0).
+
+    With `checkpoint_root`, the fold is preemption-safe: every
+    shifu.ckpt.everyChunks folded chunks a snapshot of (chunk index,
+    pass-1 sketches / pass-2 DeviceAccumulator state, row counters) lands
+    atomically under <root>/.shifu/runs/ckpt, and `resume=True` skips the
+    already-folded chunks. Because the snapshot captures the exact f32
+    device window (no early flush) and per-chunk sampling is keyed by
+    [seed, chunk_index], a resumed run is bit-identical to an
+    uninterrupted one — the chaos-parity tests pin this under injected
+    preemption.
     """
     from shifu_tpu.config.model_config import BinningMethod
     from shifu_tpu.data.pipeline import (
@@ -416,6 +470,39 @@ def compute_stats_streaming(
     reg = registry()
     timers = reg.stage_timers("stats.stage")
 
+    # ---- preemption safety: mid-stream checkpoint + resume ----
+    import pickle
+
+    from shifu_tpu.resilience import checkpoint as ckpt_mod
+    from shifu_tpu.resilience import faults
+
+    ck = None
+    phase: Optional[str] = None
+    resume_ci = -1
+    resume_arrays: Optional[dict] = None
+    resume_meta: dict = {}
+    if checkpoint_root is not None and ckpt_mod.ckpt_stream_enabled():
+        ck = ckpt_mod.StreamCheckpoint(
+            ckpt_mod.ckpt_path(checkpoint_root, "stats", "stream"),
+            _stats_config_sha(mc, stats_cols, seed))
+        if resume:
+            loaded = ck.load()
+            if loaded is not None:
+                resume_ci, resume_arrays, resume_meta, blob = loaded
+                phase = resume_meta.get("phase")
+                sketches = pickle.loads(blob)["sketches"]
+                faults.survived("preempt")
+                log.info("resuming streaming stats from %s after chunk %d",
+                         phase, resume_ci)
+        else:
+            ck.clear()  # fresh run: a stale snapshot must not resurface
+
+    def _chunks_after(start: int):
+        return ckpt_mod.resume_slice(enumerate(chunk_factory()), start)
+
+    def _sketch_blob() -> bytes:
+        return pickle.dumps({"sketches": sketches})
+
     def _prep1(numbered):
         """Background-thread transform: purify + tag + sample one chunk,
         then warm the lazy column caches (to_numeric / missing-mask /
@@ -436,32 +523,49 @@ def compute_stats_streaming(
                         chunk.missing_mask(cc.column_name)
                     else:
                         chunk.numeric(cc.column_name)
-        return chunk, tags, weights
+        return ci, chunk, tags, weights
 
     # ---- pass 1: sketches ----
-    n_valid_rows = 0
-    n_pos = n_neg = 0
-    with span("stats.pass1") as sp1:
-        for chunk, tags, weights in prefetch_iter(
-            enumerate(chunk_factory()), transform=_prep1,
-            timers=timers, stage="parse1",
-        ):
-            if not chunk.n_rows:
-                continue
-            n_valid_rows += chunk.n_rows
-            n_pos += int((tags == 1).sum())
-            n_neg += int((tags == 0).sum())
-            bm = bin_subset(tags)
-            with timers.timer("sketch"):
-                for cc in stats_cols:
-                    sk = sketches[cc.column_name]
-                    if cc.is_categorical():
-                        sk.update(chunk.column(cc.column_name),
-                                  chunk.missing_mask(cc.column_name))
-                    else:
-                        sk.update(chunk.numeric(cc.column_name), bm,
-                                  weights if use_weights else None)
-        sp1["rows"] = n_valid_rows
+    n_valid_rows = int(resume_meta.get("nValid", 0))
+    n_pos = int(resume_meta.get("nPos", 0))
+    n_neg = int(resume_meta.get("nNeg", 0))
+    if phase in (None, "pass1"):
+        with span("stats.pass1") as sp1:
+            for ci, chunk, tags, weights in prefetch_iter(
+                _chunks_after(resume_ci if phase == "pass1" else -1),
+                transform=_prep1, timers=timers, stage="parse1",
+            ):
+                # preemption seam: fires BETWEEN chunk folds, so the last
+                # snapshot always covers a whole number of chunks
+                faults.fault_point("chunk")
+                if not chunk.n_rows:
+                    continue
+                n_valid_rows += chunk.n_rows
+                n_pos += int((tags == 1).sum())
+                n_neg += int((tags == 0).sum())
+                bm = bin_subset(tags)
+                with timers.timer("sketch"):
+                    for cc in stats_cols:
+                        sk = sketches[cc.column_name]
+                        if cc.is_categorical():
+                            sk.update(chunk.column(cc.column_name),
+                                      chunk.missing_mask(cc.column_name))
+                        else:
+                            sk.update(chunk.numeric(cc.column_name), bm,
+                                      weights if use_weights else None)
+                if ck is not None:
+                    ck.maybe_save(ci, lambda _ci=ci: (
+                        None,
+                        {"phase": "pass1", "nValid": n_valid_rows,
+                         "nPos": n_pos, "nNeg": n_neg},
+                        _sketch_blob()))
+            sp1["rows"] = n_valid_rows
+        if ck is not None:
+            # pass-1 complete: pin the full sketch state so a preemption
+            # anywhere in pass 2 never re-pays the first pass
+            ck.save(-1, meta={"phase": "pass1-done",
+                              "nValid": n_valid_rows, "nPos": n_pos,
+                              "nNeg": n_neg}, blob=_sketch_blob())
     reg.counter("stats.rows_valid").inc(n_valid_rows)
     reg.counter("stats.rows_pos").inc(n_pos)
     reg.counter("stats.rows_neg").inc(n_neg)
@@ -498,9 +602,10 @@ def compute_stats_streaming(
     # ---- pass 2: chunked aggregation, padded to bucketed shapes ----
     import jax.numpy as jnp
 
-    numeric_cols: List[ColumnConfig] = []
-    slots: List[int] = []
-    col_offsets = np.zeros(0, dtype=np.int32)
+    # slot layout is a pure function of the finalized bins — computed
+    # up front so a resume that has zero chunks left to fold still has
+    # the layout _write_back needs
+    slots, col_offsets, numeric_cols = _column_slot_layout(stats_cols)
 
     def _prep2(numbered):
         """Background-thread stage: purify + bin-code + pad one chunk to
@@ -516,7 +621,8 @@ def compute_stats_streaming(
             return None
         n_real = chunk.n_rows
         with timers.timer("bincode"):
-            codes, offs, sl, values, ncols = build_codes(chunk, stats_cols)
+            codes, _offs, _sl, values, _ncols = build_codes(
+                chunk, stats_cols)
             extra = bucket_rows(codes.shape[0]) - codes.shape[0]
             if extra:
                 codes = np.pad(codes, ((0, extra), (0, 0)))
@@ -524,18 +630,20 @@ def compute_stats_streaming(
                 weights = np.pad(weights, (0, extra))
                 values = np.pad(values, ((0, extra), (0, 0)),
                                 constant_values=np.nan)
-        return n_real, codes, tags, weights, values, offs, sl, ncols
+        return ci, n_real, codes, tags, weights, values
 
     acc_dev = DeviceAccumulator()
-    n_chunks = 0
+    n_chunks = int(resume_meta.get("nChunks", 0)) if phase == "pass2" else 0
+    if phase == "pass2" and resume_arrays is not None:
+        acc_dev.restore(resume_arrays)
     with span("stats.pass2") as sp2:
-        for item in prefetch_iter(enumerate(chunk_factory()),
-                                  transform=_prep2,
-                                  timers=timers, stage="parse2"):
+        for item in prefetch_iter(
+                _chunks_after(resume_ci if phase == "pass2" else -1),
+                transform=_prep2, timers=timers, stage="parse2"):
             if item is None:
                 continue
-            (n_real, codes, tags, weights, values,
-             col_offsets, slots, numeric_cols) = item
+            faults.fault_point("chunk")
+            ci, n_real, codes, tags, weights, values = item
             n_chunks += 1
             with timers.timer("device"):
                 acc_dev.add(bin_aggregate_profiled(
@@ -546,11 +654,20 @@ def compute_stats_streaming(
                     jnp.asarray(weights, dtype=jnp.float32),
                     jnp.asarray(values),
                 ), rows=n_real)
+            if ck is not None:
+                ck.maybe_save(ci, lambda: (
+                    acc_dev.snapshot(),
+                    {"phase": "pass2", "nChunks": n_chunks,
+                     "nValid": n_valid_rows, "nPos": n_pos,
+                     "nNeg": n_neg},
+                    _sketch_blob()))
         with timers.timer("sync"):
             acc = acc_dev.fetch()
         sp2["chunks"] = n_chunks
     reg.counter("stats.chunks").inc(n_chunks)
     log.info("streaming stats pipeline: %s", timers.summary())
+    if ck is not None:
+        ck.clear()  # stream complete: nothing left to resume
     if acc is None:
         log.warning("streaming stats: no rows survived filtering")
         return

@@ -41,6 +41,7 @@ from shifu_tpu.models.nn import (
     unflatten_params,
 )
 from shifu_tpu.obs import profile
+from shifu_tpu.resilience.checkpoint import atomic_save_npy
 from shifu_tpu.train.updaters import make_updater
 from shifu_tpu.utils.log import get_logger
 
@@ -708,7 +709,7 @@ def train_nn_bagged(
                     base_cfg.progress_cb((i, it_i), float(trs[i]),
                                          float(vas[i]))
                 if checkpoint_paths and checkpoint_paths[i]:
-                    np.save(checkpoint_paths[i], flats[i])
+                    atomic_save_npy(checkpoint_paths[i], flats[i])
             if bool(np.asarray(carry[7]).all()) or it >= max_iters:
                 break
         out = carry
@@ -763,7 +764,7 @@ def _run_with_checkpoints(run_until, carry, cfg, max_iters):
         if cfg.progress_cb:
             cfg.progress_cb(it, tr, va)
         if cfg.checkpoint_path:
-            np.save(cfg.checkpoint_path, np.asarray(carry[0]))
+            atomic_save_npy(cfg.checkpoint_path, np.asarray(carry[0]))
         if bool(carry[7]) or it >= max_iters:
             break
     return carry

@@ -195,6 +195,20 @@ def _get_shard_program(cfg: NNTrainConfig, shapes):
     return prog
 
 
+def _stream_train_sha(cfg: NNTrainConfig, feed: "ShardFeed",
+                      target_class: Optional[int]) -> str:
+    """Checkpoint-compatibility identity: the full hyperparameter set +
+    the shard layout — resuming onto a different config or dataset would
+    silently train the wrong model."""
+    from shifu_tpu.resilience.checkpoint import config_sha
+
+    return config_sha({**{k: v for k, v in cfg.__dict__.items()
+                          if not callable(v) and k != "progress_cb"},
+                       "shardRows": list(feed.meta.shard_rows),
+                       "columns": list(feed.meta.columns),
+                       "targetClass": target_class})
+
+
 def train_nn_streamed(
     data_dir: str,
     cfg: NNTrainConfig,
@@ -202,6 +216,7 @@ def train_nn_streamed(
     target_class: Optional[int] = None,
     mesh=None,
     sig_override=None,
+    resume: bool = False,
 ) -> TrainResult:
     """Full-batch BSP training streamed from shards: per epoch, sum shard
     gradients (the NNMaster worker-sum), then ONE weight update. Matches
@@ -242,11 +257,6 @@ def train_nn_streamed(
 
     flat = jnp.asarray(flat0)
     opt = init_state(flat0.size)
-    if mesh is not None:
-        from shifu_tpu.parallel.mesh import replicate
-
-        flat = replicate(flat, mesh)
-        opt = replicate(opt, mesh)
     lr = cfg.learning_rate
     nts = jnp.float32(feed.n_train_size)
     key0 = jax.random.PRNGKey(cfg.seed)
@@ -257,7 +267,51 @@ def train_nn_streamed(
     bad = 0
     tr_e = va_e = 0.0
     it_done = 0
-    for it in range(cfg.num_epochs):
+    start_epoch = 0
+
+    # ---- preemption safety: the epoch checkpoint captures the FULL
+    # training state (weights, optimizer leaves, lr, best-weights
+    # bookkeeping), so a killed run resumes mid-stream and — every
+    # per-epoch input being a pure function of (seed, epoch) — finishes
+    # bit-identical to an uninterrupted one ----
+    from jax import tree_util as jtu
+
+    from shifu_tpu.resilience import checkpoint as ckpt_mod
+    from shifu_tpu.resilience import faults
+
+    ck = None
+    if cfg.checkpoint_path and cfg.checkpoint_every:
+        ck = ckpt_mod.StreamCheckpoint(
+            cfg.checkpoint_path + ".state" + ckpt_mod.CKPT_SUFFIX,
+            _stream_train_sha(cfg, feed, target_class), every=0)
+        if resume:
+            loaded = ck.load()
+            if loaded is not None:
+                _ci, arrays, meta, _blob = loaded
+                start_epoch = it_done = int(meta["epoch"])
+                flat = jnp.asarray(arrays["flat"])
+                leaves, treedef = jtu.tree_flatten(opt)
+                opt = jtu.tree_unflatten(
+                    treedef, [jnp.asarray(arrays[f"opt{i}"])
+                              for i in range(len(leaves))])
+                best_flat = np.asarray(arrays["bestFlat"])
+                lr = float(meta["lr"])
+                best_val = float(meta["bestVal"])
+                bad = int(meta["bad"])
+                tr_e, va_e = float(meta["trE"]), float(meta["vaE"])
+                faults.survived("preempt")
+                log.info("resuming streamed train at epoch %d", start_epoch)
+
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import replicate
+
+        flat = replicate(flat, mesh)
+        opt = replicate(opt, mesh)
+
+    for it in range(start_epoch, cfg.num_epochs):
+        # SIGTERM-analog seam: -Dshifu.faults=preempt@epoch=N kills the
+        # run between epochs, after the epoch's checkpoint landed
+        faults.fault_point("epoch")
         key = jax.random.fold_in(key0, it)
         g_sum = None
         tr_sum = va_sum = tr_w = va_w = None
@@ -296,10 +350,16 @@ def train_nn_streamed(
             it_done % cfg.checkpoint_every == 0
         ):
             cfg.progress_cb(it_done, tr_e, va_e)
-        if cfg.checkpoint_path and cfg.checkpoint_every and (
-            it_done % cfg.checkpoint_every == 0
-        ):
-            np.save(cfg.checkpoint_path, np.asarray(flat))
+        if ck is not None and it_done % cfg.checkpoint_every == 0:
+            leaves, _ = jtu.tree_flatten(opt)
+            arrays = {"flat": np.asarray(flat),
+                      "bestFlat": np.asarray(best_flat)}
+            arrays.update({f"opt{i}": np.asarray(leaf)
+                           for i, leaf in enumerate(leaves)})
+            ck.save(it_done, arrays=arrays, meta={
+                "epoch": it_done, "lr": lr, "bestVal": best_val,
+                "bad": bad, "trE": tr_e, "vaE": va_e})
+            ckpt_mod.atomic_save_npy(cfg.checkpoint_path, np.asarray(flat))
         if cfg.early_stop_window and bad >= cfg.early_stop_window:
             log.info("streamed early stop at epoch %d", it_done)
             break
@@ -308,6 +368,8 @@ def train_nn_streamed(
         ):
             break
 
+    if ck is not None:
+        ck.clear()  # completed: nothing left to resume
     use_best = cfg.valid_set_rate > 0 and math.isfinite(best_val)
     chosen = best_flat if use_best else np.asarray(flat)
     log.info("streamed train done: %d epochs over %d shards, train %.6f "

@@ -161,6 +161,20 @@ def _get_shard_program(cfg: WDLTrainConfig, template: WDLParams):
     return shard_grad
 
 
+def _wdl_stream_sha(cfg: WDLTrainConfig, feed: "WDLShardFeed",
+                    num_idx: List[int], cat_idx: List[int],
+                    vocab_sizes: List[int]) -> str:
+    """Checkpoint-compatibility identity (hyperparams + shard layout +
+    column split) — see train/streaming.py:_stream_train_sha."""
+    from shifu_tpu.resilience.checkpoint import config_sha
+
+    return config_sha({**{k: v for k, v in cfg.__dict__.items()
+                          if not callable(v) and k != "progress_cb"},
+                       "shardRows": list(feed.meta.shard_rows),
+                       "numIdx": list(num_idx), "catIdx": list(cat_idx),
+                       "vocab": list(vocab_sizes)})
+
+
 def train_wdl_streamed(
     norm_dir: str,
     codes_dir: str,
@@ -170,6 +184,7 @@ def train_wdl_streamed(
     cfg: WDLTrainConfig,
     init_flat: Optional[np.ndarray] = None,
     mesh=None,
+    resume: bool = False,
 ) -> WDLTrainResult:
     """With a `mesh`, shards stream row-sharded over the `data` axis and
     XLA all-reduces each shard gradient — disk spill composes with the
@@ -195,11 +210,6 @@ def train_wdl_streamed(
     )
     flat = jnp.asarray(flat0)
     opt = init_state(flat0.size)
-    if mesh is not None:
-        from shifu_tpu.parallel.mesh import replicate
-
-        flat = replicate(flat, mesh)
-        opt = replicate(opt, mesh)
     nts = jnp.float32(feed.n_train_size)
 
     best_val = math.inf
@@ -207,7 +217,47 @@ def train_wdl_streamed(
     bad = 0
     tr_e = va_e = 0.0
     it_done = 0
-    for it in range(cfg.num_epochs):
+    start_epoch = 0
+
+    # preemption safety: full-state epoch checkpoint + bit-identical
+    # resume, mirroring train/streaming.py (see the NN path for why the
+    # snapshot includes optimizer leaves and best-weights bookkeeping)
+    from jax import tree_util as jtu
+
+    from shifu_tpu.resilience import checkpoint as ckpt_mod
+    from shifu_tpu.resilience import faults
+
+    ck = None
+    if cfg.checkpoint_path and cfg.checkpoint_every:
+        ck = ckpt_mod.StreamCheckpoint(
+            cfg.checkpoint_path + ".state" + ckpt_mod.CKPT_SUFFIX,
+            _wdl_stream_sha(cfg, feed, num_idx, cat_idx, vocab_sizes),
+            every=0)
+        if resume:
+            loaded = ck.load()
+            if loaded is not None:
+                _ci, arrays, meta, _blob = loaded
+                start_epoch = it_done = int(meta["epoch"])
+                flat = jnp.asarray(arrays["flat"])
+                leaves, treedef = jtu.tree_flatten(opt)
+                opt = jtu.tree_unflatten(
+                    treedef, [jnp.asarray(arrays[f"opt{i}"])
+                              for i in range(len(leaves))])
+                best_flat = np.asarray(arrays["bestFlat"])
+                best_val = float(meta["bestVal"])
+                bad = int(meta["bad"])
+                tr_e, va_e = float(meta["trE"]), float(meta["vaE"])
+                faults.survived("preempt")
+                log.info("resuming streamed WDL at epoch %d", start_epoch)
+
+    if mesh is not None:
+        from shifu_tpu.parallel.mesh import replicate
+
+        flat = replicate(flat, mesh)
+        opt = replicate(opt, mesh)
+
+    for it in range(start_epoch, cfg.num_epochs):
+        faults.fault_point("epoch")
         g_sum = tr_sum = va_sum = tr_w = va_w = None
         for (dense, codes, t, sig_t, sig_v) in feed:
             g, trs, vas, trw, vaw = profile.dispatch(
@@ -234,12 +284,23 @@ def train_wdl_streamed(
         if cfg.checkpoint_every and it_done % cfg.checkpoint_every == 0:
             if cfg.progress_cb:
                 cfg.progress_cb(it_done, tr_e, va_e)
-            if cfg.checkpoint_path:
-                np.save(cfg.checkpoint_path, np.asarray(flat))
+            if ck is not None:
+                leaves, _ = jtu.tree_flatten(opt)
+                arrays = {"flat": np.asarray(flat),
+                          "bestFlat": np.asarray(best_flat)}
+                arrays.update({f"opt{i}": np.asarray(leaf)
+                               for i, leaf in enumerate(leaves)})
+                ck.save(it_done, arrays=arrays, meta={
+                    "epoch": it_done, "bestVal": best_val, "bad": bad,
+                    "trE": tr_e, "vaE": va_e})
+                ckpt_mod.atomic_save_npy(cfg.checkpoint_path,
+                                         np.asarray(flat))
         if cfg.early_stop_window and bad >= cfg.early_stop_window:
             log.info("streamed WDL early stop at epoch %d", it_done)
             break
 
+    if ck is not None:
+        ck.clear()  # completed: nothing left to resume
     use_best = cfg.valid_set_rate > 0 and math.isfinite(best_val)
     chosen = best_flat if use_best else np.asarray(flat)
     params = unflatten_wdl(chosen, template)

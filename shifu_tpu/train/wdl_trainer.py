@@ -25,6 +25,7 @@ from shifu_tpu.models.wdl import (
     wdl_shapes,
 )
 from shifu_tpu.obs import profile
+from shifu_tpu.resilience.checkpoint import atomic_save_npy
 from shifu_tpu.train.updaters import make_updater
 from shifu_tpu.utils.log import get_logger
 
@@ -258,7 +259,7 @@ def train_wdl(
             if cfg.progress_cb:
                 cfg.progress_cb(it, float(carry[7]), float(carry[8]))
             if cfg.checkpoint_path:
-                np.save(cfg.checkpoint_path, np.asarray(carry[0]))
+                atomic_save_npy(cfg.checkpoint_path, np.asarray(carry[0]))
             if bool(carry[6]) or it >= cfg.num_epochs:
                 break
         result = carry
@@ -425,7 +426,7 @@ def train_wdl_bagged(
                     base_cfg.progress_cb((i, it_i), float(trs[i]),
                                          float(vas[i]))
                 if checkpoint_paths and checkpoint_paths[i]:
-                    np.save(checkpoint_paths[i], flats[i])
+                    atomic_save_npy(checkpoint_paths[i], flats[i])
             if bool(np.asarray(carry[6]).all()) or it >= base_cfg.num_epochs:
                 break
         out = carry

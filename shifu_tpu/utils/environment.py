@@ -72,8 +72,11 @@ def get_float(key: str, default: float) -> float:
 
 
 def get_bool(key: str, default: bool) -> bool:
+    """Empty string = unset (falls back to `default`), matching
+    get_int/get_float — `set_property(k, "")` is the repo's only way to
+    clear an override, and it must not silently pin False."""
     v = get_property(key)
-    if v is None:
+    if v is None or not v.strip():
         return default
     return v.strip().lower() in ("1", "true", "yes", "on")
 

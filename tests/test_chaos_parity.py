@@ -1,0 +1,443 @@
+"""Chaos parity: every resumable streaming path, killed mid-stream by the
+deterministic fault injector and resumed, must produce BIT-IDENTICAL
+results to an uninterrupted run — and a real SIGTERM mid-train must leave
+a failure manifest (the PR-2 ledger contract) and resume to the pinned
+final weights.
+
+These are the acceptance tests for the preemption-safe lifecycle: the
+recovery machinery is exercised by actual injected kills, never assumed.
+"""
+
+import filecmp
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu.resilience import checkpoint as ckpt_mod
+from shifu_tpu.resilience import faults
+from shifu_tpu.resilience.faults import FaultPlan, PreemptionError
+from shifu_tpu.utils import environment
+from tests.helpers import make_model_set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _StreamEnv:
+    """Streaming knobs for one test, restored on exit."""
+
+    def __init__(self, **props):
+        self.props = props
+
+    def __enter__(self):
+        for k, v in self.props.items():
+            environment.set_property(k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k in self.props:
+            environment.set_property(k, "")
+
+
+# ---------------------------------------------------------------------------
+# streaming stats
+# ---------------------------------------------------------------------------
+
+
+def _stats_stream_setup(tmp_path, n=420, chunk_rows=64):
+    from shifu_tpu.config import ColumnConfig, ColumnType
+    from shifu_tpu.config.column_config import ColumnFlag
+    from shifu_tpu.config.model_config import Algorithm, new_model_config
+    from shifu_tpu.data.stream import chunk_source
+
+    rng = np.random.default_rng(0)
+    y = (rng.random(n) < 0.35).astype(int)
+    num = rng.normal(loc=y[:, None] * 0.7, size=(n, 4))
+    cats = np.array(["aa", "bb", "cc"])[rng.integers(0, 3, size=n)]
+    names = ["target", "n0", "n1", "n2", "n3", "c0"]
+    data_path = os.path.join(str(tmp_path), "data.txt")
+    with open(data_path, "w") as fh:
+        for i in range(n):
+            fh.write("|".join([str(y[i])]
+                              + [f"{v:.5f}" for v in num[i]]
+                              + [cats[i]]) + "\n")
+
+    mc = new_model_config("ChaosStats", Algorithm.NN)
+    mc.data_set.target_column_name = "target"
+    mc.data_set.pos_tags = ["1"]
+    mc.data_set.neg_tags = ["0"]
+
+    def fresh_cols():
+        cols = [ColumnConfig(column_num=0, column_name="target",
+                             column_flag=ColumnFlag.TARGET)]
+        for j in range(4):
+            cols.append(ColumnConfig(column_num=1 + j,
+                                     column_name=f"n{j}",
+                                     column_type=ColumnType.N))
+        cols.append(ColumnConfig(column_num=5, column_name="c0",
+                                 column_type=ColumnType.C))
+        return cols
+
+    factory = chunk_source(data_path, names, delimiter="|",
+                           chunk_rows=chunk_rows)
+    return mc, fresh_cols, factory
+
+
+def _cols_json(cols):
+    from shifu_tpu.config.column_config import save_column_config_list
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r", suffix=".json") as fh:
+        save_column_config_list(fh.name, cols)
+        return open(fh.name).read()
+
+
+@pytest.mark.parametrize("preempt_at, label", [
+    (4, "pass1"),    # 420/64 -> 7 chunks/pass: event 4 dies in pass 1
+    (10, "pass2"),   # events 8..14 are pass 2
+])
+def test_streaming_stats_preempt_resume_bit_identical(
+        tmp_path, preempt_at, label):
+    from shifu_tpu.stats.engine import compute_stats_streaming
+
+    mc, fresh_cols, factory = _stats_stream_setup(tmp_path)
+    root = str(tmp_path / f"root-{label}")
+
+    clean = fresh_cols()
+    compute_stats_streaming(mc, clean, factory)
+
+    chaos = fresh_cols()
+    with _StreamEnv(**{"shifu.ckpt.everyChunks": "1"}):
+        with faults.activate(FaultPlan.parse(f"preempt@chunk={preempt_at}")):
+            with pytest.raises(PreemptionError):
+                compute_stats_streaming(mc, chaos, factory,
+                                        checkpoint_root=root)
+        # the snapshot the kill left behind is listable / resumable
+        entries = ckpt_mod.list_resumable(root)
+        assert [e["name"] for e in entries] == ["stats-stream"]
+        resumed = fresh_cols()
+        compute_stats_streaming(mc, resumed, factory,
+                                checkpoint_root=root, resume=True)
+
+    # bit-identical: every stat, bin boundary, WOE table, count
+    assert _cols_json(resumed) == _cols_json(clean)
+    # completed stream cleared its checkpoint
+    assert ckpt_mod.list_resumable(root) == []
+
+
+def test_streaming_stats_checkpoint_off_no_files(tmp_path):
+    from shifu_tpu.stats.engine import compute_stats_streaming
+
+    mc, fresh_cols, factory = _stats_stream_setup(tmp_path, n=200)
+    root = str(tmp_path / "root-off")
+    with _StreamEnv(**{"shifu.ckpt.stream": "false"}):
+        compute_stats_streaming(mc, fresh_cols(), factory,
+                                checkpoint_root=root)
+    assert not os.path.isdir(ckpt_mod.ckpt_dir(root)) \
+        or not os.listdir(ckpt_mod.ckpt_dir(root))
+
+
+# ---------------------------------------------------------------------------
+# streaming norm
+# ---------------------------------------------------------------------------
+
+
+def _artifact_files(root):
+    from shifu_tpu.fs.pathfinder import PathFinder
+
+    paths = PathFinder(root)
+    out = {}
+    for d in (paths.normalized_data_dir(), paths.cleaned_data_dir()):
+        for f in sorted(glob.glob(os.path.join(d, "*"))):
+            out[os.path.relpath(f, root)] = f
+    return out
+
+
+def test_streaming_norm_preempt_resume_bit_identical(tmp_path):
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+
+    roots = {}
+    for name in ("clean", "chaos"):
+        root = str(tmp_path / name)
+        make_model_set(root, n_rows=300, seed=7)
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root).run() == 0
+        roots[name] = root
+
+    with _StreamEnv(**{"shifu.ingest.forceStreaming": "true",
+                       "shifu.ingest.chunkRows": "48",
+                       "shifu.ckpt.everyChunks": "1"}):
+        assert NormProcessor(roots["clean"]).run() == 0
+
+        with faults.activate(FaultPlan.parse("preempt@chunk=3")):
+            with pytest.raises(PreemptionError):
+                NormProcessor(roots["chaos"]).run()
+        # the kill still produced a failure manifest (ledger contract)
+        manifest = json.load(open(os.path.join(
+            roots["chaos"], ".shifu", "runs", "norm-1.json")))
+        assert manifest["status"] == "failed"
+        assert "PreemptionError" in manifest["error"]
+        # ... and recorded the injected fault in the metrics snapshot
+        counters = manifest["metrics"]["counters"]
+        assert counters.get('fault.injected{seam="preempt"}') == 1.0
+        # a resumable snapshot must exist — otherwise the "resume" below
+        # would be a vacuous from-scratch rerun
+        ck_file = ckpt_mod.ckpt_path(roots["chaos"], "norm", "stream")
+        assert os.path.isfile(ck_file)
+
+        with _StreamEnv(**{"shifu.resume": "true"}):
+            assert NormProcessor(roots["chaos"]).run() == 0
+        # the resumed run actually LOADED the snapshot (and cleared it)
+        resumed = json.load(open(os.path.join(
+            roots["chaos"], ".shifu", "runs", "norm-2.json")))
+        assert resumed["metrics"]["counters"].get("ckpt.resumes") == 1.0
+        assert not os.path.isfile(ck_file)
+
+    clean_files = _artifact_files(roots["clean"])
+    chaos_files = _artifact_files(roots["chaos"])
+    assert set(clean_files) == set(chaos_files)
+    for rel in clean_files:
+        assert filecmp.cmp(clean_files[rel], chaos_files[rel],
+                           shallow=False), f"{rel} differs after resume"
+
+
+# ---------------------------------------------------------------------------
+# streaming eval
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trained_root(tmp_path_factory):
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    root = str(tmp_path_factory.mktemp("chaos_eval"))
+    make_model_set(root, n_rows=300, seed=7)
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["train"]["numTrainEpochs"] = 15
+    ev = mc["evals"][0]
+    ev["dataSet"]["dataPath"] = mc["dataSet"]["dataPath"]
+    ev["dataSet"]["headerPath"] = mc["dataSet"]["headerPath"]
+    ev["dataSet"]["dataDelimiter"] = "|"
+    json.dump(mc, open(mcp, "w"), indent=2)
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    assert TrainProcessor(root).run() == 0
+    return root
+
+
+def test_streaming_eval_preempt_resume_bit_identical(trained_root):
+    from shifu_tpu.processor.evaluate import EvalProcessor
+
+    root = trained_root
+    with _StreamEnv(**{"shifu.ingest.forceStreaming": "true",
+                       "shifu.ingest.chunkRows": "48",
+                       "shifu.ckpt.everyChunks": "1"}):
+        assert EvalProcessor(root, score_name="Eval1").run() == 0
+        score_file = glob.glob(os.path.join(root, "**", "EvalScore*"),
+                               recursive=True)[0]
+        clean = open(score_file).read()
+
+        with faults.activate(FaultPlan.parse("preempt@chunk=3")):
+            with pytest.raises(PreemptionError):
+                EvalProcessor(root, score_name="Eval1").run()
+        partial = open(score_file).read()
+        assert partial != clean  # the kill really landed mid-file
+        ck_file = ckpt_mod.ckpt_path(root, "eval", "score-Eval1")
+        assert os.path.isfile(ck_file)  # resume has something to load
+
+        with _StreamEnv(**{"shifu.resume": "true"}):
+            assert EvalProcessor(root, score_name="Eval1").run() == 0
+        assert not os.path.isfile(ck_file)  # loaded and cleared
+    assert open(score_file).read() == clean
+
+
+# ---------------------------------------------------------------------------
+# streamed NN trainer
+# ---------------------------------------------------------------------------
+
+
+def test_streamed_nn_preempt_resume_bit_identical(tmp_path):
+    from shifu_tpu.models.nn import flatten_params
+    from shifu_tpu.norm.dataset import write_normalized
+    from shifu_tpu.train.nn_trainer import NNTrainConfig
+    from shifu_tpu.train.streaming import train_nn_streamed
+
+    rng = np.random.default_rng(0)
+    n, d = 600, 8
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    t = (x[:, 0] - x[:, 1] > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    data_dir = str(tmp_path / "norm")
+    write_normalized(data_dir, x, t, w, [f"c{i}" for i in range(d)],
+                     n_shards=3)
+
+    def cfg(ck_path):
+        return NNTrainConfig(hidden_nodes=[6], activations=["tanh"],
+                             propagation="R", num_epochs=9,
+                             valid_set_rate=0.2, seed=3,
+                             checkpoint_every=2, checkpoint_path=ck_path)
+
+    clean = train_nn_streamed(data_dir, cfg(str(tmp_path / "a.npy")))
+
+    ck_path = str(tmp_path / "b.npy")
+    with faults.activate(FaultPlan.parse("preempt@epoch=6")):
+        with pytest.raises(PreemptionError):
+            train_nn_streamed(data_dir, cfg(ck_path))
+    # the state snapshot survived the kill, the weights file is whole
+    assert os.path.isfile(ck_path + ".state" + ckpt_mod.CKPT_SUFFIX)
+    np.load(ck_path)  # readable, not torn
+    resumed = train_nn_streamed(data_dir, cfg(ck_path), resume=True)
+
+    flat_clean, _ = flatten_params(clean.params)
+    flat_resumed, _ = flatten_params(resumed.params)
+    np.testing.assert_array_equal(flat_clean, flat_resumed)
+    assert resumed.valid_error == clean.valid_error
+    assert resumed.iterations == clean.iterations
+    # completed: the resumable state is gone
+    assert not os.path.isfile(ck_path + ".state" + ckpt_mod.CKPT_SUFFIX)
+
+
+def test_streamed_nn_checkpoint_rejected_on_config_change(tmp_path):
+    """A leftover snapshot from a DIFFERENT hyperparameter set must not
+    be grafted on: resume starts fresh (sha mismatch), same result as a
+    clean run."""
+    from shifu_tpu.models.nn import flatten_params
+    from shifu_tpu.norm.dataset import write_normalized
+    from shifu_tpu.train.nn_trainer import NNTrainConfig
+    from shifu_tpu.train.streaming import train_nn_streamed
+
+    rng = np.random.default_rng(1)
+    n, d = 300, 6
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    t = (x[:, 0] > 0).astype(np.float32)
+    data_dir = str(tmp_path / "norm")
+    write_normalized(data_dir, x, t, np.ones(n, np.float32),
+                     [f"c{i}" for i in range(d)], n_shards=2)
+    ck_path = str(tmp_path / "w.npy")
+
+    def cfg(lr):
+        return NNTrainConfig(hidden_nodes=[4], activations=["tanh"],
+                             propagation="R", num_epochs=6,
+                             valid_set_rate=0.2, seed=3,
+                             learning_rate=lr,
+                             checkpoint_every=2, checkpoint_path=ck_path)
+
+    with faults.activate(FaultPlan.parse("preempt@epoch=5")):
+        with pytest.raises(PreemptionError):
+            train_nn_streamed(data_dir, cfg(0.1))
+    # resume under a CHANGED learning rate: snapshot must be rejected
+    resumed = train_nn_streamed(data_dir, cfg(0.2), resume=True)
+    clean = train_nn_streamed(data_dir, NNTrainConfig(
+        hidden_nodes=[4], activations=["tanh"], propagation="R",
+        num_epochs=6, valid_set_rate=0.2, seed=3, learning_rate=0.2))
+    a, _ = flatten_params(resumed.params)
+    b, _ = flatten_params(clean.params)
+    np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# real SIGTERM mid-train (subprocess lifecycle)
+# ---------------------------------------------------------------------------
+
+
+def _run_lifecycle_until_train(root):
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+
+    make_model_set(root, n_rows=240, seed=7)
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["train"]["numTrainEpochs"] = 400
+    mc["train"]["epochsPerIteration"] = 2  # checkpoint every 2 epochs
+    json.dump(mc, open(mcp, "w"), indent=2)
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+
+
+def _train_cmd(extra=()):
+    return ([sys.executable, "-m", "shifu_tpu", "train",
+             "-Dshifu.train.forceStreaming=true"] + list(extra))
+
+
+def _child_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_sigterm_mid_train_manifest_and_pinned_resume(tmp_path):
+    """Satellite: a subprocess lifecycle run killed by SIGTERM between
+    checkpoint segments still writes a failure manifest, and
+    `shifu train --resume` finishes with weights bit-identical to an
+    uninterrupted run."""
+    root_kill = str(tmp_path / "killed")
+    root_ref = str(tmp_path / "reference")
+    _run_lifecycle_until_train(root_kill)
+    _run_lifecycle_until_train(root_ref)
+
+    state_file = os.path.join(root_kill, "tmp", "train", "checkpoint_0",
+                              "weights.npy.state" + ckpt_mod.CKPT_SUFFIX)
+    proc = subprocess.Popen(_train_cmd(), cwd=root_kill, env=_child_env(),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        # SIGTERM as soon as the first mid-train snapshot lands — i.e.
+        # BETWEEN checkpoint segments, the torn-state window
+        deadline = time.time() + 120
+        while not os.path.isfile(state_file):
+            assert proc.poll() is None, \
+                "train finished before SIGTERM could land — raise epochs"
+            assert time.time() < deadline, "no checkpoint appeared"
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc != 0
+
+    # failure manifest (PR-2 ledger contract) landed with the preemption
+    manifest = json.load(open(os.path.join(
+        root_kill, ".shifu", "runs", "train-1.json")))
+    assert manifest["status"] == "failed"
+    assert "PreemptionError" in manifest["error"]
+    # the mid-train snapshot the kill left behind is intact AND visible
+    # to `shifu runs --resumable` (trainer snapshots live under
+    # tmp/train/checkpoint_*, not .shifu/runs/ckpt)
+    assert os.path.isfile(state_file)
+    assert any(e["name"] == "train-checkpoint_0"
+               for e in ckpt_mod.list_resumable(root_kill))
+
+    # resume the killed run; run the reference uninterrupted
+    rc = subprocess.run(_train_cmd(["--resume"]), cwd=root_kill,
+                        env=_child_env(), timeout=600).returncode
+    assert rc == 0
+    rc = subprocess.run(_train_cmd(), cwd=root_ref, env=_child_env(),
+                        timeout=600).returncode
+    assert rc == 0
+
+    from shifu_tpu.models.nn import NNModelSpec, flatten_params
+
+    killed = NNModelSpec.load(
+        os.path.join(root_kill, "models", "model0.nn"))
+    ref = NNModelSpec.load(os.path.join(root_ref, "models", "model0.nn"))
+    a, _ = flatten_params(killed.params)
+    b, _ = flatten_params(ref.params)
+    np.testing.assert_array_equal(a, b)

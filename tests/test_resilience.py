@@ -1,0 +1,490 @@
+"""Resilience layer (shifu_tpu/resilience/): fault-spec grammar, seeded
+determinism, bounded retry with backoff+jitter, atomic writes, stream
+checkpoints, the SH104 hygiene rule, and the self-healing serve worker
+(supervised restart with zero lost-but-unanswered requests)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu.resilience import checkpoint as ckpt_mod
+from shifu_tpu.resilience import faults, retry
+from shifu_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpecError,
+    InjectedFaultError,
+    PreemptionError,
+)
+
+
+class TestFaultSpec:
+    def test_grammar_round_trip(self):
+        plan = FaultPlan.parse(
+            "io:p=0.01:seed=7,device,preempt@chunk=40,slow:ms=250")
+        seams = [c.seam for c in plan.clauses]
+        assert seams == ["io", "device", "preempt", "slow"]
+        io = plan.clauses[0]
+        assert io.p == 0.01 and io.seed == 7 and io.counter == "io"
+        pre = plan.clauses[2]
+        assert pre.at == 40 and pre.counter == "chunk" and pre.max == 1
+        slow = plan.clauses[3]
+        assert slow.ms == 250 and slow.counter == "io" and slow.p == 1.0
+
+    @pytest.mark.parametrize("bad", [
+        "bogus", "io:p=2", "preempt@chunk", "io:frobnicate=1",
+        "io:p=abc", "preempt@chunk=x",
+    ])
+    def test_bad_specs_raise_at_parse(self, bad):
+        with pytest.raises(FaultSpecError):
+            FaultPlan.parse(bad)
+
+    def test_scheduled_preempt_fires_at_exact_ordinal(self):
+        plan = FaultPlan.parse("preempt@chunk=3")
+        plan.fire("chunk")
+        plan.fire("chunk")
+        with pytest.raises(PreemptionError):
+            plan.fire("chunk")
+        plan.fire("chunk")  # max=1: fired once, never again
+
+    def test_probabilistic_is_seed_deterministic(self):
+        def fired_at(seed):
+            plan = FaultPlan.parse(f"io:p=0.3:seed={seed}")
+            hits = []
+            for k in range(50):
+                try:
+                    plan.fire("io")
+                except InjectedFaultError:
+                    hits.append(k)
+            return hits
+
+        assert fired_at(7) == fired_at(7)  # same seed, same schedule
+        assert fired_at(7) != fired_at(8)
+        assert fired_at(7)  # p=0.3 over 50 events: some fire
+
+    def test_preempt_not_consumed_by_transient_on_shared_counter(self):
+        # a transient clause due on the same event must not burn the
+        # preempt clause's budget: preemption outranks and fires
+        plan = FaultPlan.parse("io:p=1:max=0,preempt@io=3")
+        for _ in range(2):
+            with pytest.raises(InjectedFaultError):
+                plan.fire("io")
+        with pytest.raises(PreemptionError):
+            plan.fire("io")
+
+    def test_absolute_index_pins_the_event(self):
+        plan = FaultPlan.parse("preempt@chunk=5")
+        plan.fire("chunk", index=10)  # ordinal 11 != 5
+        with pytest.raises(PreemptionError):
+            plan.fire("chunk", index=4)  # ordinal 5
+
+    def test_fault_point_noop_without_plan(self):
+        faults.fault_point("io")  # no plan armed: must not raise
+
+    def test_injected_faults_counted(self):
+        from shifu_tpu.obs import registry
+
+        before = registry().counter("fault.injected", seam="io").value
+        with faults.activate(FaultPlan.parse("io:p=1.0")):
+            with pytest.raises(InjectedFaultError):
+                faults.fault_point("io")
+        after = registry().counter("fault.injected", seam="io").value
+        assert after == before + 1
+
+
+class TestRetry:
+    def test_recovers_and_counts(self):
+        from shifu_tpu.obs import registry
+
+        calls = []
+        sleeps = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise InjectedFaultError("io", len(calls))
+            return "ok"
+
+        before = registry().counter("retry.recovered", seam="io").value
+        surv = registry().counter("fault.survived", seam="io").value
+        out = retry.retry_call(flaky, seam="io", sleeper=sleeps.append)
+        assert out == "ok" and len(calls) == 3
+        assert len(sleeps) == 2
+        assert registry().counter(
+            "retry.recovered", seam="io").value == before + 1
+        # both injected failures were survived — the proof pair
+        assert registry().counter(
+            "fault.survived", seam="io").value == surv + 2
+
+    def test_budget_exhaustion_reraises_original(self):
+        def always():
+            raise OSError("flaky disk")
+
+        with pytest.raises(OSError, match="flaky disk"):
+            retry.retry_call(always, seam="io", sleeper=lambda s: None)
+
+    def test_preemption_never_retried(self):
+        calls = []
+
+        def pre():
+            calls.append(1)
+            raise PreemptionError("now")
+
+        with pytest.raises(PreemptionError):
+            retry.retry_call(pre, seam="io", sleeper=lambda s: None)
+        assert len(calls) == 1
+
+    def test_backoff_windows_grow_and_jitter(self):
+        import random
+
+        rng = random.Random(3)
+        d1 = [retry.backoff_delay("io", 1, rng=rng) for _ in range(50)]
+        d2 = [retry.backoff_delay("io", 2, rng=rng) for _ in range(50)]
+        base, cap = retry.backoff_ms("io")
+        assert all(0 <= d <= base / 1000.0 for d in d1)
+        assert all(0 <= d <= 2 * base / 1000.0 for d in d2)
+        assert max(d2) > max(d1)  # window doubles
+        assert len({round(d, 9) for d in d1}) > 10  # full jitter, not fixed
+
+    def test_per_seam_budget_override(self):
+        from shifu_tpu.utils import environment
+
+        environment.set_property("shifu.retry.io.max", "5")
+        try:
+            assert retry.max_attempts("io") == 5
+            assert retry.max_attempts("device") == 3
+        finally:
+            environment.set_property("shifu.retry.io.max", "")
+
+
+class TestAtomicWrite:
+    def test_kill_during_write_preserves_previous(self, tmp_path):
+        path = str(tmp_path / "weights.npy")
+        ckpt_mod.atomic_save_npy(path, np.arange(4.0))
+        # injected ckpt fault fires after the temp bytes land but before
+        # the rename — the failure window a direct np.save loses to
+        with faults.activate(FaultPlan.parse("ckpt@ckpt=1")):
+            with pytest.raises(InjectedFaultError):
+                ckpt_mod.atomic_write(path, b"torn")
+        np.testing.assert_array_equal(np.load(path), np.arange(4.0))
+        # no temp debris left behind
+        assert os.listdir(str(tmp_path)) == ["weights.npy"]
+
+    def test_stream_checkpoint_save_retries_injected_ckpt_fault(
+            self, tmp_path):
+        ck = ckpt_mod.StreamCheckpoint(str(tmp_path / "s.ckpt.npz"), "sha")
+        with faults.activate(FaultPlan.parse("ckpt@ckpt=1")):
+            ck.save(3, arrays={"a": np.ones(2)}, meta={"k": 1})
+        ci, arrays, meta, blob = ck.load()
+        assert ci == 3 and meta == {"k": 1} and blob is None
+        np.testing.assert_array_equal(arrays["a"], np.ones(2))
+
+    def test_atomic_write_json_and_replace(self, tmp_path):
+        path = str(tmp_path / "state.json")
+        ckpt_mod.atomic_write_json(path, {"a": 1})
+        ckpt_mod.atomic_write_json(path, {"a": 2})
+        import json
+
+        assert json.load(open(path)) == {"a": 2}
+
+
+class TestStreamCheckpoint:
+    def test_config_sha_mismatch_rejects(self, tmp_path):
+        path = str(tmp_path / "s.ckpt.npz")
+        ckpt_mod.StreamCheckpoint(path, "sha-A").save(7, meta={"x": 1})
+        assert ckpt_mod.StreamCheckpoint(path, "sha-B").load() is None
+        assert ckpt_mod.StreamCheckpoint(path, "sha-A").load() is not None
+
+    def test_corrupt_file_rejected_not_crashed(self, tmp_path):
+        path = str(tmp_path / "s.ckpt.npz")
+        with open(path, "wb") as fh:
+            fh.write(b"not an npz")
+        assert ckpt_mod.StreamCheckpoint(path, "sha").load() is None
+
+    def test_cadence_and_clear(self, tmp_path):
+        path = str(tmp_path / "s.ckpt.npz")
+        ck = ckpt_mod.StreamCheckpoint(path, "sha", every=3)
+        writes = []
+        for ci in range(7):
+            wrote = ck.maybe_save(ci, lambda: (None, {"ci": ci}, None))
+            if wrote:
+                writes.append(ci)
+        assert writes == [2, 5]  # every 3rd folded chunk
+        assert ck.load()[0] == 5
+        ck.clear()
+        assert ck.load() is None
+        ck.clear()  # idempotent
+
+    def test_blob_round_trip(self, tmp_path):
+        import pickle
+
+        ck = ckpt_mod.StreamCheckpoint(str(tmp_path / "b.ckpt.npz"), "s")
+        ck.save(1, blob=pickle.dumps({"sk": [1, 2, 3]}))
+        _ci, _arrays, _meta, blob = ck.load()
+        assert pickle.loads(blob) == {"sk": [1, 2, 3]}
+
+    def test_list_resumable(self, tmp_path):
+        root = str(tmp_path)
+        ck = ckpt_mod.StreamCheckpoint(
+            ckpt_mod.ckpt_path(root, "stats", "stream"), "sha")
+        ck.save(12, meta={"phase": "pass2"})
+        entries = ckpt_mod.list_resumable(root)
+        assert len(entries) == 1
+        assert entries[0]["name"] == "stats-stream"
+        assert entries[0]["chunkIndex"] == 12
+        assert entries[0]["configSha"] == "sha"
+
+
+class TestDeviceAccumulatorSnapshot:
+    def test_snapshot_restore_bit_identical(self):
+        import jax.numpy as jnp
+
+        from shifu_tpu.data.pipeline import DeviceAccumulator
+        from shifu_tpu.ops.binagg import BinAggregates
+
+        def agg(seed):
+            rng = np.random.default_rng(seed)
+            return BinAggregates(*[
+                jnp.asarray(rng.normal(size=5).astype(np.float32))
+                for _ in range(10)])
+
+        a = DeviceAccumulator(flush_rows=100)
+        b = DeviceAccumulator(flush_rows=100)
+        for s in range(4):
+            a.add(agg(s), rows=30)  # forces one mid-stream window flush
+            b.add(agg(s), rows=30)
+        # snapshot b mid-fold, restore into a FRESH accumulator
+        c = DeviceAccumulator(flush_rows=100)
+        c.restore(b.snapshot())
+        for s in range(4, 7):
+            a.add(agg(s), rows=30)
+            c.add(agg(s), rows=30)
+        fa, fc = a.fetch(), c.fetch()
+        for xa, xc in zip(fa, fc):
+            np.testing.assert_array_equal(xa, xc)
+
+
+class TestSH104:
+    def _findings(self, src):
+        from shifu_tpu.analysis.engine import Module, PackageContext
+        from shifu_tpu.analysis.rules.hygiene import NonAtomicCheckpointWrite
+
+        m = Module("x.py", src)
+        ctx = PackageContext([m])
+        return list(NonAtomicCheckpointWrite().check(m, ctx))
+
+    def test_flags_np_save_to_checkpoint_path(self):
+        src = ("import numpy as np\n"
+               "def f(cfg, w):\n"
+               "    np.save(cfg.checkpoint_path, w)\n")
+        found = self._findings(src)
+        assert len(found) == 1 and found[0].severity == "error"
+        assert "atomic_save_npy" in found[0].message
+
+    def test_flags_open_w_to_manifest_path(self):
+        src = ("def f(manifest_path, doc):\n"
+               "    with open(manifest_path, 'w') as fh:\n"
+               "        fh.write(doc)\n")
+        assert len(self._findings(src)) == 1
+
+    def test_clean_for_atomic_helper_and_plain_paths(self):
+        src = ("import numpy as np\n"
+               "from shifu_tpu.resilience.checkpoint import atomic_save_npy\n"
+               "def f(cfg, w, out):\n"
+               "    atomic_save_npy(cfg.checkpoint_path, w)\n"
+               "    np.save(out, w)\n"
+               "    open(out, 'w').close()\n")
+        assert self._findings(src) == []
+
+    def test_flags_constant_sleep_retry_loop(self):
+        src = ("import time\n"
+               "def f(fetch):\n"
+               "    while True:\n"
+               "        try:\n"
+               "            return fetch()\n"
+               "        except OSError:\n"
+               "            time.sleep(1.0)\n")
+        found = self._findings(src)
+        assert len(found) == 1 and found[0].severity == "warning"
+
+    def test_computed_backoff_sleep_is_clean(self):
+        src = ("import time\n"
+               "def f(fetch, delay):\n"
+               "    while True:\n"
+               "        try:\n"
+               "            return fetch()\n"
+               "        except OSError:\n"
+               "            time.sleep(delay * 2)\n")
+        assert self._findings(src) == []
+
+    def test_repo_sweep_clean(self):
+        from shifu_tpu.analysis.engine import analyze
+
+        pkg = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "shifu_tpu")
+        findings = [f for f in analyze([pkg], ["SH104"])
+                    if not f.suppressed]
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# self-healing serve
+# ---------------------------------------------------------------------------
+
+
+def _fake_result(values):
+    from shifu_tpu.eval.scorer import ScoreResult
+
+    m = np.asarray(values, np.float64)[:, None]
+    return ScoreResult(model_scores=m, mean=m[:, 0], max=m[:, 0],
+                       min=m[:, 0], median=m[:, 0],
+                       model_names=["fake"], model_widths=[1])
+
+
+def _one_row(v):
+    from shifu_tpu.data.reader import ColumnarData
+
+    return ColumnarData(names=["v"],
+                        raw={"v": np.asarray([str(v)], object)}, n_rows=1)
+
+
+class TestServeSelfHealing:
+    def test_worker_crash_survived_zero_unanswered(self):
+        """Acceptance: a serve worker crash is survived — the in-flight
+        batch fails request-by-request, the queue is preserved, the
+        restarted worker answers everything else, and health walks
+        degraded -> ok."""
+        from shifu_tpu.serve.batcher import MicroBatcher
+        from shifu_tpu.serve.health import DEGRADED, OK, HealthMonitor
+        from shifu_tpu.serve.queue import AdmissionQueue
+
+        health = HealthMonitor(ok_after=1)
+        batcher = MicroBatcher(
+            lambda data: _fake_result(
+                [float(x) for x in data.column("v")]),
+            AdmissionQueue(64), max_batch_rows=1, max_wait_ms=1,
+            health=health, max_restarts=3)
+        # one injected `serve` fault: kills the worker WITH a gathered
+        # batch in flight
+        with faults.activate(FaultPlan.parse("serve@serve=1")):
+            reqs = [batcher.submit(_one_row(i)) for i in range(12)]
+            outcomes = []
+            for r in reqs:
+                try:
+                    outcomes.append(("ok", r.wait(10).mean[0]))
+                except RuntimeError as e:
+                    outcomes.append(("err", str(e)))
+        # EVERY admitted request got a response or an explicit error
+        assert len(outcomes) == 12
+        crashed = [o for o in outcomes if o[0] == "err"]
+        served = [o for o in outcomes if o[0] == "ok"]
+        assert len(crashed) >= 1  # the in-flight batch failed explicitly
+        assert "crashed" in crashed[0][1]
+        assert len(served) == 12 - len(crashed)  # queue preserved
+        assert batcher.restarts == 1
+        assert health.state in (OK, DEGRADED)
+        # clean batches after the crash walked health back to ok
+        batcher.submit(_one_row(99)).wait(10)
+        assert health.state == OK
+        batcher.admission.close()
+        batcher.join(5)
+
+    def test_restart_budget_exhaustion_drains_with_answers(self):
+        from shifu_tpu.serve.batcher import MicroBatcher
+        from shifu_tpu.serve.health import DRAINING, HealthMonitor
+        from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
+
+        health = HealthMonitor()
+        # every batch crashes the worker; budget of 1 restart
+        with faults.activate(FaultPlan.parse("serve:p=1:max=0")):
+            batcher = MicroBatcher(
+                lambda data: _fake_result([0.0] * data.n_rows),
+                AdmissionQueue(64), max_batch_rows=1, max_wait_ms=1,
+                health=health, max_restarts=1)
+            reqs = []
+            errors = 0
+            for i in range(6):
+                try:
+                    reqs.append(batcher.submit(_one_row(i)))
+                except RejectedError:
+                    errors += 1  # queue already closed by the give-up path
+            for r in reqs:
+                with pytest.raises(RuntimeError):
+                    r.wait(10)
+            batcher.join(5)
+        assert health.state == DRAINING
+        assert "exhausted" in health.reason
+        assert batcher.restarts == 1
+
+    def test_deadline_sheds_instead_of_hanging(self):
+        from shifu_tpu.serve.batcher import (
+            DeadlineExceededError,
+            MicroBatcher,
+        )
+        from shifu_tpu.serve.queue import AdmissionQueue
+
+        gate = threading.Event()
+
+        def slow_score(data):
+            gate.wait(10)
+            return _fake_result([float(x) for x in data.column("v")])
+
+        batcher = MicroBatcher(slow_score, AdmissionQueue(8),
+                               max_batch_rows=1, max_wait_ms=1,
+                               deadline_ms=50.0)
+        first = batcher.submit(_one_row(1))   # occupies the worker
+        stale = batcher.submit(_one_row(2))   # will outlive its deadline
+        time.sleep(0.2)
+        gate.set()
+        assert first.wait(10).mean[0] == pytest.approx(1.0)
+        with pytest.raises(DeadlineExceededError):
+            stale.wait(10)
+        batcher.admission.close()
+        batcher.join(5)
+
+    def test_retry_after_tracks_drain_rate(self):
+        from shifu_tpu.obs import registry
+        from shifu_tpu.serve.batcher import (
+            RETRY_AFTER_MAX_S,
+            RETRY_AFTER_MIN_S,
+            MicroBatcher,
+        )
+        from shifu_tpu.serve.queue import AdmissionQueue
+
+        batcher = MicroBatcher(
+            lambda data: _fake_result(
+                [float(x) for x in data.column("v")]),
+            AdmissionQueue(256), max_batch_rows=4, max_wait_ms=1)
+        for i in range(32):
+            batcher.submit(_one_row(i)).wait(10)
+        hint = batcher.retry_after_seconds()
+        assert RETRY_AFTER_MIN_S <= hint <= RETRY_AFTER_MAX_S
+        # empty queue + healthy drain history -> the floor
+        assert hint == pytest.approx(RETRY_AFTER_MIN_S)
+        assert registry().gauge(
+            "serve.retry_after_seconds").value == pytest.approx(hint)
+        batcher.admission.close()
+        batcher.join(5)
+
+    def test_health_monotone_draining(self):
+        from shifu_tpu.serve.health import (
+            DEGRADED,
+            DRAINING,
+            OK,
+            HealthMonitor,
+        )
+
+        h = HealthMonitor(ok_after=2)
+        assert h.state == OK
+        h.note_crash("boom")
+        assert h.state == DEGRADED and h.reason == "boom"
+        h.note_ok()
+        assert h.state == DEGRADED  # hysteresis: one ok is not enough
+        h.note_ok()
+        assert h.state == OK and h.reason == ""
+        h.set_draining("shutdown")
+        h.note_ok()
+        h.note_crash("x")
+        assert h.state == DRAINING  # monotone: drained stays drained
