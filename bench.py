@@ -78,6 +78,15 @@ STREAMED_STATS = dict(n=120_000, numeric=8, cat=2, chunk_rows=8192)
 # all), so it too stays out of BASELINE_MEASURED.json
 SERVE = dict(cols=30, hidden=[50], bags=3, requests=240,
              concurrency=(1, 4, 16), queue_depth=256)
+# sharded_stats sweeps FORCED host-device counts in subprocesses (the
+# device count must be fixed before jax initializes), measuring the
+# sharded lifecycle fold's work division and sync budget. CPU-harness
+# rows/s efficiency is REPORTED, not gated — on a GIL-bound CPU harness
+# 8 virtual devices buy no wall-clock — the gates are the structural
+# wins: each shard folds <= ceil(K/S)+1 chunks, and d2h syncs per
+# window stay at 1 (the psum tree) instead of O(S).
+SHARDED_STATS = dict(n=36_000, numeric=6, cat=2, chunk_rows=3072,
+                     device_counts=(1, 2, 8), reps=2)
 
 def chip_peak_tflops():
     """Pinned-peak lookup from the shared chip table (obs/costmodel.py —
@@ -732,6 +741,155 @@ def bench_streamed_stats(reps: int):
     }
 
 
+def _sharded_stats_child() -> None:
+    """Entry for `bench.py --sharded-stats-child`: one forced-device-count
+    measurement of the sharded streaming-stats fold. Runs in its own
+    process because the XLA host-device count must be fixed BEFORE jax
+    initializes — the parent sets XLA_FLAGS/JAX_PLATFORMS in this child's
+    environment. Prints ONE JSON line."""
+    import shutil
+    import tempfile
+
+    from shifu_tpu import obs
+    from shifu_tpu.config import ColumnConfig, ColumnType
+    from shifu_tpu.config.column_config import ColumnFlag
+    from shifu_tpu.config.model_config import Algorithm, new_model_config
+    from shifu_tpu.data.stream import chunk_source
+    from shifu_tpu.parallel.mesh import lifecycle_shards
+    from shifu_tpu.stats.engine import compute_stats_streaming
+
+    spec = SHARDED_STATS
+    n, chunk_rows = spec["n"], spec["chunk_rows"]
+    rng = np.random.default_rng(0)
+    y = (rng.random(n) < 0.3).astype(int)
+    num = rng.normal(loc=y[:, None] * 0.8, size=(n, spec["numeric"]))
+    cat_vals = np.array(["aa", "bb", "cc", "dd", "ee"])
+    cats = cat_vals[rng.integers(0, len(cat_vals), size=(n, spec["cat"]))]
+    names = (["target"] + [f"n{j}" for j in range(spec["numeric"])]
+             + [f"c{j}" for j in range(spec["cat"])])
+
+    tmp = tempfile.mkdtemp(prefix="bench-shstats-")
+    data_path = os.path.join(tmp, "data.txt")
+    with open(data_path, "w") as fh:
+        for i in range(n):
+            fh.write("|".join([str(y[i])] + [f"{v:.5f}" for v in num[i]]
+                              + list(cats[i])) + "\n")
+
+    mc = new_model_config("BenchShardedStats", Algorithm.NN)
+    mc.data_set.target_column_name = "target"
+    mc.data_set.pos_tags = ["1"]
+    mc.data_set.neg_tags = ["0"]
+
+    def fresh_cols():
+        cols = [ColumnConfig(column_num=0, column_name="target",
+                             column_flag=ColumnFlag.TARGET)]
+        for j in range(spec["numeric"]):
+            cols.append(ColumnConfig(column_num=1 + j, column_name=f"n{j}",
+                                     column_type=ColumnType.N))
+        for j in range(spec["cat"]):
+            cols.append(ColumnConfig(column_num=1 + spec["numeric"] + j,
+                                     column_name=f"c{j}",
+                                     column_type=ColumnType.C))
+        return cols
+
+    factory = chunk_source(data_path, names, delimiter="|",
+                           chunk_rows=chunk_rows)
+    S = lifecycle_shards()
+    K = -(-n // chunk_rows)
+    try:
+        compute_stats_streaming(mc, fresh_cols(), factory)  # warm compile
+        times = []
+        for _ in range(spec["reps"]):
+            obs.reset()
+            t0 = time.perf_counter()
+            compute_stats_streaming(mc, fresh_cols(), factory)
+            times.append(time.perf_counter() - t0)
+        reg = obs.registry()  # counters of the LAST measured run
+        shard_chunks = {
+            stage: [int(reg.counter("shard.chunks", shard=str(s),
+                                    stage=f"stats.{stage}").value)
+                    for s in range(S)]
+            for stage in ("pass1", "pass2")}
+        med = statistics.median(times)
+        print(json.dumps({
+            "devices": S,
+            "chunks": K,
+            "rows_per_s": n / med,
+            "seconds": med,
+            "shard_chunks": shard_chunks,
+            "max_shard_chunks": max(max(v) for v in
+                                    shard_chunks.values()),
+            "d2h_syncs": int(reg.counter("device.d2h_syncs").value),
+            "psum_windows": int(reg.counter(
+                "reduce.psum_windows").value),
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_sharded_stats():
+    """Sweep forced host-device counts (1/2/8) over the sharded
+    streaming-stats fold, one subprocess per count. Gates the structural
+    acceptance — work division <= ceil(K/S)+1 chunks per shard and ONE
+    d2h sync per psum window — and reports CPU-harness rows/s + scaling
+    efficiency vs 1-shard ungated."""
+    import subprocess
+
+    spec = SHARDED_STATS
+    counts = {}
+    gates = {"work_division": True, "single_sync_per_window": True}
+    base = None
+    for n_dev in spec["device_counts"]:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_dev}").strip()
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--sharded-stats-child"],
+            env=env, capture_output=True, text=True, timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"sharded_stats child ({n_dev} devices) failed:\n"
+                f"{proc.stderr[-2000:]}")
+        res = json.loads(proc.stdout.strip().splitlines()[-1])
+        K, S = res["chunks"], res["devices"]
+        bound = -(-K // S) + 1
+        division_ok = res["max_shard_chunks"] <= bound
+        sync_ok = (res["psum_windows"] >= 1
+                   and res["d2h_syncs"] == res["psum_windows"])
+        gates["work_division"] &= division_ok
+        gates["single_sync_per_window"] &= sync_ok
+        if base is None:
+            base = res["rows_per_s"]
+        counts[str(n_dev)] = {
+            "rows_per_s": round(res["rows_per_s"], 1),
+            "chunks": K,
+            "max_shard_chunks": res["max_shard_chunks"],
+            "chunk_bound": bound,
+            "shard_chunks": res["shard_chunks"],
+            "d2h_syncs": res["d2h_syncs"],
+            "psum_windows": res["psum_windows"],
+            "scaling_efficiency_vs_1shard": round(
+                res["rows_per_s"] / base / n_dev, 4),
+        }
+    if not (gates["work_division"] and gates["single_sync_per_window"]):
+        raise RuntimeError(f"sharded_stats gates failed: {gates} "
+                           f"{json.dumps(counts)}")
+    return {
+        "shard_counts": counts,
+        "gates": gates,
+        "note": ("forced host-device sweep of the sharded lifecycle "
+                 "fold; gated: each shard folds <= ceil(K/S)+1 chunks "
+                 "and host d2h syncs per window == 1 (psum-tree "
+                 "reduce). CPU-harness rows/s and scaling efficiency "
+                 "are reported, not gated — the GIL bounds parse "
+                 "overlap here; the division + sync structure is what "
+                 "carries to a real mesh"),
+    }
+
+
 def bench_serve_latency():
     """Online scoring (shifu_tpu/serve/): p50/p99 single-record latency +
     QPS at several closed-loop concurrency levels, through the full
@@ -908,6 +1066,8 @@ def main() -> None:
                                  "streamed_nn")
     streamed_stats = _with_obs_metrics(
         lambda: bench_streamed_stats(reps=3), "streamed_stats")
+    # subprocess sweep: sanitizer/obs wrappers stay in the children
+    sharded_stats = bench_sharded_stats()
     serve_latency = _with_obs_metrics(
         bench_serve_latency, "serve_latency", transfer_clean=True)
 
@@ -992,6 +1152,7 @@ def main() -> None:
                      "serial wall-clock / prefetched wall-clock on the "
                      "identical chunk stream (results bit-identical)"),
         },
+        "sharded_stats": sharded_stats,
         "serve_latency": {
             **{k: v for k, v in serve_latency.items()
                if k.startswith("concurrency_") or k == "registry"},
@@ -1008,4 +1169,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--sharded-stats-child" in sys.argv:
+        _sharded_stats_child()
+    else:
+        main()

@@ -205,51 +205,91 @@ class NonAtomicCheckpointWrite(Rule):
 
 _STREAM_ENTRY_RE = re.compile(r"(_streamed|_streaming)$|^stream_")
 _PLUMBING_PARAM_RE = re.compile(r"chunk|prefetch|feed|source|factory")
+# names that mean "this entry point iterates RAW ingest chunks" — the
+# loops that must divide work over the lifecycle shard planner
+_CHUNK_LOOP_NAMES = {"chunk_source", "iter_columnar_chunks",
+                     "chunk_factory", "chunk_rows_setting"}
+# ... and the planner vocabulary that proves it does
+_SHARD_PLAN_NAMES = {"ShardPlan", "shard_of", "shard_slice",
+                     "lifecycle_shards", "fold_group"}
+_SINGLE_SHARD_RE = re.compile(r"single[- ]shard", re.IGNORECASE)
 
 
 @register
 class StreamingPlumbing(Rule):
-    """SH103 — streaming entry point without chunk/prefetch plumbing.
+    """SH103 — streaming entry point without chunk/prefetch plumbing, or
+    chunk loop without the shard planner.
 
     Every streamed path must honor shifu.ingest.prefetchChunks and the
     chunk sizing knobs — an entry point that hand-rolls its own loop
     silently loses the overlapped-pipeline behavior (and its tests).
+    And every entry point that loops RAW ingest chunks must divide them
+    over the lifecycle shard planner (data/pipeline.ShardPlan) — a
+    hand-rolled chunk loop is O(rows) no matter how many chips are
+    attached — or declare single-shard intent ("single-shard" in its
+    docstring) when the loop is genuinely host-local.
 
     bad:  def train_foo_streamed(dir, cfg):
               for shard in read_all(dir): ...   # no prefetch, no knobs
+    bad:  def score_streaming(path):
+              for chunk in chunk_source(path)(): ...  # O(rows), no plan
     good: drive shifu_tpu.data.pipeline.prefetch_iter (directly or via a
-          feed/chunk_factory parameter), or accept chunk_rows/prefetch.
+          feed/chunk_factory parameter), or accept chunk_rows/prefetch;
+          divide chunks with ShardPlan.shard_of / declare single-shard.
     """
 
     id = "SH103"
     severity = "warning"
-    summary = ("streaming entry point neither drives prefetch_iter nor "
-               "accepts chunk/prefetch plumbing")
+    summary = ("streaming entry point without chunk/prefetch plumbing, "
+               "or raw-chunk loop bypassing the shard planner")
 
     def check(self, module: Module,
               ctx: PackageContext) -> Iterator["Finding"]:
         for node in module.tree.body:
-            if not isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                continue
-            if not _STREAM_ENTRY_RE.search(node.name):
-                continue
-            params = [p.arg for p in (node.args.posonlyargs + node.args.args
-                                      + node.args.kwonlyargs)]
-            if any(_PLUMBING_PARAM_RE.search(p) for p in params):
-                continue
-            closure = ctx.reference_closure(module, node)
-            if {"prefetch_iter", "chunk_source", "stream_columnar"} \
-                    & closure:
-                continue
+            yield from self._check_def(module, ctx, node)
+
+    def _check_def(self, module: Module, ctx: PackageContext,
+                   node) -> Iterator["Finding"]:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(node, ast.ClassDef):  # methods are entry points
+                for sub in node.body:
+                    yield from self._check_def(module, ctx, sub)
+            return
+        if not _STREAM_ENTRY_RE.search(node.name):
+            return
+        closure = ctx.reference_closure(module, node)
+        delegates = any(_STREAM_ENTRY_RE.search(n)
+                        for n in closure - {node.name})
+        params = [p.arg for p in (node.args.posonlyargs + node.args.args
+                                  + node.args.kwonlyargs)]
+        has_plumbing = (
+            any(_PLUMBING_PARAM_RE.search(p) for p in params)
+            or bool({"prefetch_iter", "chunk_source", "stream_columnar"}
+                    & closure)
             # delegating to another streaming entry point (processor
             # wrappers around train/*_streamed) inherits its plumbing
-            if any(_STREAM_ENTRY_RE.search(n)
-                   for n in closure - {node.name}):
-                continue
+            or delegates)
+        if not has_plumbing:
             yield self.finding(
                 module, node,
                 f"streaming entry point `{node.name}` neither drives "
                 f"prefetch_iter/chunk_source nor accepts chunk/prefetch "
                 f"plumbing (chunk_rows=, prefetch=, feed=, *_factory=) — "
                 f"the overlapped-pipeline knobs will be silently ignored")
+            return
+        # sharded-lifecycle check: a raw-chunk loop that bypasses the
+        # shard planner reintroduces an O(rows) single-host path
+        if not (_CHUNK_LOOP_NAMES & closure) or delegates:
+            return
+        if _SHARD_PLAN_NAMES & closure:
+            return
+        doc = ast.get_docstring(node) or ""
+        if _SINGLE_SHARD_RE.search(doc):
+            return
+        yield self.finding(
+            module, node,
+            f"streaming entry point `{node.name}` loops raw ingest "
+            f"chunks without the shard planner — divide chunks with "
+            f"data/pipeline.ShardPlan (shard_of/shard_slice) so the "
+            f"fold stays O(rows/shards), or declare \"single-shard\" "
+            f"intent in its docstring")

@@ -19,17 +19,32 @@ stats, streaming norm, the NN/WDL/tree shard feeds, chunked scoring):
     take O(log max_chunk_rows) distinct values and jit consumers compile
     a bounded set of programs regardless of the chunk-size sequence (the
     old running-max padding recompiled every time a larger chunk arrived).
-  * ``DeviceAccumulator`` — keeps the flat BinAggregates fold resident on
-    device across chunks (one jitted elementwise combine per chunk), so
-    the only device->host transfer in a streamed aggregation is the final
-    fetch instead of a full sync per chunk.
+  * ``ShardPlan`` — the deterministic chunk -> row-shard assignment the
+    whole lifecycle shares (round-robin on the chunk index), so every
+    streaming fold divides work O(rows/shards) over the mesh and every
+    shard can prefetch exactly its own slice.
+  * ``DeviceAccumulator`` — keeps one f32 BinAggregates window PER ROW
+    SHARD resident on the lifecycle mesh across chunks (the fold is a
+    shard_map program: each shard aggregates its own chunk locally), so
+    the only device->host transfer in a streamed aggregation is one
+    psum-tree-reduced window flush instead of a full sync per chunk —
+    and instead of one pull per shard.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Any, Callable, Iterable, Iterator, List, Optional
+from typing import (
+    Any,
+    Callable,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -200,76 +215,149 @@ def prefetch_iter(
     return _consume()
 
 
-_COMBINE = None
+# ---------------------------------------------------------------------------
+# shard planning — the lifecycle map/reduce work division
+# ---------------------------------------------------------------------------
 
 
-def _combine_program():
-    """Jitted elementwise fold of two BinAggregates (add everywhere, min
-    for vmin, max for vmax). Compiles once per (total_slots, n_numeric)."""
-    global _COMBINE
-    if _COMBINE is None:
-        import jax
-        import jax.numpy as jnp
+class ShardPlan:
+    """Deterministic chunk -> row-shard assignment for the lifecycle
+    folds (streaming stats, norm, eval scoring, init autotype).
 
-        from shifu_tpu.ops.binagg import BinAggregates
+    Round-robin on the global chunk index: `shard_of(ci) = ci % S`, so
+    with S shards over K chunks every shard folds at most ceil(K/S)
+    chunks — the work-division bound the sharded_stats bench gates. The
+    assignment is a pure function of (ci, S): every pass, every resume,
+    and every host in a real multi-host run derives the identical plan
+    with zero coordination, and a shard can prefetch exactly its own
+    slice of the chunk stream (`shard_slice`). S=1 is the degenerate
+    single-device plan — same code path, every chunk on shard 0.
+    """
 
-        @jax.jit
-        def combine(acc, part):
-            out: List[Any] = [a + p for a, p in zip(acc, part)]
-            out[6] = jnp.minimum(acc.vmin, part.vmin)
-            out[7] = jnp.maximum(acc.vmax, part.vmax)
-            return BinAggregates(*out)
+    def __init__(self, n_shards: Optional[int] = None) -> None:
+        from shifu_tpu.parallel.mesh import lifecycle_shards
 
-        _COMBINE = combine
-    return _COMBINE
+        self.n_shards = (lifecycle_shards() if n_shards is None
+                         else max(1, int(n_shards)))
+
+    def shard_of(self, chunk_index: int) -> int:
+        return chunk_index % self.n_shards
+
+    def group_of(self, chunk_index: int) -> int:
+        """Super-step index: group g holds chunks [g*S, (g+1)*S) — one
+        chunk per shard, the unit one sharded fold dispatch consumes."""
+        return chunk_index // self.n_shards
+
+    def shard_slice(self, numbered: Iterable, shard: int) -> Iterator:
+        """Only the (ci, item) pairs assigned to `shard` — what a
+        multi-host shard would prefetch as its own slice."""
+        for ci, item in numbered:
+            if self.shard_of(ci) == shard:
+                yield ci, item
+
+    def resume_slice(self, numbered: Iterable,
+                     cursors: Sequence[int]) -> Iterator:
+        """Per-shard resume: yield (ci, item) pairs each shard has NOT
+        folded yet (ci > its cursor). Chunks below every cursor are
+        skipped before parse, exactly like the single-cursor
+        checkpoint.resume_slice."""
+        for pair in numbered:
+            if pair[0] > cursors[self.shard_of(pair[0])]:
+                yield pair
+
+    def record(self, shard: int, rows: int, stage: str) -> None:
+        """Per-shard obs: shard.chunks / shard.rows land in every
+        manifest, labeled by shard and lifecycle stage — the counters the
+        work-division acceptance asserts."""
+        from shifu_tpu.obs import registry
+
+        reg = registry()
+        reg.counter("shard.chunks", shard=str(shard), stage=stage).inc()
+        reg.counter("shard.rows", shard=str(shard), stage=stage).inc(rows)
 
 
-# Device windows fold in f32; a slot's count stays exact below 2^24, so a
-# window is flushed to the host float64 fold before its ROW total can
-# reach that (2^23 leaves a whole 65536-row chunk of headroom, and a
-# slot's count is bounded by the window's row count).
+# Device windows fold in f32; a slot's count stays exact below 2^24. The
+# psum reduce SUMS the S shard windows in f32, so the bound that matters
+# is the TOTAL row count across all shard windows: the window flushes to
+# the host float64 fold before that total can reach 2^24 (2^23 leaves a
+# whole 65536-row chunk of headroom; a reduced slot count is bounded by
+# the window's total rows). Per-shard bounds alone would NOT be enough —
+# S exact per-shard counts can sum past 2^24.
 WINDOW_FLUSH_ROWS = 1 << 23
 
 
 class DeviceAccumulator:
-    """Device-resident fold of per-chunk BinAggregates, flushed to a host
-    float64 fold in bounded windows.
+    """Sharded device-resident fold of per-chunk BinAggregates, flushed
+    to a host float64 fold in bounded windows.
 
-    The serial path pulled every chunk's full aggregate back to host
-    (np.asarray per chunk — a blocking device->host sync that serialized
-    the pipeline); here chunks fold on device (one tiny jitted combine
-    dispatch each) and only every ~2^23 ROWS the window syncs into a host
-    float64 accumulator. Within a window the f32 fold is exact for counts
-    (slot counts are bounded by window rows < 2^24) and float-summation-
-    order-accurate for the moment sums; across windows everything
-    accumulates in float64 — arbitrarily long streams cannot saturate.
-    A 65536-row-chunk stream syncs once per ~128 chunks instead of per
-    chunk."""
+    One f32 window per row shard, stacked [S, ...] and sharded over the
+    lifecycle mesh (parallel/mesh.py). The fold is a shard_map program
+    (ops/binagg.sharded_window_fold): each shard bin-aggregates its own
+    chunk locally and folds it into its own window — one dispatch folds
+    up to S chunks with no cross-shard traffic. The windowed flush is ONE
+    psum-tree reduction over the mesh's row axes (dcn, data) followed by
+    ONE device->host sync — where a per-shard host accumulation would
+    cost O(S) pulls per window, the reduce rides ICI/DCN and the host
+    sees a single replicated result.
 
-    def __init__(self, flush_rows: int = WINDOW_FLUSH_ROWS) -> None:
-        self._acc = None  # device window
+    Exactness invariant (unchanged from the single-device fold, which is
+    the S=1 degenerate case of this class): within a window every count
+    is exact in f32 — each shard's slot counts are bounded by its own
+    window rows, the psum sums them exactly because the flush policy
+    bounds the TOTAL window rows across shards below 2^23 < 2^24 — and
+    the moment sums are float-summation-order-accurate; across windows
+    everything accumulates in float64 — arbitrarily long streams cannot
+    saturate, and counts are exact at any stream length and shard count.
+    """
+
+    def __init__(self, flush_rows: int = WINDOW_FLUSH_ROWS,
+                 n_shards: int = 1) -> None:
+        self._acc = None  # stacked [S, ...] device windows
         self._host: Optional[List[np.ndarray]] = None  # f64 fold
-        self._rows = 0
         self._flush_rows = flush_rows
+        self.n_shards = max(1, int(n_shards))
+        self._rows = np.zeros(self.n_shards, dtype=np.int64)
+        self._mesh = None
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from shifu_tpu.parallel.mesh import lifecycle_mesh
+
+            self._mesh = lifecycle_mesh(self.n_shards)
+        return self._mesh
 
     @property
     def empty(self) -> bool:
         return self._acc is None and self._host is None
+
+    @property
+    def window_rows(self) -> int:
+        """Total window rows across shards (the f32-exactness bound the
+        flush policy enforces — the psum reduce sums all shards)."""
+        return int(self._rows.sum())
 
     def _flush(self) -> None:
         if self._acc is None:
             return
         import jax
 
-        from shifu_tpu.obs import registry
+        from shifu_tpu.obs import profile, registry
+        from shifu_tpu.ops.binagg import window_reduce
 
-        # every window flush IS a blocking device->host sync — the count is
-        # the pipeline's d2h budget (one per ~2^23 rows, was one per chunk)
-        registry().counter("device.d2h_syncs").inc()
-        part = [np.asarray(x, dtype=np.float64)
-                for x in jax.device_get(self._acc)]
+        reg = registry()
+        # the reduce: ONE psum tree over the row axes closes all S shard
+        # windows; the single device_get below is the window's ENTIRE d2h
+        # budget — was one pull per shard
+        reg.counter("reduce.psum_windows").inc()
+        reg.counter("device.d2h_syncs").inc()
+        reduced = profile.dispatch(
+            "pipeline.psum_reduce", window_reduce(self.mesh), self._acc,
+            sync=False)
+        part = [np.asarray(x[0], dtype=np.float64)
+                for x in jax.device_get(reduced)]
         self._acc = None
-        self._rows = 0
+        self._rows[:] = 0
         if self._host is None:
             self._host = part
         else:
@@ -280,28 +368,89 @@ class DeviceAccumulator:
                 for k, (h, p) in enumerate(zip(self._host, part))
             ]
 
-    def add(self, agg, rows: int) -> None:
-        """Fold one chunk's aggregates in; `rows` is the chunk's REAL row
-        count (padding rows carry invalid tags and count nothing)."""
-        if self._acc is not None and self._rows + rows > self._flush_rows:
-            self._flush()
+    def _ensure_window(self, total_slots: int, n_numeric: int) -> None:
         if self._acc is None:
-            self._acc = agg
-        else:
-            # sanitizer seam: both operands are already device-resident
-            # (agg is a jit output), so the fold dispatch must not move
-            # bytes; the only sanctioned transfer is _flush's explicit
-            # device_get (-Dshifu.sanitize=transfer). Profiled async
-            # (sync would reintroduce the per-chunk RTT wait this
-            # accumulator exists to remove).
-            from shifu_tpu.analysis import sanitize
-            from shifu_tpu.obs import profile
+            from shifu_tpu.ops.binagg import window_init
 
-            with sanitize.transfer_free("pipeline.device_fold"):
-                self._acc = profile.dispatch(
-                    "pipeline.device_fold", _combine_program(),
-                    self._acc, agg, sync=False)
-        self._rows += rows
+            self._acc = window_init(self.mesh, total_slots, n_numeric)
+
+    def add(self, agg, rows: int, shard: int = 0) -> None:
+        """Fold ONE precomputed chunk aggregate into `shard`'s window;
+        `rows` is the chunk's REAL row count (padding rows carry invalid
+        tags and count nothing). The streamed stats path uses fold_group
+        (the in-program map) instead; this is the entry point for callers
+        that already hold a BinAggregates."""
+        if self._acc is not None \
+                and self.window_rows + rows > self._flush_rows:
+            self._flush()
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from shifu_tpu.analysis import sanitize
+        from shifu_tpu.obs import profile
+        from shifu_tpu.ops.binagg import masked_window_add
+
+        self._ensure_window(int(agg.pos.shape[0]), int(agg.vsum.shape[0]))
+        # replication of the aggregate across the mesh is the one
+        # sanctioned move — explicit, before the guard arms
+        rep = NamedSharding(self.mesh, P())
+        agg = jax.device_put(agg, rep)
+        sid = jax.device_put(np.int32(shard), rep)
+        # sanitizer seam: window + aggregate are now device-resident and
+        # correctly placed, so the fold dispatch must not move bytes; the
+        # only sanctioned transfer is _flush's explicit device_get.
+        # Profiled async (sync would reintroduce the per-chunk RTT wait
+        # this accumulator exists to remove).
+        with sanitize.transfer_free("pipeline.device_fold"):
+            self._acc = profile.dispatch(
+                "pipeline.device_fold", masked_window_add(self.mesh),
+                self._acc, agg, sid, sync=False)
+        self._rows[shard] += rows
+
+    def fold_group(self, codes: np.ndarray, col_offsets: np.ndarray,
+                   total_slots: int, tags: np.ndarray,
+                   weights: np.ndarray, values: np.ndarray,
+                   rows_per_shard: Sequence[int]) -> None:
+        """The sharded map: fold one super-step group — stacked [S, n, C]
+        codes / [S, n] tags / [S, n] weights / [S, n, Cn] values, one row
+        block per shard (empty shards carry invalid-tag padding) — in ONE
+        shard_map dispatch. Each shard aggregates its own block locally
+        and folds it into its own f32 window."""
+        adds = np.asarray(rows_per_shard, dtype=np.int64)
+        if self._acc is not None \
+                and self.window_rows + int(adds.sum()) > self._flush_rows:
+            self._flush()
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from shifu_tpu.analysis import sanitize
+        from shifu_tpu.obs import profile
+        from shifu_tpu.ops.binagg import sharded_window_fold
+        from shifu_tpu.parallel.mesh import row_axes
+
+        self._ensure_window(int(total_slots), int(values.shape[2]))
+        axes = row_axes(self.mesh)
+        ax = axes if len(axes) > 1 else axes[0]
+
+        def rspec(ndim):
+            return NamedSharding(
+                self.mesh, P(ax, *([None] * (ndim - 1))))
+
+        # each shard's slice lands on its own devices — the explicit,
+        # sanctioned h2d placement ("each host prefetches its own shard")
+        codes_d = jax.device_put(codes, rspec(3))
+        tags_d = jax.device_put(tags, rspec(2))
+        weights_d = jax.device_put(weights, rspec(2))
+        values_d = jax.device_put(values, rspec(3))
+        offs_d = jax.device_put(col_offsets,
+                                NamedSharding(self.mesh, P(None)))
+        with sanitize.transfer_free("pipeline.sharded_fold"):
+            self._acc = profile.dispatch(
+                "pipeline.sharded_fold",
+                sharded_window_fold(self.mesh, int(total_slots)),
+                self._acc, codes_d, offs_d, tags_d, weights_d, values_d,
+                sync=False)
+        self._rows += adds
 
     def fetch(self) -> Optional[List[np.ndarray]]:
         """Final sync: aggregates as float64 numpy arrays in BinAggregates
@@ -312,11 +461,12 @@ class DeviceAccumulator:
     # ---- checkpoint seam (resilience/checkpoint.py) ----
     def snapshot(self) -> dict:
         """Checkpointable state WITHOUT forcing a window flush: the f32
-        device window is pulled as-is (device_get is bit-exact), so a
-        resumed fold continues the identical f32 summation order and the
-        result stays bit-identical to an uninterrupted run — flushing
-        early here would regroup the f32 sums and break parity."""
-        out: dict = {"rows": self._rows}
+        device windows are pulled as-is (device_get is bit-exact), so a
+        resumed fold continues the identical per-shard f32 summation
+        order and the result stays bit-identical to an uninterrupted run
+        — flushing early here would regroup the f32 sums and break
+        parity."""
+        out: dict = {"rows": self._rows.copy()}
         if self._host is not None:
             for k, a in enumerate(self._host):
                 out[f"host{k}"] = a
@@ -328,7 +478,8 @@ class DeviceAccumulator:
         return out
 
     def restore(self, arrays: dict) -> None:
-        """Rebuild from `snapshot` arrays (device window re-placed)."""
+        """Rebuild from `snapshot` arrays (stacked windows re-placed
+        sharded over the lifecycle mesh)."""
         host = [arrays[f"host{k}"] for k in range(len(arrays))
                 if f"host{k}" in arrays]
         self._host = [np.asarray(a, dtype=np.float64) for a in host] \
@@ -336,11 +487,53 @@ class DeviceAccumulator:
         win = [arrays[f"win{k}"] for k in range(len(arrays))
                if f"win{k}" in arrays]
         if win:
-            import jax.numpy as jnp
-
-            from shifu_tpu.ops.binagg import BinAggregates
-
-            self._acc = BinAggregates(*[jnp.asarray(a) for a in win])
+            self._acc = self._place_windows(win)
         else:
             self._acc = None
-        self._rows = int(arrays["rows"])
+        rows = np.atleast_1d(np.asarray(arrays["rows"], dtype=np.int64))
+        assert rows.shape[0] == self.n_shards, (rows.shape, self.n_shards)
+        self._rows = rows.copy()
+
+    def _place_windows(self, win: List[np.ndarray]):
+        import jax
+
+        from shifu_tpu.ops.binagg import BinAggregates, window_specs
+        from jax.sharding import NamedSharding
+
+        sharded, _ = window_specs(self.mesh)
+        return BinAggregates(*[
+            jax.device_put(np.asarray(a, dtype=np.float32),
+                           NamedSharding(self.mesh, s))
+            for a, s in zip(win, sharded)])
+
+    # ---- per-shard checkpoint layout (ShardedStreamCheckpoint) ----
+    def snapshot_parts(self) -> Tuple[List[dict], dict]:
+        """(per_shard, shared): shard s's file gets ITS window slice +
+        row count (`local fold state per shard`); the shared reduce file
+        gets the post-psum host float64 fold, which no single shard
+        owns."""
+        snap = self.snapshot()
+        per_shard: List[dict] = []
+        for s in range(self.n_shards):
+            part = {"rows": np.int64(self._rows[s])}
+            for k in range(10):
+                if f"win{k}" in snap:
+                    part[f"win{k}"] = snap[f"win{k}"][s]
+            per_shard.append(part)
+        shared = {k: v for k, v in snap.items() if k.startswith("host")}
+        return per_shard, shared
+
+    def restore_parts(self, per_shard: List[dict], shared: dict) -> None:
+        assert len(per_shard) == self.n_shards, \
+            (len(per_shard), self.n_shards)
+        merged: dict = {
+            "rows": np.asarray([int(p["rows"]) for p in per_shard],
+                               dtype=np.int64)}
+        if any("win0" in p for p in per_shard):
+            for k in range(10):
+                if f"win{k}" not in per_shard[0]:
+                    continue
+                merged[f"win{k}"] = np.stack(
+                    [p[f"win{k}"] for p in per_shard])
+        merged.update(shared)
+        self.restore(merged)

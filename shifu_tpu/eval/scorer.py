@@ -113,15 +113,31 @@ class ModelRunner:
         self.scale = scale
         self._norm_cache: Dict[str, np.ndarray] = {}
         self._codes_cache: Dict[str, np.ndarray] = {}
-        self._cached_data_id: Optional[int] = None
+        self._cached_data_ref = None  # weakref to the cached batch
 
     def _check_batch(self, data: ColumnarData) -> None:
         """Feature caches are per input batch — a new ColumnarData object
-        invalidates them (model signatures alone don't identify the rows)."""
-        if self._cached_data_id != id(data):
+        invalidates them (model signatures alone don't identify the rows).
+
+        Identity is held via WEAKREF, never `id()`: in a streaming loop
+        the previous chunk is freed before the next one arrives, and the
+        allocator routinely hands the new chunk the old address — an
+        id()-keyed check then serves the PREVIOUS chunk's normalized
+        features for the new chunk's rows (observed as a whole chunk of
+        wrong scores, timing-dependent). A dead or different referent
+        always invalidates; the weakref itself keeps no chunk alive, so
+        the bounded-memory envelope is untouched."""
+        cached = (self._cached_data_ref()
+                  if self._cached_data_ref is not None else None)
+        if cached is not data:
             self._norm_cache.clear()
             self._codes_cache.clear()
-            self._cached_data_id = id(data)
+            import weakref
+
+            try:
+                self._cached_data_ref = weakref.ref(data)
+            except TypeError:  # un-weakrefable batch: never reuse across calls
+                self._cached_data_ref = None
 
     @staticmethod
     def _independent(spec):

@@ -212,6 +212,17 @@ class ProgramProfiler:
             st["compileSeconds"] += entry.compile_seconds
             st["programsCompiled"] += 1
 
+    def annotate(self, name: str, **kv: Any) -> None:
+        """Attach kernel/program shaping facts (chosen block sizes, knob
+        values) to seam `name`; they ride into every snapshot so a
+        tuning sweep can read WHICH shaping produced WHICH roofline
+        numbers from the manifest alone. Stored PROCESS-globally (like
+        the cost cache): annotations describe compiled kernels, which
+        survive obs.reset() too — a build in an earlier scope must still
+        be visible in a later scope's manifest."""
+        with _ann_lock:
+            _annotations_store.setdefault(name, {}).update(kv)
+
     def record_dispatch(self, name: str, entry: Optional[_CostEntry],
                         scale: float, seconds: float, sync: bool) -> None:
         with self._lock:
@@ -258,6 +269,9 @@ class ProgramProfiler:
             peaks = costmodel.detect()
         with self._lock:
             progs = {k: dict(v) for k, v in self._programs.items()}
+        with _ann_lock:
+            annotations = {k: dict(v)
+                           for k, v in _annotations_store.items()}
         out_programs = {}
         for name, st in sorted(progs.items()):
             synced = (st["dispatches"] > 0
@@ -300,20 +314,34 @@ class ProgramProfiler:
         tot.update(costmodel.derive(
             tot["flops"] or None, tot["bytesAccessed"] or None,
             device_s if all_synced and device_s else None, peaks))
-        return {
+        out = {
             "schema": SCHEMA,
             "chip": costmodel.peaks_dict(peaks),
             "programs": out_programs,
             "totals": tot,
         }
+        if annotations:
+            out["annotations"] = annotations
+        return out
 
 
 _profiler = ProgramProfiler()
+
+# program-shaping annotations: process-global on purpose (see
+# ProgramProfiler.annotate) — reset() preserves them, like _cost_cache
+_annotations_store: Dict[str, Dict[str, Any]] = {}
+_ann_lock = threading.Lock()
 
 
 def profiler() -> ProgramProfiler:
     """The process-global profiler (current obs scope)."""
     return _profiler
+
+
+def annotate(name: str, **kv) -> None:
+    """Record program-shaping facts against seam `name` in the current
+    obs scope (see ProgramProfiler.annotate)."""
+    _profiler.annotate(name, **kv)
 
 
 def reset() -> None:

@@ -10,7 +10,6 @@ one reducer.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -83,6 +82,184 @@ from shifu_tpu.obs.profile import wrap as _profile_wrap  # noqa: E402
 bin_aggregate_profiled = _profile_wrap(
     "stats.bin_aggregate", bin_aggregate_jit, sync=False,
     static_argnums=(2,), static_argnames=("total_slots",))
+
+
+# ---------------------------------------------------------------------------
+# sharded window fold / reduce — the lifecycle map/reduce programs
+# ---------------------------------------------------------------------------
+#
+# The streaming folds keep one f32 BinAggregates WINDOW per row shard,
+# stacked on a leading [S] axis sharded over the lifecycle mesh
+# (parallel/mesh.py). Three programs close the DrJAX map_fn/reduce shape:
+#
+#   sharded_window_fold   the map: each shard bin-aggregates ITS chunk
+#                         locally and folds it into ITS window — one
+#                         shard_map dispatch folds up to S chunks, no
+#                         cross-shard traffic at all.
+#   masked_window_add     fold ONE precomputed aggregate into one shard's
+#                         window (the degenerate/manual path — same
+#                         program family, a size-S mask instead of a map).
+#   window_reduce         the reduce: ONE psum over the row axes (pmin/
+#                         pmax for the extrema) replaces S per-shard host
+#                         pulls — on a multi-slice mesh the (dcn, data)
+#                         axis order makes XLA lower it as a tree, heavy
+#                         within-slice over ICI, one partial across DCN.
+#
+# Identity elements (0 for sums, +/-inf for min/max) make window init a
+# plain elementwise combine, so a window that never saw a chunk
+# contributes nothing to the reduce.
+
+_WINDOW_PROGRAMS: dict = {}
+
+_MIN_FIELD, _MAX_FIELD = 6, 7  # vmin / vmax positions in BinAggregates
+
+
+def _combine_aggs(win: BinAggregates, part: BinAggregates) -> BinAggregates:
+    out = [w + p for w, p in zip(win, part)]
+    out[_MIN_FIELD] = jnp.minimum(win.vmin, part.vmin)
+    out[_MAX_FIELD] = jnp.maximum(win.vmax, part.vmax)
+    return BinAggregates(*out)
+
+
+def _row_spec(axes, ndim: int):
+    return P(axes if len(axes) > 1 else axes[0], *([None] * (ndim - 1)))
+
+
+def _mesh_key(mesh) -> tuple:
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape))
+
+
+def _shard_index(mesh, axes):
+    """Linear row-shard index of the executing device inside shard_map."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    idx = jax.lax.axis_index(axes[0])
+    for a in axes[1:]:
+        idx = idx * sizes[a] + jax.lax.axis_index(a)
+    return idx
+
+
+def window_specs(mesh):
+    """(sharded, replicated) PartitionSpec pytrees for a stacked [S, ...]
+    BinAggregates window."""
+    from shifu_tpu.parallel.mesh import row_axes
+
+    axes = row_axes(mesh)
+    sharded = BinAggregates(*([_row_spec(axes, 2)] * 10))
+    replicated = BinAggregates(*([P(None, None)] * 10))
+    return sharded, replicated
+
+
+def window_init(mesh, total_slots: int, n_numeric: int) -> BinAggregates:
+    """Fresh stacked window: zeros for every sum, +/-inf for the extrema,
+    placed sharded over the mesh's row axes (one slice per shard)."""
+    from jax.sharding import NamedSharding
+
+    from shifu_tpu.parallel.mesh import row_shard_count
+
+    import numpy as np
+
+    S = row_shard_count(mesh)
+    sharded, _ = window_specs(mesh)
+    host = BinAggregates(
+        pos=np.zeros((S, total_slots), np.float32),
+        neg=np.zeros((S, total_slots), np.float32),
+        wpos=np.zeros((S, total_slots), np.float32),
+        wneg=np.zeros((S, total_slots), np.float32),
+        vsum=np.zeros((S, n_numeric), np.float32),
+        vsumsq=np.zeros((S, n_numeric), np.float32),
+        vmin=np.full((S, n_numeric), np.inf, np.float32),
+        vmax=np.full((S, n_numeric), -np.inf, np.float32),
+        vcount=np.zeros((S, n_numeric), np.float32),
+        vmissing=np.zeros((S, n_numeric), np.float32),
+    )
+    return BinAggregates(*[
+        jax.device_put(a, NamedSharding(mesh, s))
+        for a, s in zip(host, sharded)])
+
+
+def sharded_window_fold(mesh, total_slots: int):
+    """Jitted map program: (windows [S, ...], codes [S, n, C], offsets [C],
+    tags [S, n], weights [S, n], values [S, n, Cn]) -> windows'. Each
+    shard aggregates its own row block and folds it into its own window —
+    compiled once per (mesh, total_slots, row bucket)."""
+    from shifu_tpu.parallel.mesh import row_axes, shard_map_compat
+
+    key = ("fold", _mesh_key(mesh), int(total_slots))
+    prog = _WINDOW_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    axes = row_axes(mesh)
+    sharded, _ = window_specs(mesh)
+
+    def local(win, codes, offsets, tags, weights, values):
+        agg = bin_aggregate(codes[0], offsets, total_slots, tags[0],
+                            weights[0], values[0])
+        return _combine_aggs(win, BinAggregates(*[a[None] for a in agg]))
+
+    prog = jax.jit(shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(sharded, _row_spec(axes, 3), P(None), _row_spec(axes, 2),
+                  _row_spec(axes, 2), _row_spec(axes, 3)),
+        out_specs=sharded))
+    _WINDOW_PROGRAMS[key] = prog
+    return prog
+
+
+def masked_window_add(mesh):
+    """Jitted program folding ONE replicated BinAggregates into the window
+    of shard `sid` (identity elements elsewhere) — the precomputed-
+    aggregate entry point DeviceAccumulator.add uses."""
+    from shifu_tpu.parallel.mesh import row_axes, shard_map_compat
+
+    key = ("add", _mesh_key(mesh))
+    prog = _WINDOW_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    axes = row_axes(mesh)
+    sharded = window_specs(mesh)[0]
+
+    def local(win, agg, sid):
+        mine = _shard_index(mesh, axes) == sid
+        part = [jnp.where(mine, a, jnp.zeros_like(a))[None] for a in agg]
+        part[_MIN_FIELD] = jnp.where(mine, agg.vmin,
+                                     jnp.full_like(agg.vmin, jnp.inf))[None]
+        part[_MAX_FIELD] = jnp.where(mine, agg.vmax,
+                                     jnp.full_like(agg.vmax,
+                                                   -jnp.inf))[None]
+        return _combine_aggs(win, BinAggregates(*part))
+
+    prog = jax.jit(shard_map_compat(
+        local, mesh=mesh,
+        in_specs=(sharded, BinAggregates(*([P(None)] * 10)), P()),
+        out_specs=sharded))
+    _WINDOW_PROGRAMS[key] = prog
+    return prog
+
+
+def window_reduce(mesh):
+    """Jitted reduce program: psum (pmin/pmax for extrema) of the stacked
+    [S, ...] windows over the mesh's row axes — ONE collective closes the
+    whole window, so the host pulls ONE replicated result instead of S
+    per-shard windows."""
+    from shifu_tpu.parallel.mesh import row_axes, shard_map_compat
+
+    key = ("reduce", _mesh_key(mesh))
+    prog = _WINDOW_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    axes = row_axes(mesh)
+    sharded, replicated = window_specs(mesh)
+
+    def local(win):
+        out = [jax.lax.psum(w, axes) for w in win]
+        out[_MIN_FIELD] = jax.lax.pmin(win.vmin, axes)
+        out[_MAX_FIELD] = jax.lax.pmax(win.vmax, axes)
+        return BinAggregates(*out)
+
+    prog = jax.jit(shard_map_compat(
+        local, mesh=mesh, in_specs=(sharded,), out_specs=replicated))
+    _WINDOW_PROGRAMS[key] = prog
+    return prog
 
 
 def bin_aggregate_sharded(

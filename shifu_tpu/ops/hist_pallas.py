@@ -36,21 +36,45 @@ reach; it is correctness-tested in interpret mode on CPU."""
 from __future__ import annotations
 
 import functools
-from typing import List
+from typing import List, Optional
 
 # VMEM budget shaping: rows per grid step x max chunk columns. M [BLK, W]
 # f32 + A [BLK, C*L] f32 + out [C*L, W] f32 must sit well under ~16 MB.
+# Overridable per PROCESS (-Dshifu.pallas.blk / -Dshifu.pallas.wmax) so
+# the next kernel-tuning round can sweep shapings without code edits —
+# per process because the built kernels are cached (_chunk_call lru,
+# tree_trainer's program cache): set the knobs at launch, one process
+# per shaping, the way the bench children do. The chosen values land in
+# the profiler snapshot (obs.profile annotations, process-global so a
+# later obs scope still reports them) so every manifest records which
+# shaping produced its numbers.
 _BLK = 512
 _W_MAX = 1024
 
 
-def _chunk_runs(lay, target: int = _W_MAX) -> List[list]:
+def blk_setting() -> int:
+    """shifu.pallas.blk — rows per grid step (default 512)."""
+    from shifu_tpu.utils import environment
+
+    return max(8, environment.get_int("shifu.pallas.blk", _BLK))
+
+
+def wmax_setting() -> int:
+    """shifu.pallas.wmax — max one-hot columns per VMEM chunk (1024)."""
+    from shifu_tpu.utils import environment
+
+    return max(8, environment.get_int("shifu.pallas.wmax", _W_MAX))
+
+
+def _chunk_runs(lay, target: Optional[int] = None) -> List[list]:
     """Split the flat T axis into chunks of <= target columns, each chunk a
     list of runs: ('vec', f_lo, f_hi, w) for consecutive full features of
     equal width w, or ('piece', f, lo, hi) for a partial piece of a wide
     feature. Chunks always cover whole columns of [0, T) in order and the
     features of one chunk are CONTIGUOUS, so the caller can hand the
     kernel a contiguous column slice of the code matrix."""
+    if target is None:
+        target = wmax_setting()
     slots = [int(s) for s in lay.slots]
     chunks: List[dict] = []
     cur: List[tuple] = []
@@ -185,8 +209,16 @@ def make_pallas_hist_fn(L: int, lay, n_classes: int = 0,
 
     C = n_classes if n_classes >= 3 else 3
     T = lay.T
-    chunks = _chunk_runs(lay)
+    blk_max = blk_setting()
+    wmax = wmax_setting()
+    chunks = _chunk_runs(lay, target=wmax)
     clips = tuple(int(c) for c in lay.clip_max)
+    # the shaping this build chose rides into every profiler snapshot /
+    # manifest, so a -Dshifu.pallas.* sweep is self-documenting
+    from shifu_tpu.obs import profile as _profile
+
+    _profile.annotate("ops.hist_pallas", blk=blk_max, wMax=wmax,
+                      chunks=len(chunks), L=int(L), T=int(T))
 
     def hist_fn(codes, labels, weights, node_slot, active):
         n, F = codes.shape
@@ -200,7 +232,7 @@ def make_pallas_hist_fn(L: int, lay, n_classes: int = 0,
         else:
             comps = jnp.stack([w, w * labels, w * labels * labels], 1)
 
-        blk = min(_BLK, n)
+        blk = min(blk_max, n)
         n_pad = -(-n // blk) * blk
         pad = n_pad - n
         codes_p = jnp.pad(codes, ((0, pad), (0, 0)))

@@ -83,6 +83,37 @@ def data_mesh(n_devices: Optional[int] = None, model_axis: int = 1,
     return Mesh(np.array(devices), ("data",))
 
 
+_LIFECYCLE_MESHES: dict = {}
+
+
+def lifecycle_shards() -> int:
+    """Row-shard count for the lifecycle map/reduce folds (streaming
+    stats/norm/eval/autotype): `shifu.lifecycle.shards` when set (>0),
+    else every visible device. 1 is the degenerate single-device case —
+    the same code path, a 1-wide mesh."""
+    from shifu_tpu.utils import environment
+
+    n = environment.get_int("shifu.lifecycle.shards", 0)
+    if n > 0:
+        return n
+    import jax
+
+    return max(1, len(jax.devices()))
+
+
+def lifecycle_mesh(n_shards: Optional[int] = None):
+    """The (cached) mesh the lifecycle folds shard rows over: the first
+    `n_shards` devices, (dcn, data) when the set spans slices so the
+    windowed psum reduce lowers hierarchically — heavy within-slice over
+    ICI, one partial per slice over DCN."""
+    n = lifecycle_shards() if n_shards is None else max(1, int(n_shards))
+    mesh = _LIFECYCLE_MESHES.get(n)
+    if mesh is None:
+        mesh = data_mesh(n_devices=n)
+        _LIFECYCLE_MESHES[n] = mesh
+    return mesh
+
+
 def row_axes(mesh) -> Tuple[str, ...]:
     """Axis names rows shard over: ('dcn', 'data') on a multi-slice mesh,
     ('data',) otherwise. Also the psum axes for gradient/histogram

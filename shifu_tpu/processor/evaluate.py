@@ -269,27 +269,41 @@ class EvalProcessor(BasicProcessor):
         self.paths.ensure(os.path.dirname(out))
         sep = "|"
 
-        # ---- preemption safety: resume = (chunk index, score-file byte
-        # offset, partial row counters); the file is truncated back to
-        # the last snapshotted offset, so rows the killed run appended
-        # after its final checkpoint are dropped and re-scored ----
+        # ---- shard plan + preemption safety: chunks divide round-robin
+        # over the lifecycle row shards (ShardPlan, like the stats/norm
+        # folds — per-shard chunk cursors and row counters in per-shard
+        # snapshot files); the score file is the shared reduce state:
+        # resume truncates it back to the last snapshotted byte offset,
+        # so rows the killed run appended after its final checkpoint are
+        # dropped and re-scored ----
+        from shifu_tpu.data.pipeline import ShardPlan
         from shifu_tpu.resilience import checkpoint as ckpt_mod
         from shifu_tpu.resilience import faults
 
+        shard_plan = ShardPlan()
+        S = shard_plan.n_shards
+        cursors = [-1] * S
+        shard_rows_s = [0] * S
         ck = None
-        resume_ci = -1
+        resumed = False
         resume_meta: dict = {}
         if ckpt_mod.ckpt_stream_enabled():
-            ck = ckpt_mod.StreamCheckpoint(
-                ckpt_mod.ckpt_path(self.root, "eval", f"score-{ec.name}"),
-                self._eval_stream_sha(ec, paths))
+            ck = ckpt_mod.ShardedStreamCheckpoint(
+                ckpt_mod.ckpt_base(self.root, "eval", f"score-{ec.name}"),
+                self._eval_stream_sha(ec, paths, S), S)
             if ckpt_mod.resume_requested():
                 loaded = ck.load()
                 if loaded is not None and os.path.isfile(out):
-                    resume_ci, _arrays, resume_meta, _blob = loaded
+                    cursors, per_shard, shared = loaded
+                    cursors = list(cursors)
+                    shard_rows_s = [int(m.get("rows", 0))
+                                    for _a, m, _b in per_shard]
+                    resume_meta = shared[1]
+                    resumed = True
                     faults.survived("preempt")
-                    log.info("resuming eval %s after chunk %d (offset %d)",
-                             ec.name, resume_ci, resume_meta["offset"])
+                    log.info("resuming eval %s (shard cursors %s, offset "
+                             "%d)", ec.name, cursors,
+                             resume_meta["offset"])
             else:
                 ck.clear()
 
@@ -304,10 +318,10 @@ class EvalProcessor(BasicProcessor):
                 delimiter=ds.data_delimiter or mc.data_set.data_delimiter,
                 missing_values=tuple(mc.data_set.missing_or_invalid_values),
             )
-            return ckpt_mod.resume_slice(enumerate(source), resume_ci)
+            return shard_plan.resume_slice(enumerate(source), cursors)
 
-        with open(out, "r+" if resume_ci >= 0 else "w") as fh:
-            if resume_ci >= 0:
+        with open(out, "r+" if resumed else "w") as fh:
+            if resumed:
                 fh.seek(int(resume_meta["offset"]))
                 fh.truncate()
             # chunk parse rides on the prefetch thread under the previous
@@ -356,15 +370,23 @@ class EvalProcessor(BasicProcessor):
                 n_rows += chunk.n_rows
                 n_pos += int((tags == 1).sum())
                 n_neg += int((tags == 0).sum())
+                shard = shard_plan.shard_of(ci)
+                cursors[shard] = ci
+                shard_rows_s[shard] += chunk.n_rows
+                shard_plan.record(shard, chunk.n_rows, "eval.score")
                 if ck is not None:
                     def _state(_fh=fh):
                         _fh.flush()
                         os.fsync(_fh.fileno())
-                        return None, {
+                        per_shard = [
+                            (cursors[s], None,
+                             {"rows": shard_rows_s[s]}, None)
+                            for s in range(S)]
+                        return per_shard, (None, {
                             "offset": _fh.tell(), "nRows": n_rows,
                             "nPos": n_pos, "nNeg": n_neg,
-                            "wroteHeader": wrote_header}, None
-                    ck.maybe_save(ci, _state)
+                            "wroteHeader": wrote_header}, None)
+                    ck.maybe_save(_state)
             if not wrote_header:
                 # empty eval set: header-only file so the perf step reads a
                 # well-formed (zero-row) score table like the in-memory path
@@ -379,10 +401,12 @@ class EvalProcessor(BasicProcessor):
                  "models -> %s", ec.name, n_rows, n_pos, n_neg, len(paths),
                  out)
 
-    def _eval_stream_sha(self, ec: EvalConfig, paths: List[str]) -> str:
+    def _eval_stream_sha(self, ec: EvalConfig, paths: List[str],
+                         n_shards: int) -> str:
         """Checkpoint-compatibility identity for a streamed eval score
-        run: the model set (paths + sizes) and the eval data source — a
-        snapshot from different models or data must not be resumed."""
+        run: the model set (paths + sizes), the eval data source, and
+        the shard plan — a snapshot from different models or data must
+        not be resumed."""
         from shifu_tpu.data.stream import chunk_rows_setting
         from shifu_tpu.resilience.checkpoint import config_sha
 
@@ -394,6 +418,7 @@ class EvalProcessor(BasicProcessor):
                      or self.model_config.data_set.data_path),
             # the chunk index is only meaningful under the same geometry
             "chunkRows": chunk_rows_setting(),
+            "shards": int(n_shards),
         })
 
     @staticmethod

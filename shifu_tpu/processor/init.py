@@ -116,8 +116,11 @@ class InitProcessor(BasicProcessor):
         # streaming distinct-count sketches: the TPU-build analog of the
         # reference's HLL++ autotype MR job
         # (core/autotype/AutoTypeDistinctCountMapper.java:45) — bounded
-        # memory regardless of dataset size or cardinality
-        from shifu_tpu.data.pipeline import prefetch_iter
+        # memory regardless of dataset size or cardinality, sharded over
+        # the lifecycle ShardPlan like every other streaming fold: each
+        # row shard folds its own chunks into its own sketches, merged
+        # once at the end (exact union for HLL registers / count sums)
+        from shifu_tpu.data.pipeline import ShardPlan, prefetch_iter
         from shifu_tpu.data.stream import iter_columnar_chunks
         from shifu_tpu.stats.sketch import AutoTypeSketch
 
@@ -126,20 +129,30 @@ class InitProcessor(BasicProcessor):
             if not (cc.is_target() or cc.is_meta() or cc.is_weight())
         ]
         missing = tuple(ds.missing_or_invalid_values)
-        sketches = {cc.column_name: AutoTypeSketch(missing) for cc in candidates}
+        plan = ShardPlan()
+        shard_sketches = [
+            {cc.column_name: AutoTypeSketch(missing) for cc in candidates}
+            for _ in range(plan.n_shards)]
         # parse overlaps the sketch folds via the prefetch thread; only the
         # candidate columns are parsed at all — target/meta/weight (fat
         # padding fields included) never leave the CSV tokenizer
-        for chunk in prefetch_iter(iter_columnar_chunks(
+        for ci, chunk in prefetch_iter(enumerate(iter_columnar_chunks(
             self.resolve(ds.data_path),
             names,
             delimiter=ds.data_delimiter,
             missing_values=missing,
             max_rows=AUTOTYPE_MAX_ROWS,
             columns=[cc.column_name for cc in candidates],
-        )):
+        ))):
+            s = plan.shard_of(ci)
             for cc in candidates:
-                sketches[cc.column_name].update(chunk._series(cc.column_name))
+                shard_sketches[s][cc.column_name].update(
+                    chunk._series(cc.column_name))
+            plan.record(s, chunk.n_rows, "init.autotype")
+        sketches = shard_sketches[0]
+        for s in range(1, plan.n_shards):
+            for name, sk in sketches.items():
+                sk.merge(shard_sketches[s][name])
 
         threshold = ds.auto_type_threshold
         count_info = {}

@@ -148,11 +148,12 @@ class NormProcessor(BasicProcessor):
             )
         log.info("bin codes -> %s", self.paths.cleaned_data_dir())
 
-    def _stream_config_sha(self, plan, slots) -> str:
+    def _stream_config_sha(self, plan, slots, n_shards) -> str:
         """Checkpoint-compatibility identity for the streaming norm run:
         the full norm plan (type, cutoff, every per-column table), the
-        code layout, and the sampling seed — a snapshot written under
-        different stats/norm config must not be resumed onto this one."""
+        code layout, the shard plan, and the sampling seed — a snapshot
+        written under different stats/norm config must not be resumed
+        onto this one."""
         from shifu_tpu.data.stream import chunk_rows_setting
         from shifu_tpu.norm.normalizer import plan_to_json
         from shifu_tpu.resilience.checkpoint import config_sha
@@ -165,6 +166,7 @@ class NormProcessor(BasicProcessor):
             # chunk geometry governs both the chunk index AND the
             # shard-per-chunk layout — never resume across a change
             "chunkRows": chunk_rows_setting(),
+            "shards": int(n_shards),
         })
 
     def _add_class_meta(self, extra: dict, tags: np.ndarray) -> None:
@@ -277,32 +279,45 @@ class NormProcessor(BasicProcessor):
                 codes = bin_code_matrix(tree_cols, chunk, cache=code_cache)
             return ci, feats, codes, tags, weights
 
-        # ---- preemption safety: the one-shard-per-chunk path resumes
-        # from (chunk index, shards written); the external-shuffle path
-        # appends to bucket files and is NOT resumable — it restarts ----
+        # ---- shard plan + preemption safety: chunks divide round-robin
+        # over the lifecycle row shards (ShardPlan — the same plan the
+        # stats folds use), each shard keeping its own chunk cursor in
+        # its own snapshot file; the artifact writers are the shared
+        # reduce state (they append in global chunk order, which is what
+        # keeps the output byte-identical across shard counts). The
+        # external-shuffle path appends to bucket files and is NOT
+        # resumable — it restarts ----
+        from shifu_tpu.data.pipeline import ShardPlan
         from shifu_tpu.resilience import checkpoint as ckpt_mod
         from shifu_tpu.resilience import faults
 
+        shard_plan = ShardPlan()
+        S = shard_plan.n_shards
+        cursors = [-1] * S
+        shard_rows_f = [0] * S
         ck = None
-        resume_ci = -1
         n_rows = 0
         all_tag_counts: dict = {}
         if not self.shuffle and ckpt_mod.ckpt_stream_enabled():
-            ck = ckpt_mod.StreamCheckpoint(
-                ckpt_mod.ckpt_path(self.root, "norm", "stream"),
-                self._stream_config_sha(plan, slots))
+            ck = ckpt_mod.ShardedStreamCheckpoint(
+                ckpt_mod.ckpt_base(self.root, "norm", "stream"),
+                self._stream_config_sha(plan, slots, S), S)
             if ckpt_mod.resume_requested():
                 loaded = ck.load()
                 if loaded is not None:
-                    resume_ci, _arrays, meta, _blob = loaded
+                    cursors, per_shard, shared = loaded
+                    cursors = list(cursors)
+                    shard_rows_f = [int(m.get("rows", 0))
+                                    for _a, m, _b in per_shard]
+                    meta = shared[1]
                     feat_writer.restore(meta["featShardRows"])
                     code_writer.restore(meta["codeShardRows"])
                     n_rows = int(meta["nRows"])
                     all_tag_counts = {int(k): int(v) for k, v in
                                       meta["tagCounts"].items()}
                     faults.survived("preempt")
-                    log.info("resuming streaming norm after chunk %d "
-                             "(%d shards on disk)", resume_ci,
+                    log.info("resuming streaming norm (shard cursors %s, "
+                             "%d shards on disk)", cursors,
                              len(feat_writer.shard_rows))
             else:
                 ck.clear()
@@ -311,9 +326,22 @@ class NormProcessor(BasicProcessor):
                         "writer appends to bucket files and cannot "
                         "resume mid-stream; restarting from row zero")
 
-        with span("norm.stream", shuffle=self.shuffle) as sp:
-            for item in prefetch_iter(ckpt_mod.resume_slice(
-                                          enumerate(factory()), resume_ci),
+        def _ckpt_state():
+            per_shard = [
+                (cursors[s], None, {"rows": shard_rows_f[s]}, None)
+                for s in range(S)]
+            shared = (None,
+                      {"featShardRows": list(feat_writer.shard_rows),
+                       "codeShardRows": list(code_writer.shard_rows),
+                       "nRows": n_rows,
+                       "tagCounts": {str(k): v for k, v in
+                                     all_tag_counts.items()}},
+                      None)
+            return per_shard, shared
+
+        with span("norm.stream", shuffle=self.shuffle, shards=S) as sp:
+            for item in prefetch_iter(shard_plan.resume_slice(
+                                          enumerate(factory()), cursors),
                                       transform=_normed,
                                       timers=timers, stage="parse"):
                 if item is None:
@@ -324,18 +352,15 @@ class NormProcessor(BasicProcessor):
                     feat_writer.add(feats, tags, weights)
                     code_writer.add(codes, tags, weights)
                 n_rows += len(tags)
+                shard = shard_plan.shard_of(ci)
+                cursors[shard] = ci
+                shard_rows_f[shard] += len(tags)
+                shard_plan.record(shard, len(tags), "norm")
                 for t, c in zip(*np.unique(tags, return_counts=True)):
                     all_tag_counts[int(t)] = (
                         all_tag_counts.get(int(t), 0) + int(c))
                 if ck is not None:
-                    ck.maybe_save(ci, lambda: (
-                        None,
-                        {"featShardRows": list(feat_writer.shard_rows),
-                         "codeShardRows": list(code_writer.shard_rows),
-                         "nRows": n_rows,
-                         "tagCounts": {str(k): v for k, v in
-                                       all_tag_counts.items()}},
-                        None))
+                    ck.maybe_save(_ckpt_state)
             sp["rows"] = n_rows
         if ck is not None:
             ck.clear()

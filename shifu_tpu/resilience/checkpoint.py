@@ -165,6 +165,12 @@ def ckpt_path(root: str, step: str, name: str) -> str:
     return os.path.join(ckpt_dir(root), f"{step}-{name}{CKPT_SUFFIX}")
 
 
+def ckpt_base(root: str, step: str, name: str) -> str:
+    """Suffix-less base path for a sharded checkpoint family
+    (`<base>-shardNNNNN.ckpt.npz` + `<base>-shared.ckpt.npz`)."""
+    return os.path.join(ckpt_dir(root), f"{step}-{name}")
+
+
 class StreamCheckpoint:
     """One resumable stream's snapshot file.
 
@@ -268,6 +274,151 @@ class StreamCheckpoint:
             os.unlink(self.path)
         except OSError:  # never written / already cleared
             pass
+
+
+class ShardedStreamCheckpoint:
+    """Per-shard snapshot family for a sharded streaming fold.
+
+    One snapshot file PER ROW SHARD — shard s's file carries (its own
+    chunk cursor, its own local fold state, its own counters) — plus one
+    `-shared` file for the state no single shard owns (the post-psum
+    host float64 fold, writer bookkeeping). All files share the caller's
+    config sha.
+
+    Kill-atomicity is two-phase: shard files ALTERNATE between two slots
+    (`-shard00000-a` / `-b`) per save epoch, and the shared file —
+    written LAST, itself atomic — is the commit pointer: its meta names
+    the epoch and the slot that form the current complete family. A kill
+    anywhere during the S shard-file writes touches only the NEW slot;
+    the shared pointer still names the previous slot, whose files this
+    save never opened — so the previous complete snapshot is never lost,
+    exactly the guarantee the single-file `atomic_write` gave the
+    unsharded folds. `load` verifies every pointed-at shard file carries
+    the committed epoch and shard count and otherwise rejects the WHOLE
+    family (`ckpt.rejected{reason=partial|epoch|shards}`) — shards must
+    never resume from different cadence points than the shared reduce
+    state they fold into.
+
+    In a real multi-host deployment each shard writes its own slot files
+    from its own host; the shared pointer is the reduce owner's. The
+    layout is identical here, so the resume contract carries over
+    unchanged. `clear` globs the whole family — including stale slot or
+    extra-shard files a previous run with a different shard count left —
+    so nothing phantom ever shows in `shifu runs --resumable`.
+    """
+
+    _SLOTS = ("a", "b")
+
+    def __init__(self, base: str, config_sha: str, n_shards: int,
+                 every: Optional[int] = None) -> None:
+        self.base = base
+        self.n_shards = max(1, int(n_shards))
+        self.config_sha = config_sha
+        self.every = every_chunks_setting() if every is None else int(every)
+        self._since = 0
+        self._epoch = 0
+        self._shards = [
+            {slot: StreamCheckpoint(
+                f"{base}-shard{s:05d}-{slot}{CKPT_SUFFIX}",
+                config_sha, every=0) for slot in self._SLOTS}
+            for s in range(self.n_shards)]
+        self._shared = StreamCheckpoint(f"{base}-shared{CKPT_SUFFIX}",
+                                        config_sha, every=0)
+
+    def _slot(self, epoch: int) -> str:
+        return self._SLOTS[epoch % len(self._SLOTS)]
+
+    # ---- write side ----
+    def save(self, per_shard: List[Tuple[int, Optional[Dict[str, np.ndarray]],
+                                         Optional[dict], Optional[bytes]]],
+             shared: Tuple[Optional[Dict[str, np.ndarray]], Optional[dict],
+                           Optional[bytes]]) -> None:
+        """Persist every shard's (cursor, arrays, meta, blob) into the
+        next slot, then commit by writing the shared pointer last."""
+        assert len(per_shard) == self.n_shards, \
+            (len(per_shard), self.n_shards)
+        epoch = self._epoch + 1
+        slot = self._slot(epoch)
+        stamp = {"epoch": epoch, "shards": self.n_shards}
+        for cks, (ci, arrays, meta, blob) in zip(self._shards, per_shard):
+            cks[slot].save(ci, arrays=arrays,
+                           meta={**(meta or {}), **stamp}, blob=blob)
+        arrays, meta, blob = shared
+        self._shared.save(-1, arrays=arrays,
+                          meta={**(meta or {}), **stamp, "slot": slot},
+                          blob=blob)
+        self._epoch = epoch  # committed
+
+    def maybe_save(self, state_fn: Callable[[], tuple]) -> bool:
+        """Cadence-gated save (one call per folded chunk); `state_fn`
+        returns (per_shard, shared) and is only invoked when a write is
+        due."""
+        if self.every <= 0:
+            return False
+        self._since += 1
+        if self._since < self.every:
+            return False
+        self._since = 0
+        per_shard, shared = state_fn()
+        self.save(per_shard, shared)
+        return True
+
+    # ---- read side ----
+    def load(self) -> Optional[Tuple[
+            List[int], List[Tuple[Dict[str, np.ndarray], dict,
+                                  Optional[bytes]]],
+            Tuple[Dict[str, np.ndarray], dict, Optional[bytes]]]]:
+        """(cursors, per_shard [(arrays, meta, blob)], shared) or None.
+        The shared pointer names the committed (epoch, slot); any shard
+        file of that slot missing/corrupt/sha-mismatched, a shard-count
+        change, or an epoch disagreeing with the pointer rejects the
+        WHOLE family — partial resumes would silently double- or
+        drop-fold chunks."""
+        from shifu_tpu.obs import registry
+
+        shared = self._shared.load()
+        if shared is None:
+            return None
+        epoch = shared[2].get("epoch")
+        slot = shared[2].get("slot")
+        if epoch is None or slot not in self._SLOTS:
+            registry().counter("ckpt.rejected", reason="partial").inc()
+            return None
+        if shared[2].get("shards") != self.n_shards:
+            log.warning("sharded checkpoint %s was written with %s shards "
+                        "(now %d); starting fresh", self.base,
+                        shared[2].get("shards"), self.n_shards)
+            registry().counter("ckpt.rejected", reason="shards").inc()
+            return None
+        loads = [cks[slot].load() for cks in self._shards]
+        if any(ld is None for ld in loads):
+            registry().counter("ckpt.rejected", reason="partial").inc()
+            return None
+        epochs = {ld[2].get("epoch") for ld in loads}
+        if epochs != {epoch}:
+            log.warning("sharded checkpoint %s slot %s has epochs %s but "
+                        "the pointer committed %s; starting fresh",
+                        self.base, slot,
+                        sorted(str(e) for e in epochs), epoch)
+            registry().counter("ckpt.rejected", reason="epoch").inc()
+            return None
+        self._epoch = int(epoch)
+        cursors = [ld[0] for ld in loads]
+        per_shard = [(ld[1], ld[2], ld[3]) for ld in loads]
+        return cursors, per_shard, (shared[1], shared[2], shared[3])
+
+    def clear(self) -> None:
+        """Remove the WHOLE family — both slots, the pointer, and any
+        stale `-shardNNNNN*` files a run with a different shard count
+        left behind (they would otherwise show as phantom resumables)."""
+        import glob as _glob
+
+        for path in _glob.glob(self.base + "-shard*" + CKPT_SUFFIX):
+            try:
+                os.unlink(path)
+            except OSError:  # already gone
+                pass
+        self._shared.clear()
 
 
 def list_resumable(root: str) -> List[dict]:

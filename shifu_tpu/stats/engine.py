@@ -365,7 +365,7 @@ def _column_slot_layout(
 
 
 def _stats_config_sha(mc: ModelConfig, stats_cols: List[ColumnConfig],
-                      seed: int) -> str:
+                      seed: int, n_shards: int) -> str:
     """Identity of a streaming-stats run for checkpoint compatibility: a
     snapshot folded under one config must never resume under another."""
     from shifu_tpu.data.stream import chunk_rows_setting
@@ -376,6 +376,9 @@ def _stats_config_sha(mc: ModelConfig, stats_cols: List[ColumnConfig],
         # chunk geometry — resuming a 48-row-chunk snapshot under the
         # 65536 default would silently skip/double-fold rows
         "chunkRows": chunk_rows_setting(),
+        # ... and under the same shard plan: shard s's cursor means
+        # "chunks ci % S == s up to here are folded"
+        "shards": n_shards,
         "method": str(mc.stats.binning_method),
         "maxBins": mc.stats.max_num_bin,
         "cateMax": mc.stats.cate_max_num_bin,
@@ -405,27 +408,37 @@ def compute_stats_streaming(
     Peak memory = one chunk x (2 + prefetch depth) + sketches; nothing
     scales with the dataset.
 
-    Both passes run through the overlapped prefetch pipeline
-    (data/pipeline.py): parse + purify + bin-coding happen on a background
-    thread while this thread folds sketches (pass 1) or dispatches the
-    device aggregation (pass 2). Chunks are padded to power-of-two row
-    buckets so the jit aggregation compiles O(log max_chunk_rows) programs
-    whatever the chunk-size sequence, and the flat aggregate accumulator
-    stays device-resident across chunks — one combine dispatch per chunk,
-    one device->host sync per ~2^23-row window (the window flushes into a
-    host float64 fold, so arbitrarily long streams cannot saturate the f32
-    counts). Chunk order is preserved, so results are bit-identical to a
-    serial run (shifu.ingest.prefetchChunks=0).
+    Both passes are SHARDED map/reduce folds over the lifecycle mesh
+    (data/pipeline.py ShardPlan): chunk ci belongs to row shard ci % S
+    (S = shifu.lifecycle.shards, default every device), so with S shards
+    over K chunks each shard folds at most ceil(K/S) chunks — every pass
+    is O(rows/shards). Pass 1 folds each shard's chunks into that
+    shard's own sketches, merged once at bin finalization. Pass 2 is the
+    device map: one shard_map dispatch per S-chunk super-step aggregates
+    every shard's chunk on its own devices into its own f32 window, and
+    the windowed flush is ONE psum-tree reduction over the mesh row axes
+    followed by ONE device->host sync per ~2^23-total-row window (the
+    window flushes into a host float64 fold before the psum'd counts
+    could leave f32-exact range, so arbitrarily long streams cannot
+    saturate — the PR-1 exactness invariant, shard-count-proof). S=1 is
+    the degenerate single-device case
+    of the same code path. Parse + purify + bin-coding still ride the
+    background prefetch thread, chunks pad to power-of-two row buckets
+    (O(log max_chunk_rows) compiled programs), and chunk order is
+    deterministic, so results are bit-identical to a serial run
+    (shifu.ingest.prefetchChunks=0) and count-exact across shard counts.
 
-    With `checkpoint_root`, the fold is preemption-safe: every
-    shifu.ckpt.everyChunks folded chunks a snapshot of (chunk index,
-    pass-1 sketches / pass-2 DeviceAccumulator state, row counters) lands
-    atomically under <root>/.shifu/runs/ckpt, and `resume=True` skips the
-    already-folded chunks. Because the snapshot captures the exact f32
-    device window (no early flush) and per-chunk sampling is keyed by
-    [seed, chunk_index], a resumed run is bit-identical to an
-    uninterrupted one — the chaos-parity tests pin this under injected
-    preemption.
+    With `checkpoint_root`, the fold is preemption-safe PER SHARD: every
+    shifu.ckpt.everyChunks folded chunks each shard's (chunk cursor,
+    local sketches / f32 window slice, row counters) lands in its own
+    atomic snapshot file plus one shared reduce file (the host f64 fold),
+    all epoch-stamped (resilience/checkpoint.ShardedStreamCheckpoint);
+    `resume=True` resumes every shard mid-stream from its own cursor.
+    Because the snapshots capture the exact per-shard f32 windows (no
+    early flush) and per-chunk sampling is keyed by [seed, chunk_index],
+    a resumed run is bit-identical to an uninterrupted one — the
+    chaos-parity tests pin this under injected preemption, sharded and
+    degenerate.
     """
     from shifu_tpu.config.model_config import BinningMethod
     from shifu_tpu.data.pipeline import (
@@ -457,12 +470,25 @@ def compute_stats_streaming(
             return tags == 0
         return tags >= 0
 
-    sketches: Dict[str, object] = {}
-    for cc in stats_cols:
-        if cc.is_categorical():
-            sketches[cc.column_name] = CategoricalSketch()
-        else:
-            sketches[cc.column_name] = NumericSketch(max_bins=max_bins)
+    # ---- the shard plan: every fold below divides chunks over it ----
+    from shifu_tpu.data.pipeline import ShardPlan
+
+    plan = ShardPlan()
+    S = plan.n_shards
+
+    def _fresh_sketches() -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for cc in stats_cols:
+            if cc.is_categorical():
+                out[cc.column_name] = CategoricalSketch()
+            else:
+                out[cc.column_name] = NumericSketch(max_bins=max_bins)
+        return out
+
+    # one sketch set PER SHARD — each shard folds only its own chunks,
+    # merged once (shard order, deterministic) at bin finalization
+    sketches: List[Dict[str, object]] = [_fresh_sketches()
+                                         for _ in range(S)]
 
     # registry-backed: stage timings land in the run manifest, not just a
     # log line (stats.stage{stage=parse1|prepare|sketch|parse2|bincode|
@@ -470,38 +496,67 @@ def compute_stats_streaming(
     reg = registry()
     timers = reg.stage_timers("stats.stage")
 
-    # ---- preemption safety: mid-stream checkpoint + resume ----
+    # ---- preemption safety: per-shard mid-stream checkpoint + resume ----
     import pickle
 
     from shifu_tpu.resilience import checkpoint as ckpt_mod
     from shifu_tpu.resilience import faults
 
+    # per-shard fold bookkeeping (checkpointed per shard, summed for the
+    # global counters)
+    shard_valid = np.zeros(S, dtype=np.int64)
+    shard_pos = np.zeros(S, dtype=np.int64)
+    shard_neg = np.zeros(S, dtype=np.int64)
+    shard_chunks = np.zeros(S, dtype=np.int64)
+    cursors1 = [-1] * S  # last pass-1 folded chunk per shard
+    cursors2 = [-1] * S  # last pass-2 folded chunk per shard
+
     ck = None
     phase: Optional[str] = None
-    resume_ci = -1
-    resume_arrays: Optional[dict] = None
-    resume_meta: dict = {}
+    resume_acc: Optional[tuple] = None
     if checkpoint_root is not None and ckpt_mod.ckpt_stream_enabled():
-        ck = ckpt_mod.StreamCheckpoint(
-            ckpt_mod.ckpt_path(checkpoint_root, "stats", "stream"),
-            _stats_config_sha(mc, stats_cols, seed))
+        ck = ckpt_mod.ShardedStreamCheckpoint(
+            ckpt_mod.ckpt_base(checkpoint_root, "stats", "stream"),
+            _stats_config_sha(mc, stats_cols, seed, S), S)
         if resume:
             loaded = ck.load()
             if loaded is not None:
-                resume_ci, resume_arrays, resume_meta, blob = loaded
-                phase = resume_meta.get("phase")
-                sketches = pickle.loads(blob)["sketches"]
+                cursors, per_shard, shared = loaded
+                phase = shared[1].get("phase")
+                for s, (arrays, meta, blob) in enumerate(per_shard):
+                    sketches[s] = pickle.loads(blob)["sketches"]
+                    shard_valid[s] = int(meta.get("nValid", 0))
+                    shard_pos[s] = int(meta.get("nPos", 0))
+                    shard_neg[s] = int(meta.get("nNeg", 0))
+                    shard_chunks[s] = int(meta.get("nChunks", 0))
+                if phase == "pass1":
+                    cursors1 = list(cursors)
+                elif phase == "pass2":
+                    cursors2 = list(cursors)
+                    resume_acc = ([arrays for arrays, _m, _b in per_shard],
+                                  shared[0])
                 faults.survived("preempt")
-                log.info("resuming streaming stats from %s after chunk %d",
-                         phase, resume_ci)
+                log.info("resuming streaming stats from %s (shard cursors "
+                         "%s)", phase, list(cursors))
         else:
             ck.clear()  # fresh run: a stale snapshot must not resurface
 
-    def _chunks_after(start: int):
-        return ckpt_mod.resume_slice(enumerate(chunk_factory()), start)
-
-    def _sketch_blob() -> bytes:
-        return pickle.dumps({"sketches": sketches})
+    def _shard_states(arrays_per_shard, cursors, extra_meta=None):
+        """Per-shard checkpoint payloads: cursor + counters + that
+        shard's own sketches (and fold arrays when given)."""
+        out = []
+        for s in range(S):
+            meta = {"nValid": int(shard_valid[s]), "nPos": int(shard_pos[s]),
+                    "nNeg": int(shard_neg[s]),
+                    "nChunks": int(shard_chunks[s])}
+            if extra_meta:
+                meta.update(extra_meta)
+            out.append((cursors[s],
+                        None if arrays_per_shard is None
+                        else arrays_per_shard[s],
+                        meta,
+                        pickle.dumps({"sketches": sketches[s]})))
+        return out
 
     def _prep1(numbered):
         """Background-thread transform: purify + tag + sample one chunk,
@@ -525,57 +580,73 @@ def compute_stats_streaming(
                         chunk.numeric(cc.column_name)
         return ci, chunk, tags, weights
 
-    # ---- pass 1: sketches ----
-    n_valid_rows = int(resume_meta.get("nValid", 0))
-    n_pos = int(resume_meta.get("nPos", 0))
-    n_neg = int(resume_meta.get("nNeg", 0))
+    # ---- pass 1: the sharded sketch map (each shard folds its own
+    # chunks into its own sketches) ----
     if phase in (None, "pass1"):
-        with span("stats.pass1") as sp1:
+        with span("stats.pass1", shards=S) as sp1:
             for ci, chunk, tags, weights in prefetch_iter(
-                _chunks_after(resume_ci if phase == "pass1" else -1),
+                plan.resume_slice(enumerate(chunk_factory()), cursors1),
                 transform=_prep1, timers=timers, stage="parse1",
             ):
                 # preemption seam: fires BETWEEN chunk folds, so the last
                 # snapshot always covers a whole number of chunks
                 faults.fault_point("chunk")
+                s = plan.shard_of(ci)
                 if not chunk.n_rows:
+                    cursors1[s] = ci
                     continue
-                n_valid_rows += chunk.n_rows
-                n_pos += int((tags == 1).sum())
-                n_neg += int((tags == 0).sum())
+                shard_valid[s] += chunk.n_rows
+                shard_pos[s] += int((tags == 1).sum())
+                shard_neg[s] += int((tags == 0).sum())
                 bm = bin_subset(tags)
                 with timers.timer("sketch"):
                     for cc in stats_cols:
-                        sk = sketches[cc.column_name]
+                        sk = sketches[s][cc.column_name]
                         if cc.is_categorical():
                             sk.update(chunk.column(cc.column_name),
                                       chunk.missing_mask(cc.column_name))
                         else:
                             sk.update(chunk.numeric(cc.column_name), bm,
                                       weights if use_weights else None)
+                cursors1[s] = ci
+                plan.record(s, chunk.n_rows, "stats.pass1")
                 if ck is not None:
-                    ck.maybe_save(ci, lambda _ci=ci: (
-                        None,
-                        {"phase": "pass1", "nValid": n_valid_rows,
-                         "nPos": n_pos, "nNeg": n_neg},
-                        _sketch_blob()))
-            sp1["rows"] = n_valid_rows
+                    ck.maybe_save(lambda: (
+                        _shard_states(None, cursors1),
+                        (None, {"phase": "pass1"}, None)))
+            sp1["rows"] = int(shard_valid.sum())
         if ck is not None:
-            # pass-1 complete: pin the full sketch state so a preemption
-            # anywhere in pass 2 never re-pays the first pass
-            ck.save(-1, meta={"phase": "pass1-done",
-                              "nValid": n_valid_rows, "nPos": n_pos,
-                              "nNeg": n_neg}, blob=_sketch_blob())
+            # pass-1 complete: pin every shard's full sketch state so a
+            # preemption anywhere in pass 2 never re-pays the first pass
+            ck.save(_shard_states(None, [-1] * S),
+                    (None, {"phase": "pass1-done"}, None))
+    n_valid_rows = int(shard_valid.sum())
+    n_pos = int(shard_pos.sum())
+    n_neg = int(shard_neg.sum())
     reg.counter("stats.rows_valid").inc(n_valid_rows)
     reg.counter("stats.rows_pos").inc(n_pos)
     reg.counter("stats.rows_neg").inc(n_neg)
     reg.gauge("stats.columns").set(len(stats_cols))
-    log.info("streaming stats pass 1 done: %d rows (%d pos / %d neg)",
-             n_valid_rows, n_pos, n_neg)
+    log.info("streaming stats pass 1 done: %d rows (%d pos / %d neg) "
+             "over %d shards", n_valid_rows, n_pos, n_neg, S)
 
-    # ---- finalize bins from the sketches ----
+    # ---- reduce the pass-1 map: merge per-shard sketches in shard
+    # order. With checkpointing armed, a COPY of shard 0 receives the
+    # merge — the per-shard sketches must stay pristine because pass-2
+    # snapshots keep writing them and a resume re-merges; without a
+    # checkpoint nothing ever rereads them, so shard 0 absorbs the merge
+    # in place and the pickle round-trip (multi-MB on wide sketch sets)
+    # is skipped ----
+    merged: Dict[str, object] = (
+        pickle.loads(pickle.dumps(sketches[0])) if ck is not None
+        else sketches[0])
+    for s in range(1, S):
+        for name, sk in merged.items():
+            sk.merge(sketches[s][name])
+
+    # ---- finalize bins from the merged sketches ----
     for cc in stats_cols:
-        sk = sketches[cc.column_name]
+        sk = merged[cc.column_name]
         bn = cc.column_binning
         if cc.is_categorical():
             cats = sk.top_categories(cate_max)
@@ -599,13 +670,15 @@ def compute_stats_streaming(
             bn.bin_category = None
             bn.length = len(bounds)
 
-    # ---- pass 2: chunked aggregation, padded to bucketed shapes ----
-    import jax.numpy as jnp
-
+    # ---- pass 2: the sharded device map — S-chunk super-steps through
+    # one shard_map fold each, windows closed by a single psum tree ----
     # slot layout is a pure function of the finalized bins — computed
     # up front so a resume that has zero chunks left to fold still has
     # the layout _write_back needs
     slots, col_offsets, numeric_cols = _column_slot_layout(stats_cols)
+    total_slots = int(sum(slots))
+    n_numeric = len(numeric_cols)
+    col_offsets_np = np.asarray(col_offsets, dtype=np.int32)
 
     def _prep2(numbered):
         """Background-thread stage: purify + bin-code + pad one chunk to
@@ -632,38 +705,72 @@ def compute_stats_streaming(
                                 constant_values=np.nan)
         return ci, n_real, codes, tags, weights, values
 
-    acc_dev = DeviceAccumulator()
-    n_chunks = int(resume_meta.get("nChunks", 0)) if phase == "pass2" else 0
-    if phase == "pass2" and resume_arrays is not None:
-        acc_dev.restore(resume_arrays)
-    with span("stats.pass2") as sp2:
+    acc_dev = DeviceAccumulator(n_shards=S)
+    if phase == "pass2" and resume_acc is not None:
+        acc_dev.restore_parts(list(resume_acc[0]), dict(resume_acc[1]))
+
+    # super-step buffer: group g holds chunks [g*S, (g+1)*S), one per
+    # shard; a whole group folds in ONE shard_map dispatch. Windows only
+    # ever contain whole groups, so a kill mid-group loses nothing — the
+    # buffered chunks simply re-parse on resume.
+    pending: Dict[int, tuple] = {}
+    pending_group: Optional[int] = None
+
+    def _fold_pending():
+        nonlocal pending, pending_group
+        if not pending:
+            pending_group = None
+            return
+        bucket = max(p[1].shape[0] for p in pending.values())
+        codes_g = np.zeros((S, bucket, len(stats_cols)), np.int32)
+        tags_g = np.full((S, bucket), -1, np.int32)
+        weights_g = np.zeros((S, bucket), np.float32)
+        values_g = np.full((S, bucket, n_numeric), np.nan, np.float32)
+        rows_g = [0] * S
+        for s, (n_real, codes_c, tags_c, weights_c, values_c,
+                _ci) in pending.items():
+            m = codes_c.shape[0]
+            codes_g[s, :m] = codes_c
+            tags_g[s, :m] = tags_c
+            weights_g[s, :m] = weights_c
+            values_g[s, :m] = values_c
+            rows_g[s] = n_real
+        with timers.timer("device"):
+            acc_dev.fold_group(codes_g, col_offsets_np, total_slots,
+                               tags_g, weights_g, values_g, rows_g)
+        for s, item in pending.items():
+            cursors2[s] = item[5]
+            shard_chunks[s] += 1
+            plan.record(s, item[0], "stats.pass2")
+        pending = {}
+        pending_group = None
+
+    def _pass2_state():
+        parts, shared_arrays = acc_dev.snapshot_parts()
+        return (_shard_states(parts, cursors2),
+                (shared_arrays, {"phase": "pass2"}, None))
+
+    with span("stats.pass2", shards=S) as sp2:
         for item in prefetch_iter(
-                _chunks_after(resume_ci if phase == "pass2" else -1),
+                plan.resume_slice(enumerate(chunk_factory()), cursors2),
                 transform=_prep2, timers=timers, stage="parse2"):
             if item is None:
                 continue
             faults.fault_point("chunk")
             ci, n_real, codes, tags, weights, values = item
-            n_chunks += 1
-            with timers.timer("device"):
-                acc_dev.add(bin_aggregate_profiled(
-                    jnp.asarray(codes),
-                    jnp.asarray(col_offsets),
-                    int(sum(slots)),
-                    jnp.asarray(tags.astype(np.int32)),
-                    jnp.asarray(weights, dtype=jnp.float32),
-                    jnp.asarray(values),
-                ), rows=n_real)
+            g = plan.group_of(ci)
+            if pending_group is not None and g != pending_group:
+                _fold_pending()
+            pending_group = g
+            pending[plan.shard_of(ci)] = (n_real, codes, tags, weights,
+                                          values, ci)
             if ck is not None:
-                ck.maybe_save(ci, lambda: (
-                    acc_dev.snapshot(),
-                    {"phase": "pass2", "nChunks": n_chunks,
-                     "nValid": n_valid_rows, "nPos": n_pos,
-                     "nNeg": n_neg},
-                    _sketch_blob()))
+                ck.maybe_save(_pass2_state)
+        _fold_pending()
         with timers.timer("sync"):
             acc = acc_dev.fetch()
-        sp2["chunks"] = n_chunks
+        sp2["chunks"] = int(shard_chunks.sum())
+    n_chunks = int(shard_chunks.sum())
     reg.counter("stats.chunks").inc(n_chunks)
     log.info("streaming stats pipeline: %s", timers.summary())
     if ck is not None:
@@ -673,11 +780,11 @@ def compute_stats_streaming(
         return
     pos, neg, wpos, wneg, vsum, vsumsq, vmin, vmax, vcount, vmissing = acc
 
-    medians = [sketches[cc.column_name].median for cc in numeric_cols]
+    medians = [merged[cc.column_name].median for cc in numeric_cols]
     cat_missing = {}
     for cc in stats_cols:
         if cc.is_categorical():
-            sk = sketches[cc.column_name]
+            sk = merged[cc.column_name]
             cat_missing[cc.column_name] = (
                 int(sk.missing),
                 float(sk.missing) / max(n_valid_rows, 1),

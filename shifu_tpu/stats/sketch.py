@@ -178,6 +178,20 @@ class NumericSketch:
     def median(self) -> Optional[float]:
         return self.hist_all.quantile(0.5)
 
+    def merge(self, other: "NumericSketch") -> None:
+        """Fold another shard's sketch in (the reduce of the sharded
+        pass-1 map). Moments/min/max merge exactly; the centroid
+        histograms merge exactly whenever neither side compressed (few
+        distinct values), else within the SPDT error bound."""
+        self.count += other.count
+        self.missing += other.missing
+        self.sum += other.sum
+        self.sumsq += other.sumsq
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        self.hist.merge(other.hist)
+        self.hist_all.merge(other.hist_all)
+
 
 class DistinctSketch:
     """Distinct-count sketch: exact hash set up to `exact_limit`, then a
@@ -221,6 +235,18 @@ class DistinctSketch:
         h = pd.util.hash_pandas_object(ser, index=False).to_numpy(np.uint64)
         self.update_hashes(h)
 
+    def merge(self, other: "DistinctSketch") -> None:
+        """Union another shard's sketch: HLL registers max elementwise;
+        the exact sets union while BOTH sides are still exact (spilling
+        to the registers past the limit, like update_hashes)."""
+        np.maximum(self.registers, other.registers, out=self.registers)
+        if self.exact is not None and other.exact is not None:
+            self.exact |= other.exact
+            if len(self.exact) > self.exact_limit:
+                self.exact = None
+        else:
+            self.exact = None
+
     def estimate(self) -> int:
         if self.exact is not None:
             return len(self.exact)
@@ -263,6 +289,12 @@ class AutoTypeSketch:
 
     def numeric_ratio(self) -> float:
         return self.numeric_ok / self.total if self.total > 0 else 0.0
+
+    def merge(self, other: "AutoTypeSketch") -> None:
+        self.distinct.merge(other.distinct)
+        self.total += other.total
+        self.missing += other.missing
+        self.numeric_ok += other.numeric_ok
 
 
 class CategoricalSketch:
@@ -324,6 +356,38 @@ class CategoricalSketch:
 
     def numeric_ratio(self) -> float:
         return self.numeric_parse_ok / self.total if self.total > 0 else 0.0
+
+    def merge(self, other: "CategoricalSketch") -> None:
+        """Fold another shard's counter in: shard-0-first key order keeps
+        top_categories ties deterministic; counts merge exactly while
+        neither side saturated, else within the space-saving bound (the
+        floors travel with the keys, so re-eviction stays non-compounding
+        after a merge too)."""
+        for key, cnt in other.counts.items():
+            if key in self.counts:
+                self.counts[key] += cnt
+                self._floor[key] = (self._floor.get(key, 0.0)
+                                    + other._floor.get(key, 0.0))
+                if not self._floor[key]:
+                    self._floor.pop(key, None)
+            else:
+                self.counts[key] = cnt
+                if key in other._floor:
+                    self._floor[key] = other._floor[key]
+        self.missing += other.missing
+        self.total += other.total
+        self.numeric_parse_ok += other.numeric_parse_ok
+        self.saturated = self.saturated or other.saturated
+        self.error_bound = max(self.error_bound, other.error_bound)
+        self.evicted_mass += other.evicted_mass
+        if len(self.counts) > self.working_cap:
+            kept = sorted(self.counts.items(), key=lambda kv: -kv[1])
+            self.saturated = True
+            for k, cnt in kept[self.working_cap:]:
+                observed = cnt - self._floor.pop(k, 0.0)
+                self.error_bound = max(self.error_bound, observed)
+                self.evicted_mass += observed
+            self.counts = dict(kept[: self.working_cap])
 
     def top_categories(self, max_categories: int) -> List[str]:
         """Descending frequency, ties by first-seen order (dict order), same

@@ -360,6 +360,44 @@ class TestHygieneRules:
         """)
         assert rule_lines(findings, "SH103") == [2]
 
+    def test_sh103_chunk_loop_needs_shard_plan(self, tmp_path):
+        """The sharded-lifecycle extension: an entry point that loops raw
+        ingest chunks must go through the shard planner or declare
+        single-shard intent — otherwise the next contributor quietly
+        reintroduces an O(rows) path."""
+        findings = check_snippet(tmp_path, """
+            def score_all_streaming(path, names):
+                for chunk in chunk_source(path, names)():  # no plan
+                    consume(chunk)
+
+            def fold_planned_streaming(path, names):
+                plan = ShardPlan()
+                for ci, chunk in enumerate(chunk_source(path, names)()):
+                    fold(plan.shard_of(ci), chunk)
+
+            def sweep_local_streaming(path, names):
+                '''Tallies pre-reduced rows; deliberately single-shard.'''
+                for chunk in chunk_source(path, names)():
+                    tally(chunk)
+
+            def chunk_source(path, names):
+                return lambda: iter(())
+        """)
+        assert rule_lines(findings, "SH103") == [2]
+        assert "ShardPlan" in findings[0].message
+
+    def test_sh103_applies_to_methods(self, tmp_path):
+        """Lifecycle entry points are processor METHODS — the rule must
+        reach inside classes (the real `_score_streaming`/`_run_streaming`
+        seams live there)."""
+        findings = check_snippet(tmp_path, """
+            class P:
+                def _score_streaming(self, path):
+                    for chunk in iter_columnar_chunks(path):
+                        self.emit(chunk)
+        """)
+        assert rule_lines(findings, "SH103") == [3]
+
 
 # ---------------------------------------------------------------------------
 # self-check: the shipped tree is clean (the at-merge acceptance bar)

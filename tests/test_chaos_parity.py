@@ -119,9 +119,16 @@ def test_streaming_stats_preempt_resume_bit_identical(
             with pytest.raises(PreemptionError):
                 compute_stats_streaming(mc, chaos, factory,
                                         checkpoint_root=root)
-        # the snapshot the kill left behind is listable / resumable
-        entries = ckpt_mod.list_resumable(root)
-        assert [e["name"] for e in entries] == ["stats-stream"]
+        # the snapshot family the kill left behind is listable /
+        # resumable: slot files per row shard + the shared commit pointer
+        from shifu_tpu.parallel.mesh import lifecycle_shards
+
+        S = lifecycle_shards()
+        names = {e["name"] for e in ckpt_mod.list_resumable(root)}
+        assert "stats-stream-shared" in names
+        for s in range(S):
+            assert any(n.startswith(f"stats-stream-shard{s:05d}-")
+                       for n in names), (s, sorted(names))
         resumed = fresh_cols()
         compute_stats_streaming(mc, resumed, factory,
                                 checkpoint_root=root, resume=True)
@@ -189,17 +196,24 @@ def test_streaming_norm_preempt_resume_bit_identical(tmp_path):
         # ... and recorded the injected fault in the metrics snapshot
         counters = manifest["metrics"]["counters"]
         assert counters.get('fault.injected{seam="preempt"}') == 1.0
-        # a resumable snapshot must exist — otherwise the "resume" below
+        # a resumable snapshot family must exist (one file per row shard
+        # + the shared writer state) — otherwise the "resume" below
         # would be a vacuous from-scratch rerun
-        ck_file = ckpt_mod.ckpt_path(roots["chaos"], "norm", "stream")
+        base = ckpt_mod.ckpt_base(roots["chaos"], "norm", "stream")
+        ck_file = base + "-shared" + ckpt_mod.CKPT_SUFFIX
         assert os.path.isfile(ck_file)
+        assert glob.glob(base + "-shard00000-*" + ckpt_mod.CKPT_SUFFIX)
 
         with _StreamEnv(**{"shifu.resume": "true"}):
             assert NormProcessor(roots["chaos"]).run() == 0
-        # the resumed run actually LOADED the snapshot (and cleared it)
+        # the resumed run actually LOADED the whole snapshot family —
+        # one file per row shard plus the shared state — and cleared it
+        from shifu_tpu.parallel.mesh import lifecycle_shards
+
         resumed = json.load(open(os.path.join(
             roots["chaos"], ".shifu", "runs", "norm-2.json")))
-        assert resumed["metrics"]["counters"].get("ckpt.resumes") == 1.0
+        assert resumed["metrics"]["counters"].get("ckpt.resumes") == \
+            float(lifecycle_shards() + 1)
         assert not os.path.isfile(ck_file)
 
     clean_files = _artifact_files(roots["clean"])
@@ -208,6 +222,54 @@ def test_streaming_norm_preempt_resume_bit_identical(tmp_path):
     for rel in clean_files:
         assert filecmp.cmp(clean_files[rel], chaos_files[rel],
                            shallow=False), f"{rel} differs after resume"
+
+
+def test_sharded_norm_preempt_resume_matches_1shard(tmp_path):
+    """ISSUE-8 chaos parity for the sharded lifecycle: preempt the
+    8-shard streaming norm mid-stream, --resume from the per-shard
+    checkpoint family, and the NormalizedData/CleanedData artifacts are
+    byte-identical BOTH to an uninterrupted sharded run AND to the
+    1-shard degenerate run."""
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+
+    roots = {}
+    for name in ("sharded", "oneshard", "chaos"):
+        root = str(tmp_path / name)
+        make_model_set(root, n_rows=300, seed=7)
+        assert InitProcessor(root).run() == 0
+        assert StatsProcessor(root).run() == 0
+        roots[name] = root
+
+    with _StreamEnv(**{"shifu.ingest.forceStreaming": "true",
+                       "shifu.ingest.chunkRows": "48",
+                       "shifu.ckpt.everyChunks": "1"}):
+        assert NormProcessor(roots["sharded"]).run() == 0
+        with _StreamEnv(**{"shifu.lifecycle.shards": "1"}):
+            assert NormProcessor(roots["oneshard"]).run() == 0
+
+        with faults.activate(FaultPlan.parse("preempt@chunk=3")):
+            with pytest.raises(PreemptionError):
+                NormProcessor(roots["chaos"]).run()
+        # the per-shard family survived the kill — every shard can
+        # resume from its own cursor
+        entries = ckpt_mod.list_resumable(roots["chaos"])
+        names = [e["name"] for e in entries]
+        assert any(n.startswith("norm-stream-shard00000-") for n in names)
+        assert "norm-stream-shared" in names
+        with _StreamEnv(**{"shifu.resume": "true"}):
+            assert NormProcessor(roots["chaos"]).run() == 0
+
+    sharded = _artifact_files(roots["sharded"])
+    oneshard = _artifact_files(roots["oneshard"])
+    chaos = _artifact_files(roots["chaos"])
+    assert set(sharded) == set(chaos) == set(oneshard)
+    for rel in sharded:
+        assert filecmp.cmp(sharded[rel], chaos[rel], shallow=False), \
+            f"{rel}: resumed sharded run differs from uninterrupted"
+        assert filecmp.cmp(sharded[rel], oneshard[rel], shallow=False), \
+            f"{rel}: sharded run differs from the 1-shard degenerate"
 
 
 # ---------------------------------------------------------------------------
@@ -256,7 +318,8 @@ def test_streaming_eval_preempt_resume_bit_identical(trained_root):
                 EvalProcessor(root, score_name="Eval1").run()
         partial = open(score_file).read()
         assert partial != clean  # the kill really landed mid-file
-        ck_file = ckpt_mod.ckpt_path(root, "eval", "score-Eval1")
+        ck_file = (ckpt_mod.ckpt_base(root, "eval", "score-Eval1")
+                   + "-shared" + ckpt_mod.CKPT_SUFFIX)
         assert os.path.isfile(ck_file)  # resume has something to load
 
         with _StreamEnv(**{"shifu.resume": "true"}):

@@ -168,6 +168,52 @@ class TestEvalProcessor:
         assert os.path.isfile(os.path.join(out, "meta.json"))
 
 
+def test_model_runner_batch_cache_survives_address_reuse(tmp_path):
+    """ModelRunner's per-batch feature caches must invalidate by OBJECT
+    identity held weakly, never by id(): in a streaming loop the freed
+    previous chunk's address is routinely recycled for the next chunk,
+    and an id()-keyed check silently scores the new rows with the OLD
+    chunk's normalized features (a whole chunk of wrong scores,
+    timing-dependent — caught live by the sharded eval chaos loop)."""
+    import gc
+
+    from shifu_tpu.data.reader import ColumnarData
+    from shifu_tpu.eval.scorer import ModelRunner
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+
+    cols = [f"c{i}" for i in range(3)]
+    sizes = [3, 4, 1]
+    specs = [{"name": c, "kind": "value", "outNames": [c],
+              "mean": 0.0, "std": 1.0, "fill": 0.0, "zscore": True}
+             for c in cols]
+    path = str(tmp_path / "model0.nn")
+    NNModelSpec(layer_sizes=sizes, activations=["tanh"],
+                input_columns=cols, norm_specs=specs,
+                params=init_params(sizes, seed=0)).save(path)
+    runner = ModelRunner([path])
+
+    def batch(vals):
+        return ColumnarData(
+            names=cols,
+            raw={c: np.array([f"{v:.3f}" for v in vals], object)
+                 for c in cols},
+            n_rows=len(vals),
+        )
+
+    fresh = runner.score_raw(batch([2.0, -2.0])).mean.copy()
+    # score another batch, drop it, then score the target batch — the
+    # dead weakref must force a cache rebuild even if the allocator
+    # hands the new batch the dead one's address
+    d1 = batch([0.5, 0.25])
+    runner.score_raw(d1)
+    assert runner._cached_data_ref() is d1
+    del d1
+    gc.collect()
+    assert runner._cached_data_ref() is None  # dead -> must invalidate
+    again = runner.score_raw(batch([2.0, -2.0])).mean
+    np.testing.assert_array_equal(again, fresh)
+
+
 def test_eval_streaming_matches_in_memory(tmp_path):
     """Forced streaming eval writes the same score file as the in-memory
     path (chunks purify/tag/score independently)."""

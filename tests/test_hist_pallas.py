@@ -88,6 +88,41 @@ def test_chunk_runs_cover_layout():
     assert any(r[0] == "piece" for ch in chunks for r in ch["runs"])
 
 
+def test_shaping_knobs_and_profiler_annotation():
+    """-Dshifu.pallas.blk/.wmax override the VMEM shaping (the kernel-
+    tuning sweep seam), the overridden kernel still matches the scatter
+    reference exactly, and the chosen shaping lands in the profiler
+    snapshot so every manifest records what produced its numbers."""
+    from shifu_tpu import obs
+    from shifu_tpu.ops.hist_pallas import blk_setting, wmax_setting
+    from shifu_tpu.utils import environment
+
+    slots, is_cat, codes, y, w, rng = _mixed_case(n=700)
+    lay = make_layout(slots, is_cat)
+    L = 4
+    node = rng.integers(0, L, size=len(y)).astype(np.int32)
+    active = rng.random(len(y)) < 0.9
+    h_ref = _ref_hist(L, lay, codes, y, w, node, active)
+
+    environment.set_property("shifu.pallas.blk", "128")
+    environment.set_property("shifu.pallas.wmax", "256")
+    obs.reset()
+    try:
+        assert blk_setting() == 128 and wmax_setting() == 256
+        # the narrower wmax splits the flat T axis into more chunks
+        assert len(_chunk_runs(lay)) > len(_chunk_runs(lay, target=1024))
+        h_pl = _pallas_hist(L, lay, codes, y, w, node, active)
+        np.testing.assert_array_equal(h_ref[0], h_pl[0])
+        np.testing.assert_allclose(h_ref, h_pl, rtol=2e-5, atol=1e-4)
+        ann = obs.profiler().snapshot()["annotations"]["ops.hist_pallas"]
+        assert ann["blk"] == 128 and ann["wMax"] == 256
+        assert ann["chunks"] == len(_chunk_runs(lay))
+    finally:
+        environment.set_property("shifu.pallas.blk", "")
+        environment.set_property("shifu.pallas.wmax", "")
+    assert blk_setting() == 512 and wmax_setting() == 1024
+
+
 def test_bench_baseline_guards(tmp_path, monkeypatch):
     """bench.py refuses to silently clobber the calibrated pinned baseline
     and rejects config drift (review findings, round 5)."""

@@ -1,0 +1,568 @@
+"""Sharded map/reduce lifecycle (ISSUE 8 acceptance).
+
+The streaming stats/norm/eval/autotype folds divide chunks over the
+lifecycle mesh via ShardPlan and fold through the sharded
+DeviceAccumulator (shard_map map, psum-tree reduce). Pinned here, under
+the 8 virtual devices conftest forces:
+
+  * work division — with S shards over K chunks, each shard folds at
+    most ceil(K/S) chunks (obs counters asserted);
+  * one d2h sync per window — the psum reduce replaces O(S) per-shard
+    host pulls (device.d2h_syncs == reduce.psum_windows);
+  * cross-shard-count parity — the sharded fold is bit-identical to the
+    1-shard degenerate path: counts exact always; on integral-valued
+    data the whole ColumnConfig (and the norm artifacts) match byte for
+    byte between S=8 and S=1;
+  * per-shard checkpoints — epoch-stamped family, mixed epochs rejected
+    as a unit.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from shifu_tpu.utils import environment
+from tests.helpers import make_model_set
+
+
+class _Shards:
+    """Pin shifu.lifecycle.shards for one block, restored on exit."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __enter__(self):
+        environment.set_property("shifu.lifecycle.shards", str(self.n))
+        return self
+
+    def __exit__(self, *exc):
+        environment.set_property("shifu.lifecycle.shards", "")
+
+
+def _integral_stats_setup(tmp_path, n=600, chunk_rows=48):
+    """Chunked stats workload whose aggregates are all integer-valued in
+    f32 (integer values, unit weights), so every float sum is exact and
+    order-independent — the property that makes S=8 vs S=1 byte-parity a
+    meaningful assertion rather than a tolerance check."""
+    from shifu_tpu.config import ColumnConfig, ColumnType
+    from shifu_tpu.config.column_config import ColumnFlag
+    from shifu_tpu.config.model_config import Algorithm, new_model_config
+    from shifu_tpu.data.stream import chunk_source
+
+    rng = np.random.default_rng(3)
+    y = (rng.random(n) < 0.4).astype(int)
+    num = rng.integers(0, 32, size=(n, 3)) + y[:, None]
+    # distinct category frequencies -> no sort ties across merge orders
+    cats = np.array(["aa"] * 8 + ["bb"] * 4 + ["cc"] * 2 + ["dd"])[
+        rng.integers(0, 15, size=n)]
+    names = ["target", "n0", "n1", "n2", "c0"]
+    data_path = os.path.join(str(tmp_path), "data.txt")
+    with open(data_path, "w") as fh:
+        for i in range(n):
+            fh.write("|".join([str(y[i])]
+                              + [str(v) for v in num[i]]
+                              + [cats[i]]) + "\n")
+
+    mc = new_model_config("ShardedStats", Algorithm.NN)
+    mc.data_set.target_column_name = "target"
+    mc.data_set.pos_tags = ["1"]
+    mc.data_set.neg_tags = ["0"]
+
+    def fresh_cols():
+        cols = [ColumnConfig(column_num=0, column_name="target",
+                             column_flag=ColumnFlag.TARGET)]
+        for j in range(3):
+            cols.append(ColumnConfig(column_num=1 + j,
+                                     column_name=f"n{j}",
+                                     column_type=ColumnType.N))
+        cols.append(ColumnConfig(column_num=4, column_name="c0",
+                                 column_type=ColumnType.C))
+        return cols
+
+    factory = chunk_source(data_path, names, delimiter="|",
+                           chunk_rows=chunk_rows)
+    n_chunks = -(-n // chunk_rows)
+    return mc, fresh_cols, factory, n_chunks
+
+
+def _cols_json(cols) -> str:
+    import tempfile
+
+    from shifu_tpu.config.column_config import save_column_config_list
+
+    with tempfile.NamedTemporaryFile("r", suffix=".json") as fh:
+        save_column_config_list(fh.name, cols)
+        return open(fh.name).read()
+
+
+class TestShardPlan:
+    def test_round_robin_and_bound(self):
+        from shifu_tpu.data.pipeline import ShardPlan
+
+        plan = ShardPlan(n_shards=8)
+        K = 27
+        per_shard = np.bincount([plan.shard_of(ci) for ci in range(K)],
+                                minlength=8)
+        assert per_shard.sum() == K
+        assert per_shard.max() <= -(-K // 8)  # ceil(K/S)
+        assert plan.group_of(0) == 0 and plan.group_of(15) == 1
+
+    def test_shard_slice_is_the_shards_chunks(self):
+        from shifu_tpu.data.pipeline import ShardPlan
+
+        plan = ShardPlan(n_shards=4)
+        got = list(plan.shard_slice(enumerate("abcdefghij"), 2))
+        assert got == [(2, "c"), (6, "g")]
+
+    def test_resume_slice_per_shard_cursors(self):
+        from shifu_tpu.data.pipeline import ShardPlan
+
+        plan = ShardPlan(n_shards=2)
+        # shard 0 folded through ci=4, shard 1 only through ci=1
+        got = [ci for ci, _ in plan.resume_slice(
+            enumerate(range(8)), [4, 1])]
+        assert got == [3, 5, 6, 7]
+
+    def test_default_comes_from_knob_then_devices(self):
+        import jax
+
+        from shifu_tpu.data.pipeline import ShardPlan
+        from shifu_tpu.parallel.mesh import lifecycle_shards
+
+        assert lifecycle_shards() == len(jax.devices()) == 8
+        with _Shards(3):
+            assert lifecycle_shards() == 3
+            assert ShardPlan().n_shards == 3
+
+
+class TestShardedAccumulator:
+    def _group(self, rng, S, n, total_slots, Cn, present):
+        codes = np.zeros((S, n, 2), np.int32)
+        tags = np.full((S, n), -1, np.int32)
+        weights = np.zeros((S, n), np.float32)
+        values = np.full((S, n, Cn), np.nan, np.float32)
+        rows = [0] * S
+        for s in present:
+            codes[s] = rng.integers(0, 2, size=(n, 2))
+            tags[s] = rng.integers(0, 2, size=n)
+            weights[s] = 1.0
+            values[s] = rng.integers(-5, 6, size=(n, Cn))
+            rows[s] = n
+        return codes, tags, weights, values, rows
+
+    def test_fold_group_matches_host_reference_and_single_sync(self):
+        """Ragged groups (some shards empty) fold correctly, and the
+        whole run costs exactly ONE d2h sync / ONE psum window — not one
+        pull per shard."""
+        import jax.numpy as jnp
+
+        from shifu_tpu import obs
+        from shifu_tpu.data.pipeline import DeviceAccumulator
+        from shifu_tpu.ops.binagg import bin_aggregate_jit
+
+        obs.reset()
+        S, n, slots, Cn = 8, 64, 5, 2
+        offsets = np.array([0, 3], np.int32)
+        rng = np.random.default_rng(1)
+        acc = DeviceAccumulator(n_shards=S)
+        host = None
+        for present in ([0, 1, 2, 3, 4, 5, 6, 7], [0, 3, 7], [2]):
+            codes, tags, weights, values, rows = self._group(
+                rng, S, n, slots, Cn, present)
+            acc.fold_group(codes, offsets, slots, tags, weights, values,
+                           rows)
+            for s in present:
+                part = [np.asarray(x, np.float64) for x in
+                        bin_aggregate_jit(
+                            jnp.asarray(codes[s]), jnp.asarray(offsets),
+                            slots, jnp.asarray(tags[s]),
+                            jnp.asarray(weights[s]),
+                            jnp.asarray(values[s]))]
+                if host is None:
+                    host = part
+                else:
+                    host = [np.minimum(h, p) if k == 6 else
+                            np.maximum(h, p) if k == 7 else h + p
+                            for k, (h, p) in enumerate(zip(host, part))]
+        got = acc.fetch()
+        for g, h in zip(got, host):
+            np.testing.assert_allclose(g, h, rtol=1e-6)
+        reg = obs.registry()
+        assert reg.counter("reduce.psum_windows").value == 1
+        assert reg.counter("device.d2h_syncs").value == 1
+
+    def test_window_flush_is_one_sync_per_window(self):
+        """Multi-window streams: every flush is exactly one psum reduce
+        + one d2h sync, whatever S is (flush_rows=100 under 64-row
+        groups forces a flush before groups 2-4 plus the final fetch —
+        4 windows, 4 syncs: the sync count scales with WINDOWS, never
+        with shards)."""
+        from shifu_tpu import obs
+        from shifu_tpu.data.pipeline import DeviceAccumulator
+
+        obs.reset()
+        S, n, slots, Cn = 8, 64, 5, 2
+        offsets = np.array([0, 3], np.int32)
+        rng = np.random.default_rng(2)
+        acc = DeviceAccumulator(flush_rows=100, n_shards=S)
+        for _ in range(4):
+            codes, tags, weights, values, rows = self._group(
+                rng, S, n, slots, Cn, range(S))
+            acc.fold_group(codes, offsets, slots, tags, weights, values,
+                           rows)
+        acc.fetch()
+        reg = obs.registry()
+        syncs = reg.counter("device.d2h_syncs").value
+        assert syncs == reg.counter("reduce.psum_windows").value == 4
+
+    def test_snapshot_parts_round_trip_bit_identical(self):
+        """Per-shard snapshot slices + shared host fold reassemble to a
+        bit-identical accumulator (the per-shard checkpoint contract)."""
+        from shifu_tpu.data.pipeline import DeviceAccumulator
+
+        S, n, slots, Cn = 4, 32, 5, 2
+        offsets = np.array([0, 3], np.int32)
+        rng = np.random.default_rng(3)
+        a = DeviceAccumulator(flush_rows=50, n_shards=S)
+        for _ in range(3):
+            codes, tags, weights, values, rows = self._group(
+                rng, S, n, slots, Cn, range(S))
+            a.fold_group(codes, offsets, slots, tags, weights, values,
+                         rows)
+        per_shard, shared = a.snapshot_parts()
+        assert len(per_shard) == S
+        b = DeviceAccumulator(flush_rows=50, n_shards=S)
+        b.restore_parts(per_shard, shared)
+        codes, tags, weights, values, rows = self._group(
+            rng, S, n, slots, Cn, range(S))
+        for acc in (a, b):
+            acc.fold_group(codes, offsets, slots, tags, weights, values,
+                           rows)
+        for xa, xb in zip(a.fetch(), b.fetch()):
+            np.testing.assert_array_equal(xa, xb)
+
+
+class TestDcnWindowReduce:
+    def test_fold_and_reduce_over_forced_dcn_mesh(self):
+        """The psum tree lowers hierarchically on a (dcn, data) mesh —
+        same numbers as the flat 8-wide mesh, exercised here on a forced
+        2x4 virtual multi-slice mesh (the ICI/DCN shape a real pod
+        runs)."""
+        import jax
+        import jax.numpy as jnp
+
+        from shifu_tpu.ops import binagg
+        from shifu_tpu.parallel.mesh import data_mesh, row_shard_count
+
+        mesh = data_mesh(dcn_slices=2)
+        assert mesh.axis_names == ("dcn", "data")
+        S = row_shard_count(mesh)
+        assert S == 8
+        slots, Cn, n = 5, 2, 32
+        rng = np.random.default_rng(7)
+        codes = rng.integers(0, 2, size=(S, n, 2)).astype(np.int32)
+        offsets = np.array([0, 3], np.int32)
+        tags = rng.integers(0, 2, size=(S, n)).astype(np.int32)
+        weights = np.ones((S, n), np.float32)
+        values = rng.integers(-4, 5, size=(S, n, Cn)).astype(np.float32)
+
+        win = binagg.window_init(mesh, slots, Cn)
+        win = binagg.sharded_window_fold(mesh, slots)(
+            win, codes, offsets, tags, weights, values)
+        got = [np.asarray(x[0], np.float64) for x in
+               jax.device_get(binagg.window_reduce(mesh)(win))]
+        ref = None
+        for s in range(S):
+            part = [np.asarray(x, np.float64) for x in
+                    binagg.bin_aggregate_jit(
+                        jnp.asarray(codes[s]), jnp.asarray(offsets),
+                        slots, jnp.asarray(tags[s]),
+                        jnp.asarray(weights[s]),
+                        jnp.asarray(values[s]))]
+            ref = part if ref is None else [
+                np.minimum(h, p) if k == 6 else
+                np.maximum(h, p) if k == 7 else h + p
+                for k, (h, p) in enumerate(zip(ref, part))]
+        for g, r in zip(got, ref):
+            np.testing.assert_array_equal(g, r)  # integral data: exact
+
+
+class TestShardedStatsParity:
+    def test_work_division_counters(self, tmp_path):
+        """With S=8 over K chunks each shard folds <= ceil(K/S) chunks
+        in EACH pass, the per-shard counters land in the registry, and
+        the whole pass-2 fold costs one d2h sync per window."""
+        from shifu_tpu import obs
+        from shifu_tpu.stats.engine import compute_stats_streaming
+
+        mc, fresh_cols, factory, K = _integral_stats_setup(tmp_path)
+        obs.reset()
+        compute_stats_streaming(mc, fresh_cols(), factory)
+        reg = obs.registry()
+        for stage in ("stats.pass1", "stats.pass2"):
+            per_shard = [
+                reg.counter("shard.chunks", shard=str(s),
+                            stage=stage).value
+                for s in range(8)]
+            assert sum(per_shard) == K, (stage, per_shard)
+            assert max(per_shard) <= -(-K // 8) + 1, (stage, per_shard)
+        assert reg.counter("reduce.psum_windows").value == 1
+        assert reg.counter("device.d2h_syncs").value == 1
+        assert reg.counter("shard.rows", shard="0",
+                           stage="stats.pass2").value > 0
+
+    def test_sharded_equals_single_shard_byte_identical(self, tmp_path):
+        """The acceptance pin: on integral data the S=8 sharded fold and
+        the S=1 degenerate path write byte-identical ColumnConfig."""
+        from shifu_tpu.stats.engine import compute_stats_streaming
+
+        mc, fresh_cols, factory, _K = _integral_stats_setup(tmp_path)
+        sharded = fresh_cols()
+        compute_stats_streaming(mc, sharded, factory)  # default: 8
+        single = fresh_cols()
+        with _Shards(1):
+            compute_stats_streaming(mc, single, factory)
+        assert _cols_json(sharded) == _cols_json(single)
+        # sanity: the fold actually counted the data
+        assert sharded[1].column_stats.total_count > 0
+
+    def test_counts_exact_at_any_shard_count(self, tmp_path):
+        """Counts are exact (not tolerance-equal) for EVERY shard count,
+        including ones that leave idle shards."""
+        from shifu_tpu.stats.engine import compute_stats_streaming
+
+        mc, fresh_cols, factory, _K = _integral_stats_setup(
+            tmp_path, n=300, chunk_rows=64)
+        results = {}
+        for S in (1, 3, 8):
+            cols = fresh_cols()
+            with _Shards(S):
+                compute_stats_streaming(mc, cols, factory)
+            results[S] = cols
+        base = results[1]
+        for S in (3, 8):
+            for cc, cb in zip(results[S], base):
+                if cc.is_target():
+                    continue
+                assert cc.column_binning.bin_count_pos == \
+                    cb.column_binning.bin_count_pos, (S, cc.column_name)
+                assert cc.column_binning.bin_count_neg == \
+                    cb.column_binning.bin_count_neg
+                assert cc.column_stats.total_count == \
+                    cb.column_stats.total_count
+
+
+class TestShardedNormEvalInitParity:
+    def test_norm_artifacts_byte_identical_across_shard_counts(
+            self, tmp_path):
+        import filecmp
+        import glob
+
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+
+        outs = {}
+        for S in (8, 1):
+            root = str(tmp_path / f"ms-{S}")
+            make_model_set(root, n_rows=300, seed=11)
+            with _Shards(S):
+                assert InitProcessor(root).run() == 0
+                assert StatsProcessor(root).run() == 0
+                environment.set_property("shifu.ingest.forceStreaming",
+                                         "true")
+                environment.set_property("shifu.ingest.chunkRows", "48")
+                try:
+                    assert NormProcessor(root).run() == 0
+                finally:
+                    environment.set_property(
+                        "shifu.ingest.forceStreaming", "")
+                    environment.set_property("shifu.ingest.chunkRows", "")
+            outs[S] = root
+        for d in ("NormalizedData", "CleanedData"):
+            a = sorted(glob.glob(os.path.join(outs[8], "**", d, "*"),
+                                 recursive=True))
+            b = sorted(glob.glob(os.path.join(outs[1], "**", d, "*"),
+                                 recursive=True))
+            assert a and len(a) == len(b)
+            for fa, fb in zip(a, b):
+                assert filecmp.cmp(fa, fb, shallow=False), (fa, fb)
+
+    def test_autotype_identical_across_shard_counts(self, tmp_path):
+        """Sharded autotype sketches merge exactly below the HLL exact
+        limit: distinct counts / numeric ratios / ColumnConfig types are
+        identical however many shards folded them."""
+        from shifu_tpu.processor.init import InitProcessor
+
+        results = {}
+        for S in (8, 1):
+            root = str(tmp_path / f"init-{S}")
+            make_model_set(root, n_rows=400, seed=5)
+            with _Shards(S):
+                assert InitProcessor(root).run() == 0
+            at = glob_one(root, "count_info.json")
+            results[S] = (open(at).read(),
+                          open(os.path.join(
+                              root, "ColumnConfig.json")).read())
+        assert results[8][0] == results[1][0]
+        assert results[8][1] == results[1][1]
+
+
+def glob_one(root, pattern):
+    import glob
+
+    hits = glob.glob(os.path.join(root, "**", pattern), recursive=True)
+    assert hits, (root, pattern)
+    return hits[0]
+
+
+class TestShardedCheckpointFamily:
+    def test_epoch_mismatch_rejects_whole_family(self, tmp_path):
+        from shifu_tpu import obs
+        from shifu_tpu.resilience.checkpoint import (
+            ShardedStreamCheckpoint,
+        )
+
+        obs.reset()
+        base = os.path.join(str(tmp_path), "fam")
+        ck = ShardedStreamCheckpoint(base, "sha-x", 3, every=1)
+        state = ([(ci, {"w": np.arange(3)}, {"n": ci}, None)
+                  for ci in (5, 3, 4)],
+                 ({"h": np.ones(2)}, {"phase": "p"}, None))
+        ck.save(*state)
+        loaded = ShardedStreamCheckpoint(base, "sha-x", 3).load()
+        assert loaded is not None
+        cursors, per_shard, shared = loaded
+        assert cursors == [5, 3, 4]
+        np.testing.assert_array_equal(per_shard[1][0]["w"], np.arange(3))
+        assert shared[1]["phase"] == "p"
+
+        # tear: overwrite shard 1's COMMITTED slot with a foreign epoch —
+        # the pointer's epoch no longer matches, so the family rejects
+        ck2 = ShardedStreamCheckpoint(base, "sha-x", 3)
+        assert ck2.load() is not None
+        slot = ck2._slot(ck2._epoch)
+        ck2._shards[1][slot].save(9, meta={"epoch": 99, "shards": 3})
+        assert ShardedStreamCheckpoint(base, "sha-x", 3).load() is None
+        rej = obs.registry().counter("ckpt.rejected", reason="epoch")
+        assert rej.value >= 1
+
+    def test_kill_mid_family_save_keeps_previous_epoch(self, tmp_path):
+        """The two-phase commit: a kill during the per-shard slot writes
+        (before the shared pointer lands) must leave the PREVIOUS
+        complete snapshot loadable — never a from-zero restart."""
+        from shifu_tpu.resilience.checkpoint import (
+            ShardedStreamCheckpoint,
+        )
+
+        base = os.path.join(str(tmp_path), "famk")
+        ck = ShardedStreamCheckpoint(base, "sha-k", 2, every=1)
+        ck.save([(3, {"w": np.full(2, 3.0)}, None, None),
+                 (4, {"w": np.full(2, 4.0)}, None, None)],
+                (None, {"phase": "p"}, None))
+        # simulate epoch-2 shard writes WITHOUT the pointer commit: the
+        # next slot's files land, the shared file does not change
+        next_slot = ck._slot(ck._epoch + 1)
+        for s, cks in enumerate(ck._shards):
+            cks[next_slot].save(9 + s, arrays={"w": np.full(2, 9.0)},
+                                meta={"epoch": ck._epoch + 1, "shards": 2})
+        loaded = ShardedStreamCheckpoint(base, "sha-k", 2).load()
+        assert loaded is not None
+        cursors, per_shard, _shared = loaded
+        assert cursors == [3, 4]  # the epoch-1 state, fully intact
+        np.testing.assert_array_equal(per_shard[0][0]["w"],
+                                      np.full(2, 3.0))
+
+    def test_shard_count_change_rejects_and_clear_globs_all(
+            self, tmp_path):
+        import glob
+
+        from shifu_tpu.resilience.checkpoint import (
+            CKPT_SUFFIX,
+            ShardedStreamCheckpoint,
+        )
+
+        base = os.path.join(str(tmp_path), "fam2")
+        ck = ShardedStreamCheckpoint(base, "sha-y", 2, every=1)
+        ck.save([(0, None, None, None), (1, None, None, None)],
+                (None, None, None))
+        # same sha but a different family width must not resume ...
+        narrow = ShardedStreamCheckpoint(base, "sha-y", 1)
+        assert narrow.load() is None
+        # ... and clear() from the NARROWER family still removes every
+        # stale wide-family shard file (no phantom resumables left)
+        narrow.clear()
+        assert glob.glob(base + "-*" + CKPT_SUFFIX) == []
+
+
+class TestShardedChaosParitySingleVsMany:
+    @pytest.mark.parametrize("preempt_at", [9])
+    def test_preempted_sharded_resume_matches_1shard(self, tmp_path,
+                                                     preempt_at):
+        """The ISSUE acceptance: kill the sharded fold mid-stream,
+        --resume, and the final ColumnConfig is byte-identical BOTH to an
+        uninterrupted sharded run AND to the 1-shard run."""
+        from shifu_tpu.resilience import faults
+        from shifu_tpu.resilience.faults import FaultPlan, PreemptionError
+        from shifu_tpu.stats.engine import compute_stats_streaming
+
+        mc, fresh_cols, factory, _K = _integral_stats_setup(tmp_path)
+        root = str(tmp_path / "root")
+
+        clean = fresh_cols()
+        compute_stats_streaming(mc, clean, factory)
+
+        single = fresh_cols()
+        with _Shards(1):
+            compute_stats_streaming(mc, single, factory)
+
+        chaos = fresh_cols()
+        environment.set_property("shifu.ckpt.everyChunks", "1")
+        try:
+            with faults.activate(
+                    FaultPlan.parse(f"preempt@chunk={preempt_at}")):
+                with pytest.raises(PreemptionError):
+                    compute_stats_streaming(mc, chaos, factory,
+                                            checkpoint_root=root)
+            resumed = fresh_cols()
+            compute_stats_streaming(mc, resumed, factory,
+                                    checkpoint_root=root, resume=True)
+        finally:
+            environment.set_property("shifu.ckpt.everyChunks", "")
+        res = _cols_json(resumed)
+        assert res == _cols_json(clean)
+        assert res == _cols_json(single)
+
+
+class TestShardedManifestCounters:
+    def test_stats_manifest_carries_shard_counters(self, tmp_path):
+        """End to end through the processor: the run-ledger manifest of a
+        streamed `shifu stats` embeds shard.chunks/shard.rows per shard
+        and the psum-window count (what the CI multi-device job greps)."""
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+
+        root = str(tmp_path / "ms")
+        make_model_set(root, n_rows=300, seed=9)
+        assert InitProcessor(root).run() == 0
+        environment.set_property("shifu.ingest.forceStreaming", "true")
+        environment.set_property("shifu.ingest.chunkRows", "48")
+        try:
+            assert StatsProcessor(root).run() == 0
+        finally:
+            environment.set_property("shifu.ingest.forceStreaming", "")
+            environment.set_property("shifu.ingest.chunkRows", "")
+        manifest = json.load(open(os.path.join(
+            root, ".shifu", "runs", "stats-1.json")))
+        counters = manifest["metrics"]["counters"]
+        shard_keys = [k for k in counters if k.startswith("shard.chunks")]
+        assert shard_keys, sorted(counters)
+        assert any('shard="0"' in k for k in shard_keys)
+        assert counters.get("reduce.psum_windows") == 1.0
+        # the sharded fold + reduce are profiled programs (MFU/roofline
+        # attribution covers them)
+        progs = (manifest.get("profile") or {}).get("programs", {})
+        assert "pipeline.sharded_fold" in progs
+        assert "pipeline.psum_reduce" in progs
