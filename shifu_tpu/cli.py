@@ -70,6 +70,66 @@ def build_parser() -> argparse.ArgumentParser:
     p_train.add_argument("-dry", "--dry", action="store_true", help="dry run")
     p_train.add_argument("--resume", action="store_true", help=_RESUME_HELP)
 
+    p_retrain = sub.add_parser(
+        "retrain", help="warm-start incremental training: norm a new "
+                        "data stream (default: the serve traffic log), "
+                        "warm-start NN/WDL from the previous models / "
+                        "append GBT trees, write the result to the "
+                        "candidate dir for `shifu promote`")
+    p_retrain.add_argument("--from-traffic", action="store_true",
+                           dest="from_traffic",
+                           help="retrain from the serve-side traffic log "
+                                "(.shifu/runs/traffic; the default when "
+                                "one exists and --data is not given)")
+    p_retrain.add_argument("--data", default=None, dest="data_path",
+                           help="explicit new-data path/glob (takes the "
+                                "place of the traffic log; mutually "
+                                "exclusive with --from-traffic)")
+    p_retrain.add_argument("--candidate-dir", default=None,
+                           dest="candidate_dir",
+                           help="output model dir (default "
+                                "models.candidate; promoted by `shifu "
+                                "promote`)")
+    p_retrain.add_argument("--append-trees", type=int, default=None,
+                           dest="append_trees",
+                           help="GBT: trees appended on the new chunks "
+                                "(default -Dshifu.loop.appendTrees=10)")
+    p_retrain.add_argument("--resume", action="store_true",
+                           help=_RESUME_HELP)
+
+    p_promote = sub.add_parser(
+        "promote", help="gate a candidate rollout on shadow agreement + "
+                        "drift verdicts, then hot-swap it live (running "
+                        "server via --serve-url) or swap the models dir "
+                        "offline; every attempt writes a promote-<seq> "
+                        "ledger manifest")
+    p_promote.add_argument("--candidate", default=None,
+                           help="candidate model dir (default "
+                                "models.candidate)")
+    p_promote.add_argument("--serve-url", default=None, dest="serve_url",
+                           help="running server base URL (e.g. "
+                                "http://127.0.0.1:8080): stage/promote "
+                                "via /admin/* with zero downtime")
+    p_promote.add_argument("--stage", action="store_true",
+                           help="with --serve-url: stage the candidate "
+                                "as the shadow first (then gates "
+                                "evaluate on its live shadow stats)")
+    p_promote.add_argument("--agree-min", type=float, default=None,
+                           dest="agree_min",
+                           help="min shadow agreement rate (default "
+                                "-Dshifu.loop.promoteAgree=0.95)")
+    p_promote.add_argument("--min-rows", type=int, default=None,
+                           dest="min_rows",
+                           help="min shadow-scored rows (default "
+                                "-Dshifu.loop.promoteMinRows=64)")
+    p_promote.add_argument("--no-drift-gate", action="store_true",
+                           dest="no_drift_gate",
+                           help="promote without a ledger retrain "
+                                "recommendation")
+    p_promote.add_argument("--force", action="store_true",
+                           help="promote even when a gate fails "
+                                "(recorded in the manifest)")
+
     sub.add_parser("posttrain", help="post-train bin metrics and feature importance")
 
     p_eval = sub.add_parser("eval", help="evaluate model(s)")
@@ -159,6 +219,14 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("--warm", default=None,
                          help="comma-separated batch sizes to pre-compile "
                               "at startup (e.g. 1,16,256)")
+    p_serve.add_argument("--traffic-log", nargs="?", const="1.0",
+                         default=None, dest="traffic_log",
+                         metavar="SAMPLE",
+                         help="log served (features, score, model sha) "
+                              "rows to .shifu/runs/traffic for `shifu "
+                              "retrain`; optional sample fraction "
+                              "(default 1.0; same as "
+                              "-Dshifu.loop.logSample)")
 
     p_runs = sub.add_parser(
         "runs", help="list run-ledger manifests (.shifu/runs)")
@@ -288,6 +356,27 @@ def dispatch(args: argparse.Namespace) -> int:
         from shifu_tpu.processor.train import TrainProcessor
 
         return TrainProcessor(dry=args.dry).run()
+    if cmd == "retrain":
+        from shifu_tpu.processor.retrain import RetrainProcessor
+
+        return RetrainProcessor(
+            from_traffic=args.from_traffic, data_path=args.data_path,
+            candidate_dir=args.candidate_dir,
+            append_trees=args.append_trees,
+        ).run()
+    if cmd == "promote":
+        from shifu_tpu.loop.promote import run_promote
+        from shifu_tpu.processor.retrain import DEFAULT_CANDIDATE_DIR
+
+        candidate = args.candidate
+        if candidate is None and os.path.isdir(DEFAULT_CANDIDATE_DIR):
+            candidate = DEFAULT_CANDIDATE_DIR
+        return run_promote(
+            ".", candidate, serve_url=args.serve_url,
+            agree_min=args.agree_min, min_rows=args.min_rows,
+            require_drift=not args.no_drift_gate, force=args.force,
+            stage_first=args.stage,
+        )
     if cmd == "posttrain":
         from shifu_tpu.processor.posttrain import PostTrainProcessor
 
@@ -340,6 +429,20 @@ def dispatch(args: argparse.Namespace) -> int:
 
         from shifu_tpu.serve.server import ScoringServer
 
+        if args.traffic_log is not None:
+            # the flag is sugar for -Dshifu.loop.logSample=<fraction>;
+            # the server reads the property at construction. Parse it
+            # NOW: a typo must fail startup, not silently disable the
+            # log (get_float would swallow it into the 0.0 default)
+            try:
+                frac = float(args.traffic_log)
+                if not 0.0 < frac <= 1.0:
+                    raise ValueError(f"{frac} not in (0, 1]")
+            except ValueError as e:
+                log.error("serve: bad --traffic-log fraction: %s", e)
+                return 1
+            environment.set_property("shifu.loop.logSample",
+                                     args.traffic_log)
         try:
             # parse --warm BEFORE binding the port so a typo fails the
             # clean way, not with a traceback after "listening"

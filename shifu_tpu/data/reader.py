@@ -298,6 +298,29 @@ def read_columnar(
     )
 
 
+def flat_numeric_matrix(data: "ColumnarData",
+                        names: Sequence[str]) -> np.ndarray:
+    """[n, C] float64 with NaN for missing/invalid — `numeric()`'s exact
+    semantics (strip + missing-token set, non-finite -> NaN) over many
+    columns in ONE flattened pandas parse. The serve featurizer and the
+    drift monitor both bin against this parse; they MUST stay
+    bit-identical, which is why there is exactly one implementation."""
+    import pandas as pd
+
+    n = data.n_rows
+    flat = np.concatenate([
+        np.asarray(data.column(c), dtype=object) for c in names
+    ])
+    ser = pd.Series(flat)
+    vals = pd.to_numeric(ser, errors="coerce").to_numpy(np.float64)
+    tokens = [m for m in data.missing_values if m != ""]
+    if tokens:
+        miss = ser.str.strip().isin(tokens).to_numpy()
+        vals[miss] = np.nan
+    vals[~np.isfinite(vals)] = np.nan
+    return vals.reshape(len(names), n).T
+
+
 def make_tags(
     target_col: np.ndarray, pos_tags: Sequence[str], neg_tags: Sequence[str]
 ) -> np.ndarray:

@@ -1,0 +1,405 @@
+"""Online PSI drift: per-column live-vs-training bin distributions.
+
+Baseline: the training run's own bin distributions — `binCountPos +
+binCountNeg` per ColumnConfig (length = bins + trailing missing slot, the
+exact layout `stats` writes). Live side: every served micro-batch is
+bin-coded against the same ColumnConfig bins and folded into a per-column
+count accumulator; `psi_from_counts` (stats/psi.py) then gives each
+column's PSI, so offline PSI and online drift share one definition.
+
+Where the fold runs: inside the registry's already-fused scoring program.
+The monitor contributes device constants (padded boundary tables, slot
+offsets) and a traced body (`traced_fold`) the registry splices after the
+forward pass — numeric columns searchsorted-bin on device from the raw
+(pre-fill) values with an explicit missing mask, categorical/hybrid
+columns ride the host bin codes the featurizer already computes for the
+norm gather (shared `_bin_codes_for` cache: zero extra host parses). The
+counts land in an f32 device window that stays resident across batches
+(the PR-1/PR-8 windowed-fold idiom: no per-batch device->host sync) and
+flushes into a host float64 fold every `WINDOW_FLUSH_ROWS` rows or on
+demand — counts are exact at any stream length.
+
+Degrade seam: when any column's PSI crosses `-Dshifu.loop.psiDegrade`
+(default 0.2 — the classic "significant shift" PSI convention), the serve
+health monitor flips to `degraded` (a routing de-prioritization, not an
+ejection: scoring continues) and ONE `recommend-<seq>.json` manifest
+lands in the run ledger naming the drifted columns — the machine-readable
+retrain recommendation `shifu retrain`/`shifu promote` read back.
+
+Metrics: loop.drift.rows, loop.drift.flushes, gauges
+loop.drift.psi{column=}, loop.drift.max_psi, counter loop.drift.degraded.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.config import ColumnConfig
+from shifu_tpu.loop import psi_degrade_setting
+from shifu_tpu.stats.psi import psi_from_counts
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+# same f32-exactness bound as the ingest-side DeviceAccumulator: flush the
+# device window to the f64 host fold before any slot count can reach 2^24
+WINDOW_FLUSH_ROWS = 1 << 23
+
+
+class _MonitoredColumn:
+    __slots__ = ("name", "kind", "n_slots", "offset", "expected", "cc")
+
+    def __init__(self, name: str, kind: str, n_slots: int, offset: int,
+                 expected: np.ndarray, cc: ColumnConfig) -> None:
+        self.name = name
+        self.kind = kind          # "numeric" | "coded"
+        self.n_slots = n_slots
+        self.offset = offset
+        self.expected = expected  # training bin counts, len == n_slots
+        self.cc = cc
+
+
+def monitorable_columns(column_configs: List[ColumnConfig]
+                        ) -> List[ColumnConfig]:
+    """Feature columns with both bins and a training distribution."""
+    out = []
+    for cc in column_configs:
+        if cc.is_target() or cc.is_meta() or cc.is_weight():
+            continue
+        bn = cc.column_binning
+        has_bins = (bn.bin_category is not None) or bool(bn.bin_boundary)
+        if not has_bins or not bn.bin_count_pos or not bn.bin_count_neg:
+            continue
+        out.append(cc)
+    return out
+
+
+class DriftMonitor:
+    """Per-column live bin-count fold + PSI verdicts for one model set."""
+
+    def __init__(self, column_configs: List[ColumnConfig],
+                 threshold: Optional[float] = None,
+                 min_rows: Optional[int] = None) -> None:
+        from shifu_tpu.loop import drift_min_rows_setting
+
+        self.threshold = (psi_degrade_setting() if threshold is None
+                          else float(threshold))
+        # PSI over a handful of live rows is sampling noise, not a
+        # shift: verdicts report `warming` (and never degrade) until the
+        # fold has seen this many rows
+        self.min_rows = (drift_min_rows_setting() if min_rows is None
+                         else int(min_rows))
+        self.cols: List[_MonitoredColumn] = []
+        offset = 0
+        for cc in monitorable_columns(column_configs):
+            bn = cc.column_binning
+            numeric = not (cc.is_categorical() or cc.is_hybrid())
+            n_slots = (len(bn.bin_boundary) + 1 if numeric
+                       else (len(bn.bin_boundary or []) if cc.is_hybrid()
+                             else 0) + len(bn.bin_category or []) + 1)
+            expected = (np.asarray(bn.bin_count_pos, dtype=np.float64)
+                        + np.asarray(bn.bin_count_neg, dtype=np.float64))
+            if expected.size != n_slots:
+                # stats written under different binning than the config
+                # now carries — refuse to compare apples to oranges
+                log.warning("drift: column %s bin counts (%d) do not match "
+                            "its bins (%d slots); not monitored",
+                            cc.column_name, expected.size, n_slots)
+                continue
+            self.cols.append(_MonitoredColumn(
+                cc.column_name, "numeric" if numeric else "coded",
+                n_slots, offset, expected, cc))
+            offset += n_slots
+        self.total_slots = offset
+        self.numeric_cols = [c for c in self.cols if c.kind == "numeric"]
+        self.coded_cols = [c for c in self.cols if c.kind == "coded"]
+        self._lock = threading.Lock()
+        self._host = np.zeros(self.total_slots, dtype=np.float64)
+        self._window = None      # f32 device window (jnp [total_slots])
+        self._window_rows = 0
+        self._rows = 0
+        self._degraded: List[str] = []
+
+    @property
+    def enabled(self) -> bool:
+        return self.total_slots > 0
+
+    # ---- featurization (host half) ----
+    def featurize(self, data, code_cache: Optional[dict] = None,
+                  numeric_cache: Optional[dict] = None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """(raw numeric values [n, Cn] f32 with NaN=missing, bin codes
+        [n, Cc] i32 for coded columns). Both halves share the registry
+        featurizer's per-call caches (`_bin_codes_for` codes, the
+        `numeric_cache` parse), so a column the norm plan already
+        consumed is parsed exactly once per request; columns only the
+        monitor watches fall back to ONE flattened pandas parse
+        (per-column `data.numeric()` dispatch costs ~0.2 ms of fixed
+        pandas overhead each, which on a hand-of-rows online batch
+        dwarfs the fused program itself)."""
+        from shifu_tpu.norm.normalizer import _bin_codes_for
+
+        n = data.n_rows
+        if self.numeric_cols:
+            names = [c.name for c in self.numeric_cols]
+            if numeric_cache is not None and all(
+                    nm in numeric_cache for nm in names):
+                vals = np.stack([numeric_cache[nm] for nm in names],
+                                axis=1).astype(np.float32)
+            else:
+                vals = self._numeric_matrix(data)
+        else:
+            vals = np.zeros((n, 0), dtype=np.float32)
+        if self.coded_cols:
+            codes = np.stack(
+                [_bin_codes_for(c.cc, data, code_cache)
+                 for c in self.coded_cols],
+                axis=1).astype(np.int32)
+        else:
+            codes = np.zeros((n, 0), dtype=np.int32)
+        return vals, codes
+
+    def _numeric_matrix(self, data) -> np.ndarray:
+        """[n, Cn] f32, NaN = missing — the featurizer's exact parse
+        (data.reader.flat_numeric_matrix, the ONE implementation both
+        sides bin against) over all monitored numeric columns."""
+        from shifu_tpu.data.reader import flat_numeric_matrix
+
+        return flat_numeric_matrix(
+            data, [c.name for c in self.numeric_cols]).astype(np.float32)
+
+    # ---- traced half (spliced into the fused serve program) ----
+    def device_consts(self) -> dict:
+        """Static tensors the traced fold closes over."""
+        import jax.numpy as jnp
+
+        consts: dict = {
+            "num_offsets": np.asarray(
+                [c.offset for c in self.numeric_cols], np.int32),
+            "cat_offsets": np.asarray(
+                [c.offset for c in self.coded_cols], np.int32),
+            "cat_clips": np.asarray(
+                [c.n_slots - 1 for c in self.coded_cols], np.int32),
+        }
+        if self.numeric_cols:
+            bmax = max(len(c.cc.column_binning.bin_boundary)
+                       for c in self.numeric_cols)
+            bounds = np.full((len(self.numeric_cols), bmax), np.inf,
+                             dtype=np.float32)
+            nbins = np.zeros(len(self.numeric_cols), dtype=np.int32)
+            for k, c in enumerate(self.numeric_cols):
+                b = np.asarray(c.cc.column_binning.bin_boundary,
+                               dtype=np.float32)
+                bounds[k, : b.size] = b
+                nbins[k] = b.size
+            consts["num_bounds"] = jnp.asarray(bounds)
+            consts["num_nbins"] = jnp.asarray(nbins)
+        return consts
+
+    def traced_fold(self, consts, window, vals, codes, valid):
+        """Traced: fold one padded batch into the window.
+
+        vals  [n, Cn] f32 raw numeric, NaN = missing
+        codes [n, Cc] i32 host bin codes (already include missing slots)
+        valid [n]     f32 1.0 for real rows, 0.0 for bucket padding
+        Returns window + this batch's per-slot counts. Numeric binning is
+        numeric_bin_index's semantics traced: boundaries[i] <= v <
+        boundaries[i+1], non-finite -> the trailing missing slot."""
+        import jax.numpy as jnp
+
+        slot_ids = []
+        if self.numeric_cols:
+            b = consts["num_bounds"]          # [Cn, Bmax], +inf padded
+            nb = consts["num_nbins"]          # [Cn]
+            # searchsorted per column via broadcast compare: the +inf
+            # padding never counts, so sum(v >= bound) - 1 is the index
+            ge = (vals[:, :, None] >= b[None, :, :]).sum(axis=2) - 1
+            idx = jnp.clip(ge, 0, nb[None, :] - 1)
+            idx = jnp.where(jnp.isfinite(vals), idx, nb[None, :])
+            slot_ids.append(idx + consts["num_offsets"][None, :])
+        if self.coded_cols:
+            cc = jnp.clip(codes, 0, consts["cat_clips"][None, :])
+            slot_ids.append(cc + consts["cat_offsets"][None, :])
+        ids = jnp.concatenate(slot_ids, axis=1)          # [n, Cm]
+        w = jnp.broadcast_to(valid[:, None], ids.shape)
+        return window.at[ids.reshape(-1)].add(
+            w.reshape(-1), mode="drop")
+
+    # ---- window lifecycle ----
+    def window(self):
+        """The resident device window (created on first use)."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._window is None:
+                self._window = jnp.zeros(self.total_slots, jnp.float32)
+            return self._window
+
+    def note_window(self, new_window, rows: int) -> None:
+        """Adopt the post-fold window; flush to the f64 host fold when the
+        window's row budget is spent (ONE device->host sync per window)."""
+        with self._lock:
+            self._window = new_window
+            self._window_rows += rows
+            self._rows += rows
+            if self._window_rows > WINDOW_FLUSH_ROWS:
+                self._flush_locked()
+
+    def reset(self) -> None:
+        """Clean slate after a promotion acted on the drift: live counts,
+        the device window, and the degraded-column memory all clear, so
+        drift on the NEW version's traffic re-degrades and re-recommends
+        instead of being swallowed by the already-seen set (the monitor
+        is per-process; without this, the closed loop would close exactly
+        once). The baseline stays — it is the training ColumnConfig."""
+        with self._lock:
+            self._host = np.zeros(self.total_slots, dtype=np.float64)
+            self._window = None
+            self._window_rows = 0
+            self._rows = 0
+            self._degraded = []
+
+    def fold_host(self, data, code_cache: Optional[dict] = None) -> None:
+        """Host-side fold for non-fused registries (ModelRunner fallback).
+        Values AND boundaries compare in float32 — the exact semantics of
+        the traced device fold — so a column's live counts are identical
+        whichever execution path a registry runs (pinned in
+        test_loop.py); f64 boundaries would flip rows sitting within one
+        f32 ulp of a bin edge."""
+        if not self.enabled:
+            return
+        vals, codes = self.featurize(data, code_cache)
+        counts = np.zeros(self.total_slots, dtype=np.float64)
+        for k, c in enumerate(self.numeric_cols):
+            from shifu_tpu.stats.binning import numeric_bin_index
+
+            idx = numeric_bin_index(
+                vals[:, k],
+                np.asarray(c.cc.column_binning.bin_boundary, np.float32))
+            np.add.at(counts, c.offset + idx, 1.0)
+        for k, c in enumerate(self.coded_cols):
+            idx = np.clip(codes[:, k], 0, c.n_slots - 1)
+            np.add.at(counts, c.offset + idx, 1.0)
+        with self._lock:
+            self._host += counts
+            self._rows += data.n_rows
+
+    def _flush_locked(self) -> None:
+        from shifu_tpu.obs import registry
+
+        if self._window is None or self._window_rows == 0:
+            if self._window is not None:
+                self._window_rows = 0
+            return
+        import jax
+
+        self._host += np.asarray(jax.device_get(self._window),
+                                 dtype=np.float64)
+        import jax.numpy as jnp
+
+        self._window = jnp.zeros(self.total_slots, jnp.float32)
+        self._window_rows = 0
+        registry().counter("loop.drift.flushes").inc()
+
+    # ---- verdicts ----
+    def psi_by_column(self) -> Dict[str, float]:
+        """Per-column PSI of the live fold vs the training distribution
+        (forces a window flush — one d2h sync; call on a cadence, not per
+        batch)."""
+        with self._lock:
+            self._flush_locked()
+            counts = self._host
+            return {
+                c.name: psi_from_counts(
+                    c.expected, counts[c.offset: c.offset + c.n_slots])
+                for c in self.cols
+            }
+
+    def verdict(self) -> dict:
+        """The drift summary manifests and /healthz embed; also exports
+        loop.drift.* gauges."""
+        from shifu_tpu.obs import registry
+
+        psis = self.psi_by_column()
+        with self._lock:
+            rows = self._rows
+        warming = rows < self.min_rows
+        drifted = ([] if warming else
+                   sorted(name for name, p in psis.items()
+                          if p > self.threshold))
+        reg = registry()
+        for name, p in psis.items():
+            reg.gauge("loop.drift.psi", column=name).set(p)
+        max_psi = max(psis.values()) if psis else 0.0
+        reg.gauge("loop.drift.max_psi").set(max_psi)
+        reg.counter("loop.drift.rows").inc(0)  # materialize the key
+        return {
+            "rows": int(rows),
+            "threshold": self.threshold,
+            "minRows": self.min_rows,
+            "maxPsi": max_psi,
+            "psi": {k: round(v, 6) for k, v in sorted(psis.items())},
+            "driftedColumns": drifted,
+            "status": ("warming" if warming
+                       else "drift" if drifted else "ok"),
+        }
+
+    def check_degrade(self, health=None, ledger_root: Optional[str] = None,
+                      model_sha: str = "") -> Optional[dict]:
+        """Evaluate the degrade gate: on first breach flip /healthz to
+        degraded and stamp ONE retrain recommendation into the ledger.
+        Returns the verdict (None only when monitoring is disabled), so
+        a cadenced caller pays exactly one window flush + PSI pass per
+        check — never verdict() twice."""
+        if not self.enabled:
+            return None
+        v = self.verdict()
+        if v["status"] != "drift":
+            return v
+        from shifu_tpu.obs import registry
+
+        with self._lock:
+            new_cols = [c for c in v["driftedColumns"]
+                        if c not in self._degraded]
+            first = not self._degraded
+            self._degraded.extend(new_cols)
+        if not new_cols and not first:
+            return v
+        registry().counter("loop.drift.degraded").inc()
+        reason = (f"psi drift > {self.threshold:g} on "
+                  f"{','.join(v['driftedColumns'][:5])}")
+        if health is not None:
+            health.note_degraded(reason)
+        if ledger_root and first:
+            self._write_recommendation(ledger_root, v, model_sha)
+        return v
+
+    def _write_recommendation(self, root: str, verdict: dict,
+                              model_sha: str) -> None:
+        import sys
+        import time
+
+        from shifu_tpu import obs
+        from shifu_tpu.obs.ledger import RunLedger
+
+        try:
+            ledger = RunLedger(root)
+            seq = ledger.next_seq("recommend")
+            path = ledger.write(
+                "recommend", seq,
+                status="ok", exit_status=0,
+                started_at=time.time(), elapsed_seconds=0.0,
+                argv=list(sys.argv), registry=obs.registry(),
+                extra={"recommendation": {
+                    "action": "retrain",
+                    "reason": "psi-drift",
+                    "modelSetSha": model_sha,
+                    "drift": verdict,
+                }},
+            )
+            log.warning("drift degrade: retrain recommendation -> %s", path)
+        except OSError as e:  # a broken ledger must not break serving
+            log.warning("cannot write retrain recommendation: %s", e)

@@ -1,0 +1,292 @@
+"""`shifu promote` — gate a candidate rollout on shadow agreement + drift.
+
+The decision is computed from evidence, not vibes:
+
+  gate "shadow"  the staged candidate's live shadow stats (agreement rate
+                 over >= `-Dshifu.loop.promoteMinRows` rows must reach
+                 `-Dshifu.loop.promoteAgree`, and shadow scoring must not
+                 have errored). Against a RUNNING server the stats come
+                 from GET /admin/shadow; offline they come from the last
+                 serve manifest's shadow snapshot, so a canary verdict is
+                 decidable from the run ledger alone.
+  gate "drift"   the candidate must not be promoted while the ACTIVE set
+                 shows no drift and the candidate brings nothing — wait,
+                 inverted: drift on the active set is the reason TO roll
+                 forward. The gate only BLOCKS when the ledger carries no
+                 retrain recommendation AND the operator did not pass
+                 --no-drift-gate/--force; a recommendation manifest (or a
+                 live degraded /healthz with a psi reason) satisfies it.
+
+Every run writes a `promote-<seq>.json` ledger manifest with the gate
+evidence and the decision — promoted or held, the audit trail exists.
+
+Execution: with `--serve-url` the promotion is a POST /admin/promote
+(zero-downtime hot-swap in the running server); without one it is an
+offline atomic dir swap: `models/` -> `models.previous/`, candidate ->
+`models/` (os.replace-based, torn-state-proof via a rename sequence that
+always leaves a loadable models dir).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.request
+from typing import Optional
+
+from shifu_tpu.loop import (
+    promote_agree_setting,
+    promote_min_rows_setting,
+)
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+
+def _http_json(url: str, payload: Optional[dict] = None,
+               timeout: float = 30.0) -> dict:
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {},
+        method="POST" if data is not None else "GET")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def latest_recommendation(root: str) -> Optional[dict]:
+    """Newest retrain recommendation manifest, if the drift monitor ever
+    stamped one."""
+    from shifu_tpu.obs.ledger import list_runs
+
+    runs = list_runs(root, last=1, step="recommend")
+    return runs[0] if runs else None
+
+
+def latest_serve_shadow(root: str) -> Optional[dict]:
+    """Shadow snapshot from the newest serve manifest (the offline
+    evidence path)."""
+    from shifu_tpu.obs.ledger import list_runs
+
+    for m in list_runs(root, step="serve"):
+        shadow = (m.get("serve") or {}).get("shadow")
+        if shadow:
+            return shadow
+    return None
+
+
+def evaluate_gates(shadow: Optional[dict], recommendation: Optional[dict],
+                   agree_min: Optional[float] = None,
+                   min_rows: Optional[int] = None,
+                   require_drift: bool = True,
+                   candidate_sha: Optional[str] = None,
+                   active_sha: Optional[str] = None) -> dict:
+    """Pure gate evaluation — the piece tests pin. Returns
+    {promote: bool, gates: {...}} with one entry per gate and a reason
+    for every failure.
+
+    `candidate_sha` binds the shadow evidence to the candidate actually
+    being promoted — agreement earned by a previously staged set must
+    not green-light a different one. `active_sha` binds the drift gate
+    to the CURRENT active set: a recommendation stamped against an
+    older sha is stale (that drift was already acted on, or the set was
+    replaced some other way) and blocks rather than passes. Either
+    check is skipped when its sha is unknown (None)."""
+    agree_min = (promote_agree_setting() if agree_min is None
+                 else float(agree_min))
+    min_rows = (promote_min_rows_setting() if min_rows is None
+                else int(min_rows))
+    gates = {}
+
+    if shadow is None:
+        gates["shadow"] = {"ok": False,
+                           "reason": "no shadow stats (stage the "
+                                     "candidate and let it see traffic)"}
+    elif (candidate_sha and shadow.get("sha")
+          and shadow["sha"] != candidate_sha):
+        gates["shadow"] = {"ok": False,
+                           "reason": f"shadow evidence describes "
+                                     f"{shadow['sha']}, not the candidate "
+                                     f"{candidate_sha} — stage THIS "
+                                     "candidate and let it see traffic",
+                           "stats": shadow}
+    elif shadow.get("errors"):
+        gates["shadow"] = {"ok": False,
+                           "reason": f"shadow scoring errored "
+                                     f"{shadow['errors']} time(s)",
+                           "stats": shadow}
+    elif shadow.get("rows", 0) < min_rows:
+        gates["shadow"] = {"ok": False,
+                           "reason": f"only {shadow.get('rows', 0)} shadow "
+                                     f"rows (< {min_rows})",
+                           "stats": shadow}
+    elif shadow.get("agreement", 0.0) < agree_min:
+        gates["shadow"] = {"ok": False,
+                           "reason": f"agreement "
+                                     f"{shadow.get('agreement', 0.0):.4f} "
+                                     f"< {agree_min:g}",
+                           "stats": shadow}
+    else:
+        gates["shadow"] = {"ok": True, "stats": shadow}
+
+    if not require_drift:
+        gates["drift"] = {"ok": True, "reason": "gate disabled"}
+    elif recommendation is None:
+        gates["drift"] = {"ok": False,
+                          "reason": "no retrain recommendation in the "
+                                    "ledger — nothing says the active set "
+                                    "needs replacing (--no-drift-gate to "
+                                    "override)"}
+    else:
+        rec = recommendation.get("recommendation", {})
+        rec_summary = {
+            "driftedColumns": (rec.get("drift") or {}).get(
+                "driftedColumns"),
+            "maxPsi": (rec.get("drift") or {}).get("maxPsi"),
+            "modelSetSha": rec.get("modelSetSha"),
+        }
+        if (active_sha and rec.get("modelSetSha")
+                and rec["modelSetSha"] != active_sha):
+            gates["drift"] = {
+                "ok": False,
+                "reason": f"newest retrain recommendation targets sha "
+                          f"{rec['modelSetSha']} but the active set is "
+                          f"{active_sha} — that drift was already acted "
+                          "on; nothing says the CURRENT set needs "
+                          "replacing (--no-drift-gate to override)",
+                "recommendation": rec_summary,
+            }
+        else:
+            gates["drift"] = {"ok": True, "recommendation": rec_summary}
+    return {"promote": all(g["ok"] for g in gates.values()),
+            "gates": gates,
+            "agreeMin": agree_min, "minRows": min_rows}
+
+
+def _models_sha(models_dir: Optional[str]) -> Optional[str]:
+    """Content sha of a model dir — the exact identity the registry
+    serves under — or None when there is no readable model set there."""
+    from shifu_tpu.serve.registry import find_model_paths, model_set_sha
+
+    if not models_dir or not os.path.isdir(models_dir):
+        return None
+    try:
+        paths = find_model_paths(models_dir)
+        return model_set_sha(paths) if paths else None
+    except OSError:
+        return None
+
+
+def offline_swap(root: str, candidate_dir: str) -> dict:
+    """Atomic-enough dir swap for a non-running model set: the current
+    `models/` moves aside to `models.previous/`, the candidate renames
+    into place. Both moves are single `os.replace`/`os.rename` calls, so
+    a kill leaves either the old or the new layout with a loadable
+    models dir recoverable by hand — never merged halves."""
+    import shutil
+
+    models = os.path.join(os.path.abspath(root), "models")
+    previous = models + ".previous"
+    candidate_dir = os.path.abspath(candidate_dir)
+    if not os.path.isdir(candidate_dir):
+        raise FileNotFoundError(f"candidate dir {candidate_dir} not found")
+    if os.path.isdir(previous):
+        shutil.rmtree(previous)
+    if os.path.isdir(models):
+        os.rename(models, previous)
+    os.rename(candidate_dir, models)
+    return {"models": models, "previous": previous}
+
+
+def run_promote(root: str, candidate_dir: Optional[str],
+                serve_url: Optional[str] = None,
+                agree_min: Optional[float] = None,
+                min_rows: Optional[int] = None,
+                require_drift: bool = True,
+                force: bool = False,
+                stage_first: bool = False) -> int:
+    """The `shifu promote` entry point. Returns the process exit code:
+    0 promoted, 1 held by a gate, 2 operational error."""
+    import sys
+    import time
+
+    from shifu_tpu import obs
+    from shifu_tpu.obs.ledger import RunLedger
+
+    t0 = time.time()
+    shadow = None
+    active_sha = None
+    mode = "http" if serve_url else "offline"
+    try:
+        if serve_url:
+            serve_url = serve_url.rstrip("/")
+            if stage_first and candidate_dir:
+                _http_json(f"{serve_url}/admin/stage",
+                           {"modelsDir": os.path.abspath(candidate_dir)})
+            resp = _http_json(f"{serve_url}/admin/shadow")
+            shadow = resp.get("shadow")
+            active_sha = resp.get("active")
+        else:
+            shadow = latest_serve_shadow(root)
+            active_sha = _models_sha(os.path.join(os.path.abspath(root),
+                                                  "models"))
+    except (OSError, ValueError) as e:  # unreachable server / bad JSON
+        log.error("promote: cannot reach shadow stats: %s", e)
+        return 2
+    recommendation = latest_recommendation(root)
+    decision = evaluate_gates(shadow, recommendation,
+                              agree_min=agree_min, min_rows=min_rows,
+                              require_drift=require_drift,
+                              candidate_sha=_models_sha(candidate_dir),
+                              active_sha=active_sha)
+    if force and not decision["promote"]:
+        decision["forced"] = True
+        decision["promote"] = True
+    swap = None
+    error = None
+    if decision["promote"]:
+        try:
+            if serve_url:
+                # bind the swap to the sha the gates evaluated: a
+                # re-staged shadow between the gate read and this POST
+                # is refused server-side (409), never rolled out blind
+                swap = _http_json(f"{serve_url}/admin/promote",
+                                  {"sha": (shadow or {}).get("sha")})
+            else:
+                if not candidate_dir:
+                    raise ValueError(
+                        "offline promote needs a candidate dir "
+                        "(default models.candidate is missing)")
+                swap = offline_swap(root, candidate_dir)
+        except (OSError, ValueError) as e:  # failed swap: held + ledgered
+            error = f"{type(e).__name__}: {e}"
+            decision["promote"] = False
+    # the audit trail: every promote attempt is a ledger manifest
+    try:
+        ledger = RunLedger(root)
+        seq = ledger.next_seq("promote")
+        path = ledger.write(
+            "promote", seq,
+            status="ok" if error is None else "failed",
+            exit_status=0 if decision["promote"] else 1,
+            started_at=t0, elapsed_seconds=time.time() - t0,
+            argv=list(sys.argv), registry=obs.registry(),
+            error=error,
+            extra={"promote": {"mode": mode,
+                               "candidateDir": candidate_dir,
+                               "decision": decision,
+                               "swap": swap}},
+        )
+        log.info("promote manifest -> %s", path)
+    except OSError as e:
+        log.warning("cannot write promote manifest: %s", e)
+    if error:
+        log.error("promote failed: %s", error)
+        return 2
+    if not decision["promote"]:
+        for name, g in decision["gates"].items():
+            if not g["ok"]:
+                log.error("promote held by %s gate: %s", name, g["reason"])
+        return 1
+    log.info("promoted: %s", swap)
+    return 0

@@ -1,0 +1,280 @@
+"""Append-only serve-side traffic log: rotating chunk files in the ledger.
+
+Every scored micro-batch can leave a row-sampled trace of (raw features,
+mean score, model-set sha, unix timestamp) under
+`<root>/.shifu/runs/traffic/traffic-<seq>.psv`. Design constraints, in
+order:
+
+  * **Append-only + torn-write-proof.** A chunk file appears atomically
+    (resilience.checkpoint.atomic_write: temp + os.replace) when its row
+    buffer fills — a killed server leaves only whole chunk files, never a
+    half row. Files are never rewritten; the sequence number only grows
+    (across server restarts too).
+  * **Just another stream.** The files are plain `|`-delimited text plus
+    a `_meta.json` sidecar naming the columns, so `traffic_source()`
+    hands back the same `chunk_source` factory every lifecycle step
+    consumes — `shifu retrain` reads logged traffic through the identical
+    ShardPlan/prefetch machinery as any training file, and the underscore
+    sidecar is invisible to the data-file scan.
+  * **Sampled.** `-Dshifu.loop.logSample` (0..1) row-samples with a
+    deterministic per-batch RNG, so a replayed stream logs the same rows.
+
+Label plumbing: the log's schema is the caller's `columns` list — the
+serve wiring passes the registry input columns PLUS the ModelConfig's
+target/weight columns when it can see a ModelConfig, so records that
+carry outcomes (label-joined traffic) keep them and `shifu retrain` can
+train on the log directly; records without them log the empty missing
+token and the retrain norm pass drops those rows like any unlabeled row.
+
+Metrics: loop.traffic.rows / loop.traffic.sampled_out /
+loop.traffic.chunks, all in the serve shutdown manifest.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.loop import log_chunk_rows_setting, log_sample_setting
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+TRAFFIC_SUBDIR = os.path.join(".shifu", "runs", "traffic")
+DELIMITER = "|"
+META_FILE = "_meta.json"
+# scores/sha/timestamp ride as ordinary columns; retrain treats them as
+# meta (never features) because they are not in ColumnConfig
+SCORE_COLUMN = "shifu_score_mean"
+SHA_COLUMN = "shifu_model_sha"
+TS_COLUMN = "shifu_ts"
+
+_CHUNK_RE = re.compile(r"^traffic-(\d+)\.psv$")
+
+
+def traffic_dir(root: str) -> str:
+    return os.path.join(os.path.abspath(root), TRAFFIC_SUBDIR)
+
+
+def traffic_columns(base_columns: List[str]) -> List[str]:
+    return list(base_columns) + [SCORE_COLUMN, SHA_COLUMN, TS_COLUMN]
+
+
+def list_chunks(root: str) -> List[str]:
+    """Chunk files in sequence order (the append order)."""
+    out = []
+    for path in glob.glob(os.path.join(traffic_dir(root), "traffic-*.psv")):
+        m = _CHUNK_RE.match(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return [p for _s, p in sorted(out)]
+
+
+def _sanitize(value: str) -> str:
+    """Field hygiene: the log is `|`-delimited text, so the delimiter and
+    newlines inside a raw value must not corrupt row framing."""
+    if DELIMITER in value or "\n" in value or "\r" in value:
+        return (value.replace(DELIMITER, ";")
+                .replace("\n", " ").replace("\r", " "))
+    return value
+
+
+class TrafficLog:
+    """Thread-safe rotating chunk writer for one serving process."""
+
+    def __init__(self, root: str, columns: List[str],
+                 sample: Optional[float] = None,
+                 chunk_rows: Optional[int] = None,
+                 seed: int = 0) -> None:
+        self.root = os.path.abspath(root)
+        self.dir = traffic_dir(root)
+        self.columns = list(columns)
+        self.sample = (log_sample_setting() if sample is None
+                       else float(sample))
+        self.chunk_rows = (log_chunk_rows_setting() if chunk_rows is None
+                           else int(chunk_rows))
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        self._buffer: List[str] = []
+        self._batches = 0
+        self._chunks = 0  # chunks THIS process wrote (seq counts restarts)
+        self._retire_mismatched_schema()
+        self._seq = self._next_seq()
+        self._write_meta()
+
+    def _retire_mismatched_schema(self) -> None:
+        """A restart with a DIFFERENT column schema must not rewrite
+        _meta.json over chunks framed with the old one — every old row
+        would parse misaligned into the new columns and retrain on
+        garbage. The old log moves wholesale to a `superseded-<n>` subdir
+        (nothing is destroyed; readers only glob the active dir)."""
+        meta_path = os.path.join(self.dir, META_FILE)
+        if not os.path.isfile(meta_path):
+            return
+        try:
+            with open(meta_path) as fh:
+                old = json.load(fh)
+        except (OSError, ValueError):
+            old = None  # unreadable meta: retire it with the chunks
+        if old is not None and list(old.get("columns", [])) == self.columns:
+            return
+        n = 1
+        while os.path.isdir(os.path.join(self.dir, f"superseded-{n}")):
+            n += 1
+        retired = os.path.join(self.dir, f"superseded-{n}")
+        os.makedirs(retired)
+        moved = 0
+        for path in (glob.glob(os.path.join(self.dir, "traffic-*.psv"))
+                     + [meta_path]):
+            if os.path.isfile(path):
+                os.replace(path,
+                           os.path.join(retired, os.path.basename(path)))
+                moved += 1
+        log.warning("traffic log schema changed (%s -> %s columns): "
+                    "retired %d old file(s) to %s",
+                    len(old.get("columns", [])) if old else "?",
+                    len(self.columns), moved, retired)
+
+    # ---- layout ----
+    def _next_seq(self) -> int:
+        highest = 0
+        for path in glob.glob(os.path.join(self.dir, "traffic-*.psv")):
+            m = _CHUNK_RE.match(os.path.basename(path))
+            if m:
+                highest = max(highest, int(m.group(1)))
+        return highest + 1
+
+    def _write_meta(self) -> None:
+        from shifu_tpu.resilience.checkpoint import atomic_write_json
+
+        atomic_write_json(os.path.join(self.dir, META_FILE), {
+            "schema": "shifu.traffic/1",
+            "columns": self.columns,
+            "delimiter": DELIMITER,
+            "sample": self.sample,
+            "chunkRows": self.chunk_rows,
+        })
+
+    # ---- write side ----
+    def record(self, data, result, sha: str) -> int:
+        """Log one scored batch (a ColumnarData + its ScoreResult); returns
+        the number of rows actually logged after sampling."""
+        from shifu_tpu.obs import registry
+
+        if self.sample <= 0.0:
+            return 0
+        n = data.n_rows
+        with self._lock:
+            self._batches += 1
+            if self.sample >= 1.0:
+                keep = np.arange(n)
+            else:
+                # deterministic per-batch draw: a replayed stream logs the
+                # same rows, and restarts never re-use a stream position
+                rng = np.random.default_rng([self.seed, self._batches])
+                keep = np.nonzero(rng.random(n) < self.sample)[0]
+            reg = registry()
+            reg.counter("loop.traffic.rows").inc(len(keep))
+            reg.counter("loop.traffic.sampled_out").inc(n - len(keep))
+            if not len(keep):
+                return 0
+            ts = f"{time.time():.3f}"
+            cols = [np.asarray(data.column(c), dtype=object)
+                    if c in data.raw else None
+                    for c in self.columns[:-3]]
+            mean = result.mean
+            for i in keep:
+                fields = [
+                    _sanitize("" if col is None else str(col[i]))
+                    for col in cols
+                ]
+                fields.append(f"{float(mean[i]):.4f}")
+                fields.append(sha)
+                fields.append(ts)
+                self._buffer.append(DELIMITER.join(fields))
+            if len(self._buffer) >= self.chunk_rows:
+                self._rotate()
+            return len(keep)
+
+    def _rotate(self) -> None:
+        """Write the buffered rows as the next chunk file, atomically —
+        caller holds the lock."""
+        from shifu_tpu.obs import registry
+        from shifu_tpu.resilience.checkpoint import atomic_write
+
+        if not self._buffer:
+            return
+        path = os.path.join(self.dir, f"traffic-{self._seq:05d}.psv")
+        atomic_write(path, ("\n".join(self._buffer) + "\n").encode("utf-8"))
+        registry().counter("loop.traffic.chunks").inc()
+        log.debug("traffic chunk %s (%d rows)", path, len(self._buffer))
+        self._buffer = []
+        self._seq += 1
+        self._chunks += 1
+
+    def flush(self) -> None:
+        """Persist any buffered rows as a (possibly short) chunk."""
+        with self._lock:
+            self._rotate()
+
+    def close(self) -> None:
+        self.flush()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "dir": self.dir,
+                "sample": self.sample,
+                "chunks": self._chunks,
+                "bufferedRows": len(self._buffer),
+            }
+
+
+def log_meta(root: str) -> Tuple[dict, List[str]]:
+    """(parsed _meta.json, chunk paths) of the traffic log under `root`'s
+    ledger — THE validation for every consumer (traffic_source, `shifu
+    retrain`), so the operator guidance stays in one place. Raises
+    FileNotFoundError when nothing was ever logged or no chunk has
+    rotated out yet."""
+    meta_path = os.path.join(traffic_dir(root), META_FILE)
+    if not os.path.isfile(meta_path):
+        raise FileNotFoundError(
+            f"no traffic log under {traffic_dir(root)} — serve with "
+            f"--traffic-log (or -Dshifu.loop.logSample>0) first")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    chunks = list_chunks(root)
+    if not chunks:
+        raise FileNotFoundError(
+            f"traffic log {traffic_dir(root)} has no chunk files yet")
+    return meta, chunks
+
+
+def traffic_source(root: str, chunk_rows: Optional[int] = None,
+                   columns: Optional[List[str]] = None,
+                   missing_values=None) -> Tuple[object, List[str]]:
+    """(chunk_source factory, column names) over the logged traffic — the
+    seam that makes the log just another input stream. Raises
+    FileNotFoundError when nothing was ever logged."""
+    from shifu_tpu.data.reader import DEFAULT_MISSING
+    from shifu_tpu.data.stream import chunk_source
+
+    meta, _ = log_meta(root)
+    names = list(meta["columns"])
+    factory = chunk_source(
+        os.path.join(traffic_dir(root), "traffic-*.psv"),
+        names,
+        delimiter=meta.get("delimiter", DELIMITER),
+        missing_values=(tuple(missing_values) if missing_values
+                        else DEFAULT_MISSING),
+        chunk_rows=chunk_rows,
+        columns=columns,
+    )
+    return factory, names

@@ -123,6 +123,24 @@ class Histogram:
             self._min = min(self._min, v)
             self._max = max(self._max, v)
 
+    def add_binned(self, counts, total: float, n: int,
+                   vmin: float, vmax: float) -> None:
+        """Bulk merge pre-binned observations under ONE lock acquisition.
+        The caller binned with the same `v <= bucket` rule observe()
+        uses (e.g. np.searchsorted(buckets, values, side="left")) into
+        one count per bucket — the batch path for hot loops where a
+        per-value observe() would serialize on the lock."""
+        if n <= 0:
+            return
+        with self._lock:
+            for i, c in enumerate(counts):
+                if c:
+                    self._counts[i] += int(c)
+            self._sum += float(total)
+            self._count += int(n)
+            self._min = min(self._min, float(vmin))
+            self._max = max(self._max, float(vmax))
+
     def as_dict(self) -> dict:
         with self._lock:
             return {

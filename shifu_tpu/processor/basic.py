@@ -33,6 +33,10 @@ class BasicProcessor:
         self.paths = PathFinder(self.root)
         self.model_config: Optional[ModelConfig] = None
         self.column_configs: List[ColumnConfig] = []
+        # run_step() may stash step-specific manifest sections here (the
+        # retrain provenance chain rides this seam); run() merges it into
+        # the run-ledger manifest, success or failure
+        self.manifest_extra: dict = {}
 
     # ---- lifecycle ----
     def setup(self, need_columns: bool = True) -> None:
@@ -150,6 +154,8 @@ class BasicProcessor:
                         extra["perfettoTrace"] = trace_file
                 if san.active:
                     extra["sanitizer"] = san.verdict()
+                if self.manifest_extra:
+                    extra.update(self.manifest_extra)
                 try:
                     profile_snap = obs.profiler().snapshot()
                 except Exception as pe:  # pragma: no cover - defensive

@@ -48,10 +48,15 @@ def default_shards() -> int:
 class NormProcessor(BasicProcessor):
     step = "norm"
 
-    def __init__(self, root: str = ".", shuffle: bool = False, seed: int = 0):
+    def __init__(self, root: str = ".", shuffle: bool = False, seed: int = 0,
+                 names_override=None):
         super().__init__(root)
         self.shuffle = shuffle
         self.seed = seed
+        # the retrain seam: the traffic log's `_meta.json` names the file
+        # layout (input columns + target/weight + score/sha/ts), which is
+        # neither the configured header nor ColumnConfig order
+        self.names_override = list(names_override) if names_override else None
 
     def run_step(self) -> None:
         self.setup()
@@ -59,7 +64,9 @@ class NormProcessor(BasicProcessor):
         assert mc is not None
         ds = mc.data_set
 
-        if ds.header_path:
+        if self.names_override:
+            names = list(self.names_override)
+        elif ds.header_path:
             names = read_header(self.resolve(ds.header_path), ds.header_delimiter)
         else:
             names = [c.column_name for c in self.column_configs]
@@ -148,25 +155,29 @@ class NormProcessor(BasicProcessor):
             )
         log.info("bin codes -> %s", self.paths.cleaned_data_dir())
 
-    def _stream_config_sha(self, plan, slots, n_shards) -> str:
-        """Checkpoint-compatibility identity for the streaming norm run:
-        the full norm plan (type, cutoff, every per-column table), the
-        code layout, the shard plan, and the sampling seed — a snapshot
-        written under different stats/norm config must not be resumed
-        onto this one."""
+    def _stream_config_sha(self, plan, slots, n_shards):
+        """(sha, per-section shas) for the streaming norm run: the full
+        norm plan (type, cutoff, every per-column table) and code layout
+        in the `norm` section, chunk geometry / shard plan / sampling in
+        the `data` section — a snapshot written under different config
+        must not be resumed, and the rejection names which side moved."""
         from shifu_tpu.data.stream import chunk_rows_setting
         from shifu_tpu.norm.normalizer import plan_to_json
-        from shifu_tpu.resilience.checkpoint import config_sha
+        from shifu_tpu.resilience.checkpoint import sectioned_sha
 
-        return config_sha({
-            "plan": plan_to_json(plan),
-            "slots": [int(s) for s in slots],
-            "seed": self.seed,
-            "sampleRate": self.model_config.normalize.sample_rate,
-            # chunk geometry governs both the chunk index AND the
-            # shard-per-chunk layout — never resume across a change
-            "chunkRows": chunk_rows_setting(),
-            "shards": int(n_shards),
+        return sectioned_sha({
+            "norm": {
+                "plan": plan_to_json(plan),
+                "slots": [int(s) for s in slots],
+            },
+            "data": {
+                "seed": self.seed,
+                "sampleRate": self.model_config.normalize.sample_rate,
+                # chunk geometry governs both the chunk index AND the
+                # shard-per-chunk layout — never resume across a change
+                "chunkRows": chunk_rows_setting(),
+                "shards": int(n_shards),
+            },
         })
 
     def _add_class_meta(self, extra: dict, tags: np.ndarray) -> None:
@@ -299,9 +310,13 @@ class NormProcessor(BasicProcessor):
         n_rows = 0
         all_tag_counts: dict = {}
         if not self.shuffle and ckpt_mod.ckpt_stream_enabled():
+            sha, sha_sections = self._stream_config_sha(plan, slots, S)
+            # keyed by self.step so a retrain's norm pass (step
+            # "retrain-norm") never collides with a real `shifu norm`
+            # resume on the same model set
             ck = ckpt_mod.ShardedStreamCheckpoint(
-                ckpt_mod.ckpt_base(self.root, "norm", "stream"),
-                self._stream_config_sha(plan, slots, S), S)
+                ckpt_mod.ckpt_base(self.root, self.step, "stream"),
+                sha, S, sections=sha_sections)
             if ckpt_mod.resume_requested():
                 loaded = ck.load()
                 if loaded is not None:

@@ -128,35 +128,57 @@ class StatsProcessor(BasicProcessor):
             log.warning("-psi requested but stats.psiColumnName is empty; skipped")
 
         if streaming and (self.correlation or (self.psi and psi_col)):
-            # one more chunked pass accumulating both artifacts
+            # one more chunked pass accumulating both artifacts, divided
+            # over the lifecycle ShardPlan like the stats folds: chunk ci
+            # feeds shard ci % S's own accumulators, shards merge in shard
+            # order at the end — S per-shard PSI counts sum exactly (f64
+            # integer counts) and the correlation moments share ONE shift
+            # (derived from the globally first chunk), so the merged
+            # result is byte-identical to the S=1 fold on integral data
+            from shifu_tpu.data.pipeline import ShardPlan, prefetch_iter
             from shifu_tpu.stats.correlation import (
                 StreamingCorrelation,
                 save_correlation_csv,
             )
             from shifu_tpu.stats.psi import PsiAccumulator
 
-            from shifu_tpu.data.pipeline import prefetch_iter
-
-            corr_acc = StreamingCorrelation() if self.correlation else None
-            psi_acc = (
-                PsiAccumulator(self.column_configs, psi_col)
-                if self.psi and psi_col else None
-            )
+            plan = ShardPlan()
+            S = plan.n_shards
+            corr_accs = psi_accs = None
+            if self.psi and psi_col:
+                psi_accs = [PsiAccumulator(self.column_configs, psi_col)
+                            for _ in range(S)]
+            shift = None
             # parse rides on the prefetch thread while this thread folds
-            # the correlation/PSI accumulators
-            for chunk in prefetch_iter(factory()):
-                if corr_acc is not None:
-                    corr_acc.update(chunk, self.column_configs)
-                if psi_acc is not None:
-                    psi_acc.update(chunk)
-            if corr_acc is not None:
+            # the per-shard correlation/PSI accumulators
+            for ci, chunk in prefetch_iter(enumerate(factory())):
+                if self.correlation and corr_accs is None:
+                    shift = StreamingCorrelation.shift_of(
+                        chunk, self.column_configs)
+                    corr_accs = [StreamingCorrelation(shift=shift)
+                                 for _ in range(S)]
+                shard = plan.shard_of(ci)
+                if corr_accs is not None:
+                    corr_accs[shard].update(chunk, self.column_configs)
+                if psi_accs is not None:
+                    psi_accs[shard].update(chunk)
+                plan.record(shard, chunk.n_rows, "corrpsi")
+            if corr_accs is not None:
+                corr_acc = corr_accs[0]
+                for other in corr_accs[1:]:
+                    corr_acc.merge(other)
                 corr, names = corr_acc.finalize()
                 save_correlation_csv(self.paths.correlation_path(), corr, names)
-                log.info("correlation matrix (%d x %d) -> %s",
-                         len(names), len(names), self.paths.correlation_path())
-            if psi_acc is not None:
+                log.info("correlation matrix (%d x %d) -> %s [%d shards]",
+                         len(names), len(names),
+                         self.paths.correlation_path(), S)
+            if psi_accs is not None:
+                psi_acc = psi_accs[0]
+                for other in psi_accs[1:]:
+                    psi_acc.merge(other)
                 psi_acc.finalize()
-                log.info("PSI computed against unit column %s", psi_col)
+                log.info("PSI computed against unit column %s [%d shards]",
+                         psi_col, S)
         else:
             if self.correlation:
                 from shifu_tpu.stats.correlation import (

@@ -259,7 +259,9 @@ class TrainProcessor(BasicProcessor):
 
             res = train_nn_streamed(norm_dir, cfg, init_flat=init_flat,
                                     target_class=i if ova else None,
-                                    mesh=mesh, resume=resume_requested())
+                                    mesh=mesh, resume=resume_requested(),
+                                    ident_extra=getattr(
+                                        self, "train_ident_extra", None))
             spec = self._make_spec(alg, cfg, res, meta_cols, norm_json,
                                    class_tags=class_tags)
             path = self.paths.model_path(i, suffix)

@@ -147,6 +147,16 @@ def config_sha(ident: dict) -> str:
     ).hexdigest()[:16]
 
 
+def sectioned_sha(sections: Dict[str, dict]) -> Tuple[str, Dict[str, str]]:
+    """(overall sha, per-section shas) for a SECTIONED identity — e.g.
+    {"data": {...}, "train": {...}, "loop": {...}}. The overall sha keys
+    checkpoint compatibility exactly like `config_sha`; the per-section
+    shas ride in the snapshot meta so a rejection can say WHICH section
+    (data vs train vs loop) diverged instead of just "config changed"."""
+    per = {name: config_sha(ident) for name, ident in sections.items()}
+    return config_sha(per), per
+
+
 def resume_slice(numbered, after: int):
     """Skip the already-folded prefix of an enumerate()-style stream:
     yields the (index, item) pairs with index > `after` (the chunk index
@@ -182,9 +192,13 @@ class StreamCheckpoint:
     is actually due (snapshotting can cost a device sync)."""
 
     def __init__(self, path: str, config_sha: str,
-                 every: Optional[int] = None) -> None:
+                 every: Optional[int] = None,
+                 sections: Optional[Dict[str, str]] = None) -> None:
         self.path = path
         self.config_sha = config_sha
+        # per-section shas (sectioned_sha): stored in the snapshot meta so
+        # a config-mismatch rejection names the diverged section(s)
+        self.sections = dict(sections) if sections else None
         self.every = every_chunks_setting() if every is None else int(every)
         self._since = 0
 
@@ -205,6 +219,8 @@ class StreamCheckpoint:
             "configSha": self.config_sha,
             "meta": meta or {},
         }
+        if self.sections:
+            header["sections"] = self.sections
         payload[META_KEY] = np.frombuffer(
             json.dumps(header, sort_keys=True).encode("utf-8"),
             dtype=np.uint8)
@@ -260,10 +276,23 @@ class StreamCheckpoint:
             registry().counter("ckpt.rejected", reason="corrupt").inc()
             return None
         if header.get("configSha") != self.config_sha:
+            # name the diverged section(s) when both sides recorded them:
+            # "config changed" is useless at 3am; "the data section
+            # changed but train didn't" tells the operator to re-run the
+            # upstream step rather than question their hyperparameters
+            stored = header.get("sections") or {}
+            diverged = "unknown"
+            if stored and self.sections:
+                names = sorted(
+                    k for k in set(stored) | set(self.sections)
+                    if stored.get(k) != self.sections.get(k))
+                diverged = ",".join(names) or "unknown"
             log.warning("checkpoint %s was built under a different config "
-                        "(%s != %s); starting fresh", self.path,
-                        header.get("configSha"), self.config_sha)
-            registry().counter("ckpt.rejected", reason="config").inc()
+                        "(%s != %s; diverged section(s): %s); starting "
+                        "fresh", self.path, header.get("configSha"),
+                        self.config_sha, diverged)
+            registry().counter("ckpt.rejected", reason="config",
+                               section=diverged).inc()
             return None
         registry().counter("ckpt.resumes").inc()
         return int(header["chunkIndex"]), arrays, header.get("meta", {}), blob
@@ -310,7 +339,8 @@ class ShardedStreamCheckpoint:
     _SLOTS = ("a", "b")
 
     def __init__(self, base: str, config_sha: str, n_shards: int,
-                 every: Optional[int] = None) -> None:
+                 every: Optional[int] = None,
+                 sections: Optional[Dict[str, str]] = None) -> None:
         self.base = base
         self.n_shards = max(1, int(n_shards))
         self.config_sha = config_sha
@@ -320,10 +350,12 @@ class ShardedStreamCheckpoint:
         self._shards = [
             {slot: StreamCheckpoint(
                 f"{base}-shard{s:05d}-{slot}{CKPT_SUFFIX}",
-                config_sha, every=0) for slot in self._SLOTS}
+                config_sha, every=0, sections=sections)
+             for slot in self._SLOTS}
             for s in range(self.n_shards)]
         self._shared = StreamCheckpoint(f"{base}-shared{CKPT_SUFFIX}",
-                                        config_sha, every=0)
+                                        config_sha, every=0,
+                                        sections=sections)
 
     def _slot(self, epoch: int) -> str:
         return self._SLOTS[epoch % len(self._SLOTS)]
@@ -425,7 +457,8 @@ def list_resumable(root: str) -> List[dict]:
     """Stream checkpoints a preempted step left behind — the data for
     `shifu runs --resumable`. Scans <root>/.shifu/runs/ckpt (the chunked
     fold snapshots) AND the trainer checkpoint dirs (streamed NN/WDL
-    state lives beside cfg.checkpoint_path under tmp/train/)."""
+    state lives beside cfg.checkpoint_path — under tmp/train/ for
+    `shifu train`, under tmp/retrain/train/ for `shifu retrain`)."""
     import glob as _glob
 
     root = os.path.abspath(root)
@@ -434,16 +467,27 @@ def list_resumable(root: str) -> List[dict]:
     if os.path.isdir(d):
         paths.extend(os.path.join(d, name) for name in sorted(os.listdir(d))
                      if name.endswith(CKPT_SUFFIX))
-    paths.extend(sorted(_glob.glob(
-        os.path.join(root, "tmp", "train", "**", "*" + CKPT_SUFFIX),
-        recursive=True)))
+    trainer_globs = [
+        ("train", os.path.join(root, "tmp", "train")),
+        ("retrain", os.path.join(root, "tmp", "retrain", "train")),
+    ]
+    step_of = {}
+    for step, base in trainer_globs:
+        for path in sorted(_glob.glob(
+                os.path.join(base, "**", "*" + CKPT_SUFFIX),
+                recursive=True)):
+            paths.append(path)
+            step_of[path] = step
     out: List[dict] = []
     for path in paths:
         name = os.path.basename(path)[: -len(CKPT_SUFFIX)]
         if os.path.dirname(path) != d:
             # trainer snapshot: qualify with its checkpoint dir so bagged
-            # members (checkpoint_0, checkpoint_1, ...) stay distinct
-            name = f"train-{os.path.basename(os.path.dirname(path))}"
+            # members (checkpoint_0, checkpoint_1, ...) stay distinct,
+            # and with the step so `shifu retrain --resume` state is
+            # distinguishable from `shifu train --resume` state
+            name = (f"{step_of.get(path, 'train')}-"
+                    f"{os.path.basename(os.path.dirname(path))}")
         entry = {
             "name": name,
             "path": path,

@@ -180,9 +180,16 @@ class MicroBatcher:
                  max_wait_ms: Optional[float] = None,
                  health: Optional[HealthMonitor] = None,
                  max_restarts: Optional[int] = None,
-                 deadline_ms: Optional[float] = None) -> None:
+                 deadline_ms: Optional[float] = None,
+                 observer: Optional[Callable[[ColumnarData, ScoreResult],
+                                             None]] = None) -> None:
         self.score_fn = score_fn
         self.admission = admission
+        # post-resolution hook: runs AFTER every request in the batch has
+        # its answer, so traffic logging / shadow scoring / drift checks
+        # (the continuous-loop seams) never add to client latency. An
+        # observer crash is contained — it fails no request.
+        self.observer = observer
         self.health = health if health is not None else HealthMonitor()
         self.max_batch_rows = (max_batch_rows_setting()
                                if max_batch_rows is None
@@ -340,8 +347,8 @@ class MicroBatcher:
             ).observe(rows)
             try:
                 with reg.timer("serve.batch.score").time():
-                    result = self.score_fn(_concat_batches(
-                        [r.data for r in batch]))
+                    concat = _concat_batches([r.data for r in batch])
+                    result = self.score_fn(concat)
             except Exception as e:  # fan the failure out per request
                 log.warning("serve batch of %d requests failed: %s",
                             len(batch), e)
@@ -364,6 +371,15 @@ class MicroBatcher:
             with self._drain_lock:
                 self._drain_log.append((now, len(batch)))
             self.health.note_ok()
+            if self.observer is not None:
+                # every client already has its answer; the loop seams
+                # (traffic log, shadow scoring, drift verdicts) run here
+                # so they cost queue headroom, never request latency
+                try:
+                    self.observer(concat, result)
+                except Exception as oe:  # observers must not kill serving
+                    log.warning("serve observer failed: %s", oe)
+                    reg.counter("serve.observer.errors").inc()
 
     # ---- load hints ----
     def retry_after_seconds(self) -> float:

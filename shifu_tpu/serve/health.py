@@ -40,6 +40,12 @@ class HealthMonitor:
         self._ok_after = max(1, ok_after)
         self._ok_streak = 0
         self._crashes = 0
+        self._sticky = False  # degrade that clean batches must NOT clear
+        # the crash-caused degrade is tracked SEPARATELY from the sticky
+        # (drift) one: the two can layer, and clearing the sticky overlay
+        # must leave the crash degrade (and its hysteresis) underneath
+        self._crash_degraded = False
+        self._crash_reason = ""
 
     def _transition(self, state: str, reason: str) -> None:
         # caller holds the lock
@@ -56,15 +62,47 @@ class HealthMonitor:
         with self._lock:
             self._crashes += 1
             self._ok_streak = 0
+            self._crash_degraded = True
+            self._crash_reason = reason
             if self._state != DRAINING:
                 self._transition(DEGRADED, reason)
 
+    def note_degraded(self, reason: str) -> None:
+        """Degrade WITHOUT counting a crash and WITHOUT the clean-batch
+        hysteresis clearing it (the drift path: scoring is healthy, the
+        MODEL is stale — only an operator action like `shifu promote`
+        resolves it, via clear_degraded)."""
+        with self._lock:
+            self._sticky = True
+            if self._state != DRAINING:
+                self._transition(DEGRADED, reason)
+
+    def clear_degraded(self) -> None:
+        """Drop a sticky (non-crash) degrade — called after a hot-swap
+        promoted a fresh model set. A crash-caused degrade is NOT
+        cleared: scoring itself was failing, and only the clean-batch
+        hysteresis (note_ok) may lift it — a promote must not route full
+        traffic back onto a still-crashing replica."""
+        with self._lock:
+            was_sticky, self._sticky = self._sticky, False
+            self._ok_streak = 0
+            if self._state != DEGRADED or not was_sticky:
+                return
+            if self._crash_degraded:
+                # the crash degrade layered UNDER the drift one survives:
+                # scoring was failing, and only clean batches heal that
+                self._reason = self._crash_reason
+                return
+            self._transition(OK, "")
+
     def note_ok(self) -> None:
         with self._lock:
-            if self._state != DEGRADED:
+            if self._state != DEGRADED or self._sticky:
                 return
             self._ok_streak += 1
             if self._ok_streak >= self._ok_after:
+                self._crash_degraded = False
+                self._crash_reason = ""
                 self._transition(OK, "")
 
     def set_draining(self, reason: str) -> None:

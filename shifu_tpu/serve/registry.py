@@ -103,13 +103,14 @@ class _PlanFeaturizer:
                                   dtype=np.float64)
 
     def __call__(self, data: ColumnarData,
-                 code_cache: Optional[dict] = None
+                 code_cache: Optional[dict] = None,
+                 numeric_cache: Optional[dict] = None
                  ) -> Tuple[np.ndarray, np.ndarray]:
         from shifu_tpu.norm.normalizer import _bin_codes_for
 
         n = data.n_rows
         if self.value_specs:
-            vals64 = self._numeric_matrix(data)
+            vals64 = self._numeric_matrix(data, numeric_cache)
             vals = np.where(np.isfinite(vals64), vals64,
                             self._fill64[None, :]).astype(np.float32)
         else:
@@ -123,27 +124,25 @@ class _PlanFeaturizer:
             codes = np.zeros((n, 0), dtype=np.int32)
         return vals, codes
 
-    def _numeric_matrix(self, data: ColumnarData) -> np.ndarray:
+    def _numeric_matrix(self, data: ColumnarData,
+                        cache: Optional[dict] = None) -> np.ndarray:
         """[n, Cv] float64 with NaN for missing/invalid — ONE flattened
-        pandas parse instead of one per column. Semantics are exactly
-        ColumnarData.numeric's (strip + missing-token set, non-finite ->
-        NaN): online batches are a handful of rows, and per-column pandas
-        dispatch was ~25x the fused program's own latency."""
-        import pandas as pd
+        pandas parse (data.reader.flat_numeric_matrix) instead of one per
+        column: online batches are a handful of rows, and per-column
+        pandas dispatch was ~25x the fused program's own latency. `cache`
+        is the per-call column-name -> parsed-values dict shared with the
+        other per-model featurizers and the drift monitor, so each raw
+        column is parsed once per request no matter how many consumers."""
+        from shifu_tpu.data.reader import flat_numeric_matrix
 
-        n = data.n_rows
-        flat = np.concatenate([
-            np.asarray(data.column(s.cc.column_name), dtype=object)
-            for s in self.value_specs
-        ])
-        ser = pd.Series(flat)
-        vals = pd.to_numeric(ser, errors="coerce").to_numpy(np.float64)
-        tokens = [m for m in data.missing_values if m != ""]
-        if tokens:
-            miss = ser.str.strip().isin(tokens).to_numpy()
-            vals[miss] = np.nan
-        vals[~np.isfinite(vals)] = np.nan
-        return vals.reshape(len(self.value_specs), n).T
+        names = [s.cc.column_name for s in self.value_specs]
+        if cache is not None and all(c in cache for c in names):
+            return np.stack([cache[c] for c in names], axis=1)
+        out = flat_numeric_matrix(data, names)
+        if cache is not None:
+            for k, c in enumerate(names):
+                cache[c] = out[:, k]
+        return out
 
 
 def _build_plan_device_consts(plan):
@@ -225,7 +224,8 @@ class ModelRegistry:
 
     def __init__(self, models_dir: str,
                  scale: float = DEFAULT_SCORE_SCALE,
-                 column_configs=None, model_config=None) -> None:
+                 column_configs=None, model_config=None,
+                 drift=None) -> None:
         self.models_dir = models_dir
         self.paths = find_model_paths(models_dir)
         if not self.paths:
@@ -236,6 +236,15 @@ class ModelRegistry:
         self.specs = [load_model(p, column_configs, model_config)
                       for p in self.paths]
         self.fused = self._fusable()
+        # online PSI drift (loop/drift.py): when a DriftMonitor rides
+        # along, the fused program also bin-codes every batch against the
+        # training ColumnConfig bins and folds the counts into the
+        # monitor's device window — zero extra dispatches on the hot
+        # path. `drift_live` gates the fold: a staged shadow registry
+        # shares the monitor but must not double-count the sampled
+        # batches it re-scores; promotion flips it live.
+        self.drift = drift if (drift is not None and drift.enabled) else None
+        self.drift_live = True
         self._runner: Optional[ModelRunner] = None
         self._warm_buckets: set = set()
         if self.fused:
@@ -304,7 +313,10 @@ class ModelRegistry:
         specs = self.specs
         scale = self.scale
 
-        def fused(plan_inputs):
+        drift = self.drift
+        drift_consts = drift.device_consts() if drift is not None else None
+
+        def fused(plan_inputs, drift_ops=None):
             import jax.numpy as jnp
 
             from shifu_tpu.models.nn import forward
@@ -323,8 +335,20 @@ class ModelRegistry:
                     out = out[:, :1]
                 cols.append(out * scale)
             m = jnp.concatenate(cols, axis=1)
-            return (m, m.mean(axis=1), m.max(axis=1), m.min(axis=1),
+            outs = (m, m.mean(axis=1), m.max(axis=1), m.min(axis=1),
                     jnp.median(m, axis=1))
+            # the branch is on the ARGUMENT'S PYTREE STRUCTURE (None vs
+            # 4-tuple), which jit treats as static — a registry without a
+            # drift monitor traces the no-fold program, one with it
+            # traces the fused fold; no traced value is branched on
+            if drift_ops is not None:  # shifu: noqa[JX002]
+                # the drift fold, fused: live bin counts vs the training
+                # bins accumulate into the resident window with no extra
+                # dispatch and no per-batch transfer
+                d_vals, d_codes, valid, window = drift_ops
+                outs = outs + (drift.traced_fold(
+                    drift_consts, window, d_vals, d_codes, valid),)
+            return outs
 
         # ONE jit for the whole registry, constructed once (never inside
         # the request loop); per-bucket executables cache underneath it
@@ -362,10 +386,19 @@ class ModelRegistry:
         bucket list actually warmed. Call at startup so the first real
         request never pays a compile."""
         warmed = []
-        for b in sorted({self.bucket(max(1, int(s))) for s in batch_sizes}):
-            rec = {c: "0" for c in self.input_columns}
-            self.score_records([rec] * b)
-            warmed.append(b)
+        # the synthetic all-"0" rows must not fold into the live drift
+        # window: they are not traffic, and with the default driftMinRows
+        # they would both burn the warm-up budget and skew the PSI counts
+        # toward whatever bin the literal 0 lands in
+        drift_live, self.drift_live = self.drift_live, False
+        try:
+            for b in sorted({self.bucket(max(1, int(s)))
+                             for s in batch_sizes}):
+                rec = {c: "0" for c in self.input_columns}
+                self.score_records([rec] * b)
+                warmed.append(b)
+        finally:
+            self.drift_live = drift_live
         return warmed
 
     def score_records(self, records: Sequence[dict]) -> ScoreResult:
@@ -380,7 +413,11 @@ class ModelRegistry:
         reg = obs_registry()
         if not self.fused:
             reg.counter("serve.score.rows").inc(data.n_rows)
-            return self._runner.score_raw(data)
+            result = self._runner.score_raw(data)
+            if self.drift is not None and self.drift_live:
+                # ModelRunner fallback: host-side fold, same binning
+                self.drift.fold_host(data)
+            return result
         import jax
 
         from shifu_tpu.analysis import sanitize
@@ -388,14 +425,28 @@ class ModelRegistry:
         n = data.n_rows
         bucket = self.bucket(n)
         code_cache: dict = {}
+        numeric_cache: dict = {}
         plan_inputs = []
         for feat in self._featurizers:
-            vals, codes = feat(data, code_cache)
+            vals, codes = feat(data, code_cache, numeric_cache)
             extra = bucket - n
             if extra:
                 vals = np.pad(vals, ((0, extra), (0, 0)))
                 codes = np.pad(codes, ((0, extra), (0, 0)))
             plan_inputs.append((vals, codes))
+        drift_host = None
+        if self.drift is not None:
+            d_vals, d_codes = self.drift.featurize(data, code_cache,
+                                                   numeric_cache)
+            extra = bucket - n
+            if extra:
+                # padded numeric rows are NaN -> missing slot, but the
+                # valid mask zero-weights them anyway
+                d_vals = np.pad(d_vals, ((0, extra), (0, 0)))
+                d_codes = np.pad(d_codes, ((0, extra), (0, 0)))
+            valid = np.zeros(bucket, dtype=np.float32)
+            valid[:n] = 1.0
+            drift_host = (d_vals, d_codes, valid)
         key = (self.sha, bucket)
         if key not in self._warm_buckets:
             self._warm_buckets.add(key)
@@ -409,11 +460,33 @@ class ModelRegistry:
         # and serve manifests get real per-batch device seconds.
         from shifu_tpu.obs import profile
 
-        dev_inputs = jax.device_put(tuple(plan_inputs))
-        with sanitize.transfer_free("serve.score"):
-            out = profile.dispatch("serve.fused_score", self._program,
-                                   dev_inputs, sync=True)
-        m, mean, mx, mn, med = jax.device_get(out)
+        if drift_host is not None:
+            # ONE device_put covers the plan inputs AND the batch's drift
+            # inputs (a second put dispatch costs real latency on a
+            # hand-of-rows online batch); the window is already
+            # device-resident. A non-live registry (staged shadow) folds
+            # into a throwaway window so the shared monitor never
+            # double-counts sampled batches.
+            import jax.numpy as jnp
+
+            window = (self.drift.window() if self.drift_live
+                      else jnp.zeros(self.drift.total_slots, jnp.float32))
+            dev_inputs, drift_put = jax.device_put(
+                (tuple(plan_inputs), drift_host))
+            drift_dev = tuple(drift_put) + (window,)
+            with sanitize.transfer_free("serve.score"):
+                out = profile.dispatch("serve.fused_score", self._program,
+                                       dev_inputs, drift_dev, sync=True)
+            m, mean, mx, mn, med = jax.device_get(out[:5])
+            if self.drift_live:
+                self.drift.note_window(out[5], n)
+                reg.counter("loop.drift.rows").inc(n)
+        else:
+            dev_inputs = jax.device_put(tuple(plan_inputs))
+            with sanitize.transfer_free("serve.score"):
+                out = profile.dispatch("serve.fused_score", self._program,
+                                       dev_inputs, sync=True)
+            m, mean, mx, mn, med = jax.device_get(out)
         reg.counter("serve.score.rows").inc(n)
         return ScoreResult(
             model_scores=np.asarray(m)[:n],
@@ -434,4 +507,6 @@ class ModelRegistry:
             "fused": self.fused,
             "inputColumns": len(self.input_columns),
             "warmBuckets": sorted(b for (_s, b) in self._warm_buckets),
+            "driftMonitored": (len(self.drift.cols)
+                               if self.drift is not None else 0),
         }

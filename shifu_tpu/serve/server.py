@@ -46,15 +46,27 @@ DEFAULT_SCORE_TIMEOUT_S = 30.0
 
 
 class Scorer:
-    """In-process scoring API over the admission queue + micro-batcher."""
+    """In-process scoring API over the admission queue + micro-batcher.
+
+    `registry` may be a plain ModelRegistry or a SwappableRegistry
+    (loop/hotswap.py) — anything with `score_raw` + `input_columns`.
+    `observer` rides the batcher's post-resolution hook (traffic logging,
+    shadow scoring, drift checks — the continuous-loop seams)."""
 
     def __init__(self, registry: ModelRegistry,
                  admission: Optional[AdmissionQueue] = None,
                  max_batch_rows: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
                  max_restarts: Optional[int] = None,
-                 deadline_ms: Optional[float] = None) -> None:
+                 deadline_ms: Optional[float] = None,
+                 observer=None, extra_columns=None) -> None:
         self.registry = registry
+        # label plumbing: extra raw columns (target/weight) that ride
+        # through conversion and batching untouched by scoring, so the
+        # traffic log can keep outcomes and `shifu retrain` can train on
+        # the log directly (absent fields log as the missing token)
+        self.extra_columns = [c for c in (extra_columns or [])
+                              if c not in registry.input_columns]
         # explicit None-check: AdmissionQueue defines __len__, so an EMPTY
         # queue is falsy and `admission or ...` would silently swap in a
         # default-depth one
@@ -64,14 +76,15 @@ class Scorer:
             registry.score_raw, self.admission,
             max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
             health=self.health, max_restarts=max_restarts,
-            deadline_ms=deadline_ms)
+            deadline_ms=deadline_ms, observer=observer)
 
     def score_batch(self, records: Sequence[dict],
                     timeout: Optional[float] = DEFAULT_SCORE_TIMEOUT_S
                     ) -> ScoreResult:
         """Score raw records; blocks until the micro-batch containing
         them completes. Raises RejectedError on shed (429 analog)."""
-        data = records_to_columnar(records, self.registry.input_columns)
+        data = records_to_columnar(
+            records, list(self.registry.input_columns) + self.extra_columns)
         req = self.batcher.submit(data)
         return req.wait(timeout)
 
@@ -138,13 +151,53 @@ class ScoringServer:
                  max_batch_rows: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
                  column_configs=None, model_config=None) -> None:
+        from shifu_tpu.loop import drift_check_batches_setting, \
+            log_sample_setting
+        from shifu_tpu.loop.drift import DriftMonitor
+        from shifu_tpu.loop.hotswap import SwappableRegistry
+        from shifu_tpu.loop.traffic import TrafficLog, traffic_columns
+
         self.root = os.path.abspath(root)
-        self.registry = ModelRegistry(
+        # the loop seams read the model-set configs when the server runs
+        # inside one (the CLI path); an explicit models_dir outside a
+        # model set still serves, just without drift/label plumbing
+        if column_configs is None or model_config is None:
+            ccs, mc = self._load_configs()
+            column_configs = column_configs or ccs
+            model_config = model_config or mc
+        self.column_configs = column_configs
+        self.model_config = model_config
+        self.drift = (DriftMonitor(column_configs)
+                      if column_configs else None)
+        if self.drift is not None and not self.drift.enabled:
+            self.drift = None
+        base_registry = ModelRegistry(
             models_dir or os.path.join(self.root, "models"),
-            column_configs=column_configs, model_config=model_config)
+            column_configs=column_configs, model_config=model_config,
+            drift=self.drift)
+        self.registry = SwappableRegistry(base_registry)
+        # outcome columns (target/weight) ride the request conversion as
+        # extra raw columns so label-joined traffic is retrainable
+        # straight from the log
+        label_cols = []
+        if model_config is not None:
+            for extra_col in (
+                    model_config.data_set.target_column_name,
+                    model_config.data_set.weight_column_name):
+                if (extra_col and extra_col not in label_cols
+                        and extra_col not in base_registry.input_columns):
+                    label_cols.append(extra_col)
+        self.traffic: Optional[TrafficLog] = None
+        if log_sample_setting() > 0.0:
+            self.traffic = TrafficLog(self.root, traffic_columns(
+                list(base_registry.input_columns) + label_cols))
+        self._drift_check_every = max(1, drift_check_batches_setting())
+        self._observed_batches = 0
+        self._last_drift_verdict: Optional[dict] = None
         self.scorer = Scorer(
             self.registry, AdmissionQueue(queue_depth),
-            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms)
+            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
+            observer=self._observe, extra_columns=label_cols)
         self.started_at = time.time()
         self._serve_thread: Optional[threading.Thread] = None
         self._shutdown_lock = threading.Lock()
@@ -153,6 +206,75 @@ class ScoringServer:
         self.httpd = ThreadingHTTPServer((host, port),
                                          self._handler_class())
         self.httpd.daemon_threads = True
+
+    # ---- continuous-loop seams ----
+    def _load_configs(self):
+        """Best-effort model-set configs from the serving root — the
+        drift baseline (ColumnConfig bins + counts) and the traffic log's
+        label columns come from here. Absent/corrupt configs degrade to
+        plain serving, never to a failed startup."""
+        ccs = mc = None
+        try:
+            cc_path = os.path.join(self.root, "ColumnConfig.json")
+            if os.path.isfile(cc_path):
+                from shifu_tpu.config import load_column_config_list
+
+                ccs = load_column_config_list(cc_path)
+        except Exception as e:  # malformed config degrades, never kills
+            log.warning("serve: cannot load ColumnConfig.json (%s); "
+                        "drift monitoring off", e)
+        try:
+            mc_path = os.path.join(self.root, "ModelConfig.json")
+            if os.path.isfile(mc_path):
+                from shifu_tpu.config import ModelConfig
+
+                mc = ModelConfig.load(mc_path)
+        except Exception as e:  # malformed config degrades, never kills
+            log.warning("serve: cannot load ModelConfig.json (%s)", e)
+        return ccs, mc
+
+    def _observe(self, data, result) -> None:
+        """Batcher post-resolution observer: traffic log + shadow scoring
+        + cadenced drift verdict. Runs on the worker thread AFTER every
+        request in the batch is answered."""
+        if self.traffic is not None:
+            # scored_sha, not sha: a promote between the score and this
+            # observe must not re-attribute the batch's logged rows to
+            # the new version (the drift recommendation below DOES want
+            # the current active sha — it targets the set being served)
+            self.traffic.record(
+                data, result,
+                getattr(self.registry, "scored_sha", self.registry.sha))
+        self.registry.observe(data, result)
+        self._observed_batches += 1
+        if (self.drift is not None
+                and self._observed_batches % self._drift_check_every == 0):
+            # check_degrade returns the verdict it computed — one window
+            # flush + PSI pass per cadence, not two
+            self._last_drift_verdict = self.drift.check_degrade(
+                self.scorer.health, self.root,
+                model_sha=self.registry.sha)
+
+    def stage_candidate(self, models_dir: str) -> dict:
+        """Load + warm a candidate model set as the shadow version."""
+        return self.registry.stage(models_dir,
+                                   column_configs=self.column_configs,
+                                   model_config=self.model_config,
+                                   drift=self.drift)
+
+    def promote_candidate(self, expected_sha: Optional[str] = None) -> dict:
+        """Hot-swap the staged shadow live; a sticky drift degrade clears
+        — the recommendation was acted on — and the drift monitor resets
+        so drift on the NEW version's traffic re-degrades and
+        re-recommends instead of being swallowed by the old run's
+        already-seen columns. `expected_sha` (from the gate evidence)
+        must match the staged shadow, or the swap is refused."""
+        swap = self.registry.promote(expected_sha)
+        self.scorer.health.clear_degraded()
+        if self.drift is not None:
+            self.drift.reset()
+        self._last_drift_verdict = None
+        return swap
 
     # ---- HTTP ----
     @property
@@ -203,6 +325,16 @@ class ScoringServer:
                         "uptimeSeconds": round(
                             time.time() - server.started_at, 1),
                     })
+                    # drift summary from the CACHED cadence verdict — a
+                    # health probe must never force a device sync
+                    if server._last_drift_verdict is not None:
+                        v = server._last_drift_verdict
+                        health["drift"] = {
+                            "status": v["status"],
+                            "maxPsi": round(v["maxPsi"], 6),
+                            "driftedColumns": v["driftedColumns"],
+                            "threshold": v["threshold"],
+                        }
                     self._reply(code, health)
                     return
                 if self.path == "/metrics":
@@ -211,9 +343,18 @@ class ScoringServer:
                         obs_registry().to_prometheus().encode("utf-8"),
                         content_type="text/plain; version=0.0.4")
                     return
+                if self.path == "/admin/shadow":
+                    self._reply(200, {
+                        "active": server.registry.sha,
+                        "shadow": server.registry.shadow_snapshot(),
+                    })
+                    return
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):
+                if self.path in ("/admin/stage", "/admin/promote"):
+                    self._do_admin()
+                    return
                 if self.path != "/score":
                     self._reply(404, {"error": f"unknown path {self.path}"})
                     return
@@ -248,6 +389,32 @@ class ScoringServer:
                     "scores": _result_rows(res),
                 })
 
+            def _do_admin(self):
+                """Rollout control plane: stage a candidate as the shadow
+                version, or promote the staged one (zero-downtime swap).
+                `shifu promote` drives these."""
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = self.rfile.read(length) if length else b"{}"
+                    doc = json.loads(body.decode("utf-8") or "{}")
+                except ValueError as e:
+                    self._reply(400, {"error": f"bad request body: {e}"})
+                    return
+                try:
+                    if self.path == "/admin/stage":
+                        models_dir = doc.get("modelsDir")
+                        if not models_dir:
+                            self._reply(400,
+                                        {"error": "modelsDir required"})
+                            return
+                        self._reply(200, {
+                            "staged": server.stage_candidate(models_dir)})
+                        return
+                    self._reply(200, server.promote_candidate(
+                        doc.get("sha")))
+                except (ValueError, OSError) as e:
+                    self._reply(409, {"error": str(e)})
+
         return Handler
 
     # ---- lifecycle ----
@@ -281,6 +448,10 @@ class ScoringServer:
             self.httpd.server_close()
             if self._serve_thread is not None:
                 self._serve_thread.join(5.0)
+            if self.traffic is not None:
+                # buffered rows become a final (short) chunk — nothing
+                # logged is ever lost to shutdown
+                self.traffic.close()
             return self._write_manifest()
         finally:
             # whatever happens above, serve_forever() must unblock — a
@@ -301,6 +472,13 @@ class ScoringServer:
             except Exception as pe:  # pragma: no cover - defensive
                 log.warning("cannot snapshot profiler: %s", pe)
                 profile_snap = None
+            extra = {"serve": self.registry.snapshot()}
+            if self.drift is not None:
+                # final flush: the shutdown manifest carries the full
+                # per-column PSI state of everything this replica served
+                extra["drift"] = self.drift.verdict()
+            if self.traffic is not None:
+                extra["traffic"] = self.traffic.snapshot()
             seq = ledger.next_seq("serve")
             path = ledger.write(
                 "serve", seq,
@@ -312,7 +490,7 @@ class ScoringServer:
                 registry=obs.registry(),
                 tracer=obs.tracer(),
                 profile=profile_snap,
-                extra={"serve": self.registry.snapshot()},
+                extra=extra,
             )
             log.info("serve manifest -> %s", path)
             return path

@@ -110,12 +110,61 @@ class StreamingCorrelation:
     matmuls: Pearson is shift-invariant, so the result is unchanged, but the
     accumulators hold O(std)-sized residuals instead of O(mean)-sized raw
     values — without this, columns with |mean| >> std cancel catastrophically
-    in the f32 cov/var subtraction and the streaming result collapses to 0."""
+    in the f32 cov/var subtraction and the streaming result collapses to 0.
 
-    def __init__(self):
+    Sharded fold (ShardPlan): the moment accumulators are plain f64 sums,
+    so S per-shard instances merged in shard order reproduce the S=1 fold
+    — provided every shard uses the SAME shift (per-shard shifts would
+    change each shard's residuals and therefore the f64 summation values,
+    not just their order). The driver derives the shift from the globally
+    first chunk and passes it to every shard via `shift=`."""
+
+    def __init__(self, shift: np.ndarray | None = None):
         self.names: List[str] = []
         self._acc = None
-        self._shift: np.ndarray | None = None
+        self._shift: np.ndarray | None = (
+            None if shift is None else np.asarray(shift, dtype=np.float32))
+
+    @staticmethod
+    def shift_of(data: ColumnarData, columns: List[ColumnConfig]
+                 ) -> np.ndarray | None:
+        """The shift the first chunk implies — computed once by the driver
+        so all shards of a sharded pass agree on it."""
+        x, names = feature_matrix(data, columns)
+        if not names:
+            return None
+        with np.errstate(invalid="ignore"):
+            shift = np.nanmean(x.astype(np.float64), axis=0)
+        return np.nan_to_num(shift, nan=0.0).astype(np.float32)
+
+    def merge(self, other: "StreamingCorrelation") -> None:
+        """Fold another shard's moment accumulators into this one (f64
+        sums — on integral data the merged result is bit-identical to a
+        single-shard fold in any merge order)."""
+        if other._acc is None:
+            return
+        if self.names and other.names and self.names != other.names:
+            raise ValueError("cannot merge correlation accumulators over "
+                             "different column sets")
+        if self._acc is not None:
+            a, b = self._shift, other._shift
+            if (a is None) != (b is None) or (
+                    a is not None and not np.array_equal(a, b)):
+                # the moment sums are residuals AROUND the shift; folding
+                # sums built around different shifts yields silently
+                # wrong cov/var
+                raise ValueError(
+                    "cannot merge correlation accumulators built over "
+                    "different shifts — derive ONE shift (the globally "
+                    "first chunk's column means) and share it across "
+                    "shards")
+        if self._acc is None:
+            self.names = other.names
+            self._acc = other._acc
+            self._shift = other._shift
+            return
+        for k in range(len(self._acc)):
+            self._acc[k] += other._acc[k]
 
     def update(self, data: ColumnarData, columns: List[ColumnConfig]) -> None:
         x, names = feature_matrix(data, columns)

@@ -365,27 +365,35 @@ def _column_slot_layout(
 
 
 def _stats_config_sha(mc: ModelConfig, stats_cols: List[ColumnConfig],
-                      seed: int, n_shards: int) -> str:
-    """Identity of a streaming-stats run for checkpoint compatibility: a
-    snapshot folded under one config must never resume under another."""
+                      seed: int, n_shards: int):
+    """(sha, per-section shas) of a streaming-stats run for checkpoint
+    compatibility: a snapshot folded under one config must never resume
+    under another — and a rejection names whether the DATA side (chunk
+    geometry, shard plan, sampling, columns) or the STATS side (binning
+    method/limits) diverged."""
     from shifu_tpu.data.stream import chunk_rows_setting
-    from shifu_tpu.resilience.checkpoint import config_sha
+    from shifu_tpu.resilience.checkpoint import sectioned_sha
 
-    return config_sha({
-        # the recorded chunk index only means anything under the SAME
-        # chunk geometry — resuming a 48-row-chunk snapshot under the
-        # 65536 default would silently skip/double-fold rows
-        "chunkRows": chunk_rows_setting(),
-        # ... and under the same shard plan: shard s's cursor means
-        # "chunks ci % S == s up to here are folded"
-        "shards": n_shards,
-        "method": str(mc.stats.binning_method),
-        "maxBins": mc.stats.max_num_bin,
-        "cateMax": mc.stats.cate_max_num_bin,
-        "sampleRate": mc.stats.sample_rate,
-        "sampleNegOnly": mc.stats.sample_neg_only,
-        "seed": seed,
-        "columns": [(c.column_name, str(c.column_type)) for c in stats_cols],
+    return sectioned_sha({
+        "data": {
+            # the recorded chunk index only means anything under the SAME
+            # chunk geometry — resuming a 48-row-chunk snapshot under the
+            # 65536 default would silently skip/double-fold rows
+            "chunkRows": chunk_rows_setting(),
+            # ... and under the same shard plan: shard s's cursor means
+            # "chunks ci % S == s up to here are folded"
+            "shards": n_shards,
+            "sampleRate": mc.stats.sample_rate,
+            "sampleNegOnly": mc.stats.sample_neg_only,
+            "seed": seed,
+            "columns": [(c.column_name, str(c.column_type))
+                        for c in stats_cols],
+        },
+        "stats": {
+            "method": str(mc.stats.binning_method),
+            "maxBins": mc.stats.max_num_bin,
+            "cateMax": mc.stats.cate_max_num_bin,
+        },
     })
 
 
@@ -515,9 +523,10 @@ def compute_stats_streaming(
     phase: Optional[str] = None
     resume_acc: Optional[tuple] = None
     if checkpoint_root is not None and ckpt_mod.ckpt_stream_enabled():
+        sha, sha_sections = _stats_config_sha(mc, stats_cols, seed, S)
         ck = ckpt_mod.ShardedStreamCheckpoint(
             ckpt_mod.ckpt_base(checkpoint_root, "stats", "stream"),
-            _stats_config_sha(mc, stats_cols, seed, S), S)
+            sha, S, sections=sha_sections)
         if resume:
             loaded = ck.load()
             if loaded is not None:

@@ -4,6 +4,14 @@ Parity: the reference's PSI Pig job (PSI.pig, udf/PSICalculatorUDF.java,
 driven by MapReducerStatsWorker.runPSI:594) — per-unit bin distributions per
 column, PSI of each unit against the whole population, unitStats strings
 written back into ColumnConfig.
+
+State is pure bin counts, so the accumulator is a CRDT-ish fold: `merge`
+sums two accumulators' counts exactly (f64 integer sums), which makes the
+pass shardable over the lifecycle `ShardPlan` — each shard folds its own
+chunk slice, shards merge in shard order, and the result is byte-identical
+to the single-shard fold at any shard count. The serve-side drift monitor
+(`shifu_tpu/loop/drift.py`) reuses `psi_from_counts` so offline PSI and
+online drift share one smoothing/zero-handling definition.
 """
 
 from __future__ import annotations
@@ -16,6 +24,18 @@ from shifu_tpu.config import ColumnConfig
 from shifu_tpu.data.reader import ColumnarData
 from shifu_tpu.stats.binning import categorical_bin_index, numeric_bin_index
 from shifu_tpu.stats.metrics import psi_metric
+
+
+def psi_from_counts(expected: np.ndarray, actual: np.ndarray) -> float:
+    """PSI between two bin-count vectors — the one definition both the
+    offline unit-split pass and the online serve drift fold use.
+
+    Degenerate inputs are defined, not crashed on: an empty/zero side
+    (no expected traffic, or no live rows yet) is PSI 0.0, and
+    zero-frequency bins (a category unseen in training, or a training bin
+    live traffic never hits) are eps-smoothed inside `psi_metric` so a
+    single empty slot contributes a finite term instead of ±inf."""
+    return psi_metric(expected, actual)
 
 
 class PsiAccumulator:
@@ -64,6 +84,26 @@ class PsiAccumulator:
                     u, [np.zeros(k, dtype=np.float64) for k in self.n_slots]
                 )
                 per_col[j] += dist
+
+    def merge(self, other: "PsiAccumulator") -> None:
+        """Fold another shard's counts into this accumulator (exact: counts
+        are integers carried in f64). Units only one side saw merge as-is;
+        shared units sum per column. The accumulators must be built over
+        the same columns/bins — same ColumnConfig list, same psi column."""
+        if (self.psi_column != other.psi_column
+                or self.n_slots != other.n_slots
+                or [c.column_name for c in self.cols]
+                != [c.column_name for c in other.cols]):
+            raise ValueError("cannot merge PSI accumulators built over "
+                             "different columns/bins/unit column")
+        for j in range(len(self.cols)):
+            self.overall[j] += other.overall[j]
+        for u, per_col in other.unit_counts.items():
+            mine = self.unit_counts.setdefault(
+                u, [np.zeros(k, dtype=np.float64) for k in self.n_slots]
+            )
+            for j in range(len(self.cols)):
+                mine[j] += per_col[j]
 
     def finalize(self) -> None:
         """Write psi + per-unit PSI sequence into each ColumnConfig.

@@ -196,17 +196,26 @@ def _get_shard_program(cfg: NNTrainConfig, shapes):
 
 
 def _stream_train_sha(cfg: NNTrainConfig, feed: "ShardFeed",
-                      target_class: Optional[int]) -> str:
-    """Checkpoint-compatibility identity: the full hyperparameter set +
-    the shard layout — resuming onto a different config or dataset would
-    silently train the wrong model."""
-    from shifu_tpu.resilience.checkpoint import config_sha
+                      target_class: Optional[int],
+                      ident_extra: Optional[dict] = None):
+    """(sha, per-section shas): the full hyperparameter set in the
+    `train` section, the shard layout in the `data` section, and the
+    caller's extra identity (retrain's warm-start parent) in the `loop`
+    section — resuming onto a different config, dataset, or parent model
+    would silently train the wrong weights, and a rejection names which
+    side moved."""
+    from shifu_tpu.resilience.checkpoint import sectioned_sha
 
-    return config_sha({**{k: v for k, v in cfg.__dict__.items()
-                          if not callable(v) and k != "progress_cb"},
-                       "shardRows": list(feed.meta.shard_rows),
-                       "columns": list(feed.meta.columns),
-                       "targetClass": target_class})
+    sections = {
+        "train": {k: v for k, v in cfg.__dict__.items()
+                  if not callable(v) and k != "progress_cb"},
+        "data": {"shardRows": list(feed.meta.shard_rows),
+                 "columns": list(feed.meta.columns),
+                 "targetClass": target_class},
+    }
+    if ident_extra:
+        sections["loop"] = dict(ident_extra)
+    return sectioned_sha(sections)
 
 
 def train_nn_streamed(
@@ -217,6 +226,7 @@ def train_nn_streamed(
     mesh=None,
     sig_override=None,
     resume: bool = False,
+    ident_extra: Optional[dict] = None,
 ) -> TrainResult:
     """Full-batch BSP training streamed from shards: per epoch, sum shard
     gradients (the NNMaster worker-sum), then ONE weight update. Matches
@@ -281,9 +291,11 @@ def train_nn_streamed(
 
     ck = None
     if cfg.checkpoint_path and cfg.checkpoint_every:
+        sha, sha_sections = _stream_train_sha(cfg, feed, target_class,
+                                              ident_extra)
         ck = ckpt_mod.StreamCheckpoint(
             cfg.checkpoint_path + ".state" + ckpt_mod.CKPT_SUFFIX,
-            _stream_train_sha(cfg, feed, target_class), every=0)
+            sha, every=0, sections=sha_sections)
         if resume:
             loaded = ck.load()
             if loaded is not None:

@@ -20,6 +20,7 @@ class ErrorCode(enum.Enum):
     INVALID_ALGORITHM = "unsupported algorithm"
     INVALID_FILTER_EXPR = "invalid filter expression"
     GRID_CONFIG_INVALID = "invalid grid-search config"
+    ILLEGAL_ARGUMENT = "illegal argument"
 
 
 class ShifuError(Exception):
