@@ -975,6 +975,58 @@ def bench_serve_latency():
                 "qps": round(flat.size / elapsed, 1),
             }
         scorer.close()
+
+        # race-sanitizer overhead: the same closed loop at the top
+        # concurrency level, serve stack rebuilt per mode because
+        # arming is read at lock CONSTRUCTION time. Unarmed,
+        # tracked_lock returns a plain threading.Lock, so off_p50 must
+        # sit within noise of the main sweep; the armed multiplier is
+        # recorded, not gated — race is a debugging mode, never the
+        # production default. The armed pass's verdict rides the
+        # scenario sanitizer snapshot like transfer/nan trips.
+        from shifu_tpu.analysis import racetrack
+
+        def race_pass(conc):
+            reg = ModelRegistry(tmp)
+            sc = Scorer(reg, AdmissionQueue(spec["queue_depth"]))
+            reg.warm([1, conc])
+            per = spec["requests"] // conc
+            lat = [[] for _ in range(conc)]
+
+            def run(ti):
+                for k in range(per):
+                    t0 = time.perf_counter()
+                    sc.score_batch([record(ti * per + k)])
+                    lat[ti].append(time.perf_counter() - t0)
+
+            threads = [threading.Thread(target=run, args=(ti,))
+                       for ti in range(conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            sc.close()
+            flat = np.asarray([v for ts in lat for v in ts])
+            return float(np.percentile(flat, 50)) * 1e3
+
+        conc = max(spec["concurrency"])
+        off_p50 = race_pass(conc)
+        mark = racetrack.tracker().mark()
+        racetrack.arm(True)
+        try:
+            armed_p50 = race_pass(conc)
+            race_verdict = racetrack.tracker().verdict(mark)
+        finally:
+            racetrack.arm(None)
+        out["race_overhead"] = {
+            "concurrency": conc,
+            "off_p50_ms": round(off_p50, 3),
+            "armed_p50_ms": round(armed_p50, 3),
+            "armed_over_off": (round(armed_p50 / off_p50, 3)
+                               if off_p50 else None),
+            "verdict": race_verdict,
+        }
+
         out["registry"] = registry.snapshot()
         out["profile"] = _profile_delta(p0, _profile_totals(), 1,
                                         sweep_elapsed)
@@ -1304,6 +1356,12 @@ def main() -> None:
     sharded_stats = bench_sharded_stats()
     serve_latency = _with_obs_metrics(
         bench_serve_latency, "serve_latency", transfer_clean=True)
+    ro = serve_latency.get("race_overhead") or {}
+    if "verdict" in ro:
+        # the armed race pass's tracker delta lands in the scenario's
+        # sanitizer snapshot exactly like transfer trips / nan traps
+        serve_latency["sanitizer"]["race"] = {
+            "armed": True, **ro.pop("verdict")}
     continuous_loop = _with_obs_metrics(
         bench_continuous_loop, "continuous_loop")
 
@@ -1392,13 +1450,17 @@ def main() -> None:
         "serve_latency": {
             **{k: v for k, v in serve_latency.items()
                if k.startswith("concurrency_") or k == "registry"},
+            "race_overhead": serve_latency.get("race_overhead"),
             "profile": serve_latency.get("profile"),
             "metrics": serve_latency.get("metrics"),
             "sanitizer": serve_latency.get("sanitizer"),
             "note": ("closed-loop single-record requests through "
                      "admission -> micro-batcher -> fused raw->score jit; "
                      "registry.warmBuckets is the steady-state compile "
-                     "bound (transfer guard armed on the scoring seam)"),
+                     "bound (transfer guard armed on the scoring seam); "
+                     "race_overhead = p50 with -Dshifu.sanitize=race "
+                     "lock tracking off vs armed (off is a plain "
+                     "threading.Lock; armed recorded, not gated)"),
         },
         "continuous_loop": {
             "warm_start": continuous_loop["warm_start"],

@@ -329,6 +329,42 @@ class PackageContext:
                         out.append(meth)
         return out
 
+    # -- generic reachability (the traced-set machinery, reusable for
+    # other root kinds: rules/concurrency.py seeds THREAD roots the way
+    # _mark_traced seeds jit roots) --
+    def reachable(self, roots: Dict[ast.AST, str]
+                  ) -> Dict[ast.AST, str]:
+        """Transitive closure of defs referenced from `roots` through
+        the same conservative resolution the traced set uses. Returns
+        {def_node: why}."""
+        out: Dict[ast.AST, str] = {}
+        work: List[ast.AST] = []
+        for node, via in roots.items():
+            if node not in out:
+                out[node] = via
+                work.append(node)
+        while work:
+            fn = work.pop()
+            m = self._module_of.get(fn)
+            if m is None:
+                continue
+            via = f"called from `{getattr(fn, 'name', '?')}`"
+            for target in self._referenced_defs(m, fn):
+                if target not in out:
+                    out[target] = via
+                    work.append(target)
+        return out
+
+    def module_of(self, fn: ast.AST) -> Optional[Module]:
+        return self._module_of.get(fn)
+
+    def defs_named(self, m: Module, name: str) -> List[ast.AST]:
+        """Module-local defs with this simple name (for root seeding)."""
+        return list(self._defs_by_module[m].get(name, []))
+
+    def class_methods(self, m: Module, class_name: str) -> List[ast.AST]:
+        return list(self._class_methods[m].get(class_name, []))
+
     # -- public queries --
     def node_traced(self, m: Module, node: ast.AST) -> bool:
         """True when `node` executes under a jax tracer: its nearest
@@ -410,7 +446,11 @@ def register(cls):
 
 def all_rules() -> Dict[str, Rule]:
     # import for side effect: rule modules self-register
-    from shifu_tpu.analysis.rules import hygiene, jaxrules  # noqa: F401
+    from shifu_tpu.analysis.rules import (  # noqa: F401
+        concurrency,
+        hygiene,
+        jaxrules,
+    )
 
     return dict(_REGISTRY)
 

@@ -1,0 +1,186 @@
+"""Central catalog of every ``-Dshifu.*`` operational knob.
+
+The reference carried its operational surface in one ``shifuconfig``
+file; this repo grew ~50 ``-D`` properties across nine PRs, each read
+at its use site through ``utils/environment`` getters — and nothing
+guaranteed a knob written in a runbook still existed, was spelled
+right, or was read with the type its default implies. This registry is
+the single source of truth:
+
+  * ``shifu check`` rule **SH105** (rules/hygiene.py) statically
+    verifies every ``environment.get_*("shifu....")`` call site against
+    it — undeclared keys, getter/type mismatches, and declared knobs
+    nothing reads are all findings, so the catalog can never drift from
+    the code.
+  * ``shifu check --knobs`` renders it as ``docs/KNOBS.md``; the
+    committed file is checked for staleness in the tier-1 suite (and
+    therefore in CI).
+
+Dynamic keys (per-seam retry overrides, profile-diff gates) are
+declared as glob patterns — the literal ``*`` stands for exactly the
+dynamic fragment the reading f-string interpolates, and SH105 requires
+the read site's literalized pattern to match a declared glob verbatim.
+
+Types are semantic: ``get_property`` may read any knob (string read +
+manual parse is the idiom for floats that distinguish "unset" from
+"0"), but a typed getter must match the declared type exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str       # literal key, or a glob with `*` for dynamic parts
+    type: str       # "int" | "float" | "bool" | "str"
+    default: str    # rendered default (docs; "" = unset/off)
+    doc: str        # one line
+
+
+_K = Knob
+
+KNOBS: List[Knob] = [
+    # ---- ingest / streaming pipeline (PR 1, PR 8) ----
+    _K("shifu.ingest.chunkRows", "int", "65536",
+       "rows per streamed chunk (data/stream.py)"),
+    _K("shifu.ingest.memoryBudgetMB", "int", "512",
+       "datasets above this stream chunked instead of loading in-RAM"),
+    _K("shifu.ingest.forceStreaming", "str", "",
+       "\"true\"/\"1\" forces the streaming ingest path regardless of size"),
+    _K("shifu.ingest.prefetchChunks", "int", "2",
+       "background prefetch queue depth (0 = serial inline loop)"),
+    _K("shifu.lifecycle.shards", "int", "0 (= all devices)",
+       "row shards the lifecycle folds divide chunks over (ShardPlan)"),
+    # ---- train ----
+    _K("shifu.train.forceStreaming", "str", "",
+       "\"true\"/\"1\" forces shard-streamed training"),
+    _K("shifu.train.memoryBudgetMB", "int", "1024",
+       "normalized matrix budget before training streams from shards"),
+    _K("shifu.train.histCacheBudgetMB", "int", "4096",
+       "leaf-wise tree growth: retained-histogram cache budget"),
+    _K("shifu.gridsearch.threshold", "int", "30",
+       "max grid points trained in-process before bagging kicks in"),
+    _K("shifu.rebin.maxNumBin", "int", "stats.maxNumBin",
+       "rebin target bin count (defaults to the ModelConfig value)"),
+    # ---- kernels ----
+    _K("shifu.pallas.blk", "int", "512",
+       "pallas histogram kernel row-block size (ops/hist_pallas.py)"),
+    _K("shifu.pallas.wmax", "int", "1024",
+       "pallas histogram kernel max window width"),
+    # ---- observability / profiling (PR 2, PR 6) ----
+    _K("shifu.profile", "str", "",
+       "\"xla\" = deep-capture into the ledger dir; else explicit trace dir"),
+    _K("shifu.profile.mode", "str", "on",
+       "program profiler: on | off (off skips the AOT cost accounting)"),
+    _K("shifu.profile.peakTflops", "float", "0 (= chip table)",
+       "override the roofline peak TFLOP/s (obs/costmodel.py)"),
+    _K("shifu.profile.peakGBs", "float", "0 (= chip table)",
+       "override the roofline peak HBM GB/s"),
+    _K("shifu.profile.diff.*", "float", "flopsPct 10 / bytesPct 25 / "
+       "hbmPct 25 / secondsPct 0",
+       "`shifu profile --diff` regression gates (pct increase; 0 = off)"),
+    # ---- sanitizers (PR 4, this PR) ----
+    _K("shifu.sanitize", "str", "",
+       "comma list of armed sanitizer modes: transfer,nan,recompile,race"
+       " (or `all`)"),
+    _K("shifu.sanitize.recompileBudget", "int", "64",
+       "compiles per armed stage before a recompile breach is recorded"),
+    _K("shifu.sanitize.race.holdMs", "float", "250",
+       "race mode: lock-hold ms above which a long-hold event is "
+       "recorded (0 disables)"),
+    # ---- resilience (PR 7) ----
+    _K("shifu.faults", "str", "",
+       "deterministic fault-injection spec (resilience/faults.py grammar)"),
+    _K("shifu.resume", "bool", "false",
+       "resume a preempted step from its mid-stream checkpoint"),
+    _K("shifu.ckpt.stream", "bool", "true",
+       "write mid-stream checkpoints during streaming folds"),
+    _K("shifu.ckpt.everyChunks", "int", "16",
+       "folded chunks between mid-stream checkpoints"),
+    _K("shifu.retry.max", "int", "3",
+       "retry attempt budget for io/prefetch/device/ckpt seams (1 = none)"),
+    _K("shifu.retry.baseMs", "float", "25",
+       "first retry backoff (exponential, full jitter)"),
+    _K("shifu.retry.capMs", "float", "2000",
+       "retry backoff ceiling"),
+    _K("shifu.retry.*.max", "int", "shifu.retry.max",
+       "per-seam retry budget override (e.g. shifu.retry.io.max)"),
+    _K("shifu.retry.*.baseMs", "float", "shifu.retry.baseMs",
+       "per-seam backoff base override"),
+    _K("shifu.retry.*.capMs", "float", "shifu.retry.capMs",
+       "per-seam backoff cap override"),
+    # ---- serve (PR 5, PR 7) ----
+    _K("shifu.serve.maxBatchRows", "int", "1024",
+       "micro-batcher row cap per coalesced dispatch"),
+    _K("shifu.serve.maxWaitMs", "float", "2.0",
+       "micro-batcher coalesce deadline after the first request"),
+    _K("shifu.serve.queueDepth", "int", "128",
+       "admission bound — requests beyond it shed with 429"),
+    _K("shifu.serve.maxWorkerRestarts", "int", "5",
+       "supervisor restart budget before the replica drains"),
+    _K("shifu.serve.deadlineMs", "float", "30000",
+       "per-request admission-to-dispatch budget (0 disables)"),
+    # ---- continuous loop (PR 9) ----
+    _K("shifu.loop.logSample", "float", "0 (= off)",
+       "fraction of served rows written to the traffic log"),
+    _K("shifu.loop.logChunkRows", "int", "4096",
+       "rows per traffic-log chunk file"),
+    _K("shifu.loop.psiDegrade", "float", "0.2",
+       "per-column PSI that flips /healthz to degraded + recommends "
+       "retrain"),
+    _K("shifu.loop.driftMinRows", "int", "256",
+       "live rows before drift verdicts bind (below: `warming`)"),
+    _K("shifu.loop.driftCheckBatches", "int", "32",
+       "batches between drift verdict checks (a check flushes the window)"),
+    _K("shifu.loop.shadowSample", "float", "0.25",
+       "fraction of live batches the staged shadow also scores"),
+    _K("shifu.loop.shadowTolerance", "float", "5.0",
+       "|mean-score delta| (0..1000) counted as shadow agreement"),
+    _K("shifu.loop.promoteAgree", "float", "0.95",
+       "min shadow agreement rate to promote"),
+    _K("shifu.loop.promoteMinRows", "int", "64",
+       "min shadow-scored rows before a promote decision binds"),
+    _K("shifu.loop.appendTrees", "int", "10",
+       "GBT retrain: trees appended on new chunks"),
+]
+
+
+def by_name() -> Dict[str, Knob]:
+    return {k.name: k for k in KNOBS}
+
+
+def render_markdown() -> str:
+    """docs/KNOBS.md, generated — `shifu check --knobs` emits this and
+    the tier-1 suite (and therefore CI) fails when the committed file is
+    stale."""
+    lines = [
+        "# `-Dshifu.*` knob catalog",
+        "",
+        "Generated by `shifu check --knobs` from "
+        "`shifu_tpu/analysis/knobs.py` — do not edit by hand; "
+        "regenerate with:",
+        "",
+        "```",
+        "$ python -m shifu_tpu check --knobs > docs/KNOBS.md",
+        "```",
+        "",
+        "Every key is settable three ways (utils/environment.py): "
+        "`$SHIFU_TPU_HOME/conf/shifuconfig` / `/etc/shifuconfig`, a "
+        "`SHIFU_*` environment variable, or a `-Dkey=value` CLI "
+        "override (highest priority). Rule **SH105** keeps this catalog "
+        "exact: every `environment.get_*` call site must read a "
+        "declared key with the declared type, and every declared key "
+        "must have a reader. A literal `*` marks a dynamic key "
+        "fragment (per-seam / per-gate overrides).",
+        "",
+        "| knob | type | default | purpose |",
+        "|---|---|---|---|",
+    ]
+    for k in KNOBS:
+        lines.append(
+            f"| `{k.name}` | {k.type} | {k.default or '(unset)'} "
+            f"| {k.doc} |")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,826 @@
+"""SH2xx rules: thread-safety for the host-side coordination layer.
+
+The reference outsourced coordination to Hadoop MR + ZooKeeper; this
+repo pulled it in-process (prefetch workers, the micro-batcher,
+ThreadingHTTPServer handlers, traffic-log rotation, shadow scoring, the
+drift monitor, the hot-swap registry) and grew ~100 ad-hoc ``_lock``
+sites whose discipline was only ever checked by hand — PR 9's review
+pass alone fixed several latent races. These rules make thread safety a
+checked property the way JX001–JX005 made trace safety one:
+
+  * thread roots are seeded like jit roots: ``threading.Thread(target=
+    ...)`` operands, HTTP handler methods, signal/atexit handlers —
+    then propagated through the package call graph, so "thread-
+    reachable" is a computed fact, not a guess;
+  * lock discipline is *inferred* per class: an attribute predominantly
+    accessed under ``with self._lock`` is treated as guarded by it, and
+    the exceptions are the findings.
+
+SH201  thread-reachable mutation of a guarded attribute without the lock
+SH202  inconsistent nested-lock acquisition order (static cycle in the
+       lock-order graph = potential deadlock)
+SH203  blocking work while holding a lock (device sync, file I/O,
+       sleep/join, waiting on an event) — the serve p99 killers
+SH204  Event/Condition misuse (notify outside its lock, wait outside a
+       predicate loop, unbounded Event.wait)
+
+The runtime counterpart is ``-Dshifu.sanitize=race``
+(analysis/racetrack.py): what these rules prove impossible statically,
+the tracked-lock instrumentation witnesses at the real interleavings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from shifu_tpu.analysis.engine import (
+    Module,
+    PackageContext,
+    Rule,
+    dotted_name,
+    register,
+)
+
+# constructors that make an attribute lock-like. Condition guards state
+# exactly like a lock (it wraps one); Event is signaling, not guarding.
+_LOCK_CTORS = {"Lock", "RLock", "tracked_lock"}
+_COND_CTORS = {"Condition"}
+_EVENT_CTORS = {"Event"}
+
+_MUTATORS = {"append", "extend", "insert", "update", "setdefault", "add",
+             "remove", "discard", "clear", "pop", "popleft", "popitem",
+             "appendleft"}
+
+_CALLER_HOLDS_RE = re.compile(r"caller\s+holds\s+the\s+lock",
+                              re.IGNORECASE)
+
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+                  "ThreadingHTTPServer", "HTTPServer"}
+
+
+def _ctor_kind(value: ast.AST) -> Optional[str]:
+    """'lock' | 'cond' | 'event' when `value` constructs one."""
+    if not isinstance(value, ast.Call):
+        return None
+    tail = dotted_name(value.func).split(".")[-1]
+    if tail in _LOCK_CTORS:
+        return "lock"
+    if tail in _COND_CTORS:
+        return "cond"
+    if tail in _EVENT_CTORS:
+        return "event"
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'attr' for a `self.attr` expression, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _guarded_by_decorator(fn: ast.AST) -> Optional[str]:
+    """The lock attr named by a @guarded_by("_lock") decorator."""
+    for dec in getattr(fn, "decorator_list", []):
+        if (isinstance(dec, ast.Call)
+                and dotted_name(dec.func).split(".")[-1] == "guarded_by"
+                and dec.args and isinstance(dec.args[0], ast.Constant)):
+            return str(dec.args[0].value)
+    return None
+
+
+def _caller_holds(fn: ast.AST, module: Module) -> bool:
+    """The repo's caller-holds conventions: a `*_locked` name suffix, a
+    @guarded_by declaration, or a 'caller holds the lock' line in the
+    def's source (docstring or comment)."""
+    name = getattr(fn, "name", "")
+    if name.endswith("_locked"):
+        return True
+    if _guarded_by_decorator(fn) is not None:
+        return True
+    return bool(_CALLER_HOLDS_RE.search(module.segment(fn)))
+
+
+class _ClassLocks:
+    """Lock/cond/event attributes of one class + its access ledger."""
+
+    def __init__(self, module: Module, node: ast.ClassDef) -> None:
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.guards: Dict[str, str] = {}   # attr -> "lock" | "cond"
+        self.events: Set[str] = set()
+        for sub in ast.walk(node):
+            attr = None
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                attr = _self_attr(sub.targets[0])
+                value = sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                attr = _self_attr(sub.target)
+                value = sub.value
+            else:
+                continue
+            if attr is None:
+                continue
+            kind = _ctor_kind(value)
+            if kind in ("lock", "cond"):
+                self.guards[attr] = kind
+            elif kind == "event":
+                self.events.add(attr)
+
+
+def _module_locks(module: Module) -> Set[str]:
+    """Module-level lock/cond names (`_lock = threading.Lock()`)."""
+    out: Set[str] = set()
+    for node in module.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _ctor_kind(node.value) in ("lock", "cond")):
+            out.add(node.targets[0].id)
+    return out
+
+
+def _short(path: str) -> str:
+    base = os.path.basename(path)
+    return base[:-3] if base.endswith(".py") else base
+
+
+class _Analysis:
+    """Package-wide concurrency facts, computed once per PackageContext
+    and shared by SH201–SH204 (cached on the ctx instance the way the
+    traced set is precomputed for the JX rules)."""
+
+    def __init__(self, ctx: PackageContext) -> None:
+        self.ctx = ctx
+        self.classes: Dict[ast.ClassDef, _ClassLocks] = {}
+        self.module_locks: Dict[Module, Set[str]] = {}
+        for m in ctx.modules:
+            self.module_locks[m] = _module_locks(m)
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes[node] = _ClassLocks(m, node)
+        self.thread_reach = ctx.reachable(self._thread_roots())
+        # lock-order graph: (a, b) -> (module, witness node, detail)
+        self.edges: Dict[Tuple[str, str],
+                         Tuple[Module, ast.AST, str]] = {}
+        for m in ctx.modules:
+            self._collect_edges(m)
+
+    # ---- thread roots (seeded like jit roots) ----
+    def _thread_roots(self) -> Dict[ast.AST, str]:
+        roots: Dict[ast.AST, str] = {}
+
+        def add_named(m: Module, site: ast.AST, expr: ast.AST,
+                      via: str) -> None:
+            if isinstance(expr, ast.Name):
+                for d in self.ctx.defs_named(m, expr.id):
+                    roots.setdefault(d, via)
+            else:
+                attr = _self_attr(expr)
+                if attr:
+                    cls = None
+                    for anc in m.ancestors(site):
+                        if isinstance(anc, ast.ClassDef):
+                            cls = anc.name
+                            break
+                    if cls:
+                        for meth in self.ctx.class_methods(m, cls):
+                            if meth.name == attr:
+                                roots.setdefault(meth, via)
+
+        for m in self.ctx.modules:
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func)
+                    tail = name.split(".")[-1]
+                    if tail in ("Thread", "Timer"):
+                        for kw in node.keywords:
+                            if kw.arg in ("target", "function"):
+                                add_named(m, node, kw.value,
+                                          f"{tail}(target=...)")
+                    elif name.endswith("signal.signal") and \
+                            len(node.args) >= 2:
+                        add_named(m, node, node.args[1],
+                                  "signal handler")
+                    elif name.endswith("atexit.register") and node.args:
+                        add_named(m, node, node.args[0],
+                                  "atexit handler")
+                elif isinstance(node, ast.ClassDef):
+                    bases = {dotted_name(b).split(".")[-1]
+                             for b in node.bases}
+                    if bases & _HANDLER_BASES:
+                        for sub in node.body:
+                            if isinstance(sub, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)):
+                                roots.setdefault(
+                                    sub, f"HTTP handler method of "
+                                         f"`{node.name}`")
+        return roots
+
+    # ---- lock identity + with-subject resolution ----
+    def lock_id(self, m: Module, scope_node: ast.AST,
+                expr: ast.AST) -> Optional[str]:
+        """Stable name of the lock a `with <expr>:` acquires, or None
+        when `expr` is not a known lock/cond."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            for anc in m.ancestors(scope_node):
+                if isinstance(anc, ast.ClassDef):
+                    info = self.classes.get(anc)
+                    if info and attr in info.guards:
+                        return f"{info.name}.{attr}"
+                    return None
+            return None
+        if isinstance(expr, ast.Name) and \
+                expr.id in self.module_locks.get(m, set()):
+            return f"{_short(m.path)}.{expr.id}"
+        return None
+
+    def held_locks(self, m: Module, node: ast.AST) -> List[str]:
+        """Lock ids of every enclosing `with` guarding `node`,
+        innermost last."""
+        out: List[str] = []
+        for anc in m.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break  # a nested def runs later, outside these withs
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    lid = self.lock_id(m, anc, item.context_expr)
+                    if lid:
+                        out.append(lid)
+        out.reverse()
+        return out
+
+    # ---- lock-order edges (SH202) ----
+    def _with_locks_of_def(self, m: Module, fn: ast.AST) -> List[str]:
+        """Locks a def acquires directly in its own body (for the
+        one-hop edge: `with A:` body calls f(), f acquires B)."""
+        out: List[str] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = self.lock_id(m, node, item.context_expr)
+                    if lid:
+                        out.append(lid)
+        return out
+
+    def _collect_edges(self, m: Module) -> None:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.With):
+                continue
+            outer = [self.lock_id(m, node, it.context_expr)
+                     for it in node.items]
+            outer = [o for o in outer if o]
+            if not outer:
+                continue
+            for sub in ast.walk(node):
+                if sub is node:
+                    continue
+                if isinstance(sub, ast.With):
+                    for it in sub.items:
+                        inner = self.lock_id(m, sub, it.context_expr)
+                        if inner:
+                            for o in outer:
+                                self._edge(m, sub, o, inner, "nested with")
+                elif isinstance(sub, ast.Call):
+                    # one hop: a call made while holding the lock, to a
+                    # def we can resolve, that itself acquires locks
+                    for callee in self._resolve_call(m, node, sub):
+                        cm = self.ctx.module_of(callee) or m
+                        for inner in self._with_locks_of_def(cm, callee):
+                            for o in outer:
+                                self._edge(
+                                    m, sub, o, inner,
+                                    f"via call to "
+                                    f"`{getattr(callee, 'name', '?')}`")
+
+    def _resolve_call(self, m: Module, scope: ast.AST,
+                      call: ast.Call) -> List[ast.AST]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            return self.ctx.defs_named(m, fn.id)
+        attr = _self_attr(fn) if isinstance(fn, ast.Attribute) else None
+        if attr:
+            for anc in m.ancestors(scope):
+                if isinstance(anc, ast.ClassDef):
+                    return [meth for meth
+                            in self.ctx.class_methods(m, anc.name)
+                            if meth.name == attr]
+        return []
+
+    def _edge(self, m: Module, site: ast.AST, a: str, b: str,
+              how: str) -> None:
+        if a == b:
+            return
+        self.edges.setdefault(
+            (a, b), (m, site, f"{m.path}:{site.lineno} ({how})"))
+
+    def cycle_edges(self) -> Dict[Tuple[str, str], List[str]]:
+        """Edges that sit on a cycle -> the cycle's lock names.
+        Memoized: the edge set is complete after __init__, and SH202
+        consults this once per module plus once per finding."""
+        cached = getattr(self, "_cycle_edges", None)
+        if cached is not None:
+            return cached
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen, work = set(), [src]
+            while work:
+                cur = work.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                work.extend(adj.get(cur, ()))
+            return False
+
+        out: Dict[Tuple[str, str], List[str]] = {}
+        for (a, b) in self.edges:
+            if reaches(b, a):
+                out[(a, b)] = sorted({a, b})
+        self._cycle_edges = out
+        return out
+
+
+def _analysis(ctx: PackageContext) -> _Analysis:
+    cached = getattr(ctx, "_concurrency_analysis", None)
+    if cached is None:
+        cached = _Analysis(ctx)
+        ctx._concurrency_analysis = cached
+    return cached
+
+
+def _enclosing_method(module: Module, cls: ast.ClassDef,
+                      node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing def that is (transitively) inside `cls`."""
+    for anc in module.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+        if anc is cls:
+            return None
+    return None
+
+
+def _is_mutation(module: Module, node: ast.Attribute) -> Optional[str]:
+    """How `self.attr` is mutated here: 'assigned', 'augmented',
+    'item-assigned', 'deleted', '.<m>() mutated' — None for reads."""
+    if isinstance(node.ctx, ast.Store):
+        return "assigned"
+    if isinstance(node.ctx, ast.Del):
+        return "deleted"
+    parent = module.parent.get(node)
+    if isinstance(parent, ast.AugAssign) and parent.target is node:
+        return "augmented"
+    if (isinstance(parent, ast.Subscript) and parent.value is node
+            and isinstance(parent.ctx, (ast.Store, ast.Del))):
+        return "item-assigned"
+    if (isinstance(parent, ast.Attribute)
+            and parent.attr in _MUTATORS):
+        gp = module.parent.get(parent)
+        if isinstance(gp, ast.Call) and gp.func is parent:
+            return f".{parent.attr}() mutated"
+    return None
+
+
+@register
+class GuardedStateMutation(Rule):
+    """SH201 — mutation of a lock-guarded attribute without the lock.
+
+    The guard is INFERRED: an attribute of a lock-owning class that is
+    predominantly (>= 75%, >= 2 sites) accessed under `with
+    self._lock:` outside __init__ is treated as guarded by that lock.
+
+    bad:  class C:
+              def __init__(self): self._lock = Lock(); self._n = 0
+              def bump(self):
+                  with self._lock: self._n += 1
+              def reset(self): self._n = 0      # unguarded mutation
+    good: take the lock, or declare the convention checkably:
+          @guarded_by("_lock") (analysis/racetrack.py) on a method whose
+          callers hold the lock (also enforced at runtime under
+          -Dshifu.sanitize=race).
+    """
+
+    id = "SH201"
+    severity = "error"
+    summary = ("mutation of an inferred lock-guarded attribute outside "
+               "the lock (non-__init__, thread-shared class)")
+
+    MIN_GUARDED = 2
+    MIN_FRACTION = 0.75
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        an = _analysis(ctx)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = an.classes.get(node)
+            if not info or not info.guards:
+                continue
+            yield from self._check_class(module, an, info)
+
+    def _check_class(self, module: Module, an: _Analysis,
+                     info: _ClassLocks) -> Iterator["Finding"]:
+        # access ledger: attr -> [(guarding lock id or None, mutation
+        # kind or None, node, method)]
+        ledger: Dict[str, List] = {}
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Attribute):
+                continue
+            attr = _self_attr(node)
+            if (attr is None or attr in info.guards
+                    or attr in info.events):
+                continue
+            method = _enclosing_method(module, info.node, node)
+            if method is None:
+                continue
+            mname = getattr(method, "name", "")
+            if mname in ("__init__", "__new__", "__post_init__"):
+                continue
+            held = an.held_locks(module, node)
+            own = [h for h in held
+                   if h.startswith(info.name + ".")]
+            guard = own[-1] if own else None
+            if guard is None and _caller_holds(method, module):
+                dec = _guarded_by_decorator(method)
+                guard = (f"{info.name}.{dec}" if dec
+                         else f"{info.name}.(caller-held)")
+            ledger.setdefault(attr, []).append(
+                (guard, _is_mutation(module, node), node, method))
+        for attr, accesses in sorted(ledger.items()):
+            guarded = [a for a in accesses if a[0] is not None]
+            if len(guarded) < self.MIN_GUARDED:
+                continue
+            if len(guarded) / len(accesses) < self.MIN_FRACTION:
+                continue
+            locks = sorted({g for (g, _mu, _n, _m) in guarded
+                            if not g.endswith("(caller-held)")})
+            lock = locks[0] if locks else f"{info.name}._lock"
+            for (guard, mutation, node, method) in accesses:
+                if guard is not None or mutation is None:
+                    continue
+                reach = an.thread_reach.get(method)
+                via = (f"; `{method.name}` is thread-reachable "
+                       f"({reach})" if reach else "")
+                yield self.finding(
+                    module, node,
+                    f"`self.{attr}` ({mutation} in `{method.name}`) is "
+                    f"guarded by `{lock}` at {len(guarded)}/"
+                    f"{len(accesses)} access sites but mutated here "
+                    f"without it — take the lock or declare "
+                    f"@guarded_by{via}")
+
+
+@register
+class LockOrderCycle(Rule):
+    """SH202 — inconsistent nested-lock acquisition order.
+
+    bad:  def a(self):
+              with self._alock:
+                  with self._block: ...
+          def b(self):
+              with self._block:
+                  with self._alock: ...   # reverse order: deadlock
+    good: one global acquisition order (document it where the locks are
+          constructed), or restructure so the second lock is taken
+          after the first is released.
+    """
+
+    id = "SH202"
+    severity = "error"
+    summary = ("static lock-order graph has a cycle — two sites nest "
+               "the same locks in opposite orders (potential deadlock)")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        an = _analysis(ctx)
+        for (a, b), names in sorted(an.cycle_edges().items()):
+            m, site, detail = an.edges[(a, b)]
+            if m is not module:
+                continue
+            others = [an.edges[e][2] for e in an.cycle_edges()
+                      if e != (a, b) and set(e) <= set(names)]
+            yield self.finding(
+                module, site,
+                f"lock order `{a}` -> `{b}` here closes a cycle over "
+                f"{{{', '.join(names)}}} (other direction: "
+                f"{'; '.join(others) or 'see graph'}) — pick ONE "
+                f"global order for these locks")
+
+
+# blocking-call detection for SH203
+# tails that block regardless of receiver; tails needing a receiver/
+# root check (os.replace, time.sleep, np.save, .join) have dedicated
+# branches in _blocking_reason and must NOT be added here
+_BLOCKING_TAILS = {
+    "device_get": "a device->host sync",
+    "block_until_ready": "a device sync",
+    "dispatch": "a compiled-program dispatch",
+    "urlopen": "network I/O",
+    "atomic_write": "file I/O",
+    "atomic_write_json": "file I/O",
+    "atomic_save_npy": "file I/O",
+}
+_OS_IO = {"replace", "rename", "fsync"}
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    parts = name.split(".")
+    tail = parts[-1]
+    root = parts[0]
+    if tail in _OS_IO:
+        return "file I/O" if root == "os" else None
+    if tail == "sleep":
+        return "a sleep" if root in ("time", "sleep") else None
+    if tail in ("save", "load") and root in ("np", "numpy"):
+        return "file I/O"
+    if root == "subprocess":
+        return "a subprocess"
+    if tail == "open" and len(parts) == 1:
+        return "file I/O"
+    if tail == "join" and isinstance(call.func, ast.Attribute):
+        # thread join (0 args, or a single numeric timeout) — NOT
+        # str.join, whose one argument is an iterable
+        if not call.args and not call.keywords:
+            return "a thread join"
+        if (len(call.args) == 1 and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, (int, float))):
+            return "a thread join"
+        return None
+    return _BLOCKING_TAILS.get(tail)
+
+
+@register
+class BlockingUnderLock(Rule):
+    """SH203 — blocking work while holding a lock.
+
+    Every thread that needs the lock now queues behind a device sync /
+    file write / sleep — on the serve path this is the p99 killer the
+    drift-flush and traffic-rotation fixes in this PR removed.
+
+    bad:  with self._lock:
+              counts = jax.device_get(self._window)   # d2h under lock
+    good: swap the shared state out under the lock, do the blocking
+          work outside, merge back under the lock (loop/drift.py
+          `_flush`, loop/traffic.py `_write_chunk`).
+    """
+
+    id = "SH203"
+    severity = "error"
+    summary = ("blocking call (device sync, file/socket I/O, sleep, "
+               "thread join, event wait) inside a `with lock:` body")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        an = _analysis(ctx)
+        # a caller-holds method (`*_locked` / @guarded_by / "caller
+        # holds the lock") runs its WHOLE body under the caller's lock —
+        # scan it like a with-body
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not _caller_holds(node, module):
+                continue
+            dec = _guarded_by_decorator(node)
+            held = [dec or "(caller-held lock)"]
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        module.enclosing_function(sub) is node:
+                    reason = _blocking_reason(sub)
+                    if reason:
+                        yield self.finding(
+                            module, sub,
+                            f"`{dotted_name(sub.func) or '<call>'}` is "
+                            f"{reason} inside caller-holds method "
+                            f"`{node.name}` (runs under `{held[0]}`) — "
+                            f"move the blocking work outside the "
+                            f"locked region")
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.With):
+                continue
+            outer = [an.lock_id(module, node, it.context_expr)
+                     for it in node.items]
+            outer = [o for o in outer if o]
+            if not outer:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                # a nested def's body runs later, not under this with
+                skip = False
+                for anc in module.ancestors(sub):
+                    if anc is node:
+                        break
+                    if isinstance(anc, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        skip = True
+                        break
+                if skip:
+                    continue
+                yield from self._check_call(module, an, node, outer, sub)
+
+    def _check_call(self, module: Module, an: _Analysis,
+                    with_node: ast.With, outer: List[str],
+                    call: ast.Call) -> Iterator["Finding"]:
+        reason = _blocking_reason(call)
+        if reason:
+            yield self.finding(
+                module, call,
+                f"`{dotted_name(call.func) or '<call>'}` is {reason} "
+                f"inside `with {outer[-1]}:` — every thread needing "
+                f"the lock now waits on it; move the blocking work "
+                f"outside (swap state out under the lock)")
+            return
+        # waiting on an event/condition OTHER than the held lock while
+        # holding it: the setter may need this very lock (lost wakeup)
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("wait", "wait_for")):
+            subject = an.lock_id(module, with_node, call.func.value)
+            if subject is None or subject not in outer:
+                recv = dotted_name(call.func.value) or "<event>"
+                if self._receiver_waitable(module, an, with_node,
+                                           call.func.value):
+                    yield self.finding(
+                        module, call,
+                        f"waiting on `{recv}` while holding "
+                        f"`{outer[-1]}` — the setter may need the held "
+                        f"lock (deadlock/lost wakeup); wait outside "
+                        f"the lock")
+            return
+        # one hop: a resolvable callee that blocks directly (including
+        # caller-holds methods — their bodies run under THIS lock)
+        for callee in an._resolve_call(module, with_node, call):
+            for sub in ast.walk(callee):
+                if isinstance(sub, ast.Call):
+                    r = _blocking_reason(sub)
+                    if r:
+                        yield self.finding(
+                            module, call,
+                            f"`{getattr(callee, 'name', '?')}()` does "
+                            f"{r} (line {sub.lineno}) and is called "
+                            f"inside `with {outer[-1]}:` — hoist the "
+                            f"blocking work out of the locked region")
+                        break
+            else:
+                continue
+            break
+
+    @staticmethod
+    def _receiver_waitable(module: Module, an: _Analysis,
+                           scope: ast.AST, expr: ast.AST) -> bool:
+        """Is the wait() receiver a known Event/Condition (class attr or
+        local constructed from threading.Event/Condition)? Unknown
+        receivers are skipped — `.wait()` on arbitrary objects (futures,
+        subprocesses) has its own semantics."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            for anc in module.ancestors(scope):
+                if isinstance(anc, ast.ClassDef):
+                    info = an.classes.get(anc)
+                    return bool(info) and (attr in info.events
+                                           or attr in info.guards)
+        if isinstance(expr, ast.Name):
+            fn = module.enclosing_function(scope)
+            if fn is not None:
+                for n in ast.walk(fn):
+                    if (isinstance(n, ast.Assign)
+                            and len(n.targets) == 1
+                            and isinstance(n.targets[0], ast.Name)
+                            and n.targets[0].id == expr.id
+                            and _ctor_kind(n.value) in ("event", "cond")):
+                        return True
+        return False
+
+
+@register
+class EventConditionMisuse(Rule):
+    """SH204 — Event/Condition protocol violations.
+
+    bad:  self._cond.notify()            # outside `with self._cond:` —
+                                         # RuntimeError at runtime
+    bad:  with self._cond:
+              self._cond.wait()          # no predicate loop: spurious
+                                         # wakeups proceed on stale state
+    bad:  self._done.wait()              # unbounded: a dead setter
+                                         # parks this thread forever
+    good: notify under the condition; wait in a `while not pred:` loop;
+          give Event.wait a timeout (or justify the park inline).
+    """
+
+    id = "SH204"
+    severity = "error"
+    summary = ("notify outside the condition's lock (error) / cond.wait "
+               "without a predicate loop or unbounded Event.wait "
+               "(warning)")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        an = _analysis(ctx)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr not in ("notify", "notify_all", "wait", "wait_for"):
+                continue
+            recv = node.func.value
+            kind = self._receiver_kind(module, an, node, recv)
+            if kind is None:
+                continue
+            recv_name = dotted_name(recv) or "<sync>"
+            if attr in ("notify", "notify_all"):
+                if kind != "cond":
+                    continue
+                if not self._inside_with_of(module, an, node, recv):
+                    yield self.finding(
+                        module, node,
+                        f"`{recv_name}.{attr}()` outside `with "
+                        f"{recv_name}:` — raises RuntimeError('cannot "
+                        f"notify on un-acquired lock') at runtime")
+            elif kind == "cond" and attr == "wait":
+                if not self._inside_with_of(module, an, node, recv):
+                    yield self.finding(
+                        module, node,
+                        f"`{recv_name}.wait()` outside `with "
+                        f"{recv_name}:` — raises RuntimeError at "
+                        f"runtime")
+                elif not self._in_loop(module, node):
+                    yield self.finding(
+                        module, node,
+                        f"`{recv_name}.wait()` without a predicate "
+                        f"loop — spurious wakeups and stolen wakeups "
+                        f"proceed on stale state; use `while not "
+                        f"<predicate>: wait()`", severity="warning")
+            elif kind == "event" and attr == "wait":
+                if not node.args and not node.keywords:
+                    yield self.finding(
+                        module, node,
+                        f"unbounded `{recv_name}.wait()` — if the "
+                        f"setter thread died this parks forever; pass "
+                        f"a timeout and re-check, or justify the park "
+                        f"inline", severity="warning")
+
+    @staticmethod
+    def _receiver_kind(module: Module, an: _Analysis, node: ast.AST,
+                       recv: ast.AST) -> Optional[str]:
+        attr = _self_attr(recv)
+        if attr is not None:
+            for anc in module.ancestors(node):
+                if isinstance(anc, ast.ClassDef):
+                    info = an.classes.get(anc)
+                    if info is None:
+                        return None
+                    if attr in info.events:
+                        return "event"
+                    if info.guards.get(attr) == "cond":
+                        return "cond"
+                    return None
+            return None
+        if isinstance(recv, ast.Name):
+            fn = module.enclosing_function(node)
+            scope = [fn] if fn is not None else []
+            for s in scope:
+                for n in ast.walk(s):
+                    if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                            and isinstance(n.targets[0], ast.Name)
+                            and n.targets[0].id == recv.id):
+                        k = _ctor_kind(n.value)
+                        if k == "event":
+                            return "event"
+                        if k == "cond":
+                            return "cond"
+        return None
+
+    @staticmethod
+    def _inside_with_of(module: Module, an: _Analysis, node: ast.AST,
+                        recv: ast.AST) -> bool:
+        want = ast.dump(recv)
+        for anc in module.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    if ast.dump(item.context_expr) == want:
+                        return True
+        return False
+
+    @staticmethod
+    def _in_loop(module: Module, node: ast.AST) -> bool:
+        for anc in module.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+            if isinstance(anc, (ast.While, ast.For)):
+                return True
+        return False

@@ -203,6 +203,139 @@ class NonAtomicCheckpointWrite(Rule):
                         severity="warning")
 
 
+# ---------------------------------------------------------------------------
+# SH105 — knob catalog discipline
+# ---------------------------------------------------------------------------
+
+_GETTER_TYPES = {"get_property": "str", "get_int": "int",
+                 "get_float": "float", "get_bool": "bool"}
+_KNOBS_MODULE = os.path.join("analysis", "knobs.py")
+
+
+def _literal_key(module: Module, node: ast.AST):
+    """Resolve a knob-key argument to (key_or_glob, dynamic) — a
+    Constant string, an f-string with dynamic parts collapsed to `*`,
+    or a module-level UPPER_CASE string constant; None when the key is
+    not statically resolvable (a plain variable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.JoinedStr):
+        parts: list = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                if not parts or parts[-1] != "*":
+                    parts.append("*")
+        return "".join(parts), True
+    if isinstance(node, ast.Name):
+        # MODULE-LEVEL constants only (tree.body, not ast.walk): a
+        # same-named local inside some unrelated function must not
+        # mis-resolve a runtime-bound key and fabricate a type-mismatch
+        for n in module.tree.body:
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and n.targets[0].id == node.id
+                    and isinstance(n.value, ast.Constant)
+                    and isinstance(n.value.value, str)):
+                return n.value.value, False
+    return None, False
+
+
+@register
+class KnobCatalog(Rule):
+    """SH105 — every -Dshifu.* read must match the knob catalog
+    (analysis/knobs.py), and every declared knob must have a reader.
+
+    bad:  environment.get_int("shifu.serve.maxBatchRow", 1024)
+          # typo'd key: silently always the default
+    bad:  environment.get_int("shifu.loop.logSample", 0)
+          # declared float, read as int: "0.5" truncates to the default
+    good: environment.get_float("shifu.loop.logSample", 0.0)
+    Dynamic keys read via f-strings must literalize (dynamic part -> *)
+    to a declared glob: f"shifu.retry.{seam}.max" -> shifu.retry.*.max.
+    """
+
+    id = "SH105"
+    severity = "error"
+    summary = ("environment.get_* of an undeclared/mistyped shifu.* "
+               "knob, or a declared knob nothing reads")
+
+    def _reads(self, ctx: PackageContext):
+        """Package-wide {key_or_glob} actually read (cached per ctx)."""
+        cached = getattr(ctx, "_sh105_reads", None)
+        if cached is not None:
+            return cached
+        reads = set()
+        for m in ctx.modules:
+            if m.path.endswith(os.path.join("utils", "environment.py")):
+                continue  # the getter implementation itself
+            for node in ast.walk(m.tree):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                if dotted_name(node.func).split(".")[-1] not in _GETTER_TYPES:
+                    continue
+                key, _dyn = _literal_key(m, node.args[0])
+                if key and key.startswith("shifu."):
+                    reads.add(key)
+        ctx._sh105_reads = reads
+        return reads
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        from shifu_tpu.analysis.knobs import by_name
+
+        declared = by_name()
+        if module.path.endswith(os.path.join("utils", "environment.py")):
+            return
+        # the catalog side: declared knobs nothing in the analyzed tree
+        # reads, reported at their declaration lines (only when the
+        # catalog itself is part of the sweep, so fixture trees in tests
+        # don't spray unread-knob noise)
+        if module.path.endswith(_KNOBS_MODULE):
+            reads = self._reads(ctx)
+            for node in ast.walk(module.tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)
+                        and node.value in declared
+                        and node.value not in reads):
+                    # only the name field (first string of a _K(...) call)
+                    parent = module.parent.get(node)
+                    if (isinstance(parent, ast.Call)
+                            and parent.args and parent.args[0] is node):
+                        yield self.finding(
+                            module, node,
+                            f"knob `{node.value}` is declared in the "
+                            f"catalog but nothing reads it — remove the "
+                            f"entry or wire the read site")
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            getter = dotted_name(node.func).split(".")[-1]
+            if getter not in _GETTER_TYPES:
+                continue
+            key, _dyn = _literal_key(module, node.args[0])
+            if not key or not key.startswith("shifu."):
+                continue
+            knob = declared.get(key)
+            if knob is None:
+                yield self.finding(
+                    module, node,
+                    f"`{getter}(\"{key}\", ...)` reads a knob the "
+                    f"catalog (analysis/knobs.py) does not declare — "
+                    f"declare it (or fix the key; a typo silently "
+                    f"returns the default forever)")
+            elif getter != "get_property" and _GETTER_TYPES[getter] != \
+                    knob.type:
+                yield self.finding(
+                    module, node,
+                    f"`{getter}(\"{key}\", ...)` reads a knob declared "
+                    f"as {knob.type} — a mistyped read silently falls "
+                    f"back to the default (use get_{knob.type} or fix "
+                    f"the catalog)")
+
+
 _STREAM_ENTRY_RE = re.compile(r"(_streamed|_streaming)$|^stream_")
 _PLUMBING_PARAM_RE = re.compile(r"chunk|prefetch|feed|source|factory")
 # names that mean "this entry point iterates RAW ingest chunks" — the

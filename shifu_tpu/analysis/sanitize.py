@@ -1,8 +1,8 @@
-"""Runtime sanitizer harness: ``-Dshifu.sanitize=transfer,nan,recompile``.
+"""Runtime sanitizer harness: ``-Dshifu.sanitize=transfer,nan,recompile,race``.
 
 The static pass (engine.py) catches what the AST can see; this harness
 catches what only the runtime can — the ASan/TSan analog for a jit
-pipeline. Three opt-in modes, combined freely:
+pipeline. Four opt-in modes, combined freely:
 
   transfer   arms ``jax.transfer_guard("disallow")`` around *declared
              traced stages* (the ``transfer_free(...)`` seams in
@@ -21,6 +21,14 @@ pipeline. Three opt-in modes, combined freely:
              default 64); a breach is recorded and logged as a ledger
              warning — recompile storms are a perf bug, not a
              correctness trap, so the step still completes.
+  race       lock instrumentation (analysis/racetrack.py): every
+             ``tracked_lock(...)`` site constructed while armed records
+             per-thread acquisition stacks; lock-order inversions and
+             ``@guarded_by`` violations make the verdict unclean,
+             long holds past ``shifu.sanitize.race.holdMs`` are
+             reported (perf hazard, not gated). Arming is read at lock
+             CONSTRUCTION time, so set ``-Dshifu.sanitize=race`` before
+             building the serve/loop objects to be watched.
 
 Verdicts: ``Sanitizer.verdict()`` returns a ``shifu.sanitize/1`` dict —
 BasicProcessor.run() embeds it in the run-ledger manifest (success AND
@@ -32,19 +40,19 @@ Prometheus exports see them too.
 from __future__ import annotations
 
 import contextlib
-import threading
 from typing import Iterable, List, Optional
 
+from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.utils import environment
 from shifu_tpu.utils.log import get_logger
 
 log = get_logger(__name__)
 
 SCHEMA = "shifu.sanitize/1"
-MODES = ("transfer", "nan", "recompile")
+MODES = ("transfer", "nan", "recompile", "race")
 DEFAULT_RECOMPILE_BUDGET = 64
 
-_lock = threading.Lock()
+_lock = tracked_lock("analysis.sanitize")
 _current: Optional["Sanitizer"] = None
 
 
@@ -91,6 +99,12 @@ class Sanitizer:
         self.recompile_seconds = 0.0  # wall-clock of breached stages' compiles
         self.stages_armed = 0
         self.events: List[dict] = []
+        # race-mode scope: the verdict reports the tracker's DELTA from
+        # this sanitizer's construction (the tracker itself is
+        # process-global, like the fault-injection counters)
+        from shifu_tpu.analysis import racetrack
+
+        self._race_mark = racetrack.tracker().mark()
 
     @property
     def active(self) -> bool:
@@ -187,6 +201,17 @@ class Sanitizer:
 
     # ---- verdict
     def verdict(self) -> dict:
+        from shifu_tpu.analysis import racetrack
+
+        race_armed = "race" in self.modes
+        race = {"armed": race_armed}
+        race_dirty = 0
+        if race_armed:
+            race.update(racetrack.tracker().verdict(self._race_mark))
+            # inversions + guard violations are correctness findings;
+            # long holds are a perf hazard — reported, never gating
+            # `clean` (the recompile-watchdog contract)
+            race_dirty = race["inversions"] + race["guardViolations"]
         return {
             "schema": SCHEMA,
             "modes": sorted(self.modes),
@@ -205,9 +230,10 @@ class Sanitizer:
                 "breaches": self.recompile_breaches,
                 "breachedCompileSeconds": round(self.recompile_seconds, 3),
             },
+            "race": race,
             "events": self.events,
             "clean": not (self.transfer_trips or self.nan_trips
-                          or self.recompile_breaches),
+                          or self.recompile_breaches or race_dirty),
         }
 
     @staticmethod

@@ -195,6 +195,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_check.add_argument("--list-rules", action="store_true",
                          dest="list_rules",
                          help="print the rule catalog and exit")
+    p_check.add_argument("--knobs", action="store_true", dest="knobs",
+                         help="emit the generated -Dshifu.* knob catalog "
+                              "(docs/KNOBS.md) on stdout and exit; rule "
+                              "SH105 keeps it exact, CI diffs it against "
+                              "the committed file")
 
     p_serve = sub.add_parser(
         "serve", help="TPU-native online scoring server (HTTP JSONL: "
@@ -416,6 +421,11 @@ def dispatch(args: argparse.Namespace) -> int:
             for rid, rule in sorted(all_rules().items()):
                 print(f"{rid:<7} {rule.severity:<8} {rule.summary}")
             return 0
+        if args.knobs:
+            from shifu_tpu.analysis.knobs import render_markdown
+
+            print(render_markdown(), end="")
+            return 0
         paths = args.paths or [os.path.dirname(os.path.abspath(__file__))]
         rule_ids = (args.rules.split(",") if args.rules else None)
         try:
@@ -444,8 +454,12 @@ def dispatch(args: argparse.Namespace) -> int:
             environment.set_property("shifu.loop.logSample",
                                      args.traffic_log)
         try:
-            # parse --warm BEFORE binding the port so a typo fails the
-            # clean way, not with a traceback after "listening"
+            # parse --warm and -Dshifu.sanitize BEFORE binding the port
+            # so a typo fails the clean way, not with a traceback after
+            # "listening"
+            from shifu_tpu.analysis import sanitize
+
+            san = sanitize.from_environment()
             sizes = ([int(s) for s in args.warm.split(",") if s.strip()]
                      if args.warm else [])
             server = ScoringServer(
@@ -473,7 +487,13 @@ def dispatch(args: argparse.Namespace) -> int:
         # the bound port on stdout is the contract for scripted callers
         # (--port 0 smoke tests); logs go to stderr
         print(f"listening on {server.host}:{server.port}", flush=True)
-        server.serve_forever()
+        # -Dshifu.sanitize=... arms the runtime sanitizer for the whole
+        # serving run (the step-wrapper analog): transfer seams consult
+        # the active sanitizer, and the shutdown manifest embeds its
+        # shifu.sanitize/1 verdict — race-tracked locks were already
+        # constructed armed above, since -D parsing precedes the server
+        with sanitize.activate(san):
+            server.serve_forever()
         return 0
     if cmd == "runs":
         import json

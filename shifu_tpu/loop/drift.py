@@ -32,11 +32,11 @@ loop.drift.psi{column=}, loop.drift.max_psi, counter loop.drift.degraded.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.config import ColumnConfig
 from shifu_tpu.loop import psi_degrade_setting
 from shifu_tpu.stats.psi import psi_from_counts
@@ -116,12 +116,16 @@ class DriftMonitor:
         self.total_slots = offset
         self.numeric_cols = [c for c in self.cols if c.kind == "numeric"]
         self.coded_cols = [c for c in self.cols if c.kind == "coded"]
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("loop.drift")
         self._host = np.zeros(self.total_slots, dtype=np.float64)
         self._window = None      # f32 device window (jnp [total_slots])
         self._window_rows = 0
         self._rows = 0
         self._degraded: List[str] = []
+        # bumped by reset(): an in-flight _flush whose window was
+        # swapped out before a promotion reset describes the OLD
+        # version's traffic and must not merge into the clean slate
+        self._gen = 0
 
     @property
     def enabled(self) -> bool:
@@ -230,23 +234,35 @@ class DriftMonitor:
 
     # ---- window lifecycle ----
     def window(self):
-        """The resident device window (created on first use)."""
+        """(resident device window, generation) — created on first use.
+        Pass the generation back to note_window: a fold that straddles
+        a promotion reset() (window read -> dispatch -> adopt) would
+        otherwise reinstate the OLD version's counts into the cleared
+        monitor."""
         import jax.numpy as jnp
 
         with self._lock:
             if self._window is None:
                 self._window = jnp.zeros(self.total_slots, jnp.float32)
-            return self._window
+            return self._window, self._gen
 
-    def note_window(self, new_window, rows: int) -> None:
+    def note_window(self, new_window, rows: int,
+                    gen: Optional[int] = None) -> None:
         """Adopt the post-fold window; flush to the f64 host fold when the
-        window's row budget is spent (ONE device->host sync per window)."""
+        window's row budget is spent (ONE device->host sync per window).
+        The sync itself happens OUTSIDE the lock (SH203): a health/metrics
+        probe taking the lock must never queue behind a d2h transfer."""
         with self._lock:
+            if gen is not None and gen != self._gen:
+                # reset() landed between window() and here: this fold
+                # counted the old version's traffic — drop it
+                return
             self._window = new_window
             self._window_rows += rows
             self._rows += rows
-            if self._window_rows > WINDOW_FLUSH_ROWS:
-                self._flush_locked()
+            need_flush = self._window_rows > WINDOW_FLUSH_ROWS
+        if need_flush:
+            self._flush()
 
     def reset(self) -> None:
         """Clean slate after a promotion acted on the drift: live counts,
@@ -261,6 +277,7 @@ class DriftMonitor:
             self._window_rows = 0
             self._rows = 0
             self._degraded = []
+            self._gen += 1  # invalidate any flush already past its swap
 
     def fold_host(self, data, code_cache: Optional[dict] = None) -> None:
         """Host-side fold for non-fused registries (ModelRunner fallback).
@@ -287,21 +304,34 @@ class DriftMonitor:
             self._host += counts
             self._rows += data.n_rows
 
-    def _flush_locked(self) -> None:
+    def _flush(self) -> None:
+        """Swap-fetch-merge window flush: the device window is swapped
+        for a fresh one UNDER the lock, the d2h sync runs OUTSIDE it
+        (the lock is on the serve observer path — a blocked /metrics or
+        health probe must never serialize behind a device transfer,
+        SH203), and the fetched counts merge back under the lock.
+        Concurrent flushes each own their swapped-out window, so counts
+        are never lost or double-folded."""
         from shifu_tpu.obs import registry
 
-        if self._window is None or self._window_rows == 0:
-            if self._window is not None:
-                self._window_rows = 0
-            return
+        with self._lock:
+            window, rows = self._window, self._window_rows
+            if window is None or rows == 0:
+                return
+            import jax.numpy as jnp
+
+            self._window = jnp.zeros(self.total_slots, jnp.float32)
+            self._window_rows = 0
+            gen = self._gen
         import jax
 
-        self._host += np.asarray(jax.device_get(self._window),
-                                 dtype=np.float64)
-        import jax.numpy as jnp
-
-        self._window = jnp.zeros(self.total_slots, jnp.float32)
-        self._window_rows = 0
+        counts = np.asarray(jax.device_get(window), dtype=np.float64)
+        with self._lock:
+            if self._gen == gen:
+                self._host += counts
+            # else: reset() (a promotion) landed mid-flush — the
+            # swapped window counted the OLD version's traffic; merging
+            # it would pollute the new version's fold, so drop it
         registry().counter("loop.drift.flushes").inc()
 
     # ---- verdicts ----
@@ -309,14 +339,14 @@ class DriftMonitor:
         """Per-column PSI of the live fold vs the training distribution
         (forces a window flush — one d2h sync; call on a cadence, not per
         batch)."""
+        self._flush()
         with self._lock:
-            self._flush_locked()
-            counts = self._host
-            return {
-                c.name: psi_from_counts(
-                    c.expected, counts[c.offset: c.offset + c.n_slots])
-                for c in self.cols
-            }
+            counts = self._host.copy()
+        return {
+            c.name: psi_from_counts(
+                c.expected, counts[c.offset: c.offset + c.n_slots])
+            for c in self.cols
+        }
 
     def verdict(self) -> dict:
         """The drift summary manifests and /healthz embed; also exports

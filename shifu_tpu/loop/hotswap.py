@@ -34,11 +34,11 @@ compiles.
 
 from __future__ import annotations
 
-import threading
 from typing import List, Optional
 
 import numpy as np
 
+from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.loop import (
     shadow_sample_setting,
     shadow_tolerance_setting,
@@ -60,7 +60,7 @@ class ShadowStats:
     def __init__(self, tolerance: Optional[float] = None) -> None:
         self.tolerance = (shadow_tolerance_setting() if tolerance is None
                           else float(tolerance))
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("loop.hotswap.shadow_stats")
         self.batches = 0
         self.rows = 0
         self.agree_rows = 0
@@ -121,7 +121,7 @@ class SwappableRegistry:
     """Atomic active/shadow pair behind one `score_raw` entry point."""
 
     def __init__(self, registry: ModelRegistry) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("loop.hotswap.swap")
         self._active = registry
         self._shadow: Optional[ModelRegistry] = None
         self._shadow_stats: Optional[ShadowStats] = None
@@ -223,19 +223,28 @@ class SwappableRegistry:
 
     def observe(self, data, result) -> None:
         """Post-resolution hook (batcher observer): sample live batches
-        onto the shadow and accumulate score deltas. Never raises."""
-        shadow, stats = self._shadow, self._shadow_stats
-        if shadow is None or stats is None:
-            return
-        if self._shadow_sample <= 0.0:
-            return  # off, like TrafficLog's sample<=0 — not 1-in-a-million
-        self._shadow_tick += 1
-        if self._shadow_sample < 1.0:
-            # deterministic stride sampling: every k-th batch
-            stride = max(1, int(round(1.0 / max(self._shadow_sample,
-                                                1e-6))))
-            if self._shadow_tick % stride:
+        onto the shadow and accumulate score deltas. Never raises.
+
+        The (shadow, stats) pair is read under the lock as a UNIT: a
+        stage()/promote() landing between two bare reads could pair the
+        old candidate with the new candidate's stats and attribute
+        agreement evidence to the wrong sha (regression-pinned in
+        tests/test_racetrack.py). Scoring itself happens after release —
+        device work under the swap lock would block a concurrent
+        promote for a whole shadow dispatch (SH203)."""
+        with self._lock:
+            shadow, stats = self._shadow, self._shadow_stats
+            if shadow is None or stats is None:
                 return
+            if self._shadow_sample <= 0.0:
+                return  # off, like TrafficLog's sample<=0
+            self._shadow_tick += 1
+            if self._shadow_sample < 1.0:
+                # deterministic stride sampling: every k-th batch
+                stride = max(1, int(round(1.0 / max(self._shadow_sample,
+                                                    1e-6))))
+                if self._shadow_tick % stride:
+                    return
         try:
             shadow_res = shadow.score_raw(data)
         except Exception as e:  # candidate bugs must not hurt live traffic
@@ -251,7 +260,8 @@ class SwappableRegistry:
                    - np.asarray(result.mean))
 
     def shadow_snapshot(self) -> Optional[dict]:
-        shadow, stats = self._shadow, self._shadow_stats
+        with self._lock:  # paired read, like observe()
+            shadow, stats = self._shadow, self._shadow_stats
         if shadow is None or stats is None:
             return None
         return {"sha": shadow.sha,

@@ -42,6 +42,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from shifu_tpu.analysis.racetrack import guarded_by, tracked_lock
 from shifu_tpu.loop import log_chunk_rows_setting, log_sample_setting
 from shifu_tpu.utils.log import get_logger
 
@@ -101,12 +102,20 @@ class TrafficLog:
         self.chunk_rows = (log_chunk_rows_setting() if chunk_rows is None
                            else int(chunk_rows))
         self.seed = int(seed)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("loop.traffic")
         self._buffer: List[str] = []
         self._batches = 0
         self._chunks = 0  # chunks THIS process wrote (seq counts restarts)
         self._retire_mismatched_schema()
         self._seq = self._next_seq()
+        # chunk writes happen outside self._lock (SH203) but must LAND
+        # in sequence order: a reader (retrain --from-traffic against a
+        # live server) globs the dir sorted by seq and would silently
+        # skip chunk N's rows if N+1's smaller write raced onto disk
+        # first. Concurrent rotators serialize among themselves on this
+        # condition; recorders never touch it.
+        self._write_cond = threading.Condition()
+        self._next_write = self._seq
         self._write_meta()
 
     def _retire_mismatched_schema(self) -> None:
@@ -199,30 +208,59 @@ class TrafficLog:
                 fields.append(sha)
                 fields.append(ts)
                 self._buffer.append(DELIMITER.join(fields))
-            if len(self._buffer) >= self.chunk_rows:
-                self._rotate()
-            return len(keep)
+            pending = (self._swap_chunk()
+                       if len(self._buffer) >= self.chunk_rows else None)
+            kept = len(keep)
+        # the file write happens OUTSIDE the lock (SH203): the scoring
+        # worker's next record() must never queue behind disk I/O. The
+        # swap assigned this chunk its sequence number under the lock,
+        # so row order across files is preserved whatever order the
+        # writes land in.
+        if pending is not None:
+            self._write_chunk(*pending)
+        return kept
 
-    def _rotate(self) -> None:
-        """Write the buffered rows as the next chunk file, atomically —
-        caller holds the lock."""
+    @guarded_by("_lock")
+    def _swap_chunk(self) -> Optional[Tuple[int, str, List[str]]]:
+        """Take the buffered rows + their chunk seq/path out of the
+        shared state (caller holds the lock); the caller writes them
+        outside via _write_chunk, which lands files in seq order."""
+        if not self._buffer:
+            return None
+        seq = self._seq
+        path = os.path.join(self.dir, f"traffic-{seq:05d}.psv")
+        rows, self._buffer = self._buffer, []
+        self._seq += 1
+        self._chunks += 1
+        return seq, path, rows
+
+    def _write_chunk(self, seq: int, path: str, rows: List[str]) -> None:
         from shifu_tpu.obs import registry
         from shifu_tpu.resilience.checkpoint import atomic_write
 
-        if not self._buffer:
-            return
-        path = os.path.join(self.dir, f"traffic-{self._seq:05d}.psv")
-        atomic_write(path, ("\n".join(self._buffer) + "\n").encode("utf-8"))
+        # land in seq order: a reader globbing the dir mid-write must
+        # never see chunk N+1 without N (it would silently skip N's
+        # rows). Only concurrent rotators queue here, never recorders.
+        with self._write_cond:
+            while self._next_write != seq:
+                self._write_cond.wait(1.0)
+        try:
+            atomic_write(path, ("\n".join(rows) + "\n").encode("utf-8"))
+        finally:
+            # bump even on a failed write so later chunks are not
+            # wedged behind a disk error forever
+            with self._write_cond:
+                self._next_write = seq + 1
+                self._write_cond.notify_all()
         registry().counter("loop.traffic.chunks").inc()
-        log.debug("traffic chunk %s (%d rows)", path, len(self._buffer))
-        self._buffer = []
-        self._seq += 1
-        self._chunks += 1
+        log.debug("traffic chunk %s (%d rows)", path, len(rows))
 
     def flush(self) -> None:
         """Persist any buffered rows as a (possibly short) chunk."""
         with self._lock:
-            self._rotate()
+            pending = self._swap_chunk()
+        if pending is not None:
+            self._write_chunk(*pending)
 
     def close(self) -> None:
         self.flush()

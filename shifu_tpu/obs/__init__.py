@@ -18,8 +18,7 @@ manifest.
 
 from __future__ import annotations
 
-import threading
-
+from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.obs import profile as _profile
 from shifu_tpu.obs.ledger import RunLedger, format_runs, list_runs
 from shifu_tpu.obs.metrics import (
@@ -49,7 +48,7 @@ __all__ = [
     "tracer",
 ]
 
-_lock = threading.Lock()
+_lock = tracked_lock("obs.scope")
 _registry = MetricsRegistry()
 _tracer = Tracer()
 _run_depth = 0

@@ -14,10 +14,10 @@ data/pipeline.py DeviceAccumulator d2h).
 
 from __future__ import annotations
 
-import threading
+from shifu_tpu.analysis.racetrack import tracked_lock
 
 _installed = False
-_lock = threading.Lock()
+_lock = tracked_lock("obs.jaxprobe")
 
 # event name -> (counter to inc, timer to accumulate, duration histogram);
 # backend_compile is the actual XLA compile, jaxpr_trace fires per

@@ -25,10 +25,11 @@ from __future__ import annotations
 
 import json
 import re
-import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional, Tuple
+
+from shifu_tpu.analysis.racetrack import tracked_lock
 
 DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                    1.0, 2.5, 5.0, 10.0, float("inf"))
@@ -66,7 +67,7 @@ class Counter:
     __slots__ = ("_lock", "_value")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.metrics.counter")
         self._value = 0.0
 
     def inc(self, n: float = 1.0) -> None:
@@ -83,7 +84,7 @@ class Gauge:
     __slots__ = ("_lock", "_value")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.metrics.gauge")
         self._value = 0.0
 
     def set(self, v: float) -> None:
@@ -101,7 +102,7 @@ class Histogram:
                  "_min", "_max")
 
     def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.metrics.histogram")
         self.buckets = tuple(sorted(buckets))
         if self.buckets[-1] != float("inf"):
             self.buckets = self.buckets + (float("inf"),)
@@ -160,7 +161,7 @@ class Timer:
     __slots__ = ("_lock", "_seconds", "_calls")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.metrics.timer")
         self._seconds = 0.0
         self._calls = 0
 
@@ -194,7 +195,7 @@ class Series:
     __slots__ = ("_lock", "_points")
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.metrics.series")
         self._points: List[List[float]] = []
 
     def append(self, step: float, value: float) -> None:
@@ -216,7 +217,7 @@ class MetricsRegistry:
     """Label-aware, thread-safe registry with Prometheus + JSON exporters."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.metrics.registry")
         self._counters: Dict[Tuple[str, LabelsKey], Counter] = {}
         self._gauges: Dict[Tuple[str, LabelsKey], Gauge] = {}
         self._histograms: Dict[Tuple[str, LabelsKey], Histogram] = {}
@@ -433,7 +434,7 @@ class StageTimers:
                  prefix: str = "stage") -> None:
         self._registry = registry
         self._prefix = prefix
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.metrics.stage_timers")
         self._stages: Dict[str, Timer] = {}
 
     def _stage(self, stage: str) -> Timer:

@@ -34,6 +34,8 @@ from __future__ import annotations
 
 import threading
 import time
+
+from shifu_tpu.analysis.racetrack import tracked_lock
 from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -47,7 +49,7 @@ SCHEMA = "shifu.profile/1"
 # dispatch cache is separate), so the cap sits well above any one run's
 # working set of (program, layout, row-bucket) combinations.
 _COST_CACHE_MAX = 512
-_cost_lock = threading.Lock()
+_cost_lock = tracked_lock("obs.profile.cost_cache")
 _cost_cache: "OrderedDict[tuple, _CostEntry]" = OrderedDict()
 
 _tls = threading.local()
@@ -189,7 +191,7 @@ class ProgramProfiler:
     """Per-obs-scope accumulator (reset with the registry/tracer)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.profile.profiler")
         self._programs: Dict[str, Dict[str, Any]] = {}
 
     # ---- recording ----
@@ -330,7 +332,7 @@ _profiler = ProgramProfiler()
 # program-shaping annotations: process-global on purpose (see
 # ProgramProfiler.annotate) — reset() preserves them, like _cost_cache
 _annotations_store: Dict[str, Dict[str, Any]] = {}
-_ann_lock = threading.Lock()
+_ann_lock = tracked_lock("obs.profile.annotations")
 
 
 def profiler() -> ProgramProfiler:

@@ -16,13 +16,15 @@ import json
 import os
 import threading
 import time
+
+from shifu_tpu.analysis.racetrack import tracked_lock
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional
 
 
 class Tracer:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("obs.tracing")
         self._events: List[dict] = []
         self._local = threading.local()
         # one wall-clock anchor so perf_counter offsets render as absolute-ish

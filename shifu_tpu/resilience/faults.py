@@ -47,13 +47,13 @@ it). Both land in the run-ledger manifest with the rest of the registry.
 from __future__ import annotations
 
 import signal
-import threading
 import time
 import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.utils import environment
 from shifu_tpu.utils.log import get_logger
 
@@ -184,7 +184,7 @@ class FaultPlan:
         self.clauses = clauses
         self.spec = spec
         self._counts: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("resilience.faults.plan")
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -236,7 +236,7 @@ class FaultPlan:
 # process-global plan (environment-armed) + test override
 # ---------------------------------------------------------------------------
 
-_lock = threading.Lock()
+_lock = tracked_lock("resilience.faults.module")
 _plan: Optional[FaultPlan] = None
 _plan_spec: Optional[str] = None
 _override: Optional[FaultPlan] = None

@@ -42,6 +42,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.data.reader import ColumnarData
 from shifu_tpu.eval.scorer import ScoreResult
 from shifu_tpu.serve.health import HealthMonitor
@@ -208,7 +209,7 @@ class MicroBatcher:
         # (t_done, n_requests) per completed batch; the lock covers the
         # worker's append racing retry_after_seconds() on handler threads
         self._drain_log: deque = deque(maxlen=64)
-        self._drain_lock = threading.Lock()
+        self._drain_lock = tracked_lock("serve.batcher.drain_log")
         self._worker = self._spawn()
 
     def _spawn(self) -> threading.Thread:

@@ -21,7 +21,8 @@ the run-ledger manifest carries the replica's health history.
 
 from __future__ import annotations
 
-import threading
+
+from shifu_tpu.analysis.racetrack import guarded_by, tracked_lock
 
 OK = "ok"
 DEGRADED = "degraded"
@@ -34,7 +35,7 @@ class HealthMonitor:
     """Thread-safe tri-state health with crash-recovery hysteresis."""
 
     def __init__(self, ok_after: int = DEFAULT_OK_AFTER) -> None:
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("serve.health")
         self._state = OK
         self._reason = ""
         self._ok_after = max(1, ok_after)
@@ -47,8 +48,9 @@ class HealthMonitor:
         self._crash_degraded = False
         self._crash_reason = ""
 
+    @guarded_by("_lock")
     def _transition(self, state: str, reason: str) -> None:
-        # caller holds the lock
+        # caller holds the lock (declared + race-checked via @guarded_by)
         if self._state == state:
             self._reason = reason
             return

@@ -469,8 +469,11 @@ class ModelRegistry:
             # double-counts sampled batches.
             import jax.numpy as jnp
 
-            window = (self.drift.window() if self.drift_live
-                      else jnp.zeros(self.drift.total_slots, jnp.float32))
+            if self.drift_live:
+                window, drift_gen = self.drift.window()
+            else:
+                window = jnp.zeros(self.drift.total_slots, jnp.float32)
+                drift_gen = None
             dev_inputs, drift_put = jax.device_put(
                 (tuple(plan_inputs), drift_host))
             drift_dev = tuple(drift_put) + (window,)
@@ -479,7 +482,7 @@ class ModelRegistry:
                                        dev_inputs, drift_dev, sync=True)
             m, mean, mx, mn, med = jax.device_get(out[:5])
             if self.drift_live:
-                self.drift.note_window(out[5], n)
+                self.drift.note_window(out[5], n, gen=drift_gen)
                 reg.counter("loop.drift.rows").inc(n)
         else:
             dev_inputs = jax.device_put(tuple(plan_inputs))

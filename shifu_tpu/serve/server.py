@@ -33,6 +33,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Sequence
 
+from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.eval.scorer import ScoreResult
 from shifu_tpu.serve.batcher import MicroBatcher
 from shifu_tpu.serve.health import DRAINING, HealthMonitor
@@ -200,7 +201,7 @@ class ScoringServer:
             observer=self._observe, extra_columns=label_cols)
         self.started_at = time.time()
         self._serve_thread: Optional[threading.Thread] = None
-        self._shutdown_lock = threading.Lock()
+        self._shutdown_lock = tracked_lock("serve.server.shutdown")
         self._shutdown_started = False
         self._shutdown_done = threading.Event()
         self.httpd = ThreadingHTTPServer((host, port),
@@ -431,7 +432,9 @@ class ScoringServer:
     def serve_forever(self) -> None:
         """Foreground serving (the CLI path); returns after shutdown()."""
         self.start()
-        self._shutdown_done.wait()
+        # the foreground park IS the contract: shutdown() sets the event
+        # in its finally on every path, including a mid-drain crash
+        self._shutdown_done.wait()  # shifu: noqa[SH204] park by design
 
     def shutdown(self, drain_timeout: float = 30.0) -> Optional[str]:
         """Reject-new -> drain in-flight -> stop HTTP -> write manifest.
@@ -473,6 +476,15 @@ class ScoringServer:
                 log.warning("cannot snapshot profiler: %s", pe)
                 profile_snap = None
             extra = {"serve": self.registry.snapshot()}
+            from shifu_tpu.analysis import sanitize
+
+            san = sanitize.current()
+            if san is not None and san.active:
+                # the serving analog of BasicProcessor.run's embed: the
+                # shutdown manifest carries the shifu.sanitize/1 verdict
+                # (incl. the race tracker's inversions/guard violations
+                # under -Dshifu.sanitize=race) for the whole serve run
+                extra["sanitizer"] = san.verdict()
             if self.drift is not None:
                 # final flush: the shutdown manifest carries the full
                 # per-column PSI state of everything this replica served

@@ -400,6 +400,375 @@ class TestHygieneRules:
 
 
 # ---------------------------------------------------------------------------
+# concurrency rules (SH201-SH204) + knob catalog (SH105)
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrencyRules:
+    def test_sh201_unguarded_mutation_of_guarded_attr(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def read(self):
+                    with self._lock:
+                        return self._n
+
+                def double(self):
+                    with self._lock:
+                        self._n *= 2
+
+                def reset(self):
+                    self._n = 0
+        """, rules=["SH201"])
+        (line,) = rule_lines(findings, "SH201")
+        f = [x for x in findings if x.rule == "SH201"][0]
+        assert "self._n" in f.message and "C._lock" in f.message
+        assert "reset" in f.message
+
+    def test_sh201_exemptions_init_guardedby_lockedname(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+            from shifu_tpu.analysis.racetrack import guarded_by
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0              # construction: exempt
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def read(self):
+                    with self._lock:
+                        return self._n
+
+                @guarded_by("_lock")
+                def reset(self):
+                    self._n = 0              # declared caller-holds
+
+                def _clear_locked(self):
+                    self._n = 0              # *_locked convention
+        """, rules=["SH201"])
+        assert rule_lines(findings, "SH201") == []
+
+    def test_sh201_unlocked_attrs_not_inferred(self, tmp_path):
+        # an attribute never accessed under the lock has no inferred
+        # discipline — the rule must not invent one
+        findings = check_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.free = 0
+
+                def a(self):
+                    self.free += 1
+
+                def b(self):
+                    self.free = 2
+        """, rules=["SH201"])
+        assert rule_lines(findings, "SH201") == []
+
+    def test_sh201_thread_reachability_in_message(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = []
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self._q.append(1)        # mutation on the worker
+
+                def put(self, x):
+                    with self._lock:
+                        self._q.append(x)
+
+                def drain(self):
+                    with self._lock:
+                        out, self._q = self._q, []
+                    return out
+        """, rules=["SH201"])
+        (f,) = [x for x in findings if x.rule == "SH201"]
+        assert "thread-reachable" in f.message
+        assert "Thread(target=...)" in f.message
+
+    def test_sh202_inverted_nesting_is_a_cycle(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def one():
+                with _a:
+                    with _b:
+                        pass
+
+            def two():
+                with _b:
+                    with _a:
+                        pass
+        """, rules=["SH202"])
+        assert len(rule_lines(findings, "SH202")) == 2  # both edges
+        f = [x for x in findings if x.rule == "SH202"][0]
+        assert "._a" in f.message and "._b" in f.message
+
+    def test_sh202_one_hop_through_a_call(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def _take_a(self):
+                    with self._a:
+                        pass
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        self._take_a()
+        """, rules=["SH202"])
+        assert len(rule_lines(findings, "SH202")) >= 1
+
+    def test_sh202_consistent_order_is_clean(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+
+            _a = threading.Lock()
+            _b = threading.Lock()
+
+            def one():
+                with _a:
+                    with _b:
+                        pass
+
+            def two():
+                with _a:
+                    with _b:
+                        pass
+        """, rules=["SH202"])
+        assert rule_lines(findings, "SH202") == []
+
+    def test_sh203_blocking_under_lock(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+            import time
+
+            import jax
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._w = None
+                    self._done = threading.Event()
+
+                def flush_bad(self):
+                    with self._lock:
+                        return jax.device_get(self._w)
+
+                def flush_good(self):
+                    with self._lock:
+                        w = self._w
+                    return jax.device_get(w)
+
+                def nap(self):
+                    with self._lock:
+                        time.sleep(0.5)
+
+                def park(self):
+                    with self._lock:
+                        self._done.wait(1.0)
+        """, rules=["SH203"])
+        lines = rule_lines(findings, "SH203")
+        assert len(lines) == 3
+        msgs = " | ".join(f.message for f in findings
+                          if f.rule == "SH203")
+        assert "device" in msgs and "sleep" in msgs
+        assert "waiting on" in msgs  # event wait while holding the lock
+
+    def test_sh203_caller_holds_body_and_one_hop(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+
+            from shifu_tpu.resilience.checkpoint import atomic_write
+
+            class T:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._buf = []
+
+                def _rotate_locked(self):
+                    atomic_write("p", b"x")   # runs under caller's lock
+
+                def flush(self):
+                    with self._lock:
+                        self._rotate_locked()
+        """, rules=["SH203"])
+        # flagged in the caller-holds body AND at the locked call site
+        assert len(rule_lines(findings, "SH203")) == 2
+
+    def test_sh203_condition_wait_is_exempt(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._items = []
+
+                def get(self):
+                    with self._cond:
+                        while not self._items:
+                            self._cond.wait()
+                        return self._items.pop()
+        """, rules=["SH203"])
+        assert rule_lines(findings, "SH203") == []
+
+    def test_sh204_notify_and_wait_protocols(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._done = threading.Event()
+                    self._flag = False
+
+                def wake_bad(self):
+                    self._cond.notify_all()
+
+                def wake_ok(self):
+                    with self._cond:
+                        self._cond.notify()
+
+                def wait_noloop(self):
+                    with self._cond:
+                        self._cond.wait()
+
+                def wait_ok(self):
+                    with self._cond:
+                        while not self._flag:
+                            self._cond.wait()
+
+                def park_bad(self):
+                    self._done.wait()
+
+                def park_ok(self):
+                    return self._done.wait(1.0)
+        """, rules=["SH204"])
+        errors = [f for f in findings if f.rule == "SH204"
+                  and f.severity == "error"]
+        warnings = [f for f in findings if f.rule == "SH204"
+                    and f.severity == "warning"]
+        assert len(errors) == 1            # notify outside the lock
+        assert "notify_all" in errors[0].message
+        assert len(warnings) == 2          # no-loop wait + unbounded park
+        msgs = " | ".join(w.message for w in warnings)
+        assert "predicate loop" in msgs and "unbounded" in msgs
+
+
+class TestKnobCatalog:
+    def test_sh105_undeclared_and_mistyped(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            from shifu_tpu.utils import environment
+
+            def knobs():
+                a = environment.get_int("shifu.serve.maxBatchRow", 1)
+                b = environment.get_int("shifu.loop.logSample", 0)
+                c = environment.get_float("shifu.loop.logSample", 0.0)
+                d = environment.get_property("shifu.serve.maxWaitMs", "")
+                e = environment.get_int("shifu.serve.maxBatchRows", 1024)
+                return a, b, c, d, e
+        """, rules=["SH105"])
+        msgs = [f.message for f in findings if f.rule == "SH105"]
+        assert len(msgs) == 2
+        assert any("does not declare" in m for m in msgs)   # typo'd key
+        assert any("declared as float" in m for m in msgs)  # get_int
+
+    def test_sh105_dynamic_keys_and_constants(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            from shifu_tpu.utils import environment
+
+            PROP = "shifu.faults"
+
+            def read(seam):
+                a = environment.get_property(PROP, "")
+                b = environment.get_int(f"shifu.retry.{seam}.max", 3)
+                c = environment.get_float(f"shifu.bogus.{seam}.x", 0.0)
+                return a, b, c
+        """, rules=["SH105"])
+        msgs = [f.message for f in findings if f.rule == "SH105"]
+        assert len(msgs) == 1
+        assert "shifu.bogus.*.x" in msgs[0]
+
+    def test_sh105_unread_declared_knob_flagged_in_catalog(self, tmp_path):
+        # a fixture "catalog" (path ends analysis/knobs.py) declaring a
+        # real knob that nothing in the fixture tree reads
+        pkg = tmp_path / "analysis"
+        pkg.mkdir()
+        (pkg / "knobs.py").write_text(textwrap.dedent("""
+            KNOBS = [_K("shifu.loop.appendTrees", "int", "10", "doc")]
+        """))
+        findings = analyze([str(tmp_path)], rule_ids=["SH105"])
+        (f,) = [x for x in findings if x.rule == "SH105"]
+        assert "nothing reads it" in f.message
+        # ... and with a reader present, it is clean
+        (tmp_path / "reader.py").write_text(textwrap.dedent("""
+            from shifu_tpu.utils import environment
+
+            def n():
+                return environment.get_int("shifu.loop.appendTrees", 10)
+        """))
+        findings = analyze([str(tmp_path)], rule_ids=["SH105"])
+        assert [x for x in findings if x.rule == "SH105"] == []
+
+    def test_knobs_markdown_committed_file_is_fresh(self):
+        """CI staleness gate: docs/KNOBS.md must equal the generated
+        catalog (regenerate with `shifu check --knobs > docs/KNOBS.md`)."""
+        from shifu_tpu.analysis.knobs import render_markdown
+
+        import shifu_tpu
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(shifu_tpu.__file__)))
+        path = os.path.join(repo, "docs", "KNOBS.md")
+        with open(path) as fh:
+            committed = fh.read()
+        assert committed == render_markdown(), (
+            "docs/KNOBS.md is stale — regenerate with "
+            "`python -m shifu_tpu check --knobs > docs/KNOBS.md`")
+
+    def test_knobs_cli_flag(self, tmp_path, capsys):
+        from shifu_tpu.cli import main
+
+        assert main(["check", "--knobs"]) == 0
+        out = capsys.readouterr().out
+        assert "shifu.sanitize.race.holdMs" in out
+        assert out.startswith("# `-Dshifu.*` knob catalog")
+
+
+# ---------------------------------------------------------------------------
 # self-check: the shipped tree is clean (the at-merge acceptance bar)
 # ---------------------------------------------------------------------------
 
@@ -432,7 +801,7 @@ class TestSanitizer:
             assert sanitize.modes_from_environment() == ["transfer", "nan"]
             environment.set_property("shifu.sanitize", "all")
             assert set(sanitize.modes_from_environment()) == {
-                "transfer", "nan", "recompile"}
+                "transfer", "nan", "recompile", "race"}
             environment.set_property("shifu.sanitize", "transfr")
             with pytest.raises(ValueError, match="unknown mode"):
                 sanitize.modes_from_environment()
@@ -524,7 +893,8 @@ class TestSanitizer:
         v = sanitize.Sanitizer(["transfer", "nan", "recompile"]).verdict()
         assert v["schema"] == "shifu.sanitize/1"
         assert set(v) == {"schema", "modes", "stagesArmed", "transfer",
-                          "nan", "recompile", "events", "clean"}
+                          "nan", "recompile", "race", "events", "clean"}
+        assert v["race"] == {"armed": False}
         assert v["clean"] is True
 
 

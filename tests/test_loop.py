@@ -333,6 +333,52 @@ class TestDriftMonitor:
         assert len(glob.glob(os.path.join(
             ledger_root, ".shifu/runs/recommend-*.json"))) == 1
 
+    def test_reset_mid_flush_drops_old_window_counts(
+            self, model_set, column_configs, monkeypatch):
+        """A promotion reset() landing while a window flush is between
+        its swap (under the lock) and its merge-back must DROP the old
+        version's counts instead of resurrecting them into the zeroed
+        host fold — the new version's PSI must start from a clean
+        slate."""
+        import jax
+        import jax.numpy as jnp
+
+        from shifu_tpu.loop.drift import DriftMonitor
+
+        mon = DriftMonitor(column_configs, threshold=0.2, min_rows=64)
+        assert mon.enabled
+        mon.note_window(jnp.ones(mon.total_slots, jnp.float32), 8)
+        real_get = jax.device_get
+        fired = []
+
+        def reset_then_get(x):
+            if not fired:
+                fired.append(1)
+                mon.reset()  # the promotion, exactly mid-flush
+            return real_get(x)
+
+        monkeypatch.setattr(jax, "device_get", reset_then_get)
+        mon._flush()
+        assert fired
+        assert float(mon._host.sum()) == 0.0  # old counts dropped
+        # and post-reset traffic still folds normally
+        monkeypatch.setattr(jax, "device_get", real_get)
+        mon.note_window(jnp.ones(mon.total_slots, jnp.float32), 8)
+        mon._flush()
+        assert float(mon._host.sum()) == float(mon.total_slots)
+        # the fold-ADOPTION path is guarded the same way: a window read
+        # before the reset must not be adopted after it (the registry
+        # passes window()'s generation back through note_window)
+        _w, gen = mon.window()
+        mon.reset()
+        mon.note_window(jnp.full(mon.total_slots, 7.0, jnp.float32), 8,
+                        gen=gen)
+        assert mon._rows == 0 and mon._window is None  # stale: dropped
+        w, gen = mon.window()
+        mon.note_window(w + 1.0, 8, gen=gen)  # current gen: adopted
+        mon._flush()
+        assert float(mon._host.sum()) == float(mon.total_slots)
+
     def test_reset_reopens_the_degrade_loop(self, model_set,
                                             column_configs, tmp_path):
         """After a promote acts on the recommendation, reset() clears the

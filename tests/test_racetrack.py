@@ -1,0 +1,574 @@
+"""-Dshifu.sanitize=race: tracked locks, guarded_by, and the concurrency
+fix regressions (ISSUE 10 acceptance).
+
+Covers: the unarmed zero-overhead contract (tracked_lock returns a plain
+threading.Lock), barrier/event-driven interleavings that force a
+lock-order inversion and a mutate-without-lock violation and assert the
+verdict NAMES the locks/attribute, long-hold detection under the
+shifu.sanitize.race.holdMs knob, the Sanitizer verdict delta scoping —
+and targeted regressions for the races this PR fixed (metrics
+labeled-child creation, traffic rotation vs snapshot, batcher
+restart-while-draining, hotswap stage-during-observe evidence
+attribution) plus the serve+traffic-log+promote concurrent soak
+running race-armed with a clean verdict.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu.analysis import racetrack
+from shifu_tpu.utils import environment
+
+
+@pytest.fixture()
+def armed():
+    """Force race arming + a clean tracker for one test."""
+    tr = racetrack.tracker()
+    tr.reset()
+    racetrack.arm(True)
+    yield tr
+    racetrack.arm(None)
+    tr.reset()
+
+
+class _Props:
+    def __init__(self, **props):
+        self.props = {k.replace("_", "."): v for k, v in props.items()}
+
+    def __enter__(self):
+        for k, v in self.props.items():
+            environment.set_property(k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k in self.props:
+            environment.set_property(k, "")
+
+
+# ---------------------------------------------------------------------------
+# tracked_lock: arming contract
+# ---------------------------------------------------------------------------
+
+
+class TestArming:
+    def test_unarmed_returns_plain_lock(self):
+        racetrack.arm(False)
+        try:
+            lk = racetrack.tracked_lock("test.plain")
+            assert not isinstance(lk, racetrack.TrackedLock)
+            assert isinstance(lk, type(threading.Lock()))
+        finally:
+            racetrack.arm(None)
+
+    def test_environment_arms_construction(self):
+        with _Props(shifu_sanitize="race"):
+            lk = racetrack.tracked_lock("test.env")
+        assert isinstance(lk, racetrack.TrackedLock)
+        with _Props(shifu_sanitize="transfer,nan"):
+            lk2 = racetrack.tracked_lock("test.env2")
+        assert not isinstance(lk2, racetrack.TrackedLock)
+        with _Props(shifu_sanitize="all"):
+            assert isinstance(racetrack.tracked_lock("test.env3"),
+                              racetrack.TrackedLock)
+
+    def test_guarded_by_unarmed_is_passthrough_behavior(self):
+        calls = []
+
+        class C:
+            _lock = None
+
+            @racetrack.guarded_by("_lock")
+            def m(self):
+                calls.append(1)
+
+        racetrack.arm(False)
+        try:
+            C().m()
+        finally:
+            racetrack.arm(None)
+        assert calls == [1]
+        assert C.m.__shifu_guarded_by__ == "_lock"
+
+
+# ---------------------------------------------------------------------------
+# inversion + guarded-state + long holds: the detector fires with names
+# ---------------------------------------------------------------------------
+
+
+class TestDetectors:
+    def test_inverted_order_flagged_with_both_lock_names(self, armed):
+        a = racetrack.TrackedLock("test.lockA")
+        b = racetrack.TrackedLock("test.lockB")
+        first_done = threading.Event()
+        errs = []
+
+        def t1():
+            try:
+                with a:
+                    with b:
+                        pass
+            finally:
+                first_done.set()
+
+        def t2():
+            # event-sequenced, not simultaneous: the inversion is a
+            # WITNESSED ORDER property, so no real deadlock is needed
+            # to flag it (that is the point of the sanitizer)
+            assert first_done.wait(5)
+            with b:
+                with a:
+                    pass
+
+        ts = [threading.Thread(target=t1), threading.Thread(target=t2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        assert not errs
+        v = armed.verdict()
+        assert v["inversions"] == 1
+        (ev,) = v["inversionEvents"]
+        assert ev["locks"] == ["test.lockA", "test.lockB"]
+        # both witnessed orders, each with its acquisition sites
+        assert set(ev["order"]) == {"test.lockA -> test.lockB",
+                                    "test.lockB -> test.lockA"}
+        for site in ev["order"].values():
+            assert "test_racetrack.py" in site
+
+    def test_consistent_order_is_clean(self, armed):
+        a = racetrack.TrackedLock("test.okA")
+        b = racetrack.TrackedLock("test.okB")
+
+        def go():
+            for _ in range(50):
+                with a:
+                    with b:
+                        pass
+
+        ts = [threading.Thread(target=go) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        v = armed.verdict()
+        assert v["inversions"] == 0
+        assert v["acquisitions"] >= 400
+
+    def test_same_name_instances_never_invert(self, armed):
+        # two labeled metric locks share a name class: nesting them in
+        # either order must not report an inversion (no order exists
+        # between instances of one class)
+        a = racetrack.TrackedLock("test.same")
+        b = racetrack.TrackedLock("test.same")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert armed.verdict()["inversions"] == 0
+
+    def test_guarded_violation_names_lock_attr_method(self, armed):
+        class Counter:
+            def __init__(self):
+                self._lock = racetrack.tracked_lock("test.guarded")
+                self.n = 0
+
+            @racetrack.guarded_by("_lock")
+            def bump_locked(self):
+                self.n += 1
+
+            def bump_correctly(self):
+                with self._lock:
+                    self.bump_locked()
+
+        c = Counter()
+        c.bump_correctly()
+        assert armed.verdict()["guardViolations"] == 0
+
+        # force the mutate-without-lock interleaving: another thread
+        # HOLDS the lock while this thread calls the guarded method —
+        # lock.locked() is True, so only per-thread ownership tracking
+        # can catch it
+        holding = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with c._lock:
+                holding.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert holding.wait(5)
+        c.bump_locked()  # violating call on the MAIN thread
+        release.set()
+        t.join(5)
+        v = armed.verdict()
+        assert v["guardViolations"] == 1
+        (ev,) = v["guardViolationEvents"]
+        assert ev["lock"] == "test.guarded"
+        assert ev["attr"] == "_lock"
+        assert ev["method"].endswith("bump_locked")
+
+    def test_long_hold_recorded_not_gating(self, armed):
+        from shifu_tpu.analysis.sanitize import Sanitizer
+
+        with _Props(**{"shifu_sanitize_race_holdMs": "1"}):
+            san = Sanitizer(["race"])
+            lk = racetrack.TrackedLock("test.slow")
+            with lk:
+                time.sleep(0.02)
+            v = san.verdict()
+        assert v["race"]["longHolds"] == 1
+        ev = v["race"]["longHoldEvents"][0]
+        assert ev["lock"] == "test.slow"
+        assert ev["heldMs"] >= 1.0
+        # perf hazard, not a correctness trap: verdict stays clean
+        assert v["clean"] is True
+
+    def test_event_cap_limits_details_never_counts(self, armed,
+                                                   monkeypatch):
+        """MAX_EVENTS bounds the detail lists, NOT the counts: a
+        delta-scoped sanitizer built after the cap is hit must still
+        report violations that happen on its watch."""
+        monkeypatch.setattr(racetrack, "MAX_EVENTS", 3)
+        from shifu_tpu.analysis.sanitize import Sanitizer
+
+        class C:
+            def __init__(self):
+                self._lock = racetrack.tracked_lock("test.capped")
+
+            @racetrack.guarded_by("_lock")
+            def bump_locked(self):
+                pass
+
+        c = C()
+        for _ in range(5):
+            c.bump_locked()
+        v = armed.verdict()
+        assert v["guardViolations"] == 5           # count uncapped
+        assert len(v["guardViolationEvents"]) == 3  # details capped
+        san = Sanitizer(["race"])  # mark taken PAST the detail cap
+        c.bump_locked()
+        v = san.verdict()["race"]
+        assert v["guardViolations"] == 1
+        assert v["guardViolationEvents"] == []  # detail was dropped
+
+    def test_sanitizer_delta_scoping_and_unclean_on_inversion(self, armed):
+        from shifu_tpu.analysis.sanitize import Sanitizer
+
+        a = racetrack.TrackedLock("test.dA")
+        b = racetrack.TrackedLock("test.dB")
+        with a:
+            with b:
+                pass
+        san = Sanitizer(["race"])  # mark taken here: prior edge excluded
+        with b:
+            with a:
+                pass
+        v = san.verdict()
+        assert v["race"]["armed"] is True
+        assert v["race"]["inversions"] == 1
+        assert v["clean"] is False
+        # a REPEAT of an already-recorded inversion on a LATER
+        # sanitizer's watch still counts: details dedup per pair,
+        # occurrence counts never do — step 2's manifest must not
+        # report clean because step 1 saw the pair first
+        san2 = Sanitizer(["race"])
+        with b:
+            with a:
+                pass
+        v2 = san2.verdict()
+        assert v2["race"]["inversions"] == 1
+        assert v2["race"]["inversionEvents"] == []  # detail deduped
+        assert v2["clean"] is False
+
+
+# ---------------------------------------------------------------------------
+# regressions for the races this PR fixed
+# ---------------------------------------------------------------------------
+
+
+class TestFixedRaces:
+    def test_metrics_labeled_child_creation_is_single_instance(self):
+        """obs/metrics audit: N threads racing get-or-create on the same
+        labeled child must share ONE metric and lose no increments."""
+        from shifu_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        barrier = threading.Barrier(8)
+
+        def hammer(i):
+            barrier.wait(5)
+            for k in range(200):
+                reg.counter("race.c", shard=str(k % 3)).inc()
+
+        ts = [threading.Thread(target=hammer, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        snap = reg.snapshot()["counters"]
+        total = sum(v for k, v in snap.items() if k.startswith("race.c"))
+        assert total == 8 * 200
+        assert len([k for k in snap if k.startswith("race.c")]) == 3
+
+    def test_traffic_rotation_vs_snapshot_vs_record(self, tmp_path):
+        """loop/traffic fix: rotation writes files OUTSIDE the lock; rows
+        from concurrent recorders all land exactly once, frames intact."""
+        from shifu_tpu.loop.traffic import TrafficLog, list_chunks
+
+        cols = ["a", "b", "shifu_score_mean", "shifu_model_sha",
+                "shifu_ts"]
+        tl = TrafficLog(str(tmp_path), cols, sample=1.0, chunk_rows=16)
+
+        class _Data:
+            def __init__(self, n):
+                self.n_rows = n
+                self.raw = {"a": np.full(n, "1", object),
+                            "b": np.full(n, "x|y\n", object)}
+
+            def column(self, c):
+                return self.raw[c]
+
+        class _Res:
+            def __init__(self, n):
+                self.mean = np.arange(n, dtype=float)
+
+        stop = threading.Event()
+        snaps = []
+
+        def prober():
+            while not stop.is_set():
+                snaps.append(tl.snapshot())
+                tl.flush()
+
+        def recorder():
+            for _ in range(40):
+                tl.record(_Data(7), _Res(7), sha="s")
+
+        ts = [threading.Thread(target=recorder) for _ in range(4)]
+        probe = threading.Thread(target=prober)
+        probe.start()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        stop.set()
+        probe.join(10)
+        tl.close()
+        lines = []
+        for path in list_chunks(str(tmp_path)):
+            with open(path) as fh:
+                lines.extend(fh.read().splitlines())
+        assert len(lines) == 4 * 40 * 7  # every row exactly once
+        assert all(len(ln.split("|")) == len(cols) for ln in lines)
+
+    def test_batcher_restart_while_draining_answers_everything(self):
+        """serve/batcher audit: worker crashes racing a drain — every
+        admitted request still gets an individual answer, join returns."""
+        from shifu_tpu.serve.batcher import MicroBatcher
+        from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
+
+        def crash(_data):
+            raise AssertionError("boom")  # non-Exception-safe worker kill
+
+        admission = AdmissionQueue(64)
+        mb = MicroBatcher(crash, admission, max_wait_ms=0.5,
+                          max_restarts=2)
+
+        class _Data:
+            n_rows = 1
+            names = ["a"]
+            raw = {"a": np.asarray(["1"], object)}
+            missing_values = ()
+
+            def column(self, _c):
+                return self.raw["a"]
+
+        reqs = []
+        shed = 0
+        for i in range(32):
+            try:
+                reqs.append(mb.submit(_Data()))
+            except RejectedError:
+                shed += 1
+            if i == 10:
+                admission.close()  # drain starts WHILE crashes burn the
+                # restart budget
+        mb.join(20)
+        answered = 0
+        for r in reqs:
+            with pytest.raises(Exception):
+                r.wait(10)
+            answered += 1
+        assert answered == len(reqs)  # zero admitted-but-unanswered
+
+    def test_traffic_chunk_files_land_in_sequence_order(self, tmp_path):
+        """loop/traffic: chunk writes happen outside the lock, but a
+        reader globbing the dir must never see chunk N+1 without N —
+        the later rotator's write waits for the earlier seq to land."""
+        from shifu_tpu.loop.traffic import TrafficLog
+
+        cols = ["a", "shifu_score_mean", "shifu_model_sha", "shifu_ts"]
+        tl = TrafficLog(str(tmp_path), cols, sample=1.0, chunk_rows=4)
+        with tl._lock:
+            tl._buffer = ["0|0|s|0"] * 4
+            first = tl._swap_chunk()
+            tl._buffer = ["1|1|s|1"] * 4
+            second = tl._swap_chunk()
+        t = threading.Thread(target=lambda: tl._write_chunk(*second))
+        t.start()
+        time.sleep(0.1)
+        # second chunk requested first, but must wait for the first seq
+        assert not os.path.exists(second[1])
+        tl._write_chunk(*first)
+        t.join(10)
+        assert os.path.exists(first[1]) and os.path.exists(second[1])
+
+    def test_hotswap_stage_during_observe_keeps_evidence_with_scorer(
+            self, tmp_path):
+        """loop/hotswap fix: observe() reads (shadow, stats) under the
+        lock as a unit, so a stage() landing while a shadow dispatch is
+        in flight cannot attribute candidate A's agreement rows to
+        candidate B's fresh stats — B's promote gate starts from zero
+        evidence, whatever A had accumulated."""
+        from shifu_tpu.loop.hotswap import SwappableRegistry
+        from shifu_tpu.serve.registry import ModelRegistry
+
+        cols = [f"c{i}" for i in range(4)]
+        with _Props(shifu_loop_shadowSample="1.0"):
+            sw = SwappableRegistry(ModelRegistry(
+                _nn_models(str(tmp_path / "models"), cols)))
+            sw.stage(_nn_models(str(tmp_path / "candA"), cols,
+                                bias=1e-3))
+            stats_a = sw._shadow_stats
+
+            class _Res:
+                mean = np.asarray([500.0, 500.0])
+
+            class _Data:
+                n_rows = 2
+
+            entered = threading.Event()
+            release = threading.Event()
+
+            def blocking_score(_data):
+                entered.set()
+                assert release.wait(10)
+                return _Res()
+
+            sw._shadow.score_raw = blocking_score
+            t = threading.Thread(
+                target=lambda: sw.observe(_Data(), _Res()))
+            t.start()
+            assert entered.wait(10)
+            # candidate B staged while A's shadow dispatch is in flight
+            sw.stage(_nn_models(str(tmp_path / "candB"), cols,
+                                bias=2e-3))
+            release.set()
+            t.join(10)
+            # A's rows landed in A's stats; B's evidence is untouched
+            assert stats_a.snapshot()["rows"] == 2
+            assert sw.shadow_snapshot()["rows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serve + traffic-log + promote soak, race armed, clean verdict
+# ---------------------------------------------------------------------------
+
+
+def _nn_models(path, cols, seed=0, bias=0.0):
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+
+    os.makedirs(path, exist_ok=True)
+    sizes = [len(cols), 4, 1]
+    params = init_params(sizes, seed=seed)
+    params[-1]["b"] = np.asarray(params[-1]["b"]) + bias
+    NNModelSpec(layer_sizes=sizes, activations=["tanh"],
+                input_columns=cols,
+                norm_specs=[{"name": c, "kind": "value", "outNames": [c],
+                             "mean": 0.0, "std": 1.0, "fill": 0.0,
+                             "zscore": True} for c in cols],
+                params=params).save(os.path.join(path, "model0.nn"))
+    return path
+
+
+class TestSoak:
+    def test_serve_traffic_promote_soak_is_clean(self, tmp_path):
+        """The tier-1-fast seeded soak: concurrent scoring through the
+        admission->batcher->fused path, traffic logging + shadow scoring
+        on the observer, a mid-soak stage+promote — all with
+        -Dshifu.sanitize=race armed from construction. The verdict must
+        report zero inversions and zero guard violations."""
+        from shifu_tpu.analysis.sanitize import Sanitizer
+
+        tr = racetrack.tracker()
+        tr.reset()
+        cols = [f"c{i}" for i in range(4)]
+        with _Props(shifu_sanitize="race",
+                    shifu_loop_shadowSample="1.0",
+                    **{"shifu_sanitize_race_holdMs": "0"}):
+            from shifu_tpu.loop.hotswap import SwappableRegistry
+            from shifu_tpu.loop.traffic import TrafficLog, traffic_columns
+            from shifu_tpu.serve.queue import AdmissionQueue
+            from shifu_tpu.serve.registry import ModelRegistry
+            from shifu_tpu.serve.server import Scorer
+
+            san = Sanitizer(["race"])
+            sw = SwappableRegistry(ModelRegistry(
+                _nn_models(str(tmp_path / "models"), cols)))
+            traffic = TrafficLog(str(tmp_path), traffic_columns(cols),
+                                 sample=1.0, chunk_rows=32, seed=7)
+
+            def observer(data, result):
+                traffic.record(data, result, sw.scored_sha)
+                sw.observe(data, result)
+
+            scorer = Scorer(sw, AdmissionQueue(128), max_wait_ms=1.0,
+                            observer=observer)
+            rng = np.random.default_rng(7)
+            vals = rng.normal(size=(16,))
+            errs = []
+
+            def client(ti):
+                try:
+                    for k in range(20):
+                        scorer.score_batch([{
+                            c: f"{vals[(ti + k + j) % 16]:.3f}"
+                            for j, c in enumerate(cols)}], timeout=30)
+                except Exception as e:  # surface, don't deadlock join
+                    errs.append(e)
+
+            cand = _nn_models(str(tmp_path / "cand"), cols, bias=1e-3)
+            ts = [threading.Thread(target=client, args=(ti,))
+                  for ti in range(4)]
+            for t in ts:
+                t.start()
+            sw.stage(cand)
+            time.sleep(0.05)
+            sw.promote()
+            for t in ts:
+                t.join(60)
+            scorer.close()
+            traffic.close()
+            v = san.verdict()
+        racetrack.arm(None)
+        assert not errs
+        assert v["race"]["armed"] is True
+        assert v["race"]["acquisitions"] > 0  # locks really were tracked
+        assert v["race"]["inversions"] == 0, v["race"]["inversionEvents"]
+        assert v["race"]["guardViolations"] == 0, \
+            v["race"]["guardViolationEvents"]
+        assert v["clean"] is True
+        # the traffic log really rode along
+        meta = json.load(open(os.path.join(
+            str(tmp_path), ".shifu", "runs", "traffic", "_meta.json")))
+        assert meta["columns"] == traffic_columns(cols)
+        tr.reset()
