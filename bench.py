@@ -96,6 +96,19 @@ CONTINUOUS = dict(n=40_000, d=30, hidden=[50], epochs=60, shift=0.35,
 # window stay at 1 (the psum tree) instead of O(S).
 SHARDED_STATS = dict(n=36_000, numeric=6, cat=2, chunk_rows=3072,
                      device_counts=(1, 2, 8), reps=2)
+# tree_sweep probes -Dshifu.pallas.blk/.wmax shapings of the fused
+# Pallas histogram→split-scan kernel, one subprocess per shaping (the
+# built kernels and the trainer's program cache are per-process, so a
+# shaping is a process property — same pattern as sharded_stats). On a
+# TPU backend the children run the full gbt/gbt_wide/rf configs and the
+# best shaping per chip is annotated into the profiler snapshot
+# (profile.annotate -> every scenario/manifest records it); on the CPU
+# harness the kernel runs in interpret mode, so children shrink to a
+# structural smoke and vs_xla is REPORTED, not gated (interpret mode
+# loses to XLA by construction — the number that matters comes from the
+# TPU run).
+TREE_SWEEP = dict(grid_blk=(256, 512), grid_wmax=(512, 1024), reps=2,
+                  cpu_scale=dict(n=8_000, trees=2, depth=4))
 
 def chip_peak_tflops():
     """Pinned-peak lookup from the shared chip table (obs/costmodel.py —
@@ -836,6 +849,136 @@ def _sharded_stats_child() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _tree_sweep_child() -> None:
+    """Entry for `bench.py --tree-sweep-child <scenario> <mode> <blk>
+    <wmax>`: one kernel-shaping measurement of one tree scenario. Runs
+    in its own process because the pallas kernels and the trainer's
+    compiled-program cache bind the -Dshifu.pallas.* knobs at build
+    time. Prints ONE JSON line."""
+    import jax
+
+    from shifu_tpu.utils import environment
+
+    i = sys.argv.index("--tree-sweep-child")
+    scenario, mode, blk, wmax = sys.argv[i + 1:i + 5]
+    environment.set_property("shifu.pallas.mode", mode)
+    if int(blk):
+        environment.set_property("shifu.pallas.blk", blk)
+    if int(wmax):
+        environment.set_property("shifu.pallas.wmax", wmax)
+
+    from shifu_tpu.train.tree_trainer import TreeTrainConfig, train_trees
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if scenario == "gbt":
+        spec = GBT
+        slots = [spec["bins"] + 1] * spec["f"]
+        is_cat = [False] * spec["f"]
+    elif scenario == "gbt_wide":
+        slots, is_cat = _gbt_wide_slots()
+        spec = GBT_WIDE
+    else:
+        slots, is_cat = _rf_slots()
+        spec = RF
+    scale = TREE_SWEEP["cpu_scale"]
+    n = spec["n"] if on_tpu else scale["n"]
+    trees = spec["trees"] if on_tpu else scale["trees"]
+    depth = spec["depth"] if on_tpu else min(spec["depth"], scale["depth"])
+    rng = np.random.default_rng(0)
+    F = len(slots)
+    codes = np.stack([rng.integers(0, s - 1, size=n) for s in slots],
+                     1).astype(np.int32)
+    y = (codes[:, 0].astype(np.int64) + codes[:, 1]
+         + rng.integers(0, 16, size=n)
+         > (slots[0] + slots[1]) // 2).astype(np.float32)
+    w = np.ones(n, dtype=np.float32)
+    cols = [f"f{i}" for i in range(F)]
+    codes_dev = jax.device_put(codes)
+    y_dev = jax.device_put(y)
+    w_dev = jax.device_put(w)
+    alg = "RF" if scenario == "rf" else "GBT"
+    cfg = TreeTrainConfig(
+        algorithm=alg, tree_num=trees, max_depth=depth,
+        learning_rate=0.1, valid_set_rate=0.1, seed=3,
+        feature_subset_strategy="TWOTHIRDS" if alg == "RF" else "ALL")
+
+    def run():
+        train_trees(codes_dev, y_dev, w_dev, slots, is_cat, cols, cfg)
+
+    run()  # warm the compile caches
+    med, _lo, _hi = _median_timed(run, TREE_SWEEP["reps"])
+    print(json.dumps({
+        "scenario": scenario, "mode": mode, "blk": int(blk),
+        "wmax": int(wmax), "rows": n, "trees": trees, "depth": depth,
+        "row_trees_per_s": n * trees / med, "seconds": med,
+        "backend": jax.default_backend(),
+    }))
+
+
+def bench_tree_sweep():
+    """(blk, wmax) knob sweep of the fused Pallas tree kernel over the
+    gbt/gbt_wide/rf scenarios, one subprocess per shaping plus one
+    kernel-off XLA reference each. The best shaping per scenario is
+    recorded via profile.annotate against the `tree.pallas_fused` seam
+    (process-global), so every LATER scenario snapshot and manifest in
+    this bench run carries which shaping this chip prefers."""
+    import subprocess
+
+    from shifu_tpu.obs import profile as _profile
+
+    spec = TREE_SWEEP
+    out = {}
+    for scenario in ("gbt", "gbt_wide", "rf"):
+        def child(mode, blk=0, wmax=0):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--tree-sweep-child", scenario, mode, str(blk),
+                 str(wmax)],
+                env=dict(os.environ), capture_output=True, text=True,
+                timeout=3600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"tree_sweep child ({scenario} {mode} {blk}x{wmax}) "
+                    f"failed:\n{proc.stderr[-2000:]}")
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        xla = child("off")
+        shapings = {}
+        best = None
+        for blk in spec["grid_blk"]:
+            for wmax in spec["grid_wmax"]:
+                r = child("on", blk, wmax)
+                rt = r["row_trees_per_s"]
+                shapings[f"{blk}x{wmax}"] = {
+                    "row_trees_per_s": round(rt, 1),
+                    "vs_xla": round(rt / xla["row_trees_per_s"], 3),
+                }
+                if best is None or rt > best[2]:
+                    best = (blk, wmax, rt)
+        best_key = f"{best[0]}x{best[1]}"
+        _profile.annotate(
+            "tree.pallas_fused",
+            **{f"{scenario}BestBlk": best[0],
+               f"{scenario}BestWmax": best[1],
+               f"{scenario}BestVsXla": shapings[best_key]["vs_xla"]})
+        out[scenario] = {
+            "xla_row_trees_per_s": round(xla["row_trees_per_s"], 1),
+            "shapings": shapings,
+            "best": {"blk": best[0], "wmax": best[1],
+                     "vs_xla": shapings[best_key]["vs_xla"]},
+            "rows": xla["rows"], "trees": xla["trees"],
+            "depth": xla["depth"], "backend": xla["backend"],
+        }
+    out["note"] = (
+        "per-process -Dshifu.pallas.blk/.wmax shapings of the fused "
+        "kernel vs the kernel-off XLA path on the identical workload; "
+        "best shaping annotated into tree.pallas_fused so later "
+        "scenario snapshots/manifests record it. On a CPU harness the "
+        "kernel runs in INTERPRET mode at smoke scale — vs_xla < 1 "
+        "there is expected and not gated; the TPU run's numbers gate.")
+    return out
+
+
 def bench_sharded_stats():
     """Sweep forced host-device counts (1/2/8) over the sharded
     streaming-stats fold, one subprocess per count. Gates the structural
@@ -1340,6 +1483,10 @@ def main() -> None:
     dense = _with_obs_metrics(
         lambda: bench_nn(DENSE, mixed_precision=True, reps=2),
         "dense", transfer_clean=True)
+    # kernel-shaping sweep runs BEFORE the tree scenarios: its
+    # profile.annotate survives obs.reset (process-global), so the
+    # gbt/gbt_wide/rf snapshots below carry the chosen best shaping
+    tree_sweep = bench_tree_sweep()
     gbt = _with_obs_metrics(lambda: bench_gbt(reps=3),
                             "gbt", transfer_clean=True)
     gbt_wide = _with_obs_metrics(lambda: bench_gbt_wide(reps=2),
@@ -1414,6 +1561,7 @@ def main() -> None:
             "metrics": dense.get("metrics"),
             "sanitizer": dense.get("sanitizer"),
         },
+        "tree_sweep": tree_sweep,
         "gbt": section(gbt, "row_trees_per_s", "gbt_row_trees_per_s"),
         "gbt_wide": section(gbt_wide, "row_trees_per_s",
                             "gbt_wide_row_trees_per_s"),
@@ -1483,5 +1631,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--sharded-stats-child" in sys.argv:
         _sharded_stats_child()
+    elif "--tree-sweep-child" in sys.argv:
+        _tree_sweep_child()
     else:
         main()

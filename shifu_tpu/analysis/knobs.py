@@ -65,11 +65,15 @@ KNOBS: List[Knob] = [
        "max grid points trained in-process before bagging kicks in"),
     _K("shifu.rebin.maxNumBin", "int", "stats.maxNumBin",
        "rebin target bin count (defaults to the ModelConfig value)"),
-    # ---- kernels ----
+    # ---- kernels (PR 11: fused Pallas histogram→split-scan) ----
+    _K("shifu.pallas.mode", "str", "auto",
+       "fused tree histogram kernel: auto (TPU on / CPU off) | on "
+       "(forced; interpret mode off-TPU) | off (XLA lowering)"),
     _K("shifu.pallas.blk", "int", "512",
-       "pallas histogram kernel row-block size (ops/hist_pallas.py)"),
+       "pallas histogram kernel rows per grid step (ops/hist_pallas.py)"),
     _K("shifu.pallas.wmax", "int", "1024",
-       "pallas histogram kernel max window width"),
+       "pallas histogram kernel max padded one-hot columns per VMEM "
+       "chunk (fused-scan chunks clamp to 1024)"),
     # ---- observability / profiling (PR 2, PR 6) ----
     _K("shifu.profile", "str", "",
        "\"xla\" = deep-capture into the ledger dir; else explicit trace dir"),

@@ -72,7 +72,7 @@ class _CostEntry:
     executable with the OLD closure's constants baked in."""
 
     __slots__ = ("fn", "compiled", "flops", "bytes_accessed", "peak_hbm",
-                 "compile_seconds", "source")
+                 "arg_bytes", "compile_seconds", "source")
 
     def __init__(self, fn: Optional[Callable] = None) -> None:
         self.fn = fn
@@ -80,6 +80,7 @@ class _CostEntry:
         self.flops: Optional[float] = None
         self.bytes_accessed: Optional[float] = None
         self.peak_hbm: Optional[float] = None
+        self.arg_bytes: Optional[float] = None
         self.compile_seconds: float = 0.0
         self.source = "unavailable"
 
@@ -176,6 +177,8 @@ def _build_entry(name: str, fn: Callable, args: tuple,
                     + getattr(mem, "output_size_in_bytes", 0)
                     + getattr(mem, "temp_size_in_bytes", 0)
                     - getattr(mem, "alias_size_in_bytes", 0))
+                entry.arg_bytes = float(
+                    getattr(mem, "argument_size_in_bytes", 0)) or None
             except Exception:  # memory stats are best-effort per backend
                 entry.peak_hbm = None
             entry.compiled = compiled
@@ -200,7 +203,7 @@ class ProgramProfiler:
         if st is None:
             st = {
                 "dispatches": 0, "scaledDispatches": 0.0, "flops": 0.0,
-                "bytesAccessed": 0.0, "peakHbmBytes": 0.0,
+                "bytesAccessed": 0.0, "peakHbmBytes": 0.0, "argBytes": 0.0,
                 "compileSeconds": 0.0, "programsCompiled": 0,
                 "deviceSeconds": 0.0, "dispatchSeconds": 0.0,
                 "syncedDispatches": 0, "costSource": "unavailable",
@@ -246,6 +249,14 @@ class ProgramProfiler:
                 if entry.peak_hbm:
                     st["peakHbmBytes"] = max(st["peakHbmBytes"],
                                              entry.peak_hbm)
+                if entry.arg_bytes:
+                    # the program's HBM INPUT CONTRACT (largest
+                    # signature): what a dispatch must read from HBM
+                    # regardless of how the backend accounts internal
+                    # traffic — the metric that shows a once-
+                    # materialized operand (e.g. the [n, T] code
+                    # one-hot) leaving a program's argument list
+                    st["argBytes"] = max(st["argBytes"], entry.arg_bytes)
 
     # ---- views ----
     def totals(self) -> Dict[str, float]:
@@ -289,6 +300,7 @@ class ProgramProfiler:
                 "flops": st["flops"],
                 "bytesAccessed": st["bytesAccessed"],
                 "peakHbmBytes": st["peakHbmBytes"],
+                "argBytes": st["argBytes"],
                 "compileSeconds": round(st["compileSeconds"], 4),
                 "programsCompiled": st["programsCompiled"],
                 "deviceSeconds": round(st["deviceSeconds"], 4),

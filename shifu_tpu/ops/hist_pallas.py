@@ -1,55 +1,107 @@
-"""Pallas TPU kernel for the GBT/RF histogram contraction.
+"""Fused Pallas TPU kernel: bin-code gather → per-node histogram
+accumulate → split gain scan, with low-precision planes.
 
 The tree builder's hot op (dt/DTWorker.java:851 featureUpdate, fused by
 SURVEY §7.5 into "the histogram kernel") is
 
     hist[c, l, t] = Σ_i comps[i, c] · (node[i] == l) · (code_t[i] == t)
 
-The XLA lowering in tree_trainer materializes the [blk, T] code one-hot
-M in HBM between the compare and the matmul (~2·n·T·4 bytes of traffic
-per level). This kernel builds BOTH one-hots in VMEM and feeds the MXU
-directly:
+followed immediately by the split gain scan over the [C, L, T] result.
+The XLA lowering in tree_trainer materializes the [blk, T] (or, hoisted,
+the full [n, T]) code one-hot M in HBM between the compare and the
+matmul, and round-trips the histogram to HBM between the build dispatch
+and the scan. This kernel keeps BOTH in VMEM:
 
-    grid (row blocks)  — one VMEM-resident [C·L, W] accumulator per
-                         T-chunk, revisited across the grid (init at
+    grid (row blocks)  — per-chunk VMEM-resident [L, W] accumulator per
+                         component, revisited across the grid (init at
                          block 0, += afterwards)
-    per block          — oh_node [blk, L] and the chunk's code one-hot
-                         [blk, W] are built in-registers/VMEM; a single
-                         f32 dot_general contracts over the row axis
+    per block          — the chunk's code one-hot M is built by ONE
+                         broadcast-compare over a LANE-ALIGNED padded
+                         column layout (below); a dot per component
+                         plane contracts the row axis on the MXU
+    last block         — the split scan runs in-kernel on the resident
+                         planes (pairwise-rank formulation, below) and
+                         emits per-column gain/rank/left-count planes,
+                         so the histogram never has to be re-read from
+                         HBM by a second scan dispatch
 
-Feature one-hots sit at STATIC columns inside each chunk (the flat
-per-feature slot layout), so a 10k-category column spans several chunks
-instead of padding every feature to its width.
+Three measured-loss fixes over the round-5 kernel (which lost 10-25% to
+the XLA lowering on v5e and shipped dark behind an env var):
 
-f32 operands keep counts/sums exact (bit-comparable with the scatter
-path for integer weights).
+1. LANE-ALIGNED COLUMN LAYOUT. The old kernel wrote each feature's
+   one-hot segment at its raw flat-T offset with per-run slice stores;
+   33/65-wide segments land mid-lane and Mosaic emits masked unaligned
+   lane stores — the measured 10-25% loss. The rebuilt kernel pads every
+   feature piece to the 128-lane boundary INSIDE the kernel layout
+   (gaps are dead columns, masked out of the gain scan and dropped at
+   the [C, L, T] compaction — the output contract is unchanged) and
+   builds M with zero per-feature stores: a static selection matmul
+   broadcasts each column's code (codes_f32 @ E, exact in f32), then one
+   full-width compare against the static slot-position row writes the
+   whole [blk, W] block aligned.
 
-MEASURED (v5e, round 5): in-program the XLA T-chunked matmul lowering in
-tree_trainer is 10-25% faster than this kernel at both 500k x 30-narrow
-and 200k x 200-mixed-wide shapes (Mosaic's unaligned lane stores for the
-33/65-wide one-hot segments eat the VMEM-residency win), so the trainer
-defaults to XLA and enables this kernel behind SHIFU_PALLAS=1. The
-kernel's bandwidth profile (codes-only HBM reads, no [n, T] one-hot
-materialization) makes it the right base for regimes the XLA path cannot
-reach; it is correctness-tested in interpret mode on CPU."""
+2. LOW-PRECISION PLANES. Bin codes travel int8 in HBM for chunks whose
+   features all fit 128 slots (4x less code-read bandwidth than i32 —
+   the kernel is bandwidth-bound on code reads; wide chunks stay i32).
+   GBT gradient/hessian component planes travel bf16 with f32 MXU
+   accumulation (`preferred_element_type`); RF planes stay f32 so
+   integer-weight counts stay exact and PR-3's bit-parity gate holds
+   bit-for-bit.
+
+3. IN-KERNEL SPLIT SCAN. After the last grid step the kernel computes,
+   per (node, candidate column), the cumulative left/right stats IN THE
+   REFERENCE'S MEAN-SORTED ORDER without sorting: left(a) = Σ_b
+   IND[b, a] · h[b] where IND[b, a] = [b's (sec, index) lex-≤ a's,
+   same segment] — a [W, W] indicator built from one exact
+   eye-transpose of the sec row plus static column metadata, applied as
+   C matvecs on the MXU per node. rank(a) = Σ_b IND[b, a] − 1
+   reproduces the lexsort rank exactly (stable ties included), so the
+   emitted (gain, rank, left-count) planes are combinable with the XLA
+   reference scan epilogue: argmax with the reference's ordered-position
+   tie-break, rank_flat for row routing, the model-facing left mask.
+   Features too wide for one chunk (> wmax padded columns) fall back to
+   the XLA reference scan on just their columns of the compacted
+   histogram — the kernel masks them out of its own scan.
+
+Numerics: counts and integer-weight moments are exact under any
+summation order (< 2^24), so RF forests are BIT-equal with the kernel
+on vs off; GBT float planes differ only by summation association
+(tolerance-tested), with bf16 comps adding one rounding at plane build.
+
+Mode selection is the cataloged knob `-Dshifu.pallas.mode`:
+  auto  (default) kernel on TPU backends, XLA elsewhere
+  on    kernel everywhere — interpret mode off-TPU (CPU tests)
+  off   XLA lowering everywhere
+(The round-5 `SHIFU_PALLAS` env var is retired; docs/KNOBS.md has the
+catalog row.)
+"""
 
 from __future__ import annotations
 
 import functools
 from typing import List, Optional
 
-# VMEM budget shaping: rows per grid step x max chunk columns. M [BLK, W]
-# f32 + A [BLK, C*L] f32 + out [C*L, W] f32 must sit well under ~16 MB.
-# Overridable per PROCESS (-Dshifu.pallas.blk / -Dshifu.pallas.wmax) so
-# the next kernel-tuning round can sweep shapings without code edits —
-# per process because the built kernels are cached (_chunk_call lru,
-# tree_trainer's program cache): set the knobs at launch, one process
-# per shaping, the way the bench children do. The chosen values land in
-# the profiler snapshot (obs.profile annotations, process-global so a
-# later obs scope still reports them) so every manifest records which
-# shaping produced its numbers.
+import numpy as np
+
+_LANE = 128  # TPU lane width: every feature piece starts lane-aligned
+
+# VMEM budget shaping: rows per grid step x max padded chunk columns.
+# M [BLK, W] + the [W, W] scan indicator + C [L, W] planes must sit well
+# under ~16 MB. Overridable per PROCESS (-Dshifu.pallas.blk /
+# -Dshifu.pallas.wmax) so kernel-tuning rounds can sweep shapings
+# without code edits — per process because the built kernels are cached
+# (_build_call lru, tree_trainer's program cache): set the knobs at
+# launch, one process per shaping, the way the bench sweep children do.
+# The chosen values land in the profiler snapshot (obs.profile
+# annotations, process-global so a later obs scope still reports them)
+# so every manifest records which shaping produced its numbers.
 _BLK = 512
 _W_MAX = 1024
+# the in-kernel scan's [W, W] indicator scratch is W^2 f32; past 1024
+# padded columns it would blow the VMEM budget, so fused-scan chunking
+# clamps to this even when -Dshifu.pallas.wmax asks for wider (hist-only
+# chunks honor the raw knob)
+_SCAN_W_CAP = 1024
 
 
 def blk_setting() -> int:
@@ -63,194 +115,675 @@ def wmax_setting() -> int:
     """shifu.pallas.wmax — max one-hot columns per VMEM chunk (1024)."""
     from shifu_tpu.utils import environment
 
-    return max(8, environment.get_int("shifu.pallas.wmax", _W_MAX))
+    return max(_LANE, environment.get_int("shifu.pallas.wmax", _W_MAX))
 
 
-def _chunk_runs(lay, target: Optional[int] = None) -> List[list]:
-    """Split the flat T axis into chunks of <= target columns, each chunk a
-    list of runs: ('vec', f_lo, f_hi, w) for consecutive full features of
-    equal width w, or ('piece', f, lo, hi) for a partial piece of a wide
-    feature. Chunks always cover whole columns of [0, T) in order and the
-    features of one chunk are CONTIGUOUS, so the caller can hand the
-    kernel a contiguous column slice of the code matrix."""
+def pallas_mode() -> str:
+    """shifu.pallas.mode — auto | on | off (default auto)."""
+    from shifu_tpu.utils import environment
+
+    m = (environment.get_property("shifu.pallas.mode", "auto")
+         or "auto").strip().lower()
+    return m if m in ("auto", "on", "off") else "auto"
+
+
+def _on_tpu() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # jax backend probe failed: assume not a TPU
+        return False
+
+
+def pallas_active() -> tuple:
+    """(enabled, interpret) for the current process.
+
+    auto = the measured default: kernel on TPU, XLA elsewhere. on =
+    forced everywhere, interpret mode off-TPU (the CPU test harness).
+    off = XLA everywhere."""
+    mode = pallas_mode()
+    if mode == "off":
+        return False, False
+    if mode == "on":
+        return True, not _on_tpu()
+    return _on_tpu(), False
+
+
+def _pad_lane(w: int) -> int:
+    return -(-w // _LANE) * _LANE
+
+
+class _Chunk:
+    """One lane-aligned kernel chunk: a contiguous run of feature pieces,
+    each padded to the 128-lane boundary, plus the static per-column
+    metadata the kernel and the epilogue need."""
+
+    __slots__ = ("pieces", "w", "f_lo", "f_hi", "pos", "feat_rel", "clip",
+                 "seg", "size", "iscat", "scan_ok", "seg0", "t_idx",
+                 "keep", "narrow", "start")
+
+    def __init__(self, pieces, lay, whole):
+        self.pieces = pieces
+        self.f_lo = pieces[0][0]
+        self.f_hi = pieces[-1][0] + 1
+        w = pieces[-1][3] + _pad_lane(pieces[-1][2] - pieces[-1][1])
+        self.w = w
+        pos = np.full(w, -1, np.int32)
+        feat_rel = np.zeros(w, np.int32)
+        clip = np.zeros(w, np.int32)
+        seg = np.full(w, -1, np.int32)
+        size = np.ones(w, np.int32)
+        iscat = np.zeros(w, np.int32)
+        scan_ok = np.zeros(w, np.int32)
+        seg0 = np.zeros(w, np.float32)
+        t_idx = np.full(w, -1, np.int64)
+        start = np.zeros(w, np.int32)
+        for (f, lo, hi, col0) in pieces:
+            cw = hi - lo
+            sl = slice(col0, col0 + cw)
+            pos[sl] = np.arange(lo, hi, dtype=np.int32)
+            feat_rel[sl] = f - self.f_lo
+            clip[sl] = int(lay.clip_max[f])
+            seg[sl] = f
+            size[sl] = int(lay.slots[f])
+            iscat[sl] = int(bool(lay.is_cat_t[lay.off[f]]))
+            scan_ok[sl] = int(whole[f])
+            seg0[sl] = 1.0 if f == 0 else 0.0
+            t_idx[sl] = np.arange(int(lay.off[f]) + lo,
+                                  int(lay.off[f]) + hi, dtype=np.int64)
+            start[sl] = int(lay.off[f])
+        self.pos, self.feat_rel, self.clip = pos, feat_rel, clip
+        self.seg, self.size, self.iscat = seg, size, iscat
+        self.scan_ok, self.seg0, self.t_idx = scan_ok, seg0, t_idx
+        self.start = start
+        self.keep = np.nonzero(pos >= 0)[0].astype(np.int64)
+        self.narrow = all(int(lay.slots[f]) <= _LANE
+                          for (f, _lo, _hi, _c0) in pieces)
+
+
+def _chunks(lay, target: Optional[int] = None) -> List[_Chunk]:
+    """Split the flat T axis into lane-aligned chunks of <= target padded
+    columns. Every feature piece starts at a 128-lane boundary; a feature
+    wider than the target spans several pieces/chunks (and is then
+    excluded from the in-kernel scan — the epilogue's XLA fallback owns
+    it). Chunks cover whole features of [0, T) in order, so the caller
+    can hand the kernel a contiguous column slice of the code matrix."""
     if target is None:
         target = wmax_setting()
+    target = max(_LANE, (target // _LANE) * _LANE)
     slots = [int(s) for s in lay.slots]
-    chunks: List[dict] = []
+    whole = [_pad_lane(s) <= target for s in slots]
+    chunks: List[_Chunk] = []
     cur: List[tuple] = []
     cur_w = 0
-    cur_flo = None
-    cur_fhi = None
-
-    def flush():
-        nonlocal cur, cur_w, cur_flo, cur_fhi
-        if cur:
-            chunks.append({"runs": cur, "w": cur_w, "f_lo": cur_flo,
-                           "f_hi": cur_fhi})
-        cur, cur_w, cur_flo, cur_fhi = [], 0, None, None
-
     for f, s in enumerate(slots):
         lo = 0
         while lo < s:
-            take = min(s - lo, target - cur_w)
-            if take == 0:
-                flush()
+            avail = target - cur_w
+            # a chunk-fitting feature must NEVER straddle a chunk tail:
+            # its in-kernel scan sees only its own chunk's columns, so a
+            # split would scan partial histograms — start a fresh chunk
+            # instead (only over-wide features split, and those are the
+            # epilogue's XLA-fallback set)
+            if avail < _LANE or (whole[f] and _pad_lane(s) > avail):
+                chunks.append(_Chunk(cur, lay, whole))
+                cur, cur_w = [], 0
                 continue
-            full = lo == 0 and take == s
-            if cur_flo is None:
-                cur_flo = f
-            cur_fhi = f + 1
-            if (full and cur and cur[-1][0] == "vec"
-                    and cur[-1][2] == f and cur[-1][3] == s):
-                cur[-1] = ("vec", cur[-1][1], f + 1, s)
-            elif full:
-                cur.append(("vec", f, f + 1, s))
-            else:
-                cur.append(("piece", f, lo, lo + take))
-            cur_w += take
+            take = min(s - lo, avail)
+            cur.append((f, lo, lo + take, cur_w))
+            cur_w += _pad_lane(take)
             lo += take
-            if cur_w >= target:
-                flush()
-    flush()
+    if cur:
+        chunks.append(_Chunk(cur, lay, whole))
     return chunks
 
 
+def wide_features(lay, target: Optional[int] = None) -> List[int]:
+    """Features too wide for one chunk at this shaping — scanned by the
+    XLA reference fallback instead of the in-kernel scan."""
+    if target is None:
+        target = wmax_setting()
+    target = max(_LANE, (target // _LANE) * _LANE)
+    return [f for f, s in enumerate(int(x) for x in lay.slots)
+            if _pad_lane(s) > target]
+
+
 @functools.lru_cache(maxsize=None)
-def _chunk_call(L: int, C: int, blk: int, nf: int, w: int, runs: tuple,
+def _build_call(lay_key: tuple, target: int, ci: int, L: int, C: int,
+                blk: int, code_i8: bool, lowp: bool, scan_key,
                 interpret: bool):
-    """Build one chunk's pallas_call: (codes_chunk [n, nf], comps [n, C],
-    node [n, 1]) -> [C*L, w] accumulated over row blocks. `runs` use
-    CHUNK-RELATIVE feature columns: ('vec', a, b, w) spans columns
-    [a, b) of the chunk slice; ('piece', a, lo, hi, clip) is one
-    column."""
+    """One chunk's pallas_call builder, cached per static configuration.
+
+    Returns call(codes_chunk [n, nf], comps [n, C], node [n, 1],
+    featok [1, W]) -> (C hist planes [L, W], + when scan_key:
+    gain [L, W], rank [L, W], lcnt [L, W], tot0 [L, C]).
+
+    scan_key = None (hist-only) or (impurity, min_inst, min_gain,
+    n_classes)."""
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
-
     from jax.experimental.pallas import tpu as pltpu
 
-    def kernel(codes_ref, comps_ref, node_ref, *out_and_scratch):
-        out_refs = out_and_scratch[:C]
-        m_ref = out_and_scratch[C]  # [blk, w] VMEM scratch
+    from shifu_tpu.train.tree_trainer import make_layout
+
+    lay = make_layout(list(lay_key[0]), list(lay_key[1]))
+    ch = _chunks(lay, target)[ci]
+    W = ch.w
+    nf = ch.f_hi - ch.f_lo
+    do_scan = scan_key is not None
+    comp_dt = jnp.bfloat16 if lowp else jnp.float32
+    m_dt = comp_dt
+    if do_scan:
+        impurity, min_inst, min_gain, n_classes = scan_key
+        use_entropy = impurity == "entropy"
+
+    # static column metadata rides in as [1, W] / [W, 1] inputs (vector
+    # constants are inputs, not closure captures, in Mosaic)
+    pos_np = ch.pos[None, :]
+    clip_np = ch.clip[None, :]
+    featrel_np = ch.feat_rel[None, :]
+    seg_row_np = ch.seg[None, :]
+    seg_col_np = ch.seg[:, None]
+    iscat_np = ch.iscat[None, :]
+    size_np = ch.size[None, :].astype(np.float32)
+    seg0_np = ch.seg0[:, None]
+
+    def kernel(*refs):
+        (codes_ref, comps_ref, node_ref, featok_ref, pos_ref, clip_ref,
+         featrel_ref) = refs[:7]
+        k = 7
+        if do_scan:
+            (segr_ref, segc_ref, iscat_ref, size_ref, seg0_ref) = \
+                refs[k:k + 5]
+            k += 5
+        hist_refs = refs[k:k + C]
+        k += C
+        if do_scan:
+            gain_ref, rank_ref, lcnt_ref, tot0_ref = refs[k:k + 4]
+            k += 4
+        m_ref = refs[k]
+        if do_scan:
+            wsq_ref, sec_ref, secT_ref = refs[k + 1:k + 4]
+
         i = pl.program_id(0)
+        grid_n = pl.num_programs(0)
+
+        # ---- M build: selection matmul + one aligned full-width compare
+        # (no per-feature stores — the round-5 measured loss) ----
+        codes_f = codes_ref[...].astype(jnp.float32)  # [blk, nf]
+        sel = (jax.lax.broadcasted_iota(jnp.int32, (nf, W), 0)
+               == featrel_ref[...]).astype(jnp.float32)  # [nf, W]
+        cb = jax.lax.dot_general(
+            codes_f, sel, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [blk, W]: code per col
+        cb = jnp.clip(cb, 0.0, clip_ref[...].astype(jnp.float32))
+        # gap columns carry pos -1: clipped codes are >= 0, so M is 0
+        m_ref[...] = (cb == pos_ref[...].astype(jnp.float32)).astype(m_dt)
+
         comps = comps_ref[...]  # [blk, C]
-        if L == 1:
-            oh_node = None
-        else:
-            node = node_ref[...]  # [blk, 1]
-            oh_node = (node == jax.lax.broadcasted_iota(
-                jnp.int32, (blk, L), 1)).astype(jnp.float32)
-        # build the chunk's code one-hot DIRECTLY into the M scratch at
-        # static column offsets (no cols list + concat: half the live
-        # VMEM, one copy less per block)
-        col = 0
-        for run in runs:
-            if run[0] == "vec":
-                _tag, a, b, cw = run
-                for fc in range(a, b):
-                    cf = jnp.clip(codes_ref[:, fc:fc + 1], 0, cw - 1)
-                    m_ref[:, col:col + cw] = (
-                        cf == jax.lax.broadcasted_iota(
-                            jnp.int32, (blk, cw), 1)).astype(jnp.float32)
-                    col += cw
-            else:
-                _tag, a, lo, hi, clip = run
-                cw = hi - lo
-                cf = jnp.clip(codes_ref[:, a:a + 1], 0, clip)
-                m_ref[:, col:col + cw] = (
-                    (cf - lo) == jax.lax.broadcasted_iota(
-                        jnp.int32, (blk, cw), 1)).astype(jnp.float32)
-                col += cw
+        if L > 1:
+            oh_node = (node_ref[...] == jax.lax.broadcasted_iota(
+                jnp.int32, (blk, L), 1)).astype(comp_dt)
         M = m_ref[...]
-        # one dot per component plane (Mosaic-friendly: no [blk, C*L]
-        # reshape); each is [L, blk] @ [blk, w] on the MXU
         for c in range(C):
             A_c = (comps[:, c:c + 1] if L == 1
                    else comps[:, c:c + 1] * oh_node)  # [blk, L]
             contrib = jax.lax.dot_general(
                 A_c, M, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [L, w]
+                preferred_element_type=jnp.float32)  # [L, W]
 
             @pl.when(i == 0)
-            def _init(out_ref=out_refs[c]):
+            def _init(out_ref=hist_refs[c]):
                 out_ref[...] = jnp.zeros_like(out_ref)
 
-            out_refs[c][...] += contrib
+            hist_refs[c][...] += contrib
 
-    def call(codes_chunk, comps, node2d):
+        if not do_scan:
+            return
+
+        # ---- fused split scan on the VMEM-resident planes (last step):
+        # the reference's mean-sorted cumulative stats via the pairwise
+        # lex-≤ indicator — no sort, all matmul/elementwise ----
+        @pl.when(i == grid_n - 1)
+        def _scan():
+            eps = 1e-12
+            # the reference keys empty category slots with +inf so they
+            # sort last; the eye-transpose matmul would turn 0*inf into
+            # NaN, so use a huge FINITE sentinel — same ordering, same
+            # stable index tie-break among empties
+            big = 3.0e38
+            h = [hist_refs[c][...] for c in range(C)]  # [L, W] f32
+            if n_classes >= 3:
+                cnt = h[0]
+                ex = jnp.zeros_like(cnt)
+                for c in range(1, C):
+                    cnt = cnt + h[c]
+                for c in range(C):
+                    ex = ex + float(c) * h[c]
+                mean = jnp.where(cnt > 0, ex / jnp.maximum(cnt, eps), big)
+            else:
+                cnt, s1 = h[0], h[1]
+                mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, eps), big)
+            posf = pos_ref[...].astype(jnp.float32)  # [1, W]
+            sec_ref[...] = jnp.where(
+                iscat_ref[...] != 0, mean,
+                jnp.broadcast_to(posf, (L, W)))
+            # exact data transpose via an in-kernel identity matmul:
+            # secT[b, l] = sec[l, b] (1.0 * x sums with zeros — exact)
+            wsq_ref[...] = (jax.lax.broadcasted_iota(jnp.int32, (W, W), 0)
+                            == jax.lax.broadcasted_iota(
+                                jnp.int32, (W, W), 1)).astype(jnp.float32)
+            secT_ref[...] = jax.lax.dot_general(
+                wsq_ref[...], sec_ref[...], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [W, L]
+
+            seg_eq = segc_ref[...] == segr_ref[...]  # [W, W] static
+            tie = (jax.lax.broadcasted_iota(jnp.int32, (W, W), 0)
+                   <= jax.lax.broadcasted_iota(jnp.int32, (W, W), 1))
+            fok = featok_ref[...]  # [1, W] f32, gaps/wide already 0
+            sizef = size_ref[...]  # [1, W] f32
+            gain_rows, rank_rows, lcnt_rows = [], [], []
+            for l in range(L):
+                sec_r = sec_ref[l:l + 1, :]    # [1, W]
+                sec_c = secT_ref[:, l:l + 1]   # [W, 1]
+                lt = sec_c < sec_r
+                eq = sec_c == sec_r
+                inc = lt | (eq & tie)          # lex-≤ on (sec, index)
+                wsq_ref[...] = jnp.where(seg_eq & inc, 1.0, 0.0)
+                ind = wsq_ref[...]
+                left = [jax.lax.dot_general(
+                    h[c][l:l + 1, :], ind, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                    for c in range(C)]  # [1, W] each
+                rank = jnp.sum(ind, axis=0, keepdims=True) - 1.0
+                wsq_ref[...] = jnp.where(seg_eq & ~inc, 1.0, 0.0)
+                indr = wsq_ref[...]
+                right = [jax.lax.dot_general(
+                    h[c][l:l + 1, :], indr, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                    for c in range(C)]
+
+                if n_classes >= 3:
+                    lc = left[0]
+                    rc = right[0]
+                    for c in range(1, C):
+                        lc = lc + left[c]
+                        rc = rc + right[c]
+                    tc = lc + rc
+
+                    def mass(parts, total):
+                        acc = None
+                        for c in range(C):
+                            p = parts[c] / jnp.maximum(total, eps)
+                            if use_entropy:
+                                t = -p * (jnp.log2(jnp.maximum(p, eps)))
+                            else:
+                                t = p * p
+                            acc = t if acc is None else acc + t
+                        if use_entropy:
+                            return total * acc
+                        return total * (1.0 - acc)
+
+                    tot = [left[c] + right[c] for c in range(C)]
+                    g = (mass(tot, tc) - mass(left, lc) - mass(right, rc))
+                else:
+                    lc, ls1, ls2 = left
+                    rc, rs1, rs2 = right
+                    tc, ts1, ts2 = lc + rc, ls1 + rs1, ls2 + rs2
+                    if impurity == "entropy":
+                        def emass(c_, p_):
+                            pr = p_ / jnp.maximum(c_, eps)
+                            q = 1.0 - pr
+                            hh = -(pr * jnp.log2(jnp.maximum(pr, eps))
+                                   + q * jnp.log2(jnp.maximum(q, eps)))
+                            return c_ * hh
+
+                        g = emass(tc, ts1) - emass(lc, ls1) - emass(rc,
+                                                                    rs1)
+                    elif impurity == "gini":
+                        def gmass(c_, p_):
+                            ng = c_ - p_
+                            return c_ - (p_ * p_ + ng * ng) / jnp.maximum(
+                                c_, eps)
+
+                        g = gmass(tc, ts1) - gmass(lc, ls1) - gmass(rc,
+                                                                    rs1)
+                    elif impurity == "friedmanmse":
+                        ml = ls1 / jnp.maximum(lc, eps)
+                        mr = rs1 / jnp.maximum(rc, eps)
+                        g = (lc * rc / jnp.maximum(tc, eps)
+                             * (ml - mr) ** 2)
+                    else:  # variance
+
+                        def sse(c_, s_, q_):
+                            return q_ - s_ * s_ / jnp.maximum(c_, eps)
+
+                        g = (sse(tc, ts1, ts2) - sse(lc, ls1, ls2)
+                             - sse(rc, rs1, rs2))
+
+                valid = ((lc >= min_inst) & (rc >= min_inst)
+                         & (g > min_gain) & (fok > 0)
+                         & (rank < sizef - 1.0))
+                gain_rows.append(jnp.where(valid, g, -jnp.inf))
+                rank_rows.append(rank)
+                lcnt_rows.append(lc)
+            gain_ref[...] = jnp.concatenate(gain_rows, axis=0)
+            rank_ref[...] = jnp.concatenate(rank_rows, axis=0)
+            lcnt_ref[...] = jnp.concatenate(lcnt_rows, axis=0)
+            # node totals = segment-0 column sums (the reference's
+            # seg0-cumsum endpoint), summed across chunks outside
+            tot_cols = [jax.lax.dot_general(
+                hist_refs[c][...], seg0_ref[...], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) for c in range(C)]
+            tot0_ref[...] = jnp.concatenate(tot_cols, axis=1)  # [L, C]
+
+    def call(codes_chunk, comps, node2d, featok):
+        import jax.numpy as jnp
+
         n = codes_chunk.shape[0]
         grid = n // blk
-        planes = pl.pallas_call(
+        code_dt = jnp.int8 if code_i8 else jnp.int32
+        in_specs = [
+            pl.BlockSpec((blk, nf), lambda i: (i, 0)),
+            pl.BlockSpec((blk, C), lambda i: (i, 0)),
+            pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+            pl.BlockSpec((1, W), lambda i: (0, 0)),
+            pl.BlockSpec((1, W), lambda i: (0, 0)),
+            pl.BlockSpec((1, W), lambda i: (0, 0)),
+            pl.BlockSpec((1, W), lambda i: (0, 0)),
+        ]
+        args = [codes_chunk.astype(code_dt), comps, node2d,
+                featok.astype(jnp.float32),
+                jnp.asarray(pos_np), jnp.asarray(clip_np),
+                jnp.asarray(featrel_np)]
+        if do_scan:
+            in_specs += [
+                pl.BlockSpec((1, W), lambda i: (0, 0)),
+                pl.BlockSpec((W, 1), lambda i: (0, 0)),
+                pl.BlockSpec((1, W), lambda i: (0, 0)),
+                pl.BlockSpec((1, W), lambda i: (0, 0)),
+                pl.BlockSpec((W, 1), lambda i: (0, 0)),
+            ]
+            args += [jnp.asarray(seg_row_np), jnp.asarray(seg_col_np),
+                     jnp.asarray(iscat_np), jnp.asarray(size_np),
+                     jnp.asarray(seg0_np)]
+        out_specs = [pl.BlockSpec((L, W), lambda i: (0, 0))
+                     for _ in range(C)]
+        out_shape = [jax.ShapeDtypeStruct((L, W), jnp.float32)
+                     for _ in range(C)]
+        if do_scan:
+            out_specs += [pl.BlockSpec((L, W), lambda i: (0, 0))] * 3 \
+                + [pl.BlockSpec((L, C), lambda i: (0, 0))]
+            out_shape += [jax.ShapeDtypeStruct((L, W), jnp.float32)] * 3 \
+                + [jax.ShapeDtypeStruct((L, C), jnp.float32)]
+        scratch = [pltpu.VMEM((blk, W), m_dt)]
+        if do_scan:
+            scratch += [pltpu.VMEM((W, W), jnp.float32),
+                        pltpu.VMEM((L, W), jnp.float32),
+                        pltpu.VMEM((W, L), jnp.float32)]
+        outs = pl.pallas_call(
             kernel,
             grid=(grid,),
-            in_specs=[
-                pl.BlockSpec((blk, nf), lambda i: (i, 0)),
-                pl.BlockSpec((blk, C), lambda i: (i, 0)),
-                pl.BlockSpec((blk, 1), lambda i: (i, 0)),
-            ],
-            out_specs=[pl.BlockSpec((L, w), lambda i: (0, 0))
-                       for _ in range(C)],
-            out_shape=[jax.ShapeDtypeStruct((L, w), jnp.float32)
-                       for _ in range(C)],
-            scratch_shapes=[pltpu.VMEM((blk, w), jnp.float32)],
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=scratch,
             interpret=interpret,
-        )(codes_chunk, comps, node2d)
-        return jnp.stack(planes)  # [C, L, w]
+        )(*args)
+        return outs
 
     return call
 
 
+def _comps_of(labels, weights, active, n_classes: int, dtype):
+    """[n, C] component planes (shared semantics with tree_trainer's
+    _make_comps_of): inactive rows zero out via the weight."""
+    import jax.numpy as jnp
+
+    w = jnp.where(active, weights, 0.0)
+    if n_classes >= 3:
+        cls = jnp.clip(labels.astype(jnp.int32), 0, n_classes - 1)
+        cols = [w * (cls == c).astype(jnp.float32)
+                for c in range(n_classes)]
+    else:
+        cols = [w, w * labels, w * labels * labels]
+    return jnp.stack(cols, 1).astype(dtype)
+
+
+def _pad_rows(arrs, blk):
+    import jax.numpy as jnp
+
+    n = arrs[0].shape[0]
+    n_pad = -(-n // blk) * blk
+    pad = n_pad - n
+    return [jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+            for a in arrs]
+
+
+def _annotate(lay, chunks, L, do_scan, lowp, i8_chunks):
+    from shifu_tpu.obs import profile as _profile
+
+    _profile.annotate(
+        "ops.hist_pallas", blk=blk_setting(), wMax=wmax_setting(),
+        chunks=len(chunks), L=int(L), T=int(lay.T),
+        paddedT=int(sum(c.w for c in chunks)), fusedScan=bool(do_scan),
+        bf16Planes=bool(lowp), int8Chunks=int(i8_chunks),
+        mode=pallas_mode())
+
+
 def make_pallas_hist_fn(L: int, lay, n_classes: int = 0,
-                        interpret: bool = False):
-    """Traced fn (codes, labels, weights, node_slot, active) -> [C, L, T]
-    matching tree_trainer's histogram contract. `interpret=True` runs the
-    kernels in pallas interpret mode (CPU tests)."""
+                        interpret: bool = False,
+                        low_precision: bool = False):
+    """Histogram-only kernel entry: traced fn (codes, labels, weights,
+    node_slot, active) -> [C, L, T] matching tree_trainer's histogram
+    contract (the hist-subtraction built-child, budget-batched,
+    leaf-wise and streamed/shard_map call sites). `interpret=True` runs
+    the kernels in pallas interpret mode (CPU tests)."""
     import jax.numpy as jnp
 
     C = n_classes if n_classes >= 3 else 3
-    T = lay.T
     blk_max = blk_setting()
-    wmax = wmax_setting()
-    chunks = _chunk_runs(lay, target=wmax)
-    clips = tuple(int(c) for c in lay.clip_max)
-    # the shaping this build chose rides into every profiler snapshot /
-    # manifest, so a -Dshifu.pallas.* sweep is self-documenting
-    from shifu_tpu.obs import profile as _profile
-
-    _profile.annotate("ops.hist_pallas", blk=blk_max, wMax=wmax,
-                      chunks=len(chunks), L=int(L), T=int(T))
+    target = wmax_setting()
+    chunks = _chunks(lay, target)
+    comp_dt = jnp.bfloat16 if low_precision else jnp.float32
+    _annotate(lay, chunks, L, False, low_precision, 0)
 
     def hist_fn(codes, labels, weights, node_slot, active):
         n, F = codes.shape
-        w = jnp.where(active, weights, 0.0)
+        comps = _comps_of(labels, weights, active, n_classes, comp_dt)
         nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
-        if n_classes >= 3:
-            cls = jnp.clip(labels.astype(jnp.int32), 0, n_classes - 1)
-            comps = jnp.stack(
-                [w * (cls == c).astype(jnp.float32)
-                 for c in range(n_classes)], 1)
-        else:
-            comps = jnp.stack([w, w * labels, w * labels * labels], 1)
-
         blk = min(blk_max, n)
-        n_pad = -(-n // blk) * blk
-        pad = n_pad - n
-        codes_p = jnp.pad(codes, ((0, pad), (0, 0)))
-        comps_p = jnp.pad(comps, ((0, pad), (0, 0)))
-        node2d = jnp.pad(nl, (0, pad))[:, None]
-
+        codes_p, comps_p, nl_p = _pad_rows([codes, comps, nl], blk)
+        node2d = nl_p[:, None]
         parts = []
-        for ch in chunks:
-            f_lo = ch["f_lo"]
-            rel_runs = tuple(
-                ("vec", r[1] - f_lo, r[2] - f_lo, r[3]) if r[0] == "vec"
-                else ("piece", r[1] - f_lo, r[2], r[3], clips[r[1]])
-                for r in ch["runs"])
-            call = _chunk_call(L, C, blk, ch["f_hi"] - f_lo,
-                               ch["w"], rel_runs, interpret)
-            codes_chunk = codes_p[:, f_lo:ch["f_hi"]]
-            parts.append(call(codes_chunk, comps_p, node2d))  # [C, L, w]
+        for ci, ch in enumerate(chunks):
+            call = _build_call(lay.key, target, ci, L, C, blk, False,
+                               low_precision, None, interpret)
+            featok = jnp.ones((1, ch.w), jnp.float32)
+            outs = call(codes_p[:, ch.f_lo:ch.f_hi], comps_p, node2d,
+                        featok)
+            planes = jnp.stack(outs[:C])  # [C, L, W]
+            parts.append(planes[:, :, jnp.asarray(ch.keep)])
         return (parts[0] if len(parts) == 1
                 else jnp.concatenate(parts, axis=2))  # [C, L, T]
 
     return hist_fn
+
+
+def make_codes8_fn(lay):
+    """jit-able (codes [n, F] i32) -> [n, F] int8 low-bandwidth code
+    planes: exact for every feature with <= 128 slots (the int8-eligible
+    chunks); wide features keep reading the i32 matrix."""
+    import jax.numpy as jnp
+
+    cap = np.minimum(lay.clip_max, _LANE - 1).astype(np.int32)
+
+    def build(codes):
+        return jnp.clip(codes, 0, jnp.asarray(cap)[None, :]).astype(
+            jnp.int8)
+
+    return build
+
+
+def make_fused_level_fn(L: int, lay, impurity: str, min_inst: int,
+                        min_gain: float, n_classes: int = 0,
+                        interpret: bool = False,
+                        low_precision: bool = False):
+    """Fused histogram + split-scan entry for one tree level.
+
+    Traced fn (codes, codes8, labels, weights, node_slot, active,
+    feat_ok_t) -> (hist [C, L, T], scan) where `scan` is the reference
+    split_scan 9-tuple (feature, cut_rank, rank_flat, leaf_value,
+    is_split, best_gain, left_mask, node_cnt, left_cnt) — drop-in for
+    tree_trainer's per-level hist+scan pair. `codes8` may be None (i32
+    codes everywhere); when given, int8-eligible chunks read it instead
+    of the i32 matrix."""
+    import jax.numpy as jnp
+
+    C = n_classes if n_classes >= 3 else 3
+    blk_max = blk_setting()
+    target = min(wmax_setting(), _SCAN_W_CAP)
+    chunks = _chunks(lay, target)
+    wide = wide_features(lay, target)
+    comp_dt = jnp.bfloat16 if low_precision else jnp.float32
+    scan_key = (impurity, int(min_inst), float(min_gain), int(n_classes))
+    T, s_max = lay.T, lay.s_max
+    i8_chunks = sum(1 for ch in chunks if ch.narrow)
+    _annotate(lay, chunks, L, True, low_precision, i8_chunks)
+
+    # static epilogue maps over the padded column space
+    start_all = np.concatenate([ch.start for ch in chunks])
+    seg_all = np.concatenate([ch.seg for ch in chunks])
+    keep_all = np.concatenate(
+        [ch.keep + off for ch, off in zip(
+            chunks, np.cumsum([0] + [c.w for c in chunks[:-1]]))])
+    # XLA-fallback sub-layout for chunk-spanning wide features
+    if wide:
+        wide_cols = np.concatenate(
+            [np.arange(int(lay.off[f]), int(lay.off[f]) + int(lay.slots[f]),
+                       dtype=np.int64) for f in wide])
+        w_slots = np.asarray([int(lay.slots[f]) for f in wide], np.int32)
+        w_off = np.zeros(len(wide), np.int32)
+        w_off[1:] = np.cumsum(w_slots[:-1])
+        w_seg = np.repeat(np.arange(len(wide), dtype=np.int32), w_slots)
+        w_pos = np.arange(int(w_slots.sum()), dtype=np.int32) - w_off[w_seg]
+        w_start = w_off[w_seg]
+        w_size = w_slots[w_seg]
+        w_iscat = np.asarray(
+            [bool(lay.is_cat_t[lay.off[f]]) for f in wide])[w_seg]
+        w_clip = np.maximum(w_slots - 1, 0)
+        w_smax = int(w_slots.max())
+        wide_arr = np.asarray(wide, np.int32)
+        from shifu_tpu.train.tree_trainer import _make_scan_fn
+
+        wide_scan = _make_scan_fn(L, int(w_slots.sum()), w_smax, impurity,
+                                  min_inst, min_gain, n_classes)
+    off_c = np.asarray(lay.off)
+    clip_c = np.asarray(lay.clip_max)
+
+    def fused_fn(codes, codes8, labels, weights, node_slot, active,
+                 feat_ok_t):
+        n, F = codes.shape
+        comps = _comps_of(labels, weights, active, n_classes, comp_dt)
+        nl = jnp.where(active, jnp.clip(node_slot, 0, L - 1), 0)
+        blk = min(blk_max, n)
+        pads = _pad_rows(
+            [codes, comps, nl] + ([codes8] if codes8 is not None else []),
+            blk)
+        codes_p, comps_p, nl_p = pads[:3]
+        codes8_p = pads[3] if codes8 is not None else None
+        node2d = nl_p[:, None]
+        fok_f = feat_ok_t.astype(jnp.float32)
+
+        hist_parts, gain_parts, rank_parts, lcnt_parts = [], [], [], []
+        tot0 = None
+        for ci, ch in enumerate(chunks):
+            use_i8 = ch.narrow and codes8_p is not None
+            src = codes8_p if use_i8 else codes_p
+            call = _build_call(lay.key, target, ci, L, C, blk, use_i8,
+                               low_precision, scan_key, interpret)
+            # dynamic per-tree feature mask folded with the static
+            # scannable/gap mask into one [1, W] plane
+            t_clamp = np.where(ch.t_idx >= 0, ch.t_idx, 0)
+            fok = (fok_f[jnp.asarray(t_clamp)]
+                   * jnp.asarray((ch.scan_ok > 0)
+                                 & (ch.pos >= 0), np.float32))[None, :]
+            outs = call(src[:, ch.f_lo:ch.f_hi], comps_p, node2d, fok)
+            planes = jnp.stack(outs[:C])
+            hist_parts.append(planes[:, :, jnp.asarray(ch.keep)])
+            gain_parts.append(outs[C])
+            rank_parts.append(outs[C + 1])
+            lcnt_parts.append(outs[C + 2])
+            tot0 = outs[C + 3] if tot0 is None else tot0 + outs[C + 3]
+
+        hist = (hist_parts[0] if len(hist_parts) == 1
+                else jnp.concatenate(hist_parts, axis=2))  # [C, L, T]
+        gain_all = jnp.concatenate(gain_parts, axis=1)  # [L, ΣW]
+        rank_all = jnp.concatenate(rank_parts, axis=1)
+        lcnt_all = jnp.concatenate(lcnt_parts, axis=1)
+
+        # kernel-side best with the reference's ordered-position
+        # tie-break: o = segment start + within-segment rank
+        o_all = jnp.asarray(start_all, jnp.float32)[None, :] + rank_all
+        gmax = jnp.max(gain_all, axis=-1)
+        cand = gain_all == gmax[:, None]
+        obest = jnp.min(jnp.where(cand, o_all, jnp.inf), axis=-1)
+        best = jnp.argmax(cand & (o_all == obest[:, None]), axis=-1)
+        pick = lambda a: jnp.take_along_axis(  # noqa: E731
+            a, best[:, None], axis=-1)[:, 0]
+        feature = jnp.asarray(seg_all)[best].astype(jnp.int32)
+        cut_rank = pick(rank_all).astype(jnp.int32)
+        left_cnt = pick(lcnt_all)
+        best_gain = gmax
+
+        # rank_flat over the ORIGINAL flat columns (row routing + mask)
+        rank_flat = rank_all[:, jnp.asarray(keep_all)].astype(jnp.int32)
+
+        if wide:
+            sub = wide_scan(
+                hist[:, :, jnp.asarray(wide_cols)],
+                fok_f[jnp.asarray(wide_cols)] > 0,
+                jnp.asarray(w_iscat), jnp.asarray(w_seg),
+                jnp.asarray(w_pos), jnp.asarray(w_start),
+                jnp.asarray(w_size), jnp.asarray(w_off),
+                jnp.asarray(w_clip), int(w_slots[0]))
+            (f_w, cut_w, rank_w, _lv, _sp, g_w, _lm, _nc, lc_w) = sub
+            f_wg = jnp.asarray(wide_arr)[f_w]
+            o_w = jnp.asarray(off_c)[f_wg].astype(jnp.float32) \
+                + cut_w.astype(jnp.float32)
+            take_w = (g_w > best_gain) | ((g_w == best_gain)
+                                          & (o_w < obest))
+            feature = jnp.where(take_w, f_wg, feature)
+            cut_rank = jnp.where(take_w, cut_w, cut_rank)
+            left_cnt = jnp.where(take_w, lc_w, left_cnt)
+            best_gain = jnp.where(take_w, g_w, best_gain)
+            rank_flat = rank_flat.at[:, jnp.asarray(wide_cols)].set(rank_w)
+
+        is_split = jnp.isfinite(best_gain)
+
+        # node stats from the segment-0 totals (summed across chunks)
+        if n_classes >= 3:
+            node_cnt = tot0.sum(axis=1)
+            leaf_value = jnp.argmax(tot0, axis=1).astype(jnp.float32)
+        else:
+            node_cnt = tot0[:, 0]
+            leaf_value = tot0[:, 1] / jnp.maximum(node_cnt, 1e-12)
+
+        # model-facing mask over ORIGINAL codes [L, s_max] (reference
+        # formula, from the merged rank_flat)
+        s_range = jnp.arange(s_max, dtype=jnp.int32)
+        f_clip = jnp.asarray(clip_c)[feature]
+        s_idx = jnp.minimum(s_range[None, :], f_clip[:, None])
+        flat_idx = jnp.asarray(off_c)[feature][:, None] + s_idx
+        ranks = jnp.take_along_axis(rank_flat, flat_idx, axis=-1)
+        left_mask = (
+            (ranks <= cut_rank[:, None])
+            & (s_range[None, :] <= f_clip[:, None])
+            & is_split[:, None]
+        )
+        return hist, (feature, cut_rank, rank_flat, leaf_value, is_split,
+                      best_gain, left_mask, node_cnt, left_cnt)
+
+    return fused_fn

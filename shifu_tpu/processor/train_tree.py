@@ -17,6 +17,17 @@ from shifu_tpu.utils.log import get_logger
 log = get_logger(__name__)
 
 
+def _pallas_fingerprint() -> str:
+    """Resolved kernel lowering for the checkpoint fingerprint: what the
+    process would actually run, not the raw knob string."""
+    from shifu_tpu.ops.hist_pallas import pallas_active
+
+    enabled, interpret = pallas_active()
+    if not enabled:
+        return "xla"
+    return "pallas-interpret" if interpret else "pallas"
+
+
 def train_tree_models(proc, alg) -> None:
     """proc: TrainProcessor (already set up)."""
     from shifu_tpu.norm.normalizer import norm_columns
@@ -167,6 +178,10 @@ def train_tree_models(proc, alg) -> None:
             # fingerprinting them records-and-replays the choice)
             "histSubtraction": cfg.hist_subtraction,
             "maxStatsMemoryMB": cfg.max_stats_memory_mb,
+            # the Pallas fused kernel associates float sums differently
+            # than the XLA lowering (and bf16 GBT planes round at build),
+            # so a resume must replay under the SAME kernel choice
+            "pallasLowering": _pallas_fingerprint(),
             "oneVsAll": bool(mc.train.is_one_vs_all()),
             "dataSignature": data_sig,
         }

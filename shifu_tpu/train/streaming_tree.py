@@ -42,6 +42,7 @@ from shifu_tpu.models.tree import DenseTree, TreeModelSpec
 from shifu_tpu.norm.dataset import read_meta
 from shifu_tpu.train.tree_trainer import (
     DTEarlyStopDecider,
+    _low_precision,
     TreeTrainConfig,
     TreeTrainResult,
     _device_layout,
@@ -149,9 +150,15 @@ def _grow_levelwise_streamed(feed, work, la, lay, cfg, D, row_put,
                     la.clip,
                 )
             for bi, (b0, Lb) in enumerate(ranges):
+                # -Dshifu.pallas.mode routes this through the hist-mode
+                # Pallas kernel (inside shard_map on a mesh): per-shard
+                # code reads feed VMEM-resident planes, no [rows, T]
+                # one-hot materializes between transfer and psum
                 hist_p = _get_hist_program(Lb, lay,
                                            n_classes=cfg.n_classes,
-                                           mesh=mesh)
+                                           mesh=mesh,
+                                           low_precision=_low_precision(
+                                               cfg))
                 if use_sub:
                     nd, in_batch = _sub_row_masks(wk["node"], wk["active"],
                                                   left_small)
@@ -233,7 +240,8 @@ def _grow_leafwise_streamed(feed, work, la, lay, cfg, row_put, pad_to_mesh,
 
     from shifu_tpu.train.tree_trainer import _get_scan_program
 
-    hist1 = _get_hist_program(1, lay, n_classes=cfg.n_classes, mesh=mesh)
+    hist1 = _get_hist_program(1, lay, n_classes=cfg.n_classes, mesh=mesh,
+                              low_precision=_low_precision(cfg))
     scan1 = _get_scan_program(1, lay.T, lay.s_max, cfg.impurity,
                               cfg.min_instances_per_node, cfg.min_info_gain,
                               cfg.n_classes)

@@ -217,6 +217,11 @@ _PROGRAMS: Dict[tuple, object] = {}
 # L-fold redundancy stops paying for itself and the scatter path wins
 MATMUL_CL_CAP = 4096
 
+# the Pallas fused scan unrolls an L-iteration node loop in-kernel; past
+# this node count the generated program outgrows the fusion win and the
+# level drops to hist-mode kernel + XLA scan
+_FUSED_SCAN_L_CAP = 32
+
 
 def _make_comps_of(n_classes: int):
     """Shared histogram component builder: [w, wy, wy^2] for
@@ -570,18 +575,33 @@ def _make_leaf_fn(L: int, n_classes: int = 0):
 
 def _get_hist_program(L: int, lay: FeatureLayout,
                       allow_matmul: bool = True, n_classes: int = 0,
-                      mesh=None):
+                      mesh=None, low_precision: bool = False):
     """Standalone jitted histogram program. With a `mesh`, the builder runs
     under shard_map on per-device row shards and psums the [C, L, T]
     result — the per-level worker-merge for callers (streamed trainer)
-    that drive levels from the host."""
-    key = ("hist", L, lay.key, allow_matmul, n_classes, _mesh_key(mesh))
+    that drive levels from the host. When the Pallas kernel is enabled
+    (-Dshifu.pallas.mode) the builder is the hist-mode kernel — inside
+    the shard_map on a mesh, so each device contracts its own rows in
+    VMEM and only the [C, L, T] partial rides the psum."""
+    p_on, p_interp, _ = _pallas_state(mesh)
+    lowp = bool(low_precision and p_on)
+    key = ("hist", L, lay.key, allow_matmul, n_classes, _mesh_key(mesh),
+           p_on, p_interp, lowp)
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
     import jax
 
-    fn = _make_hist_fn(L, lay, allow_matmul, n_classes)
+    if p_on:
+        from shifu_tpu.ops.hist_pallas import make_pallas_hist_fn
+
+        pfn = make_pallas_hist_fn(L, lay, n_classes=n_classes,
+                                  interpret=p_interp, low_precision=lowp)
+
+        def fn(codes, labels, weights, node, active, *_layout, _pfn=pfn):
+            return _pfn(codes, labels, weights, node, active)
+    else:
+        fn = _make_hist_fn(L, lay, allow_matmul, n_classes)
     if mesh is None:
         prog = jax.jit(fn)
     else:
@@ -608,6 +628,18 @@ def _get_hist_program(L: int, lay: FeatureLayout,
     return prog
 
 
+def _make_scan_fn(L: int, T: int, s_max: int, impurity: str,
+                  min_inst: int, min_gain: float, n_classes: int = 0):
+    """Raw (unjitted) reference split scan — shared by the jitted scan
+    program and the Pallas fused path, which reuses it for the derived
+    sibling halves of histogram subtraction and as the fallback for
+    features too wide for one in-kernel chunk."""
+    if n_classes >= 3:
+        return _make_cls_scan(L, T, s_max, impurity, min_inst, min_gain,
+                              n_classes)
+    return _make_split_scan(L, T, s_max, impurity, min_inst, min_gain)
+
+
 def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
                       min_inst: int, min_gain: float, n_classes: int = 0):
     key = ("scan", L, T, s_max, impurity, min_inst, float(min_gain),
@@ -616,17 +648,20 @@ def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
     if prog is not None:
         return prog
     import jax
+
+    prog = profile.wrap(
+        "tree.split_scan",
+        jax.jit(_make_scan_fn(L, T, s_max, impurity, min_inst, min_gain,
+                              n_classes)))
+    _PROGRAMS[key] = prog
+    return prog
+
+
+def _make_split_scan(L: int, T: int, s_max: int, impurity: str,
+                     min_inst: int, min_gain: float):
+    import jax
     import jax.numpy as jnp
 
-    if n_classes >= 3:
-        prog = profile.wrap(
-            "tree.split_scan",
-            jax.jit(_make_cls_scan(L, T, s_max, impurity, min_inst,
-                                   min_gain, n_classes)))
-        _PROGRAMS[key] = prog
-        return prog
-
-    @jax.jit
     def split_scan(hist, feat_ok_t, is_cat_t, seg_t, pos_t, start_t, size_t,
                    off_f, clip_f, seg0_size):
         """Best split per node from the flat histogram.
@@ -741,9 +776,7 @@ def _get_scan_program(L: int, T: int, s_max: int, impurity: str,
         return (feature, cut_rank, rank_flat, leaf_value, is_split,
                 best_gain, left_mask, node_cnt, left_cnt)
 
-    prog = profile.wrap("tree.split_scan", split_scan)
-    _PROGRAMS[key] = prog
-    return prog
+    return split_scan
 
 
 def _make_cls_scan(L: int, T: int, s_max: int, impurity: str, min_inst: int,
@@ -1089,31 +1122,63 @@ def _mesh_key(mesh) -> Optional[tuple]:
             tuple(int(d.id) for d in mesh.devices.flat))
 
 
-def _use_pallas_hist(mesh) -> bool:
-    """Pallas histogram kernel (ops/hist_pallas.py): OPT-IN via
-    SHIFU_PALLAS=1, TPU-only, single-device. Measured on v5e (500k x 30
-    and 200k x 200-with-wide-cat, 5-tree GBT): the XLA T-chunked matmul
-    lowering is 10-25% faster in-program, so it stays the default; the
-    kernel is kept as the HBM-minimal alternative (codes-only traffic)
-    for larger-than-VMEM histogram regimes."""
-    import os
+def _pallas_state(mesh=None) -> Tuple[bool, bool, bool]:
+    """(enabled, interpret, fused_scan) for the rebuilt Pallas kernel
+    (ops/hist_pallas.py, knob -Dshifu.pallas.mode, default auto = on for
+    TPU backends). fused_scan — the in-kernel split scan — holds only
+    single-device: under a mesh each device's histogram is a PARTIAL
+    that must psum before any gain math, so meshed growers use the
+    kernel in hist-only mode inside shard_map and keep the XLA scan
+    after the collective."""
+    from shifu_tpu.ops.hist_pallas import pallas_active
 
-    import jax
+    enabled, interpret = pallas_active()
+    return enabled, interpret, enabled and mesh is None
 
-    if not os.environ.get("SHIFU_PALLAS"):
-        return False
-    if mesh is not None:
-        return False
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:  # jax backend probe failed: assume not a TPU
-        return False
+
+def _low_precision(cfg: "TreeTrainConfig") -> bool:
+    """bf16 component-plane eligibility for the Pallas kernel: GBT
+    binary/regression only — RF planes must stay f32 so integer-weight
+    counts are exact (the PR-3 bit-parity gate), and NATIVE multiclass
+    planes ARE the counts."""
+    return cfg.algorithm == "GBT" and cfg.n_classes < 3
+
+
+def _get_codes8_program(lay: FeatureLayout):
+    """Cached jit: [n, F] i32 codes -> int8 low-bandwidth planes for the
+    kernel's narrow chunks (hoisted once per forest, like the M cache —
+    codes are node/label/tree-independent)."""
+    key = ("codes8", lay.key)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        import jax
+
+        from shifu_tpu.ops.hist_pallas import make_codes8_fn
+
+        prog = profile.wrap("tree.codes8", jax.jit(make_codes8_fn(lay)))
+        _PROGRAMS[key] = prog
+    return prog
+
+
+def _interleave_children(left_small, built, derived):
+    """Interleave per-parent (built, derived) child values into level
+    order [2*Lh, ...]: the built (smaller) child sits at 2p when the
+    parent's left side was smaller, 2p+1 otherwise."""
+    import jax.numpy as jnp
+
+    Lh = built.shape[0]
+    ls = left_small.reshape((Lh,) + (1,) * (built.ndim - 1))
+    lh = jnp.where(ls, built, derived)
+    rh = jnp.where(ls, derived, built)
+    return jnp.stack([lh, rh], axis=1).reshape((2 * Lh,)
+                                               + built.shape[1:])
 
 
 def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
                       min_inst: int, min_gain: float, n_classes: int = 0,
                       mesh=None, with_m: bool = False,
-                      sub_levels: tuple = (), acc64: bool = False):
+                      sub_levels: tuple = (), acc64: bool = False,
+                      lowp: bool = False):
     """ONE jit program for a whole level-wise tree, levels UNROLLED at
     their exact widths: level d builds a [C, 2^d, T] histogram (≈3.5x less
     padded-node work than running every level at 2^D) and the final level
@@ -1145,8 +1210,11 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
     # off" rather than an IndexError in the level loop
     sub_levels = tuple(bool(s) for s in sub_levels[:D])
     sub_levels += (False,) * (D - len(sub_levels))
+    p_on, p_interp, p_fused = _pallas_state(mesh)
+    lowp = bool(lowp and p_on)
     key = ("tree", D, lay.key, impurity, min_inst, float(min_gain),
-           n_classes, _mesh_key(mesh), with_m, sub_levels, acc64)
+           n_classes, _mesh_key(mesh), with_m, sub_levels, acc64,
+           p_on, p_interp, p_fused, lowp)
     prog = _PROGRAMS.get(key)
     if prog is not None:
         return prog
@@ -1155,24 +1223,45 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
 
     T, s_max = lay.T, lay.s_max
     min_inst_eff = max(min_inst, 1)
-    if with_m:
-        hist_m_fns = [_make_hist_m_fn(2**d, lay, n_classes)
-                      for d in range(D)]
-        hist_fns = None
-    elif _use_pallas_hist(mesh):
-        from shifu_tpu.ops.hist_pallas import make_pallas_hist_fn
+    # the in-kernel scan unrolls an L-iteration node loop over [W, W]
+    # indicators; past this width the program size outweighs the fusion
+    # win, so deeper levels run the hist-mode kernel + the XLA scan
+    fuse_at = [p_fused and 2**d <= _FUSED_SCAN_L_CAP for d in range(D)]
+    fused_fns = [None] * D
+    hist_fns = None
+    hist_m_fns = None
+    if p_on:
+        from shifu_tpu.ops.hist_pallas import (make_fused_level_fn,
+                                               make_pallas_hist_fn)
 
-        pallas_fns = [make_pallas_hist_fn(2**d, lay, n_classes=n_classes)
-                      for d in range(D)]
+        fused_fns = [make_fused_level_fn(
+            2**d, lay, impurity, min_inst_eff, min_gain,
+            n_classes=n_classes, interpret=p_interp, low_precision=lowp)
+            if fuse_at[d] else None for d in range(D)]
+        # hist-mode kernel for the un-fused levels, and for meshed
+        # growers (per device inside shard_map; the scan stays XLA,
+        # after the psum merges the partials)
+        pallas_fns = [make_pallas_hist_fn(2**d, lay, n_classes=n_classes,
+                                          interpret=p_interp,
+                                          low_precision=lowp)
+                      if not fuse_at[d] else None for d in range(D)]
         hist_fns = [
-            (lambda c, lab, wt, nd, act, *_la, _f=f: _f(c, lab, wt, nd, act))
+            (lambda c, lab, wt, nd, act, *_la, _f=f: _f(c, lab, wt, nd,
+                                                        act))
+            if f is not None else None
             for f in pallas_fns
         ]
+    elif with_m:
+        hist_m_fns = [_make_hist_m_fn(2**d, lay, n_classes)
+                      for d in range(D)]
     else:
         hist_fns = [_make_hist_fn(2**d, lay, n_classes=n_classes)
                     for d in range(D)]
     scan_fns = [_get_scan_program(2**d, T, s_max, impurity, min_inst_eff,
                                   min_gain, n_classes) for d in range(D)]
+    raw_scan_fns = ([_make_scan_fn(2**d, T, s_max, impurity, min_inst_eff,
+                                   min_gain, n_classes) for d in range(D)]
+                    if p_fused else None)
     leaf_acc, leaf_finalize = _make_leaf_fn(2**D, n_classes)
 
     # static layout constants (closed over; jit hoists them once)
@@ -1193,7 +1282,7 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
     acc_dt = jnp.float64 if acc64 else jnp.float32
     derive = _get_derive_program()
 
-    def tree_body(codes, labels, weights, feat_ok_t, M=None):
+    def tree_body(codes, labels, weights, feat_ok_t, M=None, codes8=None):
         n = codes.shape[0]
         node = jnp.zeros(n, jnp.int32)
         active = jnp.ones(n, bool)
@@ -1209,20 +1298,54 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
                                   off_c, clip_c, seg_c, pos_c)
             return jax.lax.psum(h, r_axes) if on_mesh else h
 
+        def xla_scan(idx, hist, raw=False):
+            fn = raw_scan_fns[idx] if raw else scan_fns[idx]
+            return fn(hist, feat_ok_t, is_cat_c, seg_c, pos_c, start_c,
+                      size_c, off_c, clip_c, seg0)
+
         for d in range(D):
             L = 2**d
-            if prev is not None:  # sub_levels[d]: derive from the parent
+            if prev is not None and fuse_at[d - 1]:
+                # subtraction composed with the fused kernel: grow only
+                # the SMALLER child in-kernel (hist + its scan in one
+                # pass), derive the sibling as parent − built and scan it
+                # with the XLA reference, then interleave per parent
+                p_hist, p_split, p_lcnt, p_ncnt = prev
+                left_small = p_lcnt <= p_ncnt - p_lcnt
+                nhalf, build_row = _sub_row_masks(node, active, left_small)
+                built, scan_b = fused_fns[d - 1](
+                    codes, codes8, labels, weights, nhalf, build_row,
+                    feat_ok_t)
+                b_acc = built.astype(p_hist.dtype)
+                derived = jnp.where(p_split[None, :, None],
+                                    p_hist - b_acc,
+                                    jnp.zeros_like(p_hist))
+                scan_d = xla_scan(d - 1, derived.astype(jnp.float32),
+                                  raw=True)
+                (bf, br, rank_flat, lv, is_split, _g, lm, nc, lc) = tuple(
+                    _interleave_children(left_small, xb, xd)
+                    for xb, xd in zip(scan_b, scan_d))
+                hist_acc = jnp.concatenate(
+                    [_interleave_children(left_small, b_acc[c], derived[c])
+                     [None] for c in range(b_acc.shape[0])], axis=0)
+            elif prev is None and fuse_at[d]:
+                hist, scan_t = fused_fns[d](codes, codes8, labels, weights,
+                                            node, active, feat_ok_t)
+                (bf, br, rank_flat, lv, is_split, _g, lm, nc, lc) = scan_t
+                hist_acc = hist.astype(acc_dt) if acc64 else hist
+            elif prev is not None:  # sub_levels[d]: derive from the parent
                 p_hist, p_split, p_lcnt, p_ncnt = prev
                 left_small = p_lcnt <= p_ncnt - p_lcnt
                 nhalf, build_row = _sub_row_masks(node, active, left_small)
                 built = call_hist(d - 1, nhalf, build_row)
                 hist, hist_acc = derive(p_hist, built, p_split, left_small)
+                (bf, br, rank_flat, lv, is_split, _g, lm, nc,
+                 lc) = xla_scan(d, hist)
             else:
                 hist = call_hist(d, node, active)
                 hist_acc = hist.astype(acc_dt) if acc64 else hist
-            (bf, br, rank_flat, lv, is_split, _g, lm, nc, lc) = scan_fns[d](
-                hist, feat_ok_t, is_cat_c, seg_c, pos_c, start_c, size_c,
-                off_c, clip_c, seg0)
+                (bf, br, rank_flat, lv, is_split, _g, lm, nc,
+                 lc) = xla_scan(d, hist)
             prev = ((hist_acc, is_split, lc, nc)
                     if d + 1 < D and sub_levels[d + 1] else None)
             base = L - 1
@@ -1267,9 +1390,18 @@ def _get_tree_program(D: int, lay: FeatureLayout, impurity: str,
             in_specs=(rspec, rspec, rspec, P()),
             out_specs=(P(), P(), P(), rspec, rspec))
         prog = jax.jit(body)
+    elif p_fused:
+        def fused_entry(codes, codes8, labels, weights, feat_ok_t):
+            return tree_body(codes, labels, weights, feat_ok_t,
+                             codes8=codes8)
+
+        prog = jax.jit(fused_entry)
     else:
         prog = jax.jit(tree_body)
-    prog = profile.wrap("tree.whole_tree", prog)
+    # the fused-kernel grower is its own profiler seam so `shifu profile
+    # --diff` can compare it against the XLA path's tree.whole_tree
+    prog = profile.wrap("tree.pallas_fused" if p_fused
+                        else "tree.whole_tree", prog)
     _PROGRAMS[key] = prog
     return prog
 
@@ -1324,16 +1456,24 @@ def build_tree(
     # per-level dispatch chains on remote TPU links). The program bakes
     # the layout in; only the feature-subset mask transfers.
     if 2**D <= batch_cap:
+        lowp = _low_precision(cfg)
         prog = _get_tree_program(D, lay, cfg.impurity,
                                  cfg.min_instances_per_node,
                                  cfg.min_info_gain,
                                  n_classes=cfg.n_classes, mesh=mesh,
-                                 sub_levels=sub_levels, acc64=acc64)
+                                 sub_levels=sub_levels, acc64=acc64,
+                                 lowp=lowp)
         fot = jnp.asarray(np.asarray(feat_ok, bool)[lay.seg_of_t])
         if replicate_fn is not None:
             fot = replicate_fn(fot)
-        feats_d, masks_d, leaves_d, resting, _row_pred = prog(
-            codes, labels, weights, fot)
+        _p_on, _p_int, p_fused = _pallas_state(mesh)
+        if p_fused:
+            codes8 = _get_codes8_program(lay)(codes)
+            feats_d, masks_d, leaves_d, resting, _row_pred = prog(
+                codes, codes8, labels, weights, fot)
+        else:
+            feats_d, masks_d, leaves_d, resting, _row_pred = prog(
+                codes, labels, weights, fot)
         import jax
 
         _record_hist_counters(
@@ -1358,6 +1498,7 @@ def build_tree(
     derive = _get_derive_program()
     acc_dt = jnp.float64 if acc64 else jnp.float32
     sub_on = cfg.hist_subtraction
+    lowp = _low_precision(cfg)
     n_built = n_derived = n_fallback = 0
     feat_levels, mask_levels, leaf_levels = [], [], []
     prev = None  # retained parent level (hist_acc, is_split, lcnt, ncnt)
@@ -1374,7 +1515,8 @@ def build_tree(
             left_small = p_lcnt <= p_ncnt - p_lcnt
             nhalf, build_row = _sub_row_masks(node_local, active, left_small)
             hist_p = _get_hist_program(Lh, lay, allow_matmul=mesh is None,
-                                       n_classes=cfg.n_classes)
+                                       n_classes=cfg.n_classes,
+                                       low_precision=lowp)
             built = hist_p(codes, labels, weights, nhalf, build_row,
                            la.off, la.clip, la.seg_t, la.pos_t)
             hist_f32, hist_acc = derive(p_hist, built, p_split, left_small)
@@ -1383,7 +1525,8 @@ def build_tree(
             n_derived += Lh
         elif retain_next:  # full rebuild, kept whole for the next level
             hist_p = _get_hist_program(L, lay, allow_matmul=mesh is None,
-                                       n_classes=cfg.n_classes)
+                                       n_classes=cfg.n_classes,
+                                       low_precision=lowp)
             full = hist_p(codes, labels, weights, node_local, active,
                           la.off, la.clip, la.seg_t, la.pos_t)
             hist_acc = full.astype(acc_dt) if acc64 else full
@@ -1399,7 +1542,8 @@ def build_tree(
                     Lb = min(batch_cap, L - b0)
                     hist_p = _get_hist_program(Lb, lay,
                                                allow_matmul=mesh is None,
-                                               n_classes=cfg.n_classes)
+                                               n_classes=cfg.n_classes,
+                                               low_precision=lowp)
                     in_batch = (active & (node_local >= b0)
                                 & (node_local < b0 + Lb))
                     yield hist_p(codes, labels, weights, node_local - b0,
@@ -1483,7 +1627,8 @@ def build_tree_leafwise(
     # candidate splits per leaf: id -> (gain, feat, cut_rank, rank_row, mask)
     candidates: Dict[int, tuple] = {}
 
-    hist1 = _get_hist_program(1, lay, n_classes=cfg.n_classes)
+    hist1 = _get_hist_program(1, lay, n_classes=cfg.n_classes,
+                              low_precision=_low_precision(cfg))
     scan1 = _get_scan_program(1, lay.T, lay.s_max, cfg.impurity,
                               cfg.min_instances_per_node, cfg.min_info_gain,
                               cfg.n_classes)
@@ -1950,18 +2095,23 @@ def train_trees(
                                  cfg.n_classes)
     fused = (not leaf_wise) and 2**cfg.max_depth <= batch_cap
     M_forest = None
+    codes8_forest = None
+    pallas_fused = False
     if fused:
         replicate_fn = None
         if mesh is not None:
             from shifu_tpu.parallel.mesh import replicate
 
             replicate_fn = lambda a: replicate(a, mesh)  # noqa: E731
+        _p_on, _p_int, pallas_fused = _pallas_state(mesh)
         # hoist the code one-hot across the WHOLE forest when it fits:
         # node-independent, so one bf16 [n, T] build replaces a rebuild +
-        # HBM materialization per level of every tree
+        # HBM materialization per level of every tree. The Pallas fused
+        # kernel supersedes it — M is exactly the [n, T] HBM plane the
+        # kernel exists to not materialize.
         C_hist = cfg.n_classes if cfg.n_classes >= 3 else 3
         n_pad_m = -(-n // _M_BLK) * _M_BLK
-        use_m = (mesh is None
+        use_m = (mesh is None and not pallas_fused
                  and n_pad_m * lay.T * 2 <= _m_budget_bytes()
                  # deepest hist level is 2^(D-1) nodes; cap the A width
                  and C_hist * 2 ** max(cfg.max_depth - 1, 0) <= _M_CL_CAP
@@ -1977,9 +2127,14 @@ def train_trees(
             cfg.min_instances_per_node, cfg.min_info_gain,
             n_classes=cfg.n_classes, mesh=mesh, with_m=use_m,
             sub_levels=sub_levels, acc64=acc64,
+            lowp=_low_precision(cfg),
         )
         if use_m:
             M_forest = _get_m_builder(lay)(codes_j)
+        if pallas_fused:
+            # int8 code planes hoisted once per forest (codes are
+            # tree/level-independent): 4x less kernel code-read bandwidth
+            codes8_forest = _get_codes8_program(lay)(codes_j)
     deferred: List[tuple] = []  # (k, weight, feats_d, masks_d, leaves_d)
     err_pairs: List[tuple] = []  # device (train, valid) when deferred
 
@@ -2066,6 +2221,9 @@ def train_trees(
             if M_forest is not None:
                 feats_d, masks_d, leaves_d, _resting, tree_pred = tree_prog(
                     codes_j, labels_k, w_k, fot, M_forest)
+            elif pallas_fused:
+                feats_d, masks_d, leaves_d, _resting, tree_pred = tree_prog(
+                    codes_j, codes8_forest, labels_k, w_k, fot)
             else:
                 feats_d, masks_d, leaves_d, _resting, tree_pred = tree_prog(
                     codes_j, labels_k, w_k, fot)

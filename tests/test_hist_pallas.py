@@ -1,6 +1,15 @@
-"""Pallas histogram kernel vs the scatter reference (interpret mode on
-CPU; on TPU the same kernel compiles via Mosaic — see ops/hist_pallas.py
-for the measured comparison against the XLA lowering)."""
+"""Fused Pallas histogram→split-scan kernel vs the XLA references
+(interpret mode on CPU; on TPU the same kernels compile via Mosaic — see
+ops/hist_pallas.py for the lane-aligned layout and precision policy).
+
+Covers the PR-11 acceptance matrix: hist parity vs the scatter
+reference, in-kernel split scan == the reference split_scan on
+ragged/wide layouts (33/65-wide segments, multi-chunk wide features),
+RF forest BIT-parity kernel on vs off (binary + NATIVE multiclass),
+GBT tolerance parity level- and leaf-wise, int8-code/bf16-plane bounds,
+histogram-subtraction composition (built ratio still <= 0.55), and the
+-Dshifu.pallas.* knob surface.
+"""
 
 import numpy as np
 import pytest
@@ -8,12 +17,36 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 
-from shifu_tpu.ops.hist_pallas import _chunk_runs, make_pallas_hist_fn
+from shifu_tpu.ops.hist_pallas import (  # noqa: E402
+    _chunks,
+    make_codes8_fn,
+    make_fused_level_fn,
+    make_pallas_hist_fn,
+    pallas_active,
+    wide_features,
+)
 from shifu_tpu.train.tree_trainer import (  # noqa: E402
+    TreeTrainConfig,
     _device_layout,
     _make_hist_fn,
+    _make_scan_fn,
     make_layout,
+    train_trees,
 )
+from shifu_tpu.utils import environment
+
+
+@pytest.fixture
+def pallas_on():
+    environment.set_property("shifu.pallas.mode", "on")
+    try:
+        yield
+    finally:
+        environment.set_property("shifu.pallas.mode", "")
+
+
+def _set_mode(mode):
+    environment.set_property("shifu.pallas.mode", mode)
 
 
 def _ref_hist(L, lay, codes, y, w, node, active, n_classes=0):
@@ -26,25 +59,34 @@ def _ref_hist(L, lay, codes, y, w, node, active, n_classes=0):
                          la.pos_t))
 
 
-def _pallas_hist(L, lay, codes, y, w, node, active, n_classes=0):
+def _pallas_hist(L, lay, codes, y, w, node, active, n_classes=0,
+                 low_precision=False):
     fn = jax.jit(make_pallas_hist_fn(L, lay, n_classes=n_classes,
-                                     interpret=True))
+                                     interpret=True,
+                                     low_precision=low_precision))
     return np.asarray(fn(jnp.asarray(codes), jnp.asarray(y),
                          jnp.asarray(w), jnp.asarray(node),
                          jnp.asarray(active)))
 
 
-def _mixed_case(n=1500, seed=0):
+def _mixed_case(n=1500, seed=0, full_range=False):
     rng = np.random.default_rng(seed)
-    # narrow numerics + a couple of categoricals + one wide categorical
-    # that must split across T-chunks
-    slots = [9] * 6 + [33, 17] + [1500]
+    # narrow numerics + 33/65-wide categoricals (the Mosaic unaligned-
+    # store shapes of the round-5 measured loss) + one wide categorical
+    # that must split across lane-aligned chunks
+    slots = [9] * 6 + [33, 65] + [1500]
     is_cat = [False] * 6 + [True] * 3
+    hi = [s if full_range else s - 1 for s in slots]
     codes = np.stack(
-        [rng.integers(0, s, size=n) for s in slots], 1).astype(np.int32)
+        [rng.integers(0, h, size=n) for h in hi], 1).astype(np.int32)
     y = rng.random(n).astype(np.float32)
     w = rng.integers(1, 4, size=n).astype(np.float32)
     return slots, is_cat, codes, y, w, rng
+
+
+# ---------------------------------------------------------------------------
+# histogram parity
+# ---------------------------------------------------------------------------
 
 
 def test_pallas_matches_scatter_regression():
@@ -73,19 +115,328 @@ def test_pallas_matches_scatter_multiclass():
     np.testing.assert_array_equal(h_ref, h_pl)  # pure counts: exact
 
 
-def test_chunk_runs_cover_layout():
+def test_bf16_plane_parity_bounds():
+    """bf16 component planes: integer-weight COUNT plane stays exact
+    (0/1-valued bf16 operands, f32 MXU accumulation); float moment
+    planes land within bf16 rounding of the f32 reference."""
+    slots, is_cat, codes, y, w, rng = _mixed_case(n=900, seed=5)
+    lay = make_layout(slots, is_cat)
+    L = 4
+    node = rng.integers(0, L, size=len(y)).astype(np.int32)
+    active = np.ones(len(y), bool)
+    w1 = np.ones(len(y), np.float32)
+    h_ref = _ref_hist(L, lay, codes, y, w1, node, active)
+    h_pl = _pallas_hist(L, lay, codes, y, w1, node, active,
+                        low_precision=True)
+    np.testing.assert_array_equal(h_ref[0], h_pl[0])  # counts exact
+    # moments: one bf16 rounding per plane value (~2^-8 relative)
+    np.testing.assert_allclose(h_ref[1:], h_pl[1:], rtol=1e-2, atol=0.15)
+
+
+# ---------------------------------------------------------------------------
+# lane-aligned chunk layout
+# ---------------------------------------------------------------------------
+
+
+def test_chunks_cover_layout_lane_aligned():
     slots, is_cat, *_ = _mixed_case()
     lay = make_layout(slots, is_cat)
-    chunks = _chunk_runs(lay)
-    cols = 0
+    chunks = _chunks(lay)
+    kept = 0
     for ch in chunks:
-        assert ch["w"] == sum(
-            (r[2] - r[1]) * r[3] if r[0] == "vec" else r[3] - r[2]
-            for r in ch["runs"])
-        cols += ch["w"]
-    assert cols == lay.T
-    # the wide categorical must have been split
-    assert any(r[0] == "piece" for ch in chunks for r in ch["runs"])
+        assert ch.w % 128 == 0
+        for (_f, lo, hi, col0) in ch.pieces:
+            assert col0 % 128 == 0  # every piece starts lane-aligned
+        kept += len(ch.keep)
+    assert kept == lay.T  # gaps dropped at compaction, contract unchanged
+    # the 1500-wide categorical exceeds one chunk: handled by the
+    # epilogue's XLA fallback, not the in-kernel scan
+    assert wide_features(lay) == [8]
+    # chunks whose features all fit 128 slots are int8-code eligible;
+    # the 1500-wide feature's chunks are not
+    assert chunks[0].narrow
+    assert not any(ch.narrow for ch in chunks if 8 in
+                   {f for (f, _lo, _hi, _c0) in ch.pieces})
+
+
+def test_codes8_planes():
+    slots, is_cat, codes, *_ = _mixed_case(n=300)
+    lay = make_layout(slots, is_cat)
+    codes8 = np.asarray(jax.jit(make_codes8_fn(lay))(jnp.asarray(codes)))
+    assert codes8.dtype == np.int8
+    # exact for <=128-slot features; wide columns are clamped (unused)
+    np.testing.assert_array_equal(codes8[:, :8], codes[:, :8])
+    assert codes8[:, 8].max() <= 127
+
+
+# ---------------------------------------------------------------------------
+# in-kernel split scan == reference split_scan
+# ---------------------------------------------------------------------------
+
+
+def _run_scan_pair(slots, is_cat, codes, y, w, L, impurity, n_classes=0,
+                   min_inst=2, seed=7, wmax=None):
+    rng = np.random.default_rng(seed)
+    n = len(y)
+    lay = make_layout(slots, is_cat)
+    node = rng.integers(0, L, size=n).astype(np.int32)
+    active = rng.random(n) < 0.95
+    feat_ok = np.ones(len(slots), bool)
+    fot = jnp.asarray(feat_ok[lay.seg_of_t])
+    la = _device_layout(lay, feat_ok)
+    if wmax is not None:
+        environment.set_property("shifu.pallas.wmax", str(wmax))
+    try:
+        h_ref = jax.jit(_make_hist_fn(L, lay, allow_matmul=False,
+                                      n_classes=n_classes))(
+            jnp.asarray(codes), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(node), jnp.asarray(active), la.off, la.clip,
+            la.seg_t, la.pos_t)
+        scan = jax.jit(_make_scan_fn(L, lay.T, lay.s_max, impurity,
+                                     min_inst, 0.0, n_classes))
+        ref = scan(h_ref, fot, la.is_cat_t, la.seg_t, la.pos_t,
+                   la.start_t, la.size_t, la.off, la.clip,
+                   int(lay.slots[0]))
+        fused = jax.jit(make_fused_level_fn(
+            L, lay, impurity, min_inst, 0.0, n_classes=n_classes,
+            interpret=True))
+        hist, out = fused(jnp.asarray(codes), None, jnp.asarray(y),
+                          jnp.asarray(w), jnp.asarray(node),
+                          jnp.asarray(active), fot)
+    finally:
+        if wmax is not None:
+            environment.set_property("shifu.pallas.wmax", "")
+    return h_ref, hist, ref, out
+
+
+def _assert_scan_equal(ref, out, exact_floats):
+    names = ("feature", "cut_rank", "rank_flat", "leaf_value", "is_split",
+             "best_gain", "left_mask", "node_cnt", "left_cnt")
+    for nm, a, b in zip(names, ref, out):
+        a, b = np.asarray(a), np.asarray(b)
+        if nm in ("best_gain", "leaf_value", "node_cnt", "left_cnt"):
+            if exact_floats:
+                np.testing.assert_array_equal(a, b, err_msg=nm)
+            else:
+                np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-3,
+                                           err_msg=nm)
+        else:
+            np.testing.assert_array_equal(a, b, err_msg=nm)
+
+
+@pytest.mark.parametrize("impurity", ["variance", "friedmanmse",
+                                      "entropy", "gini"])
+def test_fused_scan_matches_reference_ragged(impurity):
+    """All four impurities over the ragged 33/65-wide + multi-chunk-wide
+    layout. Integer 0/1 labels x integer weights make every plane an
+    exact integer sum, so even gains/leaves must be BIT-equal between
+    the pairwise-rank kernel formulation and the lexsort reference."""
+    slots, is_cat, codes, _y, w, rng = _mixed_case(n=1300, seed=11)
+    y = (codes[:, 0] >= 4).astype(np.float32)  # 0/1: exact planes
+    h_ref, hist, ref, out = _run_scan_pair(slots, is_cat, codes, y, w,
+                                           L=4, impurity=impurity)
+    np.testing.assert_array_equal(np.asarray(h_ref), np.asarray(hist))
+    _assert_scan_equal(ref, out, exact_floats=True)
+
+
+def test_fused_scan_matches_reference_float_labels():
+    """GBT-shaped float labels: discrete outputs (feature, cut, ranks,
+    masks, split flags) still match exactly; float stats within
+    summation-order tolerance."""
+    slots, is_cat, codes, y, w, _rng = _mixed_case(n=1300, seed=12)
+    _h, _hist, ref, out = _run_scan_pair(slots, is_cat, codes, y, w,
+                                         L=4, impurity="variance")
+    _assert_scan_equal(ref, out, exact_floats=False)
+
+
+def test_fused_scan_matches_reference_multiclass():
+    slots, is_cat, codes, _y, w, rng = _mixed_case(n=1100, seed=13)
+    K = 4
+    cls = rng.integers(0, K, size=len(w)).astype(np.float32)
+    _h, _hist, ref, out = _run_scan_pair(slots, is_cat, codes, cls, w,
+                                         L=2, impurity="entropy",
+                                         n_classes=K)
+    _assert_scan_equal(ref, out, exact_floats=True)
+
+
+def test_fused_scan_chunk_tail_never_splits_fitting_feature():
+    """Regression (PR-11 review): a feature that FITS one chunk must
+    never straddle a chunk tail — its in-kernel scan only sees its own
+    chunk's columns, so a tail split would scan partial histograms
+    while staying off the wide-feature XLA fallback. slots=[850, 300]
+    at wmax 1024 is exactly that shape: f0 pads to 896, leaving 128
+    columns of tail that must NOT receive a piece of f1."""
+    rng = np.random.default_rng(21)
+    slots = [850, 300]
+    is_cat = [True, True]
+    lay = make_layout(slots, is_cat)
+    chunks = _chunks(lay, 1024)
+    assert wide_features(lay, 1024) == []
+    for ch in chunks:  # every piece covers its whole feature
+        for (f, lo, hi, _c0) in ch.pieces:
+            assert (lo, hi) == (0, slots[f])
+    n = 1200
+    codes = np.stack([rng.integers(0, s, size=n) for s in slots],
+                     1).astype(np.int32)
+    y = (codes[:, 1] >= 150).astype(np.float32)
+    w = np.ones(n, np.float32)
+    _h, _hist, ref, out = _run_scan_pair(slots, is_cat, codes, y, w,
+                                         L=2, impurity="variance")
+    _assert_scan_equal(ref, out, exact_floats=True)
+
+
+def test_fused_scan_narrow_wmax_multichunk():
+    """A small -Dshifu.pallas.wmax forces EVERY feature wider than one
+    chunk onto the XLA fallback and splits the narrow ones across many
+    chunks — the composed result must still equal the reference."""
+    slots, is_cat, codes, _y, w, rng = _mixed_case(n=900, seed=14)
+    y = (codes[:, 1] >= 5).astype(np.float32)
+    lay = make_layout(slots, is_cat)
+    assert wide_features(lay, 256) == [8]
+    assert len(_chunks(lay, 256)) > len(_chunks(lay, 1024))
+    _h, _hist, ref, out = _run_scan_pair(slots, is_cat, codes, y, w,
+                                         L=2, impurity="variance",
+                                         wmax=256)
+    _assert_scan_equal(ref, out, exact_floats=True)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end forest parity, kernel on vs off
+# ---------------------------------------------------------------------------
+
+
+def _forest_data(n=2500, seed=0):
+    rng = np.random.default_rng(seed)
+    slots = [17] * 5 + [33, 65]
+    is_cat = [False] * 5 + [True] * 2
+    codes = np.stack([rng.integers(0, s - 1, size=n) for s in slots],
+                     1).astype(np.int32)
+    y = ((codes[:, 0] >= 8).astype(np.int8)
+         | (codes[:, 5] >= 20).astype(np.int8)).astype(np.float32)
+    noise = rng.random(n) < 0.15
+    y = np.where(noise, 1.0 - y, y).astype(np.float32)
+    w = np.ones(n, np.float32)
+    cols = [f"f{i}" for i in range(len(slots))]
+    return codes, y, w, slots, is_cat, cols
+
+
+def _run_mode(mode, codes, y, w, slots, is_cat, cols, cfg):
+    _set_mode(mode)
+    try:
+        return train_trees(codes, y, w, slots, is_cat, cols, cfg)
+    finally:
+        _set_mode("")
+
+
+def _assert_forests_bit_equal(a, b):
+    assert len(a.spec.trees) == len(b.spec.trees)
+    for t0, t1 in zip(a.spec.trees, b.spec.trees):
+        np.testing.assert_array_equal(t0.feature, t1.feature)
+        np.testing.assert_array_equal(t0.left_mask, t1.left_mask)
+        np.testing.assert_array_equal(t0.leaf_value, t1.leaf_value)
+
+
+def test_rf_bit_parity_fused_kernel_binary():
+    """PR-3 gate under the fused kernel: RF integer-weight planes stay
+    f32 and exact, so the forest is BIT-equal kernel on vs off —
+    subtraction composition included (depth 4 engages the derive
+    chain)."""
+    codes, y, w, slots, is_cat, cols = _forest_data()
+    cfg = TreeTrainConfig(algorithm="RF", tree_num=3, max_depth=4,
+                          feature_subset_strategy="TWOTHIRDS", seed=3,
+                          valid_set_rate=0.1)
+    off = _run_mode("off", codes, y, w, slots, is_cat, cols, cfg)
+    on = _run_mode("on", codes, y, w, slots, is_cat, cols, cfg)
+    _assert_forests_bit_equal(off, on)
+    assert off.valid_error == on.valid_error
+
+
+def test_rf_bit_parity_fused_kernel_multiclass():
+    codes, _y, w, slots, is_cat, cols = _forest_data(seed=4)
+    rng = np.random.default_rng(9)
+    y3 = np.clip(codes[:, 0] // 6 + rng.integers(0, 2, len(w)),
+                 0, 2).astype(np.float32)
+    cfg = TreeTrainConfig(algorithm="RF", tree_num=2, max_depth=3,
+                          impurity="gini", n_classes=3, seed=5)
+    off = _run_mode("off", codes, y3, w, slots, is_cat, cols, cfg)
+    on = _run_mode("on", codes, y3, w, slots, is_cat, cols, cfg)
+    _assert_forests_bit_equal(off, on)
+
+
+def test_gbt_tolerance_parity_levelwise():
+    """GBT under the kernel: bf16 planes + matvec summation order means
+    tolerance parity, not bit parity — scores must stay close."""
+    codes, y, w, slots, is_cat, cols = _forest_data(seed=6)
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=4, max_depth=4,
+                          learning_rate=0.3, seed=7, valid_set_rate=0.1)
+    off = _run_mode("off", codes, y, w, slots, is_cat, cols, cfg)
+    on = _run_mode("on", codes, y, w, slots, is_cat, cols, cfg)
+    s_off = off.spec.independent().compute(codes)
+    s_on = on.spec.independent().compute(codes)
+    np.testing.assert_allclose(s_on, s_off, atol=0.03)
+
+
+def test_gbt_tolerance_parity_leafwise():
+    codes, y, w, slots, is_cat, cols = _forest_data(seed=8, n=1500)
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=2, max_depth=6,
+                          max_leaves=7, learning_rate=0.3, seed=9)
+    off = _run_mode("off", codes, y, w, slots, is_cat, cols, cfg)
+    on = _run_mode("on", codes, y, w, slots, is_cat, cols, cfg)
+    s_off = off.spec.independent().compute(codes)
+    s_on = on.spec.independent().compute(codes)
+    np.testing.assert_allclose(s_on, s_off, atol=0.03)
+
+
+def test_subtraction_composition_built_ratio(pallas_on):
+    """Histogram subtraction composes with the fused kernel: the kernel
+    grows only the smaller child, the sibling derives as parent − built,
+    and the built-histogram counters keep the <= 0.55 acceptance ratio
+    of the subtraction-off run."""
+    from shifu_tpu import obs
+
+    codes, y, w, slots, is_cat, cols = _forest_data(n=1200, seed=10)
+    trees, depth = 2, 4
+    cfg = TreeTrainConfig(algorithm="GBT", tree_num=trees,
+                          max_depth=depth, seed=1)
+    cfg_off = TreeTrainConfig(**{**cfg.__dict__, "hist_subtraction": False})
+
+    def counters():
+        snap = obs.registry().snapshot().get("counters", {})
+        return {k.split(".")[-1]: v for k, v in snap.items()
+                if k.startswith("tree.hist.")}
+
+    obs.reset()
+    train_trees(codes, y, w, slots, is_cat, cols, cfg)
+    c_on = counters()
+    obs.reset()
+    train_trees(codes, y, w, slots, is_cat, cols, cfg_off)
+    c_off = counters()
+    leaves = 2 ** depth
+    assert c_on["built"] == trees * (leaves // 2)
+    assert c_on["derived"] == trees * (leaves // 2 - 1)
+    assert c_on["built"] / c_off["built"] <= 0.55
+
+
+# ---------------------------------------------------------------------------
+# knob surface
+# ---------------------------------------------------------------------------
+
+
+def test_mode_knob_resolution():
+    """auto = off on the CPU harness; on = forced with interpret mode;
+    off = XLA. (On a TPU backend auto resolves to the compiled
+    kernel.)"""
+    try:
+        _set_mode("auto")
+        assert pallas_active() == (False, False)  # CPU harness
+        _set_mode("off")
+        assert pallas_active() == (False, False)
+        _set_mode("on")
+        assert pallas_active() == (True, True)  # interpret off-TPU
+        _set_mode("bogus")
+        assert pallas_active() == (False, False)  # falls back to auto
+    finally:
+        _set_mode("")
 
 
 def test_shaping_knobs_and_profiler_annotation():
@@ -95,7 +446,6 @@ def test_shaping_knobs_and_profiler_annotation():
     snapshot so every manifest records what produced its numbers."""
     from shifu_tpu import obs
     from shifu_tpu.ops.hist_pallas import blk_setting, wmax_setting
-    from shifu_tpu.utils import environment
 
     slots, is_cat, codes, y, w, rng = _mixed_case(n=700)
     lay = make_layout(slots, is_cat)
@@ -109,14 +459,16 @@ def test_shaping_knobs_and_profiler_annotation():
     obs.reset()
     try:
         assert blk_setting() == 128 and wmax_setting() == 256
-        # the narrower wmax splits the flat T axis into more chunks
-        assert len(_chunk_runs(lay)) > len(_chunk_runs(lay, target=1024))
+        # the narrower wmax splits the lane-aligned layout into more
+        # chunks
+        assert len(_chunks(lay)) > len(_chunks(lay, target=1024))
         h_pl = _pallas_hist(L, lay, codes, y, w, node, active)
         np.testing.assert_array_equal(h_ref[0], h_pl[0])
         np.testing.assert_allclose(h_ref, h_pl, rtol=2e-5, atol=1e-4)
         ann = obs.profiler().snapshot()["annotations"]["ops.hist_pallas"]
         assert ann["blk"] == 128 and ann["wMax"] == 256
-        assert ann["chunks"] == len(_chunk_runs(lay))
+        assert ann["chunks"] == len(_chunks(lay))
+        assert ann["mode"] in ("auto", "on", "off")
     finally:
         environment.set_property("shifu.pallas.blk", "")
         environment.set_property("shifu.pallas.wmax", "")
