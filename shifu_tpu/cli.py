@@ -202,17 +202,29 @@ def build_parser() -> argparse.ArgumentParser:
                               "the committed file")
 
     p_serve = sub.add_parser(
-        "serve", help="TPU-native online scoring server (HTTP JSONL: "
-                      "POST /score, GET /healthz, GET /metrics)")
+        "serve", help="TPU-native online scoring fleet (HTTP JSONL: "
+                      "POST /score, GET /healthz, GET /metrics; one "
+                      "scoring replica per device behind a drain-aware "
+                      "router)")
     p_serve.add_argument("--host", default="127.0.0.1")
     p_serve.add_argument("--port", type=int, default=8080,
                          help="listen port (0 = ephemeral, printed on "
                               "stdout)")
     p_serve.add_argument("--models-dir", default=None, dest="models_dir",
                          help="model spec dir (default: <root>/models)")
+    p_serve.add_argument("--replicas", type=int, default=None,
+                         help="scoring replicas, one per device "
+                              "(default -Dshifu.serve.replicas; 0 = "
+                              "all local devices)")
+    p_serve.add_argument("--batching", default=None,
+                         choices=["continuous", "barrier"],
+                         help="micro-batch close policy (default "
+                              "-Dshifu.serve.batching=continuous: close "
+                              "on capacity or queue-dry, never a wall "
+                              "clock)")
     p_serve.add_argument("--queue-depth", type=int, default=None,
                          dest="queue_depth",
-                         help="admission queue depth "
+                         help="admission queue depth PER REPLICA "
                               "(default -Dshifu.serve.queueDepth=128; "
                               "beyond it requests shed with 429)")
     p_serve.add_argument("--max-batch-rows", type=int, default=None,
@@ -220,7 +232,8 @@ def build_parser() -> argparse.ArgumentParser:
                          help="micro-batch row cap (default 1024)")
     p_serve.add_argument("--max-wait-ms", type=float, default=None,
                          dest="max_wait_ms",
-                         help="micro-batch deadline in ms (default 2.0)")
+                         help="barrier-mode micro-batch deadline in ms "
+                              "(default 2.0)")
     p_serve.add_argument("--warm", default=None,
                          help="comma-separated batch sizes to pre-compile "
                               "at startup (e.g. 1,16,256)")
@@ -466,7 +479,8 @@ def dispatch(args: argparse.Namespace) -> int:
                 root=".", models_dir=args.models_dir, host=args.host,
                 port=args.port, queue_depth=args.queue_depth,
                 max_batch_rows=args.max_batch_rows,
-                max_wait_ms=args.max_wait_ms)
+                max_wait_ms=args.max_wait_ms,
+                replicas=args.replicas, batching=args.batching)
         except (ValueError, OSError) as e:  # bad --warm / no models / port in use
             log.error("serve: %s", e)
             return 1
@@ -486,7 +500,8 @@ def dispatch(args: argparse.Namespace) -> int:
         signal.signal(signal.SIGTERM, _stop)
         # the bound port on stdout is the contract for scripted callers
         # (--port 0 smoke tests); logs go to stderr
-        print(f"listening on {server.host}:{server.port}", flush=True)
+        print(f"listening on {server.host}:{server.port} "
+              f"({len(server.registry.replicas)} replica(s))", flush=True)
         # -Dshifu.sanitize=... arms the runtime sanitizer for the whole
         # serving run (the step-wrapper analog): transfer seams consult
         # the active sanitizer, and the shutdown manifest embeds its

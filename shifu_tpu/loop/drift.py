@@ -118,7 +118,19 @@ class DriftMonitor:
         self.coded_cols = [c for c in self.cols if c.kind == "coded"]
         self._lock = tracked_lock("loop.drift")
         self._host = np.zeros(self.total_slots, dtype=np.float64)
-        self._window = None      # f32 device window (jnp [total_slots])
+        # f32 device windows keyed by (owner, device) — owner is the
+        # folding replica's label, so each window has exactly ONE
+        # worker thread folding into it even when replicas share a
+        # device: the fleet shares ONE monitor, but each replica's
+        # fused fold must read/write an array resident on ITS device —
+        # the host f64 fold below is where the per-key windows merge.
+        # _epochs[key] bumps every time a flush swaps that key's window
+        # out: a fold whose BASE window was already merged must not be
+        # re-adopted (its base would double-count), so note_window drops
+        # it — one micro-batch's counts lost at a flush boundary is
+        # statistical noise; double-counting the whole window is not.
+        self._windows: Dict = {}
+        self._epochs: Dict = {}
         self._window_rows = 0
         self._rows = 0
         self._degraded: List[str] = []
@@ -233,31 +245,43 @@ class DriftMonitor:
             w.reshape(-1), mode="drop")
 
     # ---- window lifecycle ----
-    def window(self):
-        """(resident device window, generation) — created on first use.
-        Pass the generation back to note_window: a fold that straddles
-        a promotion reset() (window read -> dispatch -> adopt) would
-        otherwise reinstate the OLD version's counts into the cleared
-        monitor."""
-        import jax.numpy as jnp
+    def window(self, device=None, owner: Optional[str] = None):
+        """(resident device window for (owner, device), generation
+        token) — created on first use per key. Pass the token back to
+        note_window: a fold that straddles a promotion reset() OR a
+        concurrent flush (window read -> dispatch -> adopt) would
+        otherwise reinstate counts the host fold already absorbed."""
+        import jax
 
+        key = (owner, device)
         with self._lock:
-            if self._window is None:
-                self._window = jnp.zeros(self.total_slots, jnp.float32)
-            return self._window, self._gen
+            win = self._windows.get(key)
+            if win is None:
+                win = jax.device_put(
+                    np.zeros(self.total_slots, np.float32), device)
+                self._windows[key] = win
+            return win, (self._gen, self._epochs.get(key, 0))
 
     def note_window(self, new_window, rows: int,
-                    gen: Optional[int] = None) -> None:
-        """Adopt the post-fold window; flush to the f64 host fold when the
-        window's row budget is spent (ONE device->host sync per window).
-        The sync itself happens OUTSIDE the lock (SH203): a health/metrics
-        probe taking the lock must never queue behind a d2h transfer."""
+                    gen=None, device=None,
+                    owner: Optional[str] = None) -> None:
+        """Adopt the post-fold window for (owner, device); flush ALL
+        windows to the f64 host fold when the summed row budget is spent
+        (ONE device->host sync per window per key). The sync itself
+        happens OUTSIDE the lock (SH203): a health/metrics probe taking
+        the lock must never queue behind a d2h transfer."""
+        key = (owner, device)
         with self._lock:
-            if gen is not None and gen != self._gen:
-                # reset() landed between window() and here: this fold
-                # counted the old version's traffic — drop it
-                return
-            self._window = new_window
+            if gen is not None:
+                want = (self._gen, self._epochs.get(key, 0))
+                if (gen if isinstance(gen, tuple) else (gen, 0)) != want:
+                    # reset() (a promotion — the fold counted the old
+                    # version's traffic) or a concurrent _flush (the
+                    # fold's BASE window is already in the host fold —
+                    # adopting base+delta would double-count the base)
+                    # landed between window() and here: drop the fold
+                    return
+            self._windows[key] = new_window
             self._window_rows += rows
             self._rows += rows
             need_flush = self._window_rows > WINDOW_FLUSH_ROWS
@@ -273,7 +297,7 @@ class DriftMonitor:
         once). The baseline stays — it is the training ColumnConfig."""
         with self._lock:
             self._host = np.zeros(self.total_slots, dtype=np.float64)
-            self._window = None
+            self._windows = {}
             self._window_rows = 0
             self._rows = 0
             self._degraded = []
@@ -315,23 +339,30 @@ class DriftMonitor:
         from shifu_tpu.obs import registry
 
         with self._lock:
-            window, rows = self._window, self._window_rows
-            if window is None or rows == 0:
+            windows, rows = self._windows, self._window_rows
+            if not windows or rows == 0:
                 return
-            import jax.numpy as jnp
-
-            self._window = jnp.zeros(self.total_slots, jnp.float32)
+            # swap the whole window family out; fresh zeros lazily
+            # re-create on each key's next window() call. Bumping each
+            # key's epoch invalidates any fold in flight against the
+            # swapped-out base (note_window drops it instead of
+            # double-counting the base into the next flush).
+            for key in windows:
+                self._epochs[key] = self._epochs.get(key, 0) + 1
+            self._windows = {}
             self._window_rows = 0
             gen = self._gen
         import jax
 
-        counts = np.asarray(jax.device_get(window), dtype=np.float64)
+        counts = np.zeros(self.total_slots, dtype=np.float64)
+        for win in windows.values():
+            counts += np.asarray(jax.device_get(win), dtype=np.float64)
         with self._lock:
             if self._gen == gen:
                 self._host += counts
             # else: reset() (a promotion) landed mid-flush — the
-            # swapped window counted the OLD version's traffic; merging
-            # it would pollute the new version's fold, so drop it
+            # swapped windows counted the OLD version's traffic; merging
+            # them would pollute the new version's fold, so drop them
         registry().counter("loop.drift.flushes").inc()
 
     # ---- verdicts ----

@@ -108,6 +108,10 @@ class ShadowStats:
             return {
                 "batches": self.batches,
                 "rows": self.rows,
+                # raw count alongside the rate: the fleet's cross-replica
+                # psum aggregation needs an additive quantity (rates
+                # don't sum; agree-row counts do)
+                "agreeRows": self.agree_rows,
                 "errors": self.errors,
                 "tolerance": self.tolerance,
                 "agreement": (self.agree_rows / rows if self.rows else 0.0),
@@ -118,10 +122,18 @@ class ShadowStats:
 
 
 class SwappableRegistry:
-    """Atomic active/shadow pair behind one `score_raw` entry point."""
+    """Atomic active/shadow pair behind one `score_raw` entry point.
 
-    def __init__(self, registry: ModelRegistry) -> None:
+    In a fleet (serve/fleet.py) each replica owns one SwappableRegistry,
+    so a rolling promote flips replicas one at a time; `labels`
+    (typically {"replica": "<i>"}) ride the per-version serve.version.*
+    counters so every answered request stays attributable to (replica,
+    sha) across the roll."""
+
+    def __init__(self, registry: ModelRegistry,
+                 labels: Optional[dict] = None) -> None:
         self._lock = tracked_lock("loop.hotswap.swap")
+        self.labels = dict(labels or {})
         self._active = registry
         self._shadow: Optional[ModelRegistry] = None
         self._shadow_stats: Optional[ShadowStats] = None
@@ -141,9 +153,10 @@ class SwappableRegistry:
         # must not re-attribute the batch to the NEW version
         self._last_scored_sha = active.sha
         reg = obs_registry()
-        reg.counter("serve.version.batches", sha=active.sha).inc()
-        reg.counter("serve.version.records", sha=active.sha).inc(
-            data.n_rows)
+        reg.counter("serve.version.batches", sha=active.sha,
+                    **self.labels).inc()
+        reg.counter("serve.version.records", sha=active.sha,
+                    **self.labels).inc(data.n_rows)
         return result
 
     # ---- registry façade (what the server/front end reads) ----
@@ -191,7 +204,9 @@ class SwappableRegistry:
         from shifu_tpu.obs import registry as obs_registry
 
         cand = ModelRegistry(models_dir, column_configs=column_configs,
-                             model_config=model_config, drift=drift)
+                             model_config=model_config, drift=drift,
+                             device=getattr(self._active, "device", None),
+                             labels=getattr(self._active, "labels", None))
         # staged: shadow scoring must not double-count drift rows the
         # active fold already saw; promotion flips the fold live
         cand.drift_live = False

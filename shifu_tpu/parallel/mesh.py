@@ -188,6 +188,63 @@ def replicate(tree, mesh):
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)
 
 
+_FLEET_REDUCE_PROGRAMS: dict = {}
+
+
+def fleet_mesh(n_devices: int):
+    """Mesh over the first `n_devices` devices — the serving fleet's
+    replica devices are always a prefix of jax.devices() (serve/fleet.py
+    assigns replica i -> device i % ndev), so this is the mesh whose row
+    shards line up one-to-one with the fleet's distinct devices. Shares
+    the lifecycle mesh cache: the fleet's reduce and the lifecycle folds
+    deliberately run on ONE mesh family (the TensorFlow/DrJAX argument —
+    train and serve share a compiled-graph substrate)."""
+    return lifecycle_mesh(n_shards=max(1, int(n_devices)))
+
+
+def fleet_reduce(mesh, parts: np.ndarray, max_cols: int = 0) -> np.ndarray:
+    """One-collective merge of per-device stat vectors — the serving
+    fleet's analog of ops/binagg.window_reduce: `parts` is [D, K] with
+    one row per mesh device, the leading K-max_cols columns reduce with
+    psum and the trailing `max_cols` columns with pmax (extrema don't
+    sum), and every device ends up with the same replicated [K] result —
+    the host pulls ONE vector, not D.
+
+    Used for cross-replica shadow-agreement evidence (rolling promote):
+    each replica's counts stage onto its own device, one psum tree
+    closes the fleet verdict."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    parts = np.asarray(parts, dtype=np.float32)
+    axes = row_axes(mesh)
+    n_shards = row_shard_count(mesh)
+    assert parts.shape[0] == n_shards, (parts.shape, n_shards)
+    key = (id(mesh), int(parts.shape[1]), int(max_cols))
+    prog = _FLEET_REDUCE_PROGRAMS.get(key)
+    if prog is None:
+        def local(v):  # v: [1, K] — this device's stat row
+            summed = jax.lax.psum(v, axes)
+            if max_cols:
+                maxed = jax.lax.pmax(v[:, -max_cols:], axes)
+                summed = jnp.concatenate(
+                    [summed[:, : v.shape[1] - max_cols], maxed], axis=1)
+            return summed[0]
+
+        prog = jax.jit(shard_map_compat(
+            local, mesh=mesh,
+            in_specs=(P(axes if len(axes) > 1 else axes[0], None),),
+            out_specs=P()))
+        _FLEET_REDUCE_PROGRAMS[key] = prog
+    spec = P(axes if len(axes) > 1 else axes[0], None)
+    staged = jax.device_put(parts, NamedSharding(mesh, spec))
+    from shifu_tpu.obs import registry
+
+    registry().counter("serve.fleet.reduces").inc()
+    return np.asarray(jax.device_get(prog(staged)), dtype=np.float64)
+
+
 def shard_map_compat(fn, *, mesh, in_specs, out_specs, check: bool = False):
     """shard_map across jax versions: newer jax exports `jax.shard_map`
     (replication checking spelled `check_vma`), 0.4.x only has

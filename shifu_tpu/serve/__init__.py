@@ -3,10 +3,12 @@
 The training side of the lifecycle ends at `eval`/`export`; this package
 is the missing serving side: a model registry that loads a model set once
 and fuses raw-record normalization + forward + aggregation into one jit
-program (registry.py), a dynamic micro-batcher that coalesces concurrent
-requests into power-of-two shape buckets (batcher.py), a bounded admission
-queue with explicit load-shed rejections (queue.py), and a stdlib-only
-HTTP JSONL front end plus an in-process Scorer API (server.py).
+program (registry.py), a dynamic micro-batcher with continuous (in-flight
+admission) or barrier batching into power-of-two shape buckets
+(batcher.py), a bounded admission queue with explicit load-shed
+rejections (queue.py), an N-replica scoring fleet — one replica per
+device — behind a drain-aware router (fleet.py), and a stdlib-only HTTP
+JSONL front end plus an in-process Scorer API (server.py).
 
     from shifu_tpu.serve import ModelRegistry, ScoringServer
 
@@ -15,23 +17,34 @@ HTTP JSONL front end plus an in-process Scorer API (server.py).
     ...
     server.shutdown()                     # drain + run-ledger manifest
 
-Knobs (all `-Dk=v` properties):
-    shifu.serve.queueDepth     admission queue depth (default 128)
+Knobs (all `-Dk=v` properties; full catalog in docs/KNOBS.md):
+    shifu.serve.replicas       scoring replicas (0 = all local devices)
+    shifu.serve.batching       continuous | barrier (default continuous)
+    shifu.serve.queueDepth     admission depth PER REPLICA (default 128)
     shifu.serve.maxBatchRows   micro-batch row cap (default 1024)
-    shifu.serve.maxWaitMs      batching deadline in ms (default 2.0)
+    shifu.serve.maxWaitMs      barrier-mode coalesce deadline (ms)
+    shifu.serve.routerPenalty  degraded-replica expected-wait multiplier
 """
 
 from shifu_tpu.serve.batcher import MicroBatcher, ScoreRequest
+from shifu_tpu.serve.fleet import (
+    DrainAwareRouter,
+    ReplicaFleet,
+    ScoringReplica,
+)
 from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
 from shifu_tpu.serve.registry import ModelRegistry
 from shifu_tpu.serve.server import Scorer, ScoringServer
 
 __all__ = [
     "AdmissionQueue",
+    "DrainAwareRouter",
     "MicroBatcher",
     "ModelRegistry",
     "RejectedError",
+    "ReplicaFleet",
     "ScoreRequest",
     "Scorer",
+    "ScoringReplica",
     "ScoringServer",
 ]

@@ -3,19 +3,36 @@
 Single-record dispatches waste the accelerator (a 1-row matmul costs the
 same launch overhead as a 1024-row one); unbounded batching wastes the
 client's latency budget. The batcher sits between the admission queue and
-the fused registry program and closes each batch on whichever bound hits
-first:
+the fused registry program and closes each batch by one of two policies
+(`shifu.serve.batching`):
 
-  * row cap       shifu.serve.maxBatchRows (default 1024)
-  * wait deadline shifu.serve.maxWaitMs    (default 2.0 ms after the
-                  batch's FIRST request arrives — a lone request never
-                  waits longer than that for company)
+  continuous (default) — in-flight admission: requests coalesce in the
+      admission queue WHILE the previous dispatch is on device, and the
+      bucket closes on capacity (`shifu.serve.maxBatchRows`) or the
+      instant the queue runs dry — never on a wall clock. An idle
+      replica dispatches a lone request immediately instead of parking
+      it `maxWaitMs` hoping for company, so p99 under load stops paying
+      the coalesce deadline: the previous dispatch's device time IS the
+      coalescing window.
+  barrier — the pre-fleet policy, kept for comparison benches and
+      deployments that want a minimum coalesce window:
+
+      * row cap       shifu.serve.maxBatchRows (default 1024)
+      * wait deadline shifu.serve.maxWaitMs    (default 2.0 ms after the
+                      batch's FIRST request arrives)
 
 Coalesced rows concatenate into one raw batch, score in one fused
 dispatch (the registry pads to the power-of-two row bucket, so compile
-count stays bounded whatever sizes traffic produces), and the result is
-sliced back per request — padding rows belong to the registry, request
-boundaries to the batcher, and neither leaks into the other.
+count stays bounded whatever sizes traffic produces — continuous
+buckets close ragged and pad to the same power-of-two shapes), and the
+result is sliced back per request — padding rows belong to the
+registry, request boundaries to the batcher, and neither leaks into the
+other.
+
+Fleet context (serve/fleet.py): one batcher serves one replica. `labels`
+(typically {"replica": "0"}) ride every serve.* metric the batcher
+records, and `expected_wait`/`drain_stats` expose the observed drain
+rate the DrainAwareRouter places micro-batches by.
 
 One worker thread keeps ordering FIFO and the device queue depth at one
 batch; requests resolve through a per-request event (`ScoreRequest.wait`).
@@ -38,7 +55,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -56,6 +73,8 @@ DEFAULT_MAX_BATCH_ROWS = 1024
 DEFAULT_MAX_WAIT_MS = 2.0
 DEFAULT_MAX_WORKER_RESTARTS = 5
 DEFAULT_DEADLINE_MS = 30_000.0
+BATCHING_CONTINUOUS = "continuous"
+BATCHING_BARRIER = "barrier"
 # Retry-After clamp: never tell a client "come back immediately" while
 # shedding, never park it longer than half a minute on a stale estimate
 RETRY_AFTER_MIN_S = 1.0
@@ -90,6 +109,15 @@ def max_wait_ms_setting() -> float:
 def max_worker_restarts_setting() -> int:
     return environment.get_int("shifu.serve.maxWorkerRestarts",
                                DEFAULT_MAX_WORKER_RESTARTS)
+
+
+def batching_setting() -> str:
+    """shifu.serve.batching — continuous (close buckets on capacity or
+    queue-dry, never a wall clock) | barrier (the maxWaitMs coalesce
+    deadline). Unknown values fall back to continuous."""
+    raw = environment.get_property("shifu.serve.batching", "").strip()
+    return (BATCHING_BARRIER if raw.lower() == BATCHING_BARRIER
+            else BATCHING_CONTINUOUS)
 
 
 def deadline_ms_setting() -> float:
@@ -183,9 +211,18 @@ class MicroBatcher:
                  max_restarts: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
                  observer: Optional[Callable[[ColumnarData, ScoreResult],
-                                             None]] = None) -> None:
+                                             None]] = None,
+                 batching: Optional[str] = None,
+                 labels: Optional[dict] = None) -> None:
         self.score_fn = score_fn
         self.admission = admission
+        # metric identity: the fleet passes {"replica": "<i>"} so every
+        # serve.* sample this batcher records is attributable to its
+        # replica on one shared /metrics page
+        self.labels = dict(labels or {})
+        self.batching = batching_setting() if batching is None else (
+            BATCHING_BARRIER if str(batching).lower() == BATCHING_BARRIER
+            else BATCHING_CONTINUOUS)
         # post-resolution hook: runs AFTER every request in the batch has
         # its answer, so traffic logging / shadow scoring / drift checks
         # (the continuous-loop seams) never add to client latency. An
@@ -246,7 +283,7 @@ class MicroBatcher:
         except BaseException as e:  # supervisor: ANY worker death (incl.
             # injected faults and non-Exception crashes) must be survived
             reg = registry()
-            reg.counter("serve.worker.crashes").inc()
+            reg.counter("serve.worker.crashes", **self.labels).inc()
             log.warning("serve scoring worker crashed: %s: %s",
                         type(e).__name__, e)
             # the batch being scored when the worker died: every request
@@ -274,14 +311,21 @@ class MicroBatcher:
                 self._drained.set()
                 return
             self.restarts += 1
-            reg.counter("serve.worker.restarts").inc()
+            reg.counter("serve.worker.restarts", **self.labels).inc()
             log.info("restarting serve scoring worker (%d/%d)",
                      self.restarts, self.max_restarts)
             self._worker = self._spawn()
 
     def _gather(self) -> Optional[List[ScoreRequest]]:
-        """Block for the next request, then coalesce until the row cap or
-        the max-wait deadline. None = queue closed and fully drained."""
+        """Block for the next request, then coalesce into the bucket.
+        None = queue closed and fully drained.
+
+        Continuous mode: everything already queued joins (up to the row
+        cap) and the bucket closes the instant the queue runs dry — the
+        coalescing window was the previous dispatch's device time, and
+        a lone request on an idle replica dispatches immediately.
+        Barrier mode: the bucket additionally holds up to `maxWaitMs`
+        after the FIRST request, the pre-fleet policy."""
         first = self.admission.get()
         if first is None:
             return None
@@ -292,6 +336,14 @@ class MicroBatcher:
         # still coalescing
         self._inflight = batch
         rows = first.n_rows
+        if self.batching == BATCHING_CONTINUOUS:
+            while rows < self.max_batch_rows:
+                nxt = self.admission.get(timeout=0)
+                if nxt is None:
+                    break  # capacity not hit but nothing is waiting NOW
+                batch.append(nxt)
+                rows += nxt.n_rows
+            return batch
         deadline = time.perf_counter() + self.max_wait_s
         while rows < self.max_batch_rows:
             remaining = deadline - time.perf_counter()
@@ -320,7 +372,7 @@ class MicroBatcher:
             live: List[ScoreRequest] = []
             for r in batch:
                 if r.expired(now):
-                    reg.counter("serve.deadline.shed").inc()
+                    reg.counter("serve.deadline.shed", **self.labels).inc()
                     r.fail(DeadlineExceededError(
                         "request exceeded shifu.serve.deadlineMs before "
                         "dispatch"))
@@ -342,18 +394,19 @@ class MicroBatcher:
             self._inflight = batch
             faults.fault_point("serve")
             rows = sum(r.n_rows for r in batch)
-            reg.counter("serve.batches").inc()
+            reg.counter("serve.batches", **self.labels).inc()
             reg.histogram(
                 "serve.batch.rows", buckets=BATCH_ROWS_BUCKETS,
+                **self.labels,
             ).observe(rows)
             try:
-                with reg.timer("serve.batch.score").time():
+                with reg.timer("serve.batch.score", **self.labels).time():
                     concat = _concat_batches([r.data for r in batch])
                     result = self.score_fn(concat)
             except Exception as e:  # fan the failure out per request
                 log.warning("serve batch of %d requests failed: %s",
                             len(batch), e)
-                reg.counter("serve.batch.errors").inc()
+                reg.counter("serve.batch.errors", **self.labels).inc()
                 for r in batch:
                     r.fail(e)
                 self._inflight = None
@@ -361,13 +414,13 @@ class MicroBatcher:
             off = 0
             now = time.perf_counter()
             lat = reg.histogram("serve.latency_seconds",
-                                buckets=LATENCY_BUCKETS)
+                                buckets=LATENCY_BUCKETS, **self.labels)
             for r in batch:
                 r.resolve(_slice_result(result, off, off + r.n_rows))
                 off += r.n_rows
                 lat.observe(now - r.enqueued_at)
-            reg.counter("serve.requests").inc(len(batch))
-            reg.counter("serve.records").inc(rows)
+            reg.counter("serve.requests", **self.labels).inc(len(batch))
+            reg.counter("serve.records", **self.labels).inc(rows)
             self._inflight = None
             with self._drain_lock:
                 self._drain_log.append((now, len(batch)))
@@ -380,30 +433,62 @@ class MicroBatcher:
                     self.observer(concat, result)
                 except Exception as oe:  # observers must not kill serving
                     log.warning("serve observer failed: %s", oe)
-                    reg.counter("serve.observer.errors").inc()
+                    reg.counter("serve.observer.errors",
+                                **self.labels).inc()
 
     # ---- load hints ----
+    def drain_stats(self, now: Optional[float] = None
+                    ) -> Tuple[int, Optional[float]]:
+        """(queued requests, observed drain rate in requests/s over the
+        last DRAIN_WINDOW_S, or None with no usable history) — the
+        per-replica signal the DrainAwareRouter and the fleet Retry-After
+        estimator both read. Rates count REQUESTS, not batches: queue
+        depth counts requests, so a batches/s rate would overestimate
+        the backlog by the coalescing factor."""
+        if now is None:
+            now = time.perf_counter()
+        with self._drain_lock:
+            drained = list(self._drain_log)
+        recent = [(t, n) for t, n in drained if now - t <= DRAIN_WINDOW_S]
+        # backlog = queued + the bucket currently on device: the router
+        # must see a replica whose whole queue just moved into one
+        # in-flight bucket as busy, not idle (bare read — _inflight is a
+        # single reference the worker swaps, and an off-by-a-batch
+        # estimate only shades the ranking)
+        inflight = self._inflight
+        depth = len(self.admission) + (len(inflight) if inflight else 0)
+        if len(recent) >= 2:
+            span = max(now - recent[0][0], 1e-3)
+            return depth, sum(n for _, n in recent) / span
+        return depth, None
+
+    def expected_wait(self, now: Optional[float] = None) -> float:
+        """Estimated seconds before a newly admitted request dispatches:
+        backlog ÷ observed drain rate. With no drain history yet the raw
+        backlog ranks the replica (0.0 for an idle one), which is all
+        the router's RELATIVE placement needs."""
+        depth, rate = self.drain_stats(now)
+        if not depth:
+            return 0.0
+        if rate is None:
+            return float(depth)
+        return depth / max(rate, 1e-3)
+
     def retry_after_seconds(self) -> float:
         """429 Retry-After derived from the OBSERVED drain rate: queue
         depth ÷ recently drained requests/s, clamped — a loaded server
         tells clients how long the backlog actually is instead of a
-        fixed hint. Exported as the `serve.retry_after_seconds` gauge."""
+        fixed hint. Exported as the `serve.retry_after_seconds` gauge.
+        (The fleet-wide analog lives on ReplicaFleet: total backlog over
+        the SUMMED per-replica drain rates.)"""
         from shifu_tpu.obs import registry
 
-        now = time.perf_counter()
-        with self._drain_lock:
-            drained = list(self._drain_log)
-        recent = [(t, n) for t, n in drained if now - t <= DRAIN_WINDOW_S]
-        depth = len(self.admission)
-        if len(recent) >= 2:
-            span = max(now - recent[0][0], 1e-3)
-            # depth counts REQUESTS, so the rate must too — batches/s
-            # alone would overestimate the backlog by the coalescing
-            # factor (requests per batch)
-            requests_per_s = sum(n for _, n in recent) / span
-            hint = depth / max(requests_per_s, 1e-3)
+        depth, rate = self.drain_stats()
+        if rate is not None:
+            hint = depth / max(rate, 1e-3)
         else:
             hint = RETRY_AFTER_MIN_S  # no drain history: cheap optimism
         hint = min(max(hint, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
-        registry().gauge("serve.retry_after_seconds").set(hint)
+        registry().gauge("serve.retry_after_seconds",
+                         **self.labels).set(hint)
         return hint

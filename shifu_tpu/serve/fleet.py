@@ -1,0 +1,573 @@
+"""Replicated serving fleet: per-device scoring replicas behind a
+drain-aware router.
+
+The single-device `shifu serve` tops out at one micro-batcher feeding
+one fused program. This module takes it to fleet shape — the reference
+serves from a fleet of JVM workers behind PayPal's traffic tier; here
+the fleet is N replicas of the SAME compiled scoring program, one per
+device (TensorFlow's shared train/serve substrate argument), with a
+cheap cross-replica reduce for rollout evidence (DrJAX's
+MapReduce-as-collectives decomposition, the PR-8 `window_reduce` idiom
+via `parallel.mesh.fleet_reduce`).
+
+  ScoringReplica    one device's complete scoring stack: a
+                    SwappableRegistry whose fused program, weights and
+                    norm/drift constants are pinned to THAT device, its
+                    own admission queue, micro-batch worker, health
+                    state machine and compiled-program cache. Replica
+                    `i` runs on `jax.devices()[i % ndev]` — replicas
+                    beyond the device count share devices (useful for
+                    tests and oversubscription), never fail.
+  DrainAwareRouter  places each request on the replica with the lowest
+                    EXPECTED WAIT = queue backlog / observed drain rate
+                    (the PR-7 Retry-After estimator computed per
+                    replica). Degraded replicas are de-prioritized by a
+                    multiplier (`shifu.serve.routerPenalty`), draining
+                    replicas are skipped, a full replica spills to the
+                    next-best one, and ties rotate round-robin so an
+                    idle fleet warms every replica.
+  ReplicaFleet      construction + the fleet-level contract: aggregate
+                    /healthz (one degraded replica = degraded fleet
+                    with the replica named; ALL draining = draining ->
+                    503), fleet-wide Retry-After (total backlog over
+                    summed drain rates), stage-on-every-replica, the
+                    psum-merged shadow evidence, and the ROLLING promote
+                    (one replica at a time, each swap atomic under its
+                    replica's lock, per-step audit callback).
+
+Replica counts come from `-Dshifu.serve.replicas` (0 = every local
+device). `replicas=1` is the degenerate case and preserves the
+pre-fleet behavior exactly: same code path, a 1-wide fleet.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from shifu_tpu.analysis.racetrack import tracked_lock
+from shifu_tpu.eval.scorer import DEFAULT_SCORE_SCALE, ScoreResult
+from shifu_tpu.serve.batcher import (
+    RETRY_AFTER_MAX_S,
+    RETRY_AFTER_MIN_S,
+    MicroBatcher,
+    ScoreRequest,
+)
+from shifu_tpu.serve.health import DEGRADED, DRAINING, OK, HealthMonitor
+from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
+from shifu_tpu.serve.registry import ModelRegistry, records_to_columnar
+from shifu_tpu.utils import environment
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+DEFAULT_ROUTER_PENALTY = 4.0
+
+
+def replicas_setting() -> int:
+    """shifu.serve.replicas — scoring replicas (0 = all local devices)."""
+    return environment.get_int("shifu.serve.replicas", 0)
+
+
+def router_penalty_setting() -> float:
+    """shifu.serve.routerPenalty — expected-wait multiplier applied to
+    DEGRADED replicas (de-prioritize, don't eject)."""
+    return environment.get_float("shifu.serve.routerPenalty",
+                                 DEFAULT_ROUTER_PENALTY)
+
+
+class ScoringReplica:
+    """One device's complete scoring stack (registry + queue + batcher +
+    health), labeled `replica=<i>` on every metric it records."""
+
+    def __init__(self, registry, index: int = 0,
+                 admission: Optional[AdmissionQueue] = None,
+                 health: Optional[HealthMonitor] = None,
+                 max_batch_rows: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 batching: Optional[str] = None,
+                 queue_depth: Optional[int] = None,
+                 observer: Optional[Callable] = None) -> None:
+        self.index = int(index)
+        self.name = str(self.index)
+        self.registry = registry
+        self.device = getattr(registry, "device", None)
+        labels = {"replica": self.name}
+        self.admission = (AdmissionQueue(queue_depth, labels=labels)
+                          if admission is None else admission)
+        self.health = (HealthMonitor(labels=labels)
+                       if health is None else health)
+        if observer is None:
+            batch_observer = None
+        else:
+            # the fleet observer wants to know WHICH replica resolved the
+            # batch (per-replica scored_sha stamps the fleet-global
+            # traffic log); the batcher's hook doesn't — adapt here
+            def batch_observer(data, result, _rep=self):
+                observer(_rep, data, result)
+        self.batcher = MicroBatcher(
+            registry.score_raw, self.admission,
+            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
+            health=self.health, max_restarts=max_restarts,
+            deadline_ms=deadline_ms, observer=batch_observer,
+            batching=batching, labels=labels)
+
+    def snapshot(self) -> dict:
+        snap = {
+            "replica": self.name,
+            **self.registry.snapshot(),
+            "health": self.health.snapshot(),
+            "queueDepth": len(self.admission),
+            "workerRestarts": self.batcher.restarts,
+        }
+        if self.device is not None:
+            snap["device"] = str(self.device)
+        return snap
+
+
+class DrainAwareRouter:
+    """Place each request on the replica that will dispatch it soonest.
+
+    Preference order per submit: skip DRAINING replicas, rank the rest
+    by expected wait (backlog / observed drain rate, the per-replica
+    Retry-After estimator) with DEGRADED replicas multiplied by
+    `penalty`, break ties round-robin. A full replica spills to the
+    next candidate (`serve.router.spill`); only when every candidate
+    sheds does the caller see the rejection. All replicas draining =
+    RejectedError("closed")."""
+
+    def __init__(self, replicas: Sequence[ScoringReplica],
+                 penalty: Optional[float] = None) -> None:
+        self.replicas = list(replicas)
+        self.penalty = (router_penalty_setting() if penalty is None
+                        else float(penalty))
+        self._lock = tracked_lock("serve.router")
+        self._rr = 0
+
+    def order(self) -> List[ScoringReplica]:
+        """Routable replicas, best placement first."""
+        now = time.perf_counter()
+        with self._lock:
+            rr = self._rr
+            self._rr += 1
+        n = max(1, len(self.replicas))
+        ranked = []
+        for rep in self.replicas:
+            state = rep.health.state
+            if state == DRAINING:
+                continue  # 503 territory: never place new work here
+            wait = rep.batcher.expected_wait(now)
+            if state == DEGRADED:
+                # de-prioritize, don't eject: the +epsilon keeps an IDLE
+                # degraded replica (wait 0.0) behind idle healthy ones
+                wait = (wait + 1e-3) * self.penalty
+            ranked.append((wait, (rep.index - rr) % n, rep))
+        ranked.sort(key=lambda t: (t[0], t[1]))
+        return [t[2] for t in ranked]
+
+    def submit(self, data) -> ScoreRequest:
+        """Admit one request on the best replica, spilling past full
+        ones. Raises RejectedError when nothing can take it."""
+        from shifu_tpu.obs import registry
+
+        order = self.order()
+        if not order:
+            raise RejectedError("closed")
+        reg = registry()
+        last: Optional[RejectedError] = None
+        for i, rep in enumerate(order):
+            try:
+                req = rep.batcher.submit(data)
+            except RejectedError as e:
+                last = e
+                if i == 0:
+                    # the PLANNED placement shed — everything after is a
+                    # drain-around (counted so routing-around-a-backlog
+                    # is visible on /metrics)
+                    reg.counter("serve.router.spill",
+                                replica=rep.name).inc()
+                continue
+            reg.counter("serve.router.routed", replica=rep.name).inc()
+            return req
+        raise last if last is not None else RejectedError("closed")
+
+
+class ReplicaFleet:
+    """N scoring replicas + router + the fleet-level serving contract.
+
+    Also the registry facade the server front end reads: `sha`,
+    `model_names`, `fused`, `input_columns`, `score_records` (direct,
+    un-routed — parity checks), `warm`, `snapshot`, and the rollout
+    surface `stage`/`shadow_snapshot`/`promote`/`observe` — so a
+    1-replica fleet is a drop-in for the SwappableRegistry the server
+    used to hold."""
+
+    def __init__(self, replicas: Sequence[ScoringReplica],
+                 router: Optional[DrainAwareRouter] = None) -> None:
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas = list(replicas)
+        self.router = router or DrainAwareRouter(self.replicas)
+        # fleet-level health: sticky drift degrades and shutdown live
+        # here; per-replica crash/restart state lives on each replica's
+        # own monitor and aggregates in health_snapshot()
+        self.health = HealthMonitor()
+        # control-plane mutual exclusion (stage/unstage/promote): a
+        # re-stage landing MID-ROLL would change later replicas' staged
+        # shadows after the pre-roll sha validation and strand the
+        # fleet half-promoted — so fleet-level rollout operations
+        # exclude each other via a flag (never held across device
+        # work); a concurrent operation is REFUSED (409 over HTTP),
+        # not queued
+        self._ctl_lock = tracked_lock("serve.fleet.control")
+        self._ctl_busy: Optional[str] = None
+        from shifu_tpu.obs import registry
+
+        registry().gauge("serve.replicas").set(len(self.replicas))
+
+    @contextmanager
+    def _control(self, op: str):
+        with self._ctl_lock:
+            if self._ctl_busy is not None:
+                raise ValueError(
+                    f"fleet {self._ctl_busy} in progress — retry when "
+                    "it completes")
+            self._ctl_busy = op
+        try:
+            yield
+        finally:
+            with self._ctl_lock:
+                self._ctl_busy = None
+
+    # ---- construction ----
+    @classmethod
+    def build(cls, models_dir: str, n_replicas: Optional[int] = None,
+              scale: float = DEFAULT_SCORE_SCALE,
+              column_configs=None, model_config=None, drift=None,
+              queue_depth: Optional[int] = None,
+              max_batch_rows: Optional[int] = None,
+              max_wait_ms: Optional[float] = None,
+              max_restarts: Optional[int] = None,
+              deadline_ms: Optional[float] = None,
+              batching: Optional[str] = None,
+              observer: Optional[Callable] = None) -> "ReplicaFleet":
+        """One replica per device (replica i -> jax.devices()[i % ndev]),
+        each loading the model set onto ITS device with its own compiled
+        program cache. `n_replicas` falls back to -Dshifu.serve.replicas,
+        then to every local device."""
+        import jax
+
+        devices = jax.devices()
+        n = n_replicas if n_replicas is not None else replicas_setting()
+        n = int(n) if n and int(n) > 0 else len(devices)
+        replicas = []
+        for i in range(n):
+            dev = devices[i % len(devices)]
+            reg = ModelRegistry(
+                models_dir, scale=scale, column_configs=column_configs,
+                model_config=model_config, drift=drift, device=dev,
+                labels={"replica": str(i)})
+            from shifu_tpu.loop.hotswap import SwappableRegistry
+
+            sw = SwappableRegistry(reg, labels={"replica": str(i)})
+            replicas.append(ScoringReplica(
+                sw, index=i, queue_depth=queue_depth,
+                max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
+                max_restarts=max_restarts, deadline_ms=deadline_ms,
+                batching=batching, observer=observer))
+        log.info("serving fleet: %d replica(s) over %d device(s)",
+                 n, min(n, len(devices)))
+        return cls(replicas)
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    # ---- scoring ----
+    def submit(self, data) -> ScoreRequest:
+        return self.router.submit(data)
+
+    def score_raw(self, data) -> ScoreResult:
+        """Routed scoring of one raw batch (blocks for the result)."""
+        return self.submit(data).wait()
+
+    # ---- registry facade (replica 0 is the canonical read) ----
+    @property
+    def sha(self) -> str:
+        return self.replicas[0].registry.sha
+
+    @property
+    def model_names(self) -> List[str]:
+        return self.replicas[0].registry.model_names
+
+    @property
+    def fused(self) -> bool:
+        return self.replicas[0].registry.fused
+
+    @property
+    def input_columns(self) -> List[str]:
+        return self.replicas[0].registry.input_columns
+
+    def score_records(self, records: Sequence[dict]) -> ScoreResult:
+        """Direct (un-routed, un-batched) scoring on replica 0 — the
+        parity-check path, NOT the serving path."""
+        return self.replicas[0].registry.score_records(records)
+
+    def warm(self, batch_sizes: Sequence[int]) -> List[int]:
+        """Pre-compile the buckets on EVERY replica (each owns its own
+        compiled-program cache on its own device)."""
+        warmed: List[int] = []
+        for rep in self.replicas:
+            warmed = rep.registry.warm(batch_sizes)
+        return warmed
+
+    # ---- health ----
+    def health_snapshot(self) -> dict:
+        """Aggregate /healthz: per-replica states roll up so a balancer
+        gets one verdict and an operator gets the replica named."""
+        fleet = self.health.snapshot()
+        per = []
+        for rep in self.replicas:
+            s = rep.health.snapshot()
+            s.update({"replica": rep.name,
+                      "sha": rep.registry.sha,
+                      "queueDepth": len(rep.admission),
+                      "workerRestarts": rep.batcher.restarts})
+            per.append(s)
+        bad = [p for p in per if p["status"] != OK]
+        if (fleet["status"] == DRAINING
+                or all(p["status"] == DRAINING for p in per)):
+            status = DRAINING
+            reason = fleet["reason"] or "all replicas draining"
+        elif fleet["status"] == DEGRADED:
+            status, reason = DEGRADED, fleet["reason"]
+        elif bad:
+            status = DEGRADED
+            reason = "; ".join(
+                f"replica {p['replica']} {p['status']}"
+                + (f": {p['reason']}" if p.get("reason") else "")
+                for p in bad)
+        else:
+            status, reason = OK, ""
+        return {
+            "status": status,
+            "reason": reason,
+            "workerCrashes": sum(p["workerCrashes"] for p in per),
+            "replicas": per,
+        }
+
+    # ---- load hints ----
+    def retry_after_seconds(self) -> float:
+        """Fleet Retry-After: TOTAL backlog over the SUMMED per-replica
+        drain rates — the hint a shed client gets describes the fleet's
+        capacity to absorb it, not one replica's. Exported as the
+        unlabeled serve.retry_after_seconds gauge (per-replica labeled
+        gauges come from each batcher)."""
+        from shifu_tpu.obs import registry
+
+        now = time.perf_counter()
+        depth_total = 0
+        rate_total = 0.0
+        rated = False
+        for rep in self.replicas:
+            depth, rate = rep.batcher.drain_stats(now)
+            depth_total += depth
+            if rate is not None:
+                rate_total += rate
+                rated = True
+        if rated:
+            hint = depth_total / max(rate_total, 1e-3)
+        else:
+            hint = RETRY_AFTER_MIN_S  # no drain history: cheap optimism
+        hint = min(max(hint, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
+        registry().gauge("serve.retry_after_seconds").set(hint)
+        return hint
+
+    # ---- rollout: stage / shadow evidence / rolling promote ----
+    def observe(self, data, result) -> None:
+        """Compat shim for callers that treated the registry as the
+        observer (single-replica embeddings): replica 0's observer."""
+        self.replicas[0].registry.observe(data, result)
+
+    def stage(self, models_dir: str, column_configs=None,
+              model_config=None, drift=None) -> Optional[dict]:
+        """Stage + warm the candidate as the shadow on EVERY replica
+        (each loads it onto its own device and pre-compiles its live
+        buckets). Returns the aggregated shadow snapshot. Refused while
+        another rollout operation (stage/promote) is in flight."""
+        with self._control("stage"):
+            staged = [rep.registry.stage(models_dir,
+                                         column_configs=column_configs,
+                                         model_config=model_config,
+                                         drift=drift)
+                      for rep in self.replicas]
+            shas = {s["sha"] for s in staged}
+            if len(shas) != 1:  # same dir: only a mid-stage redeploy
+                raise ValueError(
+                    f"staged shadow shas diverge across replicas "
+                    f"({shas}) — the candidate dir changed mid-stage; "
+                    "re-stage")
+            return self.shadow_snapshot()
+
+    def unstage(self) -> None:
+        with self._control("unstage"):
+            for rep in self.replicas:
+                rep.registry.unstage()
+
+    def shadow_snapshot(self) -> Optional[dict]:
+        """Fleet shadow evidence: per-replica ShadowStats merged with ONE
+        psum/pmax collective over the fleet mesh (additive counts sum,
+        maxAbsDelta pmaxes — parallel.mesh.fleet_reduce, the PR-8
+        window_reduce substrate), so `shifu promote`'s gates read one
+        fleet-wide agreement rate. None until every replica has a staged
+        shadow."""
+        per = [rep.registry.shadow_snapshot() for rep in self.replicas]
+        if any(p is None for p in per):
+            return None
+        if len(per) == 1:
+            return dict(per[0], replicas=per)
+        agg = _reduce_shadow_stats(self.replicas, per)
+        agg.update({
+            "sha": per[0]["sha"],
+            "models": per[0]["models"],
+            "fused": per[0]["fused"],
+            "tolerance": per[0]["tolerance"],
+            "replicas": per,
+        })
+        return agg
+
+    def promote(self, expected_sha: Optional[str] = None,
+                step_cb: Optional[Callable] = None) -> dict:
+        """ROLLING promote: replicas flip shadow -> active ONE AT A TIME,
+        each swap atomic under its replica's lock (the in-flight batch
+        finishes on the old version, the next gathered batch scores on
+        the new) — requests keep flowing on the not-yet-rolled replicas
+        throughout, so the fleet never has a scoring gap.
+
+        The staged sha is validated across ALL replicas (and against
+        `expected_sha`, the sha the caller's gate evidence described)
+        BEFORE the first swap, and the whole roll excludes concurrent
+        stage()/unstage() via the fleet control-plane flag — so a roll
+        can neither start on nor be diverted mid-way to a candidate the
+        gates never saw, and a refusal always happens with ZERO
+        replicas swapped. `step_cb(replica, step)` fires after each
+        replica's swap — the server uses it to stamp one sha-bound
+        audit manifest per replica step."""
+        with self._control("promote"):
+            staged = [rep.registry.shadow_snapshot()
+                      for rep in self.replicas]
+            missing = [rep.name for rep, s in zip(self.replicas, staged)
+                       if s is None]
+            if missing:
+                raise ValueError("no staged candidate on replica(s) "
+                                 + ",".join(missing))
+            shas = {s["sha"] for s in staged}
+            if len(shas) != 1:
+                raise ValueError(
+                    f"staged shadow shas diverge across replicas "
+                    f"({shas}); re-stage before promoting")
+            sha = shas.pop()
+            if expected_sha and sha != expected_sha:
+                raise ValueError(
+                    f"staged shadow is {sha}, not the gated candidate "
+                    f"{expected_sha} — it was re-staged since the gates "
+                    "evaluated; re-run the gate on the current shadow")
+            shadow = self.shadow_snapshot()
+            steps = []
+            from shifu_tpu.obs import registry
+
+            for rep in self.replicas:
+                swap = rep.registry.promote(expected_sha)
+                step = {"replica": rep.name, **swap}
+                steps.append(step)
+                registry().counter("serve.swap.steps",
+                                   replica=rep.name).inc()
+                if step_cb is not None:
+                    try:
+                        step_cb(rep, step)
+                    except Exception as e:  # audit trouble must not
+                        # stop the roll half-way: a half-promoted fleet
+                        # serves two versions indefinitely, which is
+                        # worse than a missing manifest
+                        log.warning("promote step callback failed on "
+                                    "replica %s: %s", rep.name, e)
+            return {"from": steps[0]["from"], "to": sha,
+                    "replicas": len(steps), "steps": steps,
+                    "shadow": shadow}
+
+    def snapshot(self) -> dict:
+        """Manifest/bench view: fleet summary + per-replica registry
+        snapshots (warm buckets prove each replica's compile bound)."""
+        snap = self.replicas[0].registry.snapshot()
+        snap.update({
+            "replicas": [rep.snapshot() for rep in self.replicas],
+            "replicaCount": len(self.replicas),
+        })
+        return snap
+
+    # ---- lifecycle ----
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop admitting fleet-wide and drain every replica."""
+        self.health.set_draining("shutdown")
+        for rep in self.replicas:
+            rep.health.set_draining("shutdown")
+            rep.admission.close()
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        for rep in self.replicas:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            rep.batcher.join(remaining)
+
+    def score_batch(self, records: Sequence[dict],
+                    timeout: Optional[float] = None,
+                    extra_columns: Optional[Sequence[str]] = None
+                    ) -> ScoreResult:
+        """Routed in-process scoring of raw records."""
+        cols = list(self.input_columns) + [
+            c for c in (extra_columns or []) if c not in self.input_columns]
+        data = records_to_columnar(records, cols)
+        return self.submit(data).wait(timeout)
+
+
+def _reduce_shadow_stats(replicas: Sequence[ScoringReplica],
+                         per: List[dict]) -> dict:
+    """Merge per-replica shadow stats into the fleet verdict with one
+    collective: stats stage per DEVICE (replicas sharing a device sum
+    host-side first, exactly like per-shard partials), then one
+    psum/pmax closes the fleet totals (parallel.mesh.fleet_reduce)."""
+    from shifu_tpu.parallel.mesh import fleet_mesh, fleet_reduce
+
+    # [batches, rows, agreeRows, errors, sumAbsDelta, maxAbsDelta]
+    vec = {}
+    order: List = []
+    for rep, p in zip(replicas, per):
+        row = np.asarray(
+            [p["batches"], p["rows"], p["agreeRows"], p["errors"],
+             p["meanAbsDelta"] * p["rows"], p["maxAbsDelta"]],
+            dtype=np.float64)
+        key = rep.device
+        if key not in vec:
+            vec[key] = row.copy()
+            order.append(key)
+        else:  # same device: host-side partial (max for the extremum)
+            vec[key][:5] += row[:5]
+            vec[key][5] = max(vec[key][5], row[5])
+    parts = np.stack([vec[k] for k in order])
+    mesh = fleet_mesh(len(order))
+    total = fleet_reduce(mesh, parts, max_cols=1)
+    batches, rows, agree, errors, sum_abs, max_abs = total
+    rows_div = max(rows, 1.0)
+    return {
+        "batches": int(batches),
+        "rows": int(rows),
+        "agreeRows": int(agree),
+        "errors": int(errors),
+        "agreement": (agree / rows_div if rows else 0.0),
+        "meanAbsDelta": (sum_abs / rows_div if rows else 0.0),
+        "maxAbsDelta": float(max_abs),
+    }

@@ -21,6 +21,7 @@ the run-ledger manifest carries the replica's health history.
 
 from __future__ import annotations
 
+from typing import Optional
 
 from shifu_tpu.analysis.racetrack import guarded_by, tracked_lock
 
@@ -32,10 +33,17 @@ DEFAULT_OK_AFTER = 3
 
 
 class HealthMonitor:
-    """Thread-safe tri-state health with crash-recovery hysteresis."""
+    """Thread-safe tri-state health with crash-recovery hysteresis.
 
-    def __init__(self, ok_after: int = DEFAULT_OK_AFTER) -> None:
+    `labels` (typically {"replica": "<i>"}) ride the transition counter
+    so a fleet's per-replica health histories stay separable in one
+    metrics page; the fleet-level aggregation over these monitors lives
+    in serve/fleet.py (`ReplicaFleet.health_snapshot`)."""
+
+    def __init__(self, ok_after: int = DEFAULT_OK_AFTER,
+                 labels: Optional[dict] = None) -> None:
         self._lock = tracked_lock("serve.health")
+        self.labels = dict(labels or {})
         self._state = OK
         self._reason = ""
         self._ok_after = max(1, ok_after)
@@ -58,7 +66,8 @@ class HealthMonitor:
         self._reason = reason
         from shifu_tpu.obs import registry
 
-        registry().counter("serve.health.transitions", to=state).inc()
+        registry().counter("serve.health.transitions", to=state,
+                           **self.labels).inc()
 
     def note_crash(self, reason: str) -> None:
         with self._lock:

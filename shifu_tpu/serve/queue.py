@@ -48,12 +48,18 @@ class RejectedError(RuntimeError):
 
 
 class AdmissionQueue:
-    """Bounded FIFO with shed-on-full admission and drain-aware close."""
+    """Bounded FIFO with shed-on-full admission and drain-aware close.
 
-    def __init__(self, depth: Optional[int] = None) -> None:
+    `labels` (typically {"replica": "<i>"} from the serving fleet) ride
+    every serve.queue.* metric, so one /metrics page attributes depth
+    and sheds per replica."""
+
+    def __init__(self, depth: Optional[int] = None,
+                 labels: Optional[dict] = None) -> None:
         self.depth = queue_depth_setting() if depth is None else int(depth)
         if self.depth <= 0:
             raise ValueError("admission queue depth must be positive")
+        self.labels = dict(labels or {})
         self._items: deque = deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -70,16 +76,18 @@ class AdmissionQueue:
         reg = self._metrics()
         with self._cond:
             if self._closed:
-                reg.counter("serve.queue.shed", reason="closed").inc()
+                reg.counter("serve.queue.shed", reason="closed",
+                            **self.labels).inc()
                 raise RejectedError("closed")
             if len(self._items) >= self.depth:
-                reg.counter("serve.queue.shed", reason="full").inc()
+                reg.counter("serve.queue.shed", reason="full",
+                            **self.labels).inc()
                 raise RejectedError("full", depth=self.depth)
             self._items.append(item)
             depth = len(self._items)
             self._cond.notify()
-        reg.counter("serve.queue.admitted").inc()
-        reg.gauge("serve.queue.depth").set(depth)
+        reg.counter("serve.queue.admitted", **self.labels).inc()
+        reg.gauge("serve.queue.depth", **self.labels).set(depth)
 
     def get(self, timeout: Optional[float] = None) -> Optional[Any]:
         """Next admitted item; None when the queue is closed AND empty
@@ -99,7 +107,7 @@ class AdmissionQueue:
                             return None
             item = self._items.popleft()
             depth = len(self._items)
-        self._metrics().gauge("serve.queue.depth").set(depth)
+        self._metrics().gauge("serve.queue.depth", **self.labels).set(depth)
         return item
 
     def close(self) -> None:

@@ -28,6 +28,14 @@ hot path raises. Results come back via one explicit `jax.device_get`.
 Model sets that mix in tree/WDL/reference-format specs fall back to the
 ModelRunner path (still batched, still served) — `fused` reports which
 mode a registry runs in.
+
+Replica discipline (serve/fleet.py): `device=` pins EVERYTHING this
+registry owns — weights, norm constants, drift constants, the per-batch
+device_put and therefore the fused dispatch itself — to one device, so
+N registries over N devices are N independent scoring replicas whose
+dispatches overlap. `labels` (typically {"replica": "<i>"}) ride the
+registry's serve.* metrics. Both default off: a bare ModelRegistry
+behaves exactly as before (default-device placement, unlabeled metrics).
 """
 
 from __future__ import annotations
@@ -145,20 +153,25 @@ class _PlanFeaturizer:
         return out
 
 
-def _build_plan_device_consts(plan):
+def _build_plan_device_consts(plan, device=None):
     """Static per-plan tensors the fused program closes over, pre-staged
     as jnp arrays so no constant crosses the host->device boundary at
-    call time."""
+    call time. `device` pins them to one replica's device (None keeps
+    default placement)."""
+    import jax
     import jax.numpy as jnp
+
+    def put(a, dtype):
+        return jax.device_put(np.asarray(a, dtype), device)
 
     value_specs = [s for s in plan.specs if s.kind == "value"]
     table_specs = [s for s in plan.specs if s.kind == "table"]
     coded_specs = [s for s in plan.specs if s.kind in ("table", "onehot")]
     consts = {
-        "mean": jnp.asarray([s.mean for s in value_specs], jnp.float32),
-        "std": jnp.asarray([s.std for s in value_specs], jnp.float32),
-        "zs": jnp.asarray([1.0 if s.zscore else 0.0 for s in value_specs],
-                          jnp.float32),
+        "mean": put([s.mean for s in value_specs], np.float32),
+        "std": put([s.std for s in value_specs], np.float32),
+        "zs": put([1.0 if s.zscore else 0.0 for s in value_specs],
+                  np.float32),
         "cutoff": jnp.float32(plan.cutoff),
     }
     if table_specs:
@@ -166,7 +179,7 @@ def _build_plan_device_consts(plan):
         tables = np.zeros((len(table_specs), max_s), dtype=np.float32)
         for k, s in enumerate(table_specs):
             tables[k, : s.table.size] = s.table
-        consts["tables"] = jnp.asarray(tables)
+        consts["tables"] = put(tables, np.float32)
         # static columns of the shared codes matrix that feed the table
         # gather (the rest feed one-hot expansion)
         consts["tab_positions"] = np.asarray(
@@ -225,13 +238,18 @@ class ModelRegistry:
     def __init__(self, models_dir: str,
                  scale: float = DEFAULT_SCORE_SCALE,
                  column_configs=None, model_config=None,
-                 drift=None) -> None:
+                 drift=None, device=None,
+                 labels: Optional[dict] = None) -> None:
         self.models_dir = models_dir
         self.paths = find_model_paths(models_dir)
         if not self.paths:
             raise ValueError(f"no models under {models_dir}")
         self.sha = model_set_sha(self.paths)
         self.scale = float(scale)
+        # replica pinning: every array this registry owns (and every
+        # per-batch device_put) targets this device; None = default
+        self.device = device
+        self.labels = dict(labels or {})
         self.model_names = [os.path.basename(p) for p in self.paths]
         self.specs = [load_model(p, column_configs, model_config)
                       for p in self.paths]
@@ -298,10 +316,11 @@ class ModelRegistry:
                 self._featurizers.append(_PlanFeaturizer(plan))
             self._model_plan_idx.append(plan_keys.index(key))
 
-        consts = [_build_plan_device_consts(p) for p in self._plans]
+        consts = [_build_plan_device_consts(p, self.device)
+                  for p in self._plans]
         params = [
-            [{"W": jax.numpy.asarray(layer["W"]),
-              "b": jax.numpy.asarray(layer["b"])}
+            [{"W": jax.device_put(np.asarray(layer["W"]), self.device),
+              "b": jax.device_put(np.asarray(layer["b"]), self.device)}
              for layer in spec.params]
             for spec in self.specs
         ]
@@ -314,7 +333,13 @@ class ModelRegistry:
         scale = self.scale
 
         drift = self.drift
-        drift_consts = drift.device_consts() if drift is not None else None
+        drift_consts = None
+        if drift is not None:
+            # the monitor is fleet-shared; ITS constants must live on
+            # THIS replica's device or the fused dispatch would mix
+            # committed devices
+            drift_consts = jax.device_put(drift.device_consts(),
+                                          self.device)
 
         def fused(plan_inputs, drift_ops=None):
             import jax.numpy as jnp
@@ -412,7 +437,7 @@ class ModelRegistry:
 
         reg = obs_registry()
         if not self.fused:
-            reg.counter("serve.score.rows").inc(data.n_rows)
+            reg.counter("serve.score.rows", **self.labels).inc(data.n_rows)
             result = self._runner.score_raw(data)
             if self.drift is not None and self.drift_live:
                 # ModelRunner fallback: host-side fold, same binning
@@ -450,8 +475,8 @@ class ModelRegistry:
         key = (self.sha, bucket)
         if key not in self._warm_buckets:
             self._warm_buckets.add(key)
-            reg.counter("serve.program_compiles").inc()
-            reg.gauge("serve.registry.buckets").set(
+            reg.counter("serve.program_compiles", **self.labels).inc()
+            reg.gauge("serve.registry.buckets", **self.labels).set(
                 len(self._warm_buckets))
         # the hot seam: inputs staged with ONE explicit device_put, then
         # the fused dispatch must move no other bytes
@@ -467,30 +492,38 @@ class ModelRegistry:
             # device-resident. A non-live registry (staged shadow) folds
             # into a throwaway window so the shared monitor never
             # double-counts sampled batches.
-            import jax.numpy as jnp
-
             if self.drift_live:
-                window, drift_gen = self.drift.window()
+                # per-(replica, device) window: the fleet-shared monitor
+                # keeps one resident window PER folding replica (merged
+                # at flush), so this replica's fold never drags another
+                # device's array into its dispatch and never interleaves
+                # with another replica's adoption of the same window
+                window, drift_gen = self.drift.window(
+                    self.device, owner=self.labels.get("replica"))
             else:
-                window = jnp.zeros(self.drift.total_slots, jnp.float32)
+                window = jax.device_put(
+                    np.zeros(self.drift.total_slots, np.float32),
+                    self.device)
                 drift_gen = None
             dev_inputs, drift_put = jax.device_put(
-                (tuple(plan_inputs), drift_host))
+                (tuple(plan_inputs), drift_host), self.device)
             drift_dev = tuple(drift_put) + (window,)
             with sanitize.transfer_free("serve.score"):
                 out = profile.dispatch("serve.fused_score", self._program,
                                        dev_inputs, drift_dev, sync=True)
             m, mean, mx, mn, med = jax.device_get(out[:5])
             if self.drift_live:
-                self.drift.note_window(out[5], n, gen=drift_gen)
+                self.drift.note_window(out[5], n, gen=drift_gen,
+                                       device=self.device,
+                                       owner=self.labels.get("replica"))
                 reg.counter("loop.drift.rows").inc(n)
         else:
-            dev_inputs = jax.device_put(tuple(plan_inputs))
+            dev_inputs = jax.device_put(tuple(plan_inputs), self.device)
             with sanitize.transfer_free("serve.score"):
                 out = profile.dispatch("serve.fused_score", self._program,
                                        dev_inputs, sync=True)
             m, mean, mx, mn, med = jax.device_get(out)
-        reg.counter("serve.score.rows").inc(n)
+        reg.counter("serve.score.rows", **self.labels).inc(n)
         return ScoreResult(
             model_scores=np.asarray(m)[:n],
             mean=np.asarray(mean)[:n],
@@ -504,7 +537,7 @@ class ModelRegistry:
     def snapshot(self) -> dict:
         """Registry state for manifests/bench output: compiled buckets
         prove the steady-state compile bound."""
-        return {
+        snap = {
             "sha": self.sha,
             "models": list(self.model_names),
             "fused": self.fused,
@@ -513,3 +546,6 @@ class ModelRegistry:
             "driftMonitored": (len(self.drift.cols)
                                if self.drift is not None else 0),
         }
+        if self.device is not None:
+            snap["device"] = str(self.device)
+        return snap

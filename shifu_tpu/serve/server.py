@@ -36,9 +36,10 @@ from typing import List, Optional, Sequence
 from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.eval.scorer import ScoreResult
 from shifu_tpu.serve.batcher import MicroBatcher
-from shifu_tpu.serve.health import DRAINING, HealthMonitor
+from shifu_tpu.serve.fleet import ReplicaFleet, ScoringReplica
+from shifu_tpu.serve.health import DRAINING
 from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
-from shifu_tpu.serve.registry import ModelRegistry, records_to_columnar
+from shifu_tpu.serve.registry import ModelRegistry
 from shifu_tpu.utils.log import get_logger
 
 log = get_logger(__name__)
@@ -47,53 +48,88 @@ DEFAULT_SCORE_TIMEOUT_S = 30.0
 
 
 class Scorer:
-    """In-process scoring API over the admission queue + micro-batcher.
+    """In-process scoring API over the replica fleet's router.
 
-    `registry` may be a plain ModelRegistry or a SwappableRegistry
-    (loop/hotswap.py) — anything with `score_raw` + `input_columns`.
-    `observer` rides the batcher's post-resolution hook (traffic logging,
-    shadow scoring, drift checks — the continuous-loop seams)."""
+    Two construction modes:
 
-    def __init__(self, registry: ModelRegistry,
+      Scorer(registry, admission=...)  — the embedding path: the given
+          registry (plain ModelRegistry or SwappableRegistry — anything
+          with `score_raw` + `input_columns`) becomes a ONE-replica
+          fleet around the given admission queue. Behaviorally the
+          pre-fleet Scorer: `.batcher`/`.admission`/`.health` read the
+          same objects they always did.
+      Scorer(fleet=ReplicaFleet(...))  — the server path: requests
+          route across N per-device replicas by observed drain rate.
+
+    `observer(data, result)` rides each replica batcher's
+    post-resolution hook (traffic logging, shadow scoring, drift
+    checks — the continuous-loop seams)."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
                  admission: Optional[AdmissionQueue] = None,
                  max_batch_rows: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
                  max_restarts: Optional[int] = None,
                  deadline_ms: Optional[float] = None,
-                 observer=None, extra_columns=None) -> None:
-        self.registry = registry
+                 observer=None, extra_columns=None,
+                 batching: Optional[str] = None,
+                 fleet: Optional[ReplicaFleet] = None) -> None:
+        if fleet is None:
+            if registry is None:
+                raise ValueError("Scorer needs a registry or a fleet")
+            if observer is None:
+                wrapped = None
+            else:
+                # single-replica compat: callers pass (data, result)
+                def wrapped(_rep, data, result):
+                    observer(data, result)
+            fleet = ReplicaFleet([ScoringReplica(
+                registry, index=0, admission=admission,
+                max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
+                max_restarts=max_restarts, deadline_ms=deadline_ms,
+                batching=batching, observer=wrapped)])
+        self.fleet = fleet
+        self.registry = fleet.replicas[0].registry
         # label plumbing: extra raw columns (target/weight) that ride
         # through conversion and batching untouched by scoring, so the
         # traffic log can keep outcomes and `shifu retrain` can train on
         # the log directly (absent fields log as the missing token)
         self.extra_columns = [c for c in (extra_columns or [])
-                              if c not in registry.input_columns]
-        # explicit None-check: AdmissionQueue defines __len__, so an EMPTY
-        # queue is falsy and `admission or ...` would silently swap in a
-        # default-depth one
-        self.admission = AdmissionQueue() if admission is None else admission
-        self.health = HealthMonitor()
-        self.batcher = MicroBatcher(
-            registry.score_raw, self.admission,
-            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
-            health=self.health, max_restarts=max_restarts,
-            deadline_ms=deadline_ms, observer=observer)
+                              if c not in fleet.input_columns]
+        # fleet-level health (sticky drift degrades, shutdown); replica
+        # monitors aggregate into health_snapshot()
+        self.health = fleet.health
+
+    # single-replica accessors (the embedding/test surface; in a fleet
+    # they read replica 0 — per-replica state lives on fleet.replicas)
+    @property
+    def admission(self) -> AdmissionQueue:
+        return self.fleet.replicas[0].admission
+
+    @property
+    def batcher(self) -> MicroBatcher:
+        return self.fleet.replicas[0].batcher
+
+    def health_snapshot(self) -> dict:
+        """Aggregate fleet health (one degraded replica = degraded fleet
+        with the replica named; all draining = draining)."""
+        return self.fleet.health_snapshot()
+
+    def retry_after_seconds(self) -> float:
+        """Fleet-wide Retry-After (total backlog / summed drain rates)."""
+        return self.fleet.retry_after_seconds()
 
     def score_batch(self, records: Sequence[dict],
                     timeout: Optional[float] = DEFAULT_SCORE_TIMEOUT_S
                     ) -> ScoreResult:
         """Score raw records; blocks until the micro-batch containing
         them completes. Raises RejectedError on shed (429 analog)."""
-        data = records_to_columnar(
-            records, list(self.registry.input_columns) + self.extra_columns)
-        req = self.batcher.submit(data)
-        return req.wait(timeout)
+        return self.fleet.score_batch(records, timeout=timeout,
+                                      extra_columns=self.extra_columns)
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
-        """Stop admitting and drain every in-flight request."""
-        self.health.set_draining("shutdown")
-        self.admission.close()
-        self.batcher.join(timeout)
+        """Stop admitting and drain every in-flight request fleet-wide."""
+        self.fleet.close(timeout)
 
 
 def _result_rows(res: ScoreResult) -> List[dict]:
@@ -151,11 +187,12 @@ class ScoringServer:
                  queue_depth: Optional[int] = None,
                  max_batch_rows: Optional[int] = None,
                  max_wait_ms: Optional[float] = None,
+                 replicas: Optional[int] = None,
+                 batching: Optional[str] = None,
                  column_configs=None, model_config=None) -> None:
         from shifu_tpu.loop import drift_check_batches_setting, \
             log_sample_setting
         from shifu_tpu.loop.drift import DriftMonitor
-        from shifu_tpu.loop.hotswap import SwappableRegistry
         from shifu_tpu.loop.traffic import TrafficLog, traffic_columns
 
         self.root = os.path.abspath(root)
@@ -172,11 +209,19 @@ class ScoringServer:
                       if column_configs else None)
         if self.drift is not None and not self.drift.enabled:
             self.drift = None
-        base_registry = ModelRegistry(
+        # the fleet: one SwappableRegistry + queue + batcher per device
+        # (replicas=None reads -Dshifu.serve.replicas; default = all
+        # local devices; 1 is the exact pre-fleet behavior). It is also
+        # the registry facade this server reads (sha/model_names/warm/
+        # stage/promote) — replica 0 is the canonical read.
+        self.registry = ReplicaFleet.build(
             models_dir or os.path.join(self.root, "models"),
+            n_replicas=replicas,
             column_configs=column_configs, model_config=model_config,
-            drift=self.drift)
-        self.registry = SwappableRegistry(base_registry)
+            drift=self.drift, queue_depth=queue_depth,
+            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
+            batching=batching, observer=self._observe)
+        input_columns = self.registry.input_columns
         # outcome columns (target/weight) ride the request conversion as
         # extra raw columns so label-joined traffic is retrainable
         # straight from the log
@@ -186,19 +231,21 @@ class ScoringServer:
                     model_config.data_set.target_column_name,
                     model_config.data_set.weight_column_name):
                 if (extra_col and extra_col not in label_cols
-                        and extra_col not in base_registry.input_columns):
+                        and extra_col not in input_columns):
                     label_cols.append(extra_col)
         self.traffic: Optional[TrafficLog] = None
         if log_sample_setting() > 0.0:
             self.traffic = TrafficLog(self.root, traffic_columns(
-                list(base_registry.input_columns) + label_cols))
+                list(input_columns) + label_cols))
         self._drift_check_every = max(1, drift_check_batches_setting())
+        # N replica workers observe concurrently now — the cadence
+        # counter needs its own lock (the drift monitor and traffic log
+        # are internally locked already)
+        self._observe_lock = tracked_lock("serve.server.observe")
         self._observed_batches = 0
         self._last_drift_verdict: Optional[dict] = None
-        self.scorer = Scorer(
-            self.registry, AdmissionQueue(queue_depth),
-            max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
-            observer=self._observe, extra_columns=label_cols)
+        self.scorer = Scorer(fleet=self.registry,
+                             extra_columns=label_cols)
         self.started_at = time.time()
         self._serve_thread: Optional[threading.Thread] = None
         self._shutdown_lock = tracked_lock("serve.server.shutdown")
@@ -234,48 +281,83 @@ class ScoringServer:
             log.warning("serve: cannot load ModelConfig.json (%s)", e)
         return ccs, mc
 
-    def _observe(self, data, result) -> None:
-        """Batcher post-resolution observer: traffic log + shadow scoring
-        + cadenced drift verdict. Runs on the worker thread AFTER every
-        request in the batch is answered."""
+    def _observe(self, replica, data, result) -> None:
+        """Per-replica post-resolution observer: traffic log + shadow
+        scoring + cadenced drift verdict. Runs on THAT replica's worker
+        thread AFTER every request in the batch is answered; the traffic
+        log and drift window stay fleet-global (one log, one monitor)."""
         if self.traffic is not None:
-            # scored_sha, not sha: a promote between the score and this
-            # observe must not re-attribute the batch's logged rows to
-            # the new version (the drift recommendation below DOES want
-            # the current active sha — it targets the set being served)
+            # the REPLICA's scored_sha, not the fleet sha: mid-roll, each
+            # replica may serve a different version, and a promote
+            # between the score and this observe must not re-attribute
+            # the batch's logged rows (the drift recommendation below
+            # DOES want the current active sha — it targets the set
+            # being served)
             self.traffic.record(
                 data, result,
-                getattr(self.registry, "scored_sha", self.registry.sha))
-        self.registry.observe(data, result)
-        self._observed_batches += 1
-        if (self.drift is not None
-                and self._observed_batches % self._drift_check_every == 0):
+                getattr(replica.registry, "scored_sha",
+                        replica.registry.sha))
+        replica.registry.observe(data, result)
+        with self._observe_lock:
+            self._observed_batches += 1
+            check = (self.drift is not None
+                     and self._observed_batches
+                     % self._drift_check_every == 0)
+        if check:
             # check_degrade returns the verdict it computed — one window
-            # flush + PSI pass per cadence, not two
+            # flush + PSI pass per cadence, not two; OUTSIDE the cadence
+            # lock (it forces a d2h window flush, SH203)
             self._last_drift_verdict = self.drift.check_degrade(
                 self.scorer.health, self.root,
                 model_sha=self.registry.sha)
 
     def stage_candidate(self, models_dir: str) -> dict:
-        """Load + warm a candidate model set as the shadow version."""
+        """Load + warm a candidate model set as the shadow version on
+        EVERY replica (each onto its own device)."""
         return self.registry.stage(models_dir,
                                    column_configs=self.column_configs,
                                    model_config=self.model_config,
                                    drift=self.drift)
 
     def promote_candidate(self, expected_sha: Optional[str] = None) -> dict:
-        """Hot-swap the staged shadow live; a sticky drift degrade clears
-        — the recommendation was acted on — and the drift monitor resets
-        so drift on the NEW version's traffic re-degrades and
-        re-recommends instead of being swallowed by the old run's
-        already-seen columns. `expected_sha` (from the gate evidence)
-        must match the staged shadow, or the swap is refused."""
-        swap = self.registry.promote(expected_sha)
+        """ROLLING hot-swap: the fleet promotes one replica at a time
+        (requests keep flowing on the others), and each replica step
+        stamps a sha-bound `swap-<seq>.json` audit manifest — from/to
+        shas plus that replica's own shadow evidence, so a rollout is
+        reconstructible per replica from the ledger alone. Afterwards a
+        sticky drift degrade clears — the recommendation was acted on —
+        and the drift monitor resets so drift on the NEW version's
+        traffic re-degrades and re-recommends instead of being swallowed
+        by the old run's already-seen columns. `expected_sha` (from the
+        gate evidence) must match the staged shadow on every replica, or
+        the roll is refused before the first swap."""
+        swap = self.registry.promote(expected_sha,
+                                     step_cb=self._write_swap_manifest)
         self.scorer.health.clear_degraded()
         if self.drift is not None:
             self.drift.reset()
         self._last_drift_verdict = None
         return swap
+
+    def _write_swap_manifest(self, replica, step: dict) -> None:
+        """One sha-bound audit manifest per replica promote step."""
+        import sys
+
+        from shifu_tpu import obs
+        from shifu_tpu.obs.ledger import RunLedger
+
+        ledger = RunLedger(self.root)
+        seq = ledger.next_seq("swap")
+        path = ledger.write(
+            "swap", seq,
+            status="ok", exit_status=0,
+            started_at=time.time(), elapsed_seconds=0.0,
+            argv=list(sys.argv), registry=obs.registry(),
+            extra={"swap": dict(step,
+                                fleetReplicas=len(self.registry.replicas))},
+        )
+        log.info("promote step (replica %s) manifest -> %s",
+                 replica.name, path)
 
     # ---- HTTP ----
     @property
@@ -311,7 +393,11 @@ class ScoringServer:
                 from shifu_tpu.obs import registry as obs_registry
 
                 if self.path == "/healthz":
-                    health = server.scorer.health.snapshot()
+                    # aggregate fleet health: one degraded replica =
+                    # degraded fleet with the replica named in `reason`
+                    # and the per-replica states under `replicas`; ALL
+                    # replicas draining (or fleet shutdown) = draining
+                    health = server.scorer.health_snapshot()
                     # draining replies 503 so load balancers stop routing
                     # here; ok AND degraded stay 200 (degraded still
                     # scores — it is a de-prioritization hint, not an
@@ -321,8 +407,13 @@ class ScoringServer:
                         "models": len(server.registry.model_names),
                         "sha": server.registry.sha,
                         "fused": server.registry.fused,
-                        "queueDepth": len(server.scorer.admission),
-                        "workerRestarts": server.scorer.batcher.restarts,
+                        "replicaCount": len(server.registry.replicas),
+                        "queueDepth": sum(
+                            len(r.admission)
+                            for r in server.registry.replicas),
+                        "workerRestarts": sum(
+                            r.batcher.restarts
+                            for r in server.registry.replicas),
                         "uptimeSeconds": round(
                             time.time() - server.started_at, 1),
                     })
@@ -371,10 +462,11 @@ class ScoringServer:
                 try:
                     res = server.scorer.score_batch(records)
                 except RejectedError as e:
-                    # Retry-After from the observed drain rate (queue
-                    # depth / recent batches-per-second, clamped) — a
-                    # real backlog estimate, not a fixed hint
-                    hint = server.scorer.batcher.retry_after_seconds()
+                    # Retry-After from the FLEET drain rate (total
+                    # backlog / summed per-replica drain rates, clamped)
+                    # — the hint describes the fleet's capacity to
+                    # absorb the retry, not one replica's
+                    hint = server.scorer.retry_after_seconds()
                     self._reply(429, {"error": str(e),
                                       "reason": e.reason,
                                       "retryAfterSeconds": round(hint, 3)},

@@ -373,11 +373,27 @@ class TestDriftMonitor:
         mon.reset()
         mon.note_window(jnp.full(mon.total_slots, 7.0, jnp.float32), 8,
                         gen=gen)
-        assert mon._rows == 0 and mon._window is None  # stale: dropped
+        assert mon._rows == 0 and not mon._windows  # stale: dropped
         w, gen = mon.window()
         mon.note_window(w + 1.0, 8, gen=gen)  # current gen: adopted
         mon._flush()
         assert float(mon._host.sum()) == float(mon.total_slots)
+        # fleet-PR regression: a fold whose BASE window a concurrent
+        # flush already merged must be DROPPED (its token's flush epoch
+        # is stale) — adopting base+delta would double-count the base
+        # into the next flush (the N-replica worker interleave)
+        w, tok = mon.window()
+        mon.note_window(w + 1.0, 8, gen=tok)
+        mon._flush()          # merges w+1.0; bumps the key's epoch
+        before = float(mon._host.sum())
+        mon.note_window(w + 1.0, 8, gen=tok)  # stale epoch: dropped
+        mon._flush()
+        assert float(mon._host.sum()) == before
+        # and a fresh token folds normally again
+        w, tok = mon.window()
+        mon.note_window(w + 1.0, 8, gen=tok)
+        mon._flush()
+        assert float(mon._host.sum()) == before + float(mon.total_slots)
 
     def test_reset_reopens_the_degrade_loop(self, model_set,
                                             column_configs, tmp_path):

@@ -378,7 +378,12 @@ class TestScoringServer:
         from shifu_tpu.serve.server import ScoringServer
 
         obs.reset()
-        srv = ScoringServer(root=model_set, max_wait_ms=1).start()
+        # replicas=1 pins the single-replica semantics this test is
+        # about (the suite forces 8 virtual devices, and the default
+        # fleet would spread these requests); multi-replica behavior is
+        # tests/test_fleet.py's job
+        srv = ScoringServer(root=model_set, max_wait_ms=1,
+                            replicas=1).start()
         base = f"http://127.0.0.1:{srv.port}"
         cols = srv.registry.input_columns
         recs = [{c: str(raw_data.column(c)[i]) for c in cols}
@@ -426,8 +431,10 @@ class TestScoringServer:
         assert m["schema"] == "shifu.run/1"
         assert m["step"] == "serve"
         assert m["serve"]["sha"] == srv.registry.sha
-        assert m["metrics"]["counters"]["serve.requests"] >= 2
-        assert m["metrics"]["counters"]["serve.records"] >= 6
+        # fleet PR: serve.* metrics carry a replica label (replica "0"
+        # is the whole fleet at the default single-replica test config)
+        assert m["metrics"]["counters"]['serve.requests{replica="0"}'] >= 2
+        assert m["metrics"]["counters"]['serve.records{replica="0"}'] >= 6
         # post-shutdown: in-process scoring is an explicit rejection
         from shifu_tpu.serve.queue import RejectedError
 
@@ -441,8 +448,12 @@ class TestScoringServer:
         from shifu_tpu.serve.registry import records_to_columnar
         from shifu_tpu.serve.server import ScoringServer
 
+        # replicas=1: with a fleet, saturating ONE replica no longer
+        # sheds — the router drains around it (pinned in test_fleet.py);
+        # this test pins the single-replica 429 contract
         srv = ScoringServer(root=model_set, queue_depth=2,
-                            max_batch_rows=1, max_wait_ms=1).start()
+                            max_batch_rows=1, max_wait_ms=1,
+                            replicas=1).start()
         base = f"http://127.0.0.1:{srv.port}"
         cols = srv.registry.input_columns
         rec = {c: str(raw_data.column(c)[0]) for c in cols}
@@ -625,6 +636,63 @@ class TestPmmlServeParity:
         nc = lt.find(f"{NS}DerivedField/{NS}NormContinuous")
         assert nc.get("outliers") == "asExtremeValues"
         assert len(nc.findall(f"{NS}LinearNorm")) == 2
+
+
+class TestFlatNumericFastPath:
+    """Fleet-PR satellite: flat_numeric_matrix grew a C-speed cast fast
+    path for fully numeric batches (the serve hot path competes with
+    every replica worker for the GIL). The fast and slow paths MUST
+    stay value-identical — python-float grammar extras (underscore
+    separators, non-ASCII digits) are routed to the slow parser by the
+    codepoint guard, and numeric-looking missing tokens disable the
+    fast path entirely."""
+
+    def _data(self, cols, missing=("", "?")):
+        from shifu_tpu.data.reader import ColumnarData
+
+        n = len(next(iter(cols.values())))
+        return ColumnarData(
+            names=list(cols),
+            raw={k: np.asarray(v, dtype=object) for k, v in cols.items()},
+            n_rows=n, missing_values=set(missing))
+
+    def test_fast_and_slow_paths_identical(self):
+        from shifu_tpu.data.reader import flat_numeric_matrix
+
+        fast = self._data({"a": ["1.5", "  2e3 ", "+4", ".5"],
+                           "b": ["-1", "inf", "3", "0"]})
+        slow = self._data({"a": ["1.5", "  2e3 ", "+4", ".5"],
+                           "b": ["-1", "inf", "3", "?"]})
+        got_fast = flat_numeric_matrix(fast, ["a", "b"])
+        got_slow = flat_numeric_matrix(slow, ["a", "b"])
+        np.testing.assert_array_equal(got_fast[:, 0], got_slow[:, 0])
+        np.testing.assert_array_equal(
+            got_fast[:3, 1], got_slow[:3, 1])
+        assert np.isnan(got_slow[3, 1])       # token -> missing
+        assert np.isnan(got_fast[1, 1])       # inf -> non-finite -> NaN
+
+    def test_python_float_grammar_extras_route_to_slow_parser(self):
+        """'1_234' and full-width digits parse under python float but
+        coerce to NaN under pandas — the guard must keep the documented
+        to_numeric semantics, not widen them."""
+        from shifu_tpu.data.reader import flat_numeric_matrix
+
+        got = flat_numeric_matrix(
+            self._data({"a": ["1_234", "2.0"]}), ["a"])
+        assert np.isnan(got[0, 0]) and got[1, 0] == 2.0
+        got = flat_numeric_matrix(
+            self._data({"a": ["１２３", "2.0"]}), ["a"])
+        assert np.isnan(got[0, 0]) and got[1, 0] == 2.0
+
+    def test_numeric_missing_token_still_masks(self):
+        """A missing token that itself parses as a number ('999') must
+        still mask — the fast path is disabled for such token sets."""
+        from shifu_tpu.data.reader import flat_numeric_matrix
+
+        got = flat_numeric_matrix(
+            self._data({"a": ["999", "1.0"]}, missing=("", "999")),
+            ["a"])
+        assert np.isnan(got[0, 0]) and got[1, 0] == 1.0
 
 
 class TestLatencyHistogramBuckets:
