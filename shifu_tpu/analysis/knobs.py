@@ -86,6 +86,19 @@ KNOBS: List[Knob] = [
     _K("shifu.profile.diff.*", "float", "flopsPct 10 / bytesPct 25 / "
        "hbmPct 25 / secondsPct 0",
        "`shifu profile --diff` regression gates (pct increase; 0 = off)"),
+    # ---- request tracing (PR 13) ----
+    _K("shifu.trace.sample", "float", "0.05",
+       "request-trace head sampling: fraction of requests whose traces "
+       "are retained in the ring (0 = slow-tail capture only)"),
+    _K("shifu.trace.slowMs", "float", "100",
+       "request-trace tail capture: every request slower than this is "
+       "retained regardless of sampling (0 disables)"),
+    _K("shifu.trace.maxTraces", "int", "512",
+       "retained request-trace ring capacity (overflow drops the "
+       "oldest, counted serve.trace.dropped)"),
+    _K("shifu.trace.maxEvents", "int", "65536",
+       "span-tracer event ring capacity (obs/tracing.py; overflow "
+       "drops the oldest span, counted trace.dropped)"),
     # ---- sanitizers (PR 4, this PR) ----
     _K("shifu.sanitize", "str", "",
        "comma list of armed sanitizer modes: transfer,nan,recompile,race"
@@ -139,6 +152,12 @@ KNOBS: List[Knob] = [
        "supervisor restart budget before the replica drains"),
     _K("shifu.serve.deadlineMs", "float", "30000",
        "per-request admission-to-dispatch budget (0 disables)"),
+    _K("shifu.serve.sloMs", "float", "0 (= off)",
+       "request-latency SLO threshold in ms: arms serve.slo.good/bad "
+       "counters + the burn-rate gauge wired into /healthz reasons"),
+    _K("shifu.serve.sloTarget", "float", "0.99",
+       "SLO objective (fraction of requests that must meet sloMs); "
+       "burn rate = windowed bad fraction / (1 - target)"),
     # ---- continuous loop (PR 9) ----
     _K("shifu.loop.logSample", "float", "0 (= off)",
        "fraction of served rows written to the traffic log"),

@@ -246,6 +246,32 @@ def build_parser() -> argparse.ArgumentParser:
                               "(default 1.0; same as "
                               "-Dshifu.loop.logSample)")
 
+    p_trace = sub.add_parser(
+        "trace", help="inspect captured request traces "
+                      "(.shifu/runs/serve-<seq>.traces.json: per-stage "
+                      "timelines, Perfetto-loadable; captured by `shifu "
+                      "serve` head sampling / slow-tail capture)")
+    p_trace.add_argument("--last", type=int, default=None,
+                         help="show only the N most recent traces "
+                              "(default 10)")
+    p_trace.add_argument("--slowest", type=int, default=None,
+                         metavar="N",
+                         help="show the N slowest traces by total ms "
+                              "(or by one stage's ms with --stage)")
+    p_trace.add_argument("--stage", default=None,
+                         choices=["featurize", "route", "queue",
+                                  "coalesce", "device", "d2h",
+                                  "serialize"],
+                         help="with --slowest: rank by this stage's "
+                              "summed duration instead of the total")
+    p_trace.add_argument("--show", default=None, metavar="ID",
+                         help="print one trace's full per-stage "
+                              "timeline (searches all trace files, "
+                              "newest first)")
+    p_trace.add_argument("--json", action="store_true", dest="as_json",
+                         help="emit the selected trace summaries as "
+                              "JSON")
+
     p_runs = sub.add_parser(
         "runs", help="list run-ledger manifests (.shifu/runs)")
     p_runs.add_argument("--last", type=int, default=None,
@@ -263,6 +289,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="list mid-stream checkpoints a preempted "
                              "step left under .shifu/runs/ckpt (resume "
                              "with `shifu <step> --resume`)")
+    p_runs.add_argument("--traces", action="store_true",
+                        help="add a TRACES column (captured count + "
+                             "slowest ms) so serve-run listings point "
+                             "at their request-trace evidence "
+                             "(`shifu trace`)")
 
     p_prof = sub.add_parser(
         "profile", help="per-program XLA cost/roofline tables from "
@@ -510,6 +541,63 @@ def dispatch(args: argparse.Namespace) -> int:
         with sanitize.activate(san):
             server.serve_forever()
         return 0
+    if cmd == "trace":
+        import json
+
+        from shifu_tpu.obs.reqtrace import (
+            format_trace_detail,
+            format_trace_table,
+            load_trace_file,
+            slowest_summaries,
+            trace_files,
+        )
+
+        files = trace_files(".")
+        if not files:
+            print("(no trace files under .shifu/runs — serve with "
+                  "-Dshifu.trace.sample>0, -Dshifu.trace.slowMs>0 or an "
+                  "X-Shifu-Trace header, then shut down cleanly)")
+            return 0
+        if args.show:
+            for path in files:
+                try:
+                    doc = load_trace_file(path)
+                except (OSError, ValueError):
+                    continue
+                for s in doc.get("shifuTraces", []):
+                    if s.get("id") == args.show:
+                        if args.as_json:
+                            print(json.dumps(s, indent=2, sort_keys=True))
+                        else:
+                            print(format_trace_detail(s, path=path))
+                        return 0
+            log.error("trace id %s not found in %d trace file(s)",
+                      args.show, len(files))
+            return 1
+        try:
+            doc = load_trace_file(files[0])
+        except (OSError, ValueError) as e:
+            log.error("trace: cannot read %s: %s", files[0], e)
+            return 2
+        summaries = doc.get("shifuTraces", [])
+        if args.slowest is not None:
+            summaries = slowest_summaries(summaries, args.slowest,
+                                          stage=args.stage)
+        else:
+            summaries = summaries[:args.last
+                                  if args.last is not None else 10]
+        if args.as_json:
+            print(json.dumps({"file": files[0],
+                              "summary": doc.get("summary"),
+                              "traces": summaries},
+                             indent=2, sort_keys=True))
+        else:
+            print(f"{files[0]} "
+                  f"({(doc.get('summary') or {}).get('count', '?')} "
+                  f"trace(s), dropped "
+                  f"{(doc.get('summary') or {}).get('dropped', 0)})")
+            print(format_trace_table(summaries))
+        return 0
     if cmd == "runs":
         import json
 
@@ -561,7 +649,7 @@ def dispatch(args: argparse.Namespace) -> int:
         if args.as_json:
             print(json.dumps(manifests, indent=2, sort_keys=True))
         else:
-            print(format_runs(manifests))
+            print(format_runs(manifests, show_traces=args.traces))
         return 0
     if cmd == "profile":
         import json

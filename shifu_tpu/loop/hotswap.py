@@ -145,6 +145,7 @@ class SwappableRegistry:
     # ---- live path (batcher score_fn) ----
     def score_raw(self, data):
         from shifu_tpu.obs import registry as obs_registry
+        from shifu_tpu.obs import reqtrace
 
         active = self._active  # one atomic read: the swap point
         result = active.score_raw(data)
@@ -152,6 +153,11 @@ class SwappableRegistry:
         # thread): a promote landing between this score and the observe
         # must not re-attribute the batch to the NEW version
         self._last_scored_sha = active.sha
+        # request traces carry the sha read at the SAME swap point, so
+        # a trace stays attributed to the version that actually scored
+        # it across a mid-roll promote (the traffic log's scored_sha
+        # discipline, per request)
+        reqtrace.note_attr(scoredSha=active.sha)
         reg = obs_registry()
         reg.counter("serve.version.batches", sha=active.sha,
                     **self.labels).inc()

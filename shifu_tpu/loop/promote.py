@@ -75,6 +75,30 @@ def latest_serve_shadow(root: str) -> Optional[dict]:
     return None
 
 
+def retrain_lineage(root: str, candidate_sha: Optional[str]) -> Optional[dict]:
+    """Serve -> train -> promote lineage for the promote manifest: the
+    retrain manifest that produced this candidate (matched by candidate
+    model-set sha; newest retrain when the sha is unknown) plus the
+    traffic-log trace evidence it recorded — so a promoted rollout
+    points back at the exact request traces it was trained on."""
+    from shifu_tpu.obs.ledger import list_runs
+
+    for m in list_runs(root, step="retrain"):
+        rt = m.get("retrain") or {}
+        cand = (rt.get("candidate") or {}).get("modelSetSha")
+        if candidate_sha is not None and cand != candidate_sha:
+            continue
+        return {
+            "retrainManifest": os.path.basename(m.get("path", "")),
+            "parentModelSetSha": (rt.get("parent") or {}).get(
+                "modelSetSha"),
+            "candidateModelSetSha": cand,
+            "source": (rt.get("source") or {}).get("kind"),
+            "traffic": rt.get("lineage"),
+        }
+    return None
+
+
 def evaluate_gates(shadow: Optional[dict], recommendation: Optional[dict],
                    agree_min: Optional[float] = None,
                    min_rows: Optional[int] = None,
@@ -234,10 +258,14 @@ def run_promote(root: str, candidate_dir: Optional[str],
         log.error("promote: cannot reach shadow stats: %s", e)
         return 2
     recommendation = latest_recommendation(root)
+    # resolved BEFORE any swap: offline_swap renames the candidate dir
+    # into models/, after which the sha (and therefore the lineage
+    # match below) would be unrecoverable
+    candidate_sha = _models_sha(candidate_dir)
     decision = evaluate_gates(shadow, recommendation,
                               agree_min=agree_min, min_rows=min_rows,
                               require_drift=require_drift,
-                              candidate_sha=_models_sha(candidate_dir),
+                              candidate_sha=candidate_sha,
                               active_sha=active_sha)
     if force and not decision["promote"]:
         decision["forced"] = True
@@ -261,7 +289,13 @@ def run_promote(root: str, candidate_dir: Optional[str],
         except (OSError, ValueError) as e:  # failed swap: held + ledgered
             error = f"{type(e).__name__}: {e}"
             decision["promote"] = False
-    # the audit trail: every promote attempt is a ledger manifest
+    # the audit trail: every promote attempt is a ledger manifest,
+    # carrying the serve->train lineage of the candidate it gated
+    try:
+        lineage = retrain_lineage(root, candidate_sha)
+    except (OSError, ValueError) as e:
+        log.warning("promote: cannot resolve retrain lineage: %s", e)
+        lineage = None
     try:
         ledger = RunLedger(root)
         seq = ledger.next_seq("promote")
@@ -275,6 +309,7 @@ def run_promote(root: str, candidate_dir: Optional[str],
             extra={"promote": {"mode": mode,
                                "candidateDir": candidate_dir,
                                "decision": decision,
+                               "lineage": lineage,
                                "swap": swap}},
         )
         log.info("promote manifest -> %s", path)

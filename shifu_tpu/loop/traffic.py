@@ -51,11 +51,16 @@ log = get_logger(__name__)
 TRAFFIC_SUBDIR = os.path.join(".shifu", "runs", "traffic")
 DELIMITER = "|"
 META_FILE = "_meta.json"
-# scores/sha/timestamp ride as ordinary columns; retrain treats them as
-# meta (never features) because they are not in ColumnConfig
+# scores/sha/trace/timestamp ride as ordinary columns; retrain treats
+# them as meta (never features) because they are not in ColumnConfig.
+# TRACE_COLUMN is the request-trace id (obs/reqtrace.py) of the request
+# that produced the row — the serve -> retrain -> promote lineage key.
 SCORE_COLUMN = "shifu_score_mean"
 SHA_COLUMN = "shifu_model_sha"
+TRACE_COLUMN = "shifu_trace"
 TS_COLUMN = "shifu_ts"
+# count of meta columns appended after the feature columns, in order
+META_COLUMNS = (SCORE_COLUMN, SHA_COLUMN, TRACE_COLUMN, TS_COLUMN)
 
 _CHUNK_RE = re.compile(r"^traffic-(\d+)\.psv$")
 
@@ -65,7 +70,7 @@ def traffic_dir(root: str) -> str:
 
 
 def traffic_columns(base_columns: List[str]) -> List[str]:
-    return list(base_columns) + [SCORE_COLUMN, SHA_COLUMN, TS_COLUMN]
+    return list(base_columns) + list(META_COLUMNS)
 
 
 def list_chunks(root: str) -> List[str]:
@@ -197,8 +202,11 @@ class TrafficLog:
             ts = f"{time.time():.3f}"
             cols = [np.asarray(data.column(c), dtype=object)
                     if c in data.raw else None
-                    for c in self.columns[:-3]]
+                    for c in self.columns[:-len(META_COLUMNS)]]
             mean = result.mean
+            # per-row request-trace ids (set by the batcher before the
+            # observer runs) — rows from un-traced requests log empty
+            trace_ids = getattr(data, "trace_ids", None)
             for i in keep:
                 fields = [
                     _sanitize("" if col is None else str(col[i]))
@@ -206,6 +214,9 @@ class TrafficLog:
                 ]
                 fields.append(f"{float(mean[i]):.4f}")
                 fields.append(sha)
+                fields.append(_sanitize(str(trace_ids[i]))
+                              if trace_ids is not None
+                              and i < len(trace_ids) else "")
                 fields.append(ts)
                 self._buffer.append(DELIMITER.join(fields))
             pending = (self._swap_chunk()
@@ -293,6 +304,51 @@ def log_meta(root: str) -> Tuple[dict, List[str]]:
         raise FileNotFoundError(
             f"traffic log {traffic_dir(root)} has no chunk files yet")
     return meta, chunks
+
+
+def trace_lineage(root: str, limit: int = 8) -> Optional[dict]:
+    """Serve -> train lineage evidence from the traffic log: how many
+    logged rows carry a request-trace id (obs/reqtrace.py) and a sample
+    of the ids, so retrain/promote manifests can point back at the
+    exact serving evidence. A single-shard whole-log scan — the log's
+    chunk files are small and this runs once per retrain, not on any
+    hot path. None when the log has no trace column (pre-trace logs)."""
+    try:
+        meta, chunks = log_meta(root)
+    except FileNotFoundError:
+        return None
+    columns = list(meta.get("columns", []))
+    if TRACE_COLUMN not in columns:
+        return None
+    idx = columns.index(TRACE_COLUMN)
+    delim = meta.get("delimiter", DELIMITER)
+    traced = 0
+    total = 0
+    sample: List[str] = []
+    seen = set()
+    for path in chunks:
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.rstrip("\n")
+                    if not line:
+                        continue
+                    total += 1
+                    fields = line.split(delim)
+                    tid = fields[idx] if idx < len(fields) else ""
+                    if tid:
+                        traced += 1
+                        if tid not in seen and len(sample) < limit:
+                            seen.add(tid)
+                            sample.append(tid)
+        except OSError:
+            continue
+    return {
+        "traceColumn": TRACE_COLUMN,
+        "rows": total,
+        "tracedRows": traced,
+        "sampleTraceIds": sample,
+    }
 
 
 def traffic_source(root: str, chunk_rows: Optional[int] = None,

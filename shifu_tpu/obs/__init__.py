@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.obs import profile as _profile
+from shifu_tpu.obs import reqtrace as _reqtrace
 from shifu_tpu.obs.ledger import RunLedger, format_runs, list_runs
 from shifu_tpu.obs.metrics import (
     MetricsRegistry,
@@ -77,14 +78,15 @@ def span(name: str, **attrs):
 
 
 def reset() -> None:
-    """Fresh registry + tracer + profiler scope (step boundaries, bench
-    scenarios, tests). The profiler's program-cost cache survives — the
-    compiled executables it mirrors do too."""
+    """Fresh registry + tracer + profiler + request-trace scope (step
+    boundaries, bench scenarios, tests). The profiler's program-cost
+    cache survives — the compiled executables it mirrors do too."""
     global _registry, _tracer
     with _lock:
         _registry = MetricsRegistry()
         _tracer = Tracer()
         _profile.reset()
+        _reqtrace.reset()
 
 
 def begin_run() -> int:

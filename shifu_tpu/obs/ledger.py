@@ -163,12 +163,16 @@ def list_runs(root: str, last: Optional[int] = None,
     return out
 
 
-def format_runs(manifests: List[dict]) -> str:
-    """Human table for `shifu runs`."""
+def format_runs(manifests: List[dict], show_traces: bool = False) -> str:
+    """Human table for `shifu runs`; `show_traces` adds a TRACES column
+    (captured request-trace count + slowest ms from the manifest's
+    trace summary) so serve-run rows point at their `shifu trace`
+    evidence."""
     if not manifests:
         return "(no runs recorded under .shifu/runs)"
+    traces_col = f"{'TRACES':<14} " if show_traces else ""
     header = f"{'STEP':<10} {'SEQ':>4} {'STATUS':<7} {'ELAPSED':>9} " \
-             f"{'STARTED (UTC)':<20} KEY METRICS"
+             f"{'STARTED (UTC)':<20} {traces_col}KEY METRICS"
     lines = [header]
     for m in manifests:
         metrics = m.get("metrics", {})
@@ -187,10 +191,20 @@ def format_runs(manifests: List[dict]) -> str:
         if n_series:
             hints.append(f"series={n_series}")
         started = (m.get("startedAt") or "")[:19]
+        tr_cell = ""
+        if show_traces:
+            tr = m.get("traces") or {}
+            if tr.get("count"):
+                slowest = tr.get("slowestMs")
+                tr_cell = (f"{tr['count']}@{slowest:.1f}ms"
+                           if slowest is not None else str(tr["count"]))
+            else:
+                tr_cell = "-"
+            tr_cell = f"{tr_cell:<14} "
         lines.append(
             f"{m.get('step', '?'):<10} {m.get('seq', 0):>4} "
             f"{m.get('status', '?'):<7} "
             f"{m.get('elapsedSeconds', 0.0):>8.2f}s "
-            f"{started:<20} {', '.join(hints[:4])}"
+            f"{started:<20} {tr_cell}{', '.join(hints[:4])}"
         )
     return "\n".join(lines)

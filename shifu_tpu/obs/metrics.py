@@ -23,6 +23,7 @@ the prefetch worker thread and the consumer thread concurrently.
 
 from __future__ import annotations
 
+import bisect
 import json
 import re
 import time
@@ -99,7 +100,7 @@ class Gauge:
 
 class Histogram:
     __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count",
-                 "_min", "_max")
+                 "_min", "_max", "_exemplars")
 
     def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS) -> None:
         self._lock = tracked_lock("obs.metrics.histogram")
@@ -111,14 +112,25 @@ class Histogram:
         self._count = 0
         self._min = float("inf")
         self._max = float("-inf")
+        # per-bucket last (value, trace id): /metrics links a slow
+        # bucket straight to a captured request trace (OpenMetrics
+        # exemplar annotations on the _bucket samples)
+        self._exemplars: Dict[int, Tuple[float, str]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
+        # first bucket with v <= bound, C-speed (the last bound is +inf,
+        # so any non-NaN value lands in range) — observe runs per
+        # request on the serve path, where a Python linear scan is
+        # measurable. NaN (v != v) counts in NO bucket, matching the
+        # old linear scan's no-match behavior (bisect would mis-place
+        # it in bucket 0).
+        i = bisect.bisect_left(self.buckets, v) if v == v else -1
         with self._lock:
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    self._counts[i] += 1
-                    break
+            if i >= 0:
+                self._counts[i] += 1
+                if exemplar is not None:
+                    self._exemplars[i] = (v, str(exemplar))
             self._sum += v
             self._count += 1
             self._min = min(self._min, v)
@@ -144,7 +156,7 @@ class Histogram:
 
     def as_dict(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "buckets": ["inf" if b == float("inf") else b
                             for b in self.buckets],
                 "counts": list(self._counts),
@@ -153,6 +165,11 @@ class Histogram:
                 "min": self._min if self._count else None,
                 "max": self._max if self._count else None,
             }
+            if self._exemplars:
+                out["exemplars"] = {
+                    str(i): [v, eid]
+                    for i, (v, eid) in sorted(self._exemplars.items())}
+            return out
 
 
 class Timer:
@@ -314,6 +331,9 @@ class MetricsRegistry:
                              else float("inf"))
                 hist._max = (h["max"] if h["max"] is not None
                              else float("-inf"))
+                hist._exemplars = {
+                    int(i): (float(v), str(eid))
+                    for i, (v, eid) in (h.get("exemplars") or {}).items()}
         for key, t in snap.get("timers", {}).items():
             name, labels = _parse_key(key)
             reg.timer(name, **labels).add(t["seconds"], t["calls"])
@@ -361,6 +381,25 @@ class MetricsRegistry:
                 flat[sanitize_name(name) + "_last" + _label_str(labels)] = last
         return flat
 
+    def _bucket_exemplars(self) -> Dict[str, Tuple[float, str]]:
+        """{_bucket sample key: (value, trace id)} — same key shape as
+        flatten(), so to_prometheus can annotate the matching lines."""
+        with self._lock:
+            histograms = dict(self._histograms)
+        out: Dict[str, Tuple[float, str]] = {}
+        for (name, labels), h in histograms.items():
+            if not h._exemplars:  # bare emptiness peek (GIL-atomic):
+                continue          # skip the second locked snapshot for
+                                  # the common exemplar-less histogram
+            base = sanitize_name(name)
+            d = h.as_dict()
+            for i, (v, eid) in (d.get("exemplars") or {}).items():
+                b = d["buckets"][int(i)]
+                le = "+Inf" if b == "inf" else repr(float(b))
+                bl = _labels_key(dict(labels, le=le))
+                out[base + "_bucket" + _label_str(bl)] = (float(v), eid)
+        return out
+
     def to_prometheus(self) -> str:
         lines: List[str] = []
         types: Dict[str, str] = {}
@@ -374,8 +413,16 @@ class MetricsRegistry:
         for base in sorted(types):
             lines.append(f"# TYPE {base} {types[base]}")
         flat = self.flatten()
+        exemplars = self._bucket_exemplars()
         for sample in sorted(flat):
-            lines.append(f"{sample} {_fmt_value(flat[sample])}")
+            line = f"{sample} {_fmt_value(flat[sample])}"
+            ex = exemplars.get(sample)
+            if ex is not None:
+                # OpenMetrics exemplar: the slow bucket names the trace
+                # id whose request landed in it (evidence, not a sample)
+                v, eid = ex
+                line += f' # {{trace_id="{_escape(eid)}"}} {_fmt_value(v)}'
+            lines.append(line)
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -407,6 +454,11 @@ def parse_prometheus(text: str) -> Dict[str, float]:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # exemplar annotations (` # {trace_id="..."} v`) are evidence
+        # riding the sample line, not part of the sample value —
+        # anchored at end-of-line so a label VALUE containing " # "
+        # (label values only escape \ and ") can never be truncated
+        line = re.sub(r' # \{[^{}]*\} \S+$', '', line)
         sample, _, value = line.rpartition(" ")
         if value == "+Inf":
             out[sample] = float("inf")

@@ -8,6 +8,12 @@ one file per lifecycle step next to the run manifest (obs/ledger.py).
 Thread-safe: the streaming pipeline's prefetch worker opens spans on its own
 thread; events carry the recording thread id so overlap between the parse
 thread and the device thread is visible as parallel tracks.
+
+Bounded: the event store is a ring of `-Dshifu.trace.maxEvents` entries
+(knob read at construction — obs.reset()/step boundaries re-read it). A
+long-running `shifu serve` used to grow `_events` forever; now overflow
+drops the OLDEST span and counts `trace.dropped`, so the newest spans —
+the ones a shutdown manifest wants — survive at bounded memory.
 """
 
 from __future__ import annotations
@@ -16,16 +22,29 @@ import json
 import os
 import threading
 import time
+from collections import deque
 
 from shifu_tpu.analysis.racetrack import tracked_lock
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional
 
+from shifu_tpu.utils import environment
+
+DEFAULT_MAX_EVENTS = 65536
+
+
+def max_events_setting() -> int:
+    """shifu.trace.maxEvents — span-event ring capacity (per Tracer)."""
+    return environment.get_int("shifu.trace.maxEvents", DEFAULT_MAX_EVENTS)
+
 
 class Tracer:
-    def __init__(self) -> None:
+    def __init__(self, max_events: Optional[int] = None) -> None:
         self._lock = tracked_lock("obs.tracing")
-        self._events: List[dict] = []
+        self.max_events = max(1, (max_events_setting()
+                                  if max_events is None else int(max_events)))
+        self._events: deque = deque(maxlen=self.max_events)
+        self._dropped = 0
         self._local = threading.local()
         # one wall-clock anchor so perf_counter offsets render as absolute-ish
         self._t0 = time.perf_counter()
@@ -65,13 +84,27 @@ class Tracer:
             }
             if stack:
                 event["args"]["parent"] = "/".join(stack)
+            overflow = False
             with self._lock:
+                if len(self._events) == self._events.maxlen:
+                    self._dropped += 1  # deque evicts the oldest span
+                    overflow = True
                 self._events.append(event)
+            if overflow:
+                from shifu_tpu.obs import registry
+
+                registry().counter("trace.dropped").inc()
 
     @property
     def events(self) -> List[dict]:
         with self._lock:
             return [dict(e) for e in self._events]
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted by the -Dshifu.trace.maxEvents ring."""
+        with self._lock:
+            return self._dropped
 
     def span_seconds(self, name: str) -> float:
         """Total recorded duration of all spans with this name (seconds)."""

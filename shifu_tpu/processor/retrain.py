@@ -274,11 +274,23 @@ class RetrainProcessor(BasicProcessor):
                      "appendTrees": (append if alg == Algorithm.GBT
                                      else None)},
         })
+        # serve -> train lineage: the request-trace ids stamped into the
+        # traffic log tie this candidate back to the exact serving
+        # evidence (`shifu trace --show <id>` on the serve ledger)
+        lineage = None
+        if kind == "traffic":
+            from shifu_tpu.loop.traffic import trace_lineage
+
+            try:
+                lineage = trace_lineage(self.root)
+            except (OSError, ValueError) as e:
+                log.warning("retrain: cannot read trace lineage: %s", e)
         self.manifest_extra["retrain"] = {
             "source": {"kind": kind,
                        "dataPath": sub_mc.data_set.data_path,
                        "trafficChunks": traffic_chunks,
                        "rows": int(norm_meta.n_rows)},
+            "lineage": lineage,
             "parent": {"modelSetSha": parent_sha,
                        "modelsDir": parent_dir,
                        "models": parent_files,

@@ -136,21 +136,28 @@ class DeadlineExceededError(TimeoutError):
 
 
 class ScoreRequest:
-    """One admitted request: a raw columnar slice plus its completion."""
+    """One admitted request: a raw columnar slice plus its completion.
 
-    __slots__ = ("data", "n_rows", "enqueued_at", "deadline", "_done",
-                 "result", "error")
+    `trace` (obs/reqtrace.RequestTrace, optional) rides along so the
+    batcher can stamp the queue-wait / coalesce-wait stages and fan the
+    batch-level featurize/device/d2h durations out per request."""
+
+    __slots__ = ("data", "n_rows", "enqueued_at", "popped_at", "deadline",
+                 "_done", "result", "error", "trace")
 
     def __init__(self, data: ColumnarData,
-                 deadline_s: Optional[float] = None) -> None:
+                 deadline_s: Optional[float] = None,
+                 trace=None) -> None:
         self.data = data
         self.n_rows = data.n_rows
         self.enqueued_at = time.perf_counter()
+        self.popped_at = self.enqueued_at
         self.deadline = (self.enqueued_at + deadline_s
                          if deadline_s else None)
         self._done = threading.Event()
         self.result: Optional[ScoreResult] = None
         self.error: Optional[BaseException] = None
+        self.trace = trace
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -184,6 +191,16 @@ def _concat_batches(datas: Sequence[ColumnarData]) -> ColumnarData:
     return ColumnarData(names=list(names), raw=raw,
                         n_rows=sum(d.n_rows for d in datas),
                         missing_values=datas[0].missing_values)
+
+
+def _note_popped(req: ScoreRequest) -> None:
+    """Stamp the queue-wait stage the moment a request leaves the
+    admission queue (enqueue -> worker pop)."""
+    now = time.perf_counter()
+    req.popped_at = now
+    if req.trace is not None:
+        req.trace.add_stage("queue", now - req.enqueued_at,
+                            t0=req.enqueued_at)
 
 
 def _slice_result(res: ScoreResult, start: int, stop: int) -> ScoreResult:
@@ -256,9 +273,10 @@ class MicroBatcher:
         worker.start()
         return worker
 
-    def submit(self, data: ColumnarData) -> ScoreRequest:
+    def submit(self, data: ColumnarData, trace=None) -> ScoreRequest:
         """Admit one request (raises queue.RejectedError on shed)."""
-        req = ScoreRequest(data, deadline_s=self.deadline_s or None)
+        req = ScoreRequest(data, deadline_s=self.deadline_s or None,
+                           trace=trace)
         self.admission.put(req)
         return req
 
@@ -329,6 +347,7 @@ class MicroBatcher:
         first = self.admission.get()
         if first is None:
             return None
+        _note_popped(first)
         batch = [first]
         # register with the supervisor IMMEDIATELY (same list object, so
         # later appends are visible): a request popped from the queue is
@@ -341,6 +360,7 @@ class MicroBatcher:
                 nxt = self.admission.get(timeout=0)
                 if nxt is None:
                     break  # capacity not hit but nothing is waiting NOW
+                _note_popped(nxt)
                 batch.append(nxt)
                 rows += nxt.n_rows
             return batch
@@ -352,12 +372,13 @@ class MicroBatcher:
             nxt = self.admission.get(timeout=remaining)
             if nxt is None:
                 break
+            _note_popped(nxt)
             batch.append(nxt)
             rows += nxt.n_rows
         return batch
 
     def _loop(self) -> None:
-        from shifu_tpu.obs import registry
+        from shifu_tpu.obs import registry, reqtrace
         from shifu_tpu.resilience import faults
 
         while True:
@@ -394,15 +415,33 @@ class MicroBatcher:
             self._inflight = batch
             faults.fault_point("serve")
             rows = sum(r.n_rows for r in batch)
+            # coalesce-wait closes here: pop -> dispatch is the time a
+            # request spent waiting for its bucket to fill/close — the
+            # convoy term the continuous-batching policy exists to bound
+            dispatch_t = time.perf_counter()
+            dispatch_unix = time.time()
+            traced = [r for r in batch if r.trace is not None]
+            replica = self.labels.get("replica", "0")
+            for r in traced:
+                r.trace.add_stage("coalesce", dispatch_t - r.popped_at,
+                                  t0=r.popped_at)
+                r.trace.annotate(replica=replica, batchRequests=len(batch),
+                                 batchRows=rows)
             reg.counter("serve.batches", **self.labels).inc()
             reg.histogram(
                 "serve.batch.rows", buckets=BATCH_ROWS_BUCKETS,
                 **self.labels,
             ).observe(rows)
             try:
-                with reg.timer("serve.batch.score", **self.labels).time():
-                    concat = _concat_batches([r.data for r in batch])
-                    result = self.score_fn(concat)
+                # the registry notes featurize/device/d2h into the
+                # thread-local capture; they fan out to every request
+                # that rode the bucket (a batch-level stage IS each
+                # rider's wait)
+                with reqtrace.capture_stages(enabled=bool(traced)) as cap:
+                    with reg.timer("serve.batch.score",
+                                   **self.labels).time():
+                        concat = _concat_batches([r.data for r in batch])
+                        result = self.score_fn(concat)
             except Exception as e:  # fan the failure out per request
                 log.warning("serve batch of %d requests failed: %s",
                             len(batch), e)
@@ -411,6 +450,17 @@ class MicroBatcher:
                     r.fail(e)
                 self._inflight = None
                 continue
+            if cap:
+                for stage, dur, t0 in cap.stages:
+                    for r in traced:
+                        r.trace.add_stage(stage, dur, t0)
+                if cap.attrs:
+                    # batch-level attributes (the scoring version's sha,
+                    # from the SwappableRegistry swap point) annotate
+                    # every rider — per-request version lineage that
+                    # stays correct across a mid-roll promote
+                    for r in traced:
+                        r.trace.annotate(**cap.attrs)
             off = 0
             now = time.perf_counter()
             lat = reg.histogram("serve.latency_seconds",
@@ -425,10 +475,26 @@ class MicroBatcher:
             with self._drain_lock:
                 self._drain_log.append((now, len(batch)))
             self.health.note_ok()
+            if traced:
+                # the convoy witness: which traces shared this bucket
+                reqtrace.buffer().note_batch(
+                    replica, [r.trace.trace_id for r in traced],
+                    requests=len(batch), rows=rows,
+                    started_unix=dispatch_unix,
+                    dur_s=now - dispatch_t)
             if self.observer is not None:
                 # every client already has its answer; the loop seams
                 # (traffic log, shadow scoring, drift verdicts) run here
                 # so they cost queue headroom, never request latency
+                if traced:
+                    # per-row trace ids ride the batch into the traffic
+                    # log (serve -> retrain lineage); rows of un-traced
+                    # requests log the empty token
+                    concat.trace_ids = np.concatenate([
+                        np.full(r.n_rows,
+                                r.trace.trace_id if r.trace else "",
+                                dtype=object)
+                        for r in batch])
                 try:
                     self.observer(concat, result)
                 except Exception as oe:  # observers must not kill serving

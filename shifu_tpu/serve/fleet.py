@@ -51,12 +51,19 @@ import numpy as np
 from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.eval.scorer import DEFAULT_SCORE_SCALE, ScoreResult
 from shifu_tpu.serve.batcher import (
+    LATENCY_BUCKETS,
     RETRY_AFTER_MAX_S,
     RETRY_AFTER_MIN_S,
     MicroBatcher,
     ScoreRequest,
 )
-from shifu_tpu.serve.health import DEGRADED, DRAINING, OK, HealthMonitor
+from shifu_tpu.serve.health import (
+    DEGRADED,
+    DRAINING,
+    OK,
+    HealthMonitor,
+    SloTracker,
+)
 from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
 from shifu_tpu.serve.registry import ModelRegistry, records_to_columnar
 from shifu_tpu.utils import environment
@@ -170,7 +177,7 @@ class DrainAwareRouter:
         ranked.sort(key=lambda t: (t[0], t[1]))
         return [t[2] for t in ranked]
 
-    def submit(self, data) -> ScoreRequest:
+    def submit(self, data, trace=None) -> ScoreRequest:
         """Admit one request on the best replica, spilling past full
         ones. Raises RejectedError when nothing can take it."""
         from shifu_tpu.obs import registry
@@ -182,7 +189,7 @@ class DrainAwareRouter:
         last: Optional[RejectedError] = None
         for i, rep in enumerate(order):
             try:
-                req = rep.batcher.submit(data)
+                req = rep.batcher.submit(data, trace=trace)
             except RejectedError as e:
                 last = e
                 if i == 0:
@@ -193,6 +200,8 @@ class DrainAwareRouter:
                                 replica=rep.name).inc()
                 continue
             reg.counter("serve.router.routed", replica=rep.name).inc()
+            if trace is not None:
+                trace.annotate(replica=rep.name, spilled=bool(i))
             return req
         raise last if last is not None else RejectedError("closed")
 
@@ -226,6 +235,19 @@ class ReplicaFleet:
         # not queued
         self._ctl_lock = tracked_lock("serve.fleet.control")
         self._ctl_busy: Optional[str] = None
+        # request-latency SLO accounting (serve/health.py SloTracker):
+        # armed by -Dshifu.serve.sloMs, read by /healthz and the
+        # shutdown manifest; a no-op object when the knob is unset
+        self.slo = SloTracker()
+        # per-(stage, replica) histogram cache: finish_trace runs once
+        # per request, and seven registry get-or-create lookups (label
+        # sort + registry lock each) per request are measurable GIL
+        # time at fleet concurrency. Plain dict — reads are GIL-atomic,
+        # a racing first-miss just does the registry lookup twice and
+        # lands on the SAME registry-owned histogram either way. Cleared
+        # when the obs registry is swapped (reset) under us.
+        self._stage_hists: dict = {}
+        self._stage_hists_reg = None
         from shifu_tpu.obs import registry
 
         registry().gauge("serve.replicas").set(len(self.replicas))
@@ -288,12 +310,51 @@ class ReplicaFleet:
         return len(self.replicas)
 
     # ---- scoring ----
-    def submit(self, data) -> ScoreRequest:
-        return self.router.submit(data)
+    def submit(self, data, trace=None) -> ScoreRequest:
+        return self.router.submit(data, trace=trace)
 
     def score_raw(self, data) -> ScoreResult:
         """Routed scoring of one raw batch (blocks for the result)."""
         return self.submit(data).wait()
+
+    # ---- request tracing / SLO ----
+    def finish_trace(self, trace) -> bool:
+        """Close one request's trace: offer it to the bounded ring
+        (obs/reqtrace.buffer — head-sampled or slow-captured), feed the
+        per-stage `serve.stage_seconds{stage=,replica=}` histograms
+        (retained traces ride along as bucket exemplars, so /metrics
+        links straight to the evidence), and count the request against
+        the SLO. Returns True when the trace was retained."""
+        from shifu_tpu.obs import registry, reqtrace
+
+        total = trace.finish()
+        kept = reqtrace.buffer().offer(trace)
+        reg = registry()
+        if reg is not self._stage_hists_reg:
+            # obs scope was reset (new bench scenario/test): old
+            # histograms belong to the dead registry
+            self._stage_hists = {}
+            self._stage_hists_reg = reg
+        # a request shed before placement has no replica: label its
+        # stage samples "unrouted" rather than fabricating an empty
+        # replica="" series next to the real 0..N-1 ones
+        replica = str(trace.attrs.get("replica", "unrouted"))
+        exemplar = trace.trace_id if kept else None
+        for stage, dur in trace.stage_totals().items():
+            hist = self._stage_hists.get((stage, replica))
+            if hist is None:
+                hist = reg.histogram("serve.stage_seconds",
+                                     buckets=LATENCY_BUCKETS,
+                                     stage=stage, replica=replica)
+                self._stage_hists[(stage, replica)] = hist
+            hist.observe(dur, exemplar=exemplar)
+        # `status` is set only by the error paths (rejected/timeout/
+        # exception): such a request got no score, so it counts BAD
+        # whatever its latency — a fleet shedding 90% of traffic in
+        # sub-millisecond 429s must burn the SLO budget, not look fast
+        self.slo.observe(total,
+                         ok=False if "status" in trace.attrs else None)
+        return kept
 
     # ---- registry facade (replica 0 is the canonical read) ----
     @property
@@ -525,13 +586,25 @@ class ReplicaFleet:
 
     def score_batch(self, records: Sequence[dict],
                     timeout: Optional[float] = None,
-                    extra_columns: Optional[Sequence[str]] = None
-                    ) -> ScoreResult:
-        """Routed in-process scoring of raw records."""
+                    extra_columns: Optional[Sequence[str]] = None,
+                    trace=None) -> ScoreResult:
+        """Routed in-process scoring of raw records. A `trace`
+        (obs/reqtrace.RequestTrace) rides through record conversion
+        (featurize), placement (route) and the batcher stages; the
+        CALLER finishes it (finish_trace) so it can stamp its own
+        serialize stage first."""
         cols = list(self.input_columns) + [
             c for c in (extra_columns or []) if c not in self.input_columns]
-        data = records_to_columnar(records, cols)
-        return self.submit(data).wait(timeout)
+        if trace is None:
+            data = records_to_columnar(records, cols)
+            return self.submit(data).wait(timeout)
+        with trace.stage("featurize"):
+            data = records_to_columnar(records, cols)
+        trace.annotate(rows=data.n_rows)
+        t0 = time.perf_counter()
+        req = self.submit(data, trace=trace)
+        trace.add_stage("route", time.perf_counter() - t0, t0=t0)
+        return req.wait(timeout)
 
 
 def _reduce_shadow_stats(replicas: Sequence[ScoringReplica],

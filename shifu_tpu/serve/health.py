@@ -21,15 +21,116 @@ the run-ledger manifest carries the replica's health history.
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Optional
 
 from shifu_tpu.analysis.racetrack import guarded_by, tracked_lock
+from shifu_tpu.utils import environment
 
 OK = "ok"
 DEGRADED = "degraded"
 DRAINING = "draining"
 
 DEFAULT_OK_AFTER = 3
+
+DEFAULT_SLO_TARGET = 0.99
+DEFAULT_SLO_WINDOW_S = 60.0
+# rolling-window event bound: at 4096 requests the window estimate is
+# already statistical, and the deque stays O(KB) at any uptime
+SLO_WINDOW_EVENTS = 4096
+
+
+def slo_ms_setting() -> float:
+    """shifu.serve.sloMs — per-request latency SLO threshold in ms
+    (0 = SLO accounting off)."""
+    return environment.get_float("shifu.serve.sloMs", 0.0)
+
+
+def slo_target_setting() -> float:
+    """shifu.serve.sloTarget — the objective: the fraction of requests
+    that must meet sloMs (burn rate is measured against 1 - target)."""
+    return environment.get_float("shifu.serve.sloTarget",
+                                 DEFAULT_SLO_TARGET)
+
+
+class SloTracker:
+    """Good/bad SLO accounting + burn rate over a rolling window.
+
+    A request is GOOD when its end-to-end latency meets
+    `-Dshifu.serve.sloMs`; good/bad land in the `serve.slo.good` /
+    `serve.slo.bad` counters. `burn_rate()` is the classic SRE number:
+    the bad fraction over the rolling window divided by the error
+    budget (1 - target) — 1.0 means the budget burns exactly at the
+    sustainable rate, above it /healthz carries an SLO reason."""
+
+    def __init__(self, slo_ms: Optional[float] = None,
+                 target: Optional[float] = None,
+                 window_s: float = DEFAULT_SLO_WINDOW_S) -> None:
+        self.slo_ms = slo_ms_setting() if slo_ms is None else float(slo_ms)
+        target = slo_target_setting() if target is None else float(target)
+        self.target = min(max(target, 0.0), 0.9999)
+        self.window_s = float(window_s)
+        self._lock = tracked_lock("serve.slo")
+        self._events: deque = deque(maxlen=SLO_WINDOW_EVENTS)
+        self._good = 0
+        self._bad = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.slo_ms > 0.0
+
+    def observe(self, latency_s: float, ok: Optional[bool] = None) -> None:
+        """Count one request. `ok=None` applies the latency test;
+        `ok=False` forces a bad count — shed (429) and failed requests
+        got NO score, which must burn budget rather than dilute the
+        window as sub-millisecond "good" outcomes."""
+        if not self.enabled:
+            return
+        from shifu_tpu.obs import registry
+
+        if ok is None:
+            ok = latency_s * 1e3 <= self.slo_ms
+        with self._lock:
+            self._events.append((time.perf_counter(), ok))
+            if ok:
+                self._good += 1
+            else:
+                self._bad += 1
+        registry().counter("serve.slo.good" if ok else "serve.slo.bad").inc()
+
+    def burn_rate(self, now: Optional[float] = None) -> float:
+        """Bad fraction over the rolling window / (1 - target); exported
+        as the `serve.slo.burn_rate` gauge on every read."""
+        if not self.enabled:
+            return 0.0
+        from shifu_tpu.obs import registry
+
+        if now is None:
+            now = time.perf_counter()
+        with self._lock:
+            recent = [ok for t, ok in self._events
+                      if now - t <= self.window_s]
+        if not recent:
+            rate = 0.0
+        else:
+            bad = sum(1 for ok in recent if not ok)
+            rate = (bad / len(recent)) / max(1e-9, 1.0 - self.target)
+        registry().gauge("serve.slo.burn_rate").set(rate)
+        return rate
+
+    def snapshot(self) -> dict:
+        rate = self.burn_rate()
+        with self._lock:
+            return {
+                "sloMs": self.slo_ms,
+                "target": self.target,
+                "windowSeconds": self.window_s,
+                "good": self._good,
+                "bad": self._bad,
+                "burnRate": round(rate, 4),
+                "burning": rate > 1.0,
+            }
 
 
 class HealthMonitor:

@@ -433,12 +433,24 @@ class ModelRegistry:
     def score_raw(self, data: ColumnarData) -> ScoreResult:
         """Raw batch -> ScoreResult, padded to the row bucket and sliced
         back; one explicit device_put in, one explicit device_get out."""
+        import time
+
         from shifu_tpu.obs import registry as obs_registry
+        from shifu_tpu.obs import reqtrace
 
         reg = obs_registry()
+        # version lineage for request traces: bare-registry embeddings
+        # get the same scoredSha attribute the SwappableRegistry stamps
+        # (which overwrites this with the sha read at its swap point)
+        reqtrace.note_attr(scoredSha=self.sha)
         if not self.fused:
             reg.counter("serve.score.rows", **self.labels).inc(data.n_rows)
+            t_dev = time.perf_counter()
             result = self._runner.score_raw(data)
+            # fallback path: the runner owns featurize+dispatch+fetch in
+            # one opaque call, so the whole of it attributes as device
+            reqtrace.note_stage("device", time.perf_counter() - t_dev,
+                                t0=t_dev)
             if self.drift is not None and self.drift_live:
                 # ModelRunner fallback: host-side fold, same binning
                 self.drift.fold_host(data)
@@ -447,6 +459,10 @@ class ModelRegistry:
 
         from shifu_tpu.analysis import sanitize
 
+        # featurize = host parse + per-plan prep + the h2d device_put
+        # (the ROADMAP's "parse+device_put" host term, now measured per
+        # request instead of inferred from aggregate counters)
+        t_feat = time.perf_counter()
         n = data.n_rows
         bucket = self.bucket(n)
         code_cache: dict = {}
@@ -508,10 +524,17 @@ class ModelRegistry:
             dev_inputs, drift_put = jax.device_put(
                 (tuple(plan_inputs), drift_host), self.device)
             drift_dev = tuple(drift_put) + (window,)
+            reqtrace.note_stage("featurize", time.perf_counter() - t_feat,
+                                t0=t_feat)
+            t_dev = time.perf_counter()
             with sanitize.transfer_free("serve.score"):
                 out = profile.dispatch("serve.fused_score", self._program,
                                        dev_inputs, drift_dev, sync=True)
+            t_d2h = time.perf_counter()
+            reqtrace.note_stage("device", t_d2h - t_dev, t0=t_dev)
             m, mean, mx, mn, med = jax.device_get(out[:5])
+            reqtrace.note_stage("d2h", time.perf_counter() - t_d2h,
+                                t0=t_d2h)
             if self.drift_live:
                 self.drift.note_window(out[5], n, gen=drift_gen,
                                        device=self.device,
@@ -519,10 +542,17 @@ class ModelRegistry:
                 reg.counter("loop.drift.rows").inc(n)
         else:
             dev_inputs = jax.device_put(tuple(plan_inputs), self.device)
+            reqtrace.note_stage("featurize", time.perf_counter() - t_feat,
+                                t0=t_feat)
+            t_dev = time.perf_counter()
             with sanitize.transfer_free("serve.score"):
                 out = profile.dispatch("serve.fused_score", self._program,
                                        dev_inputs, sync=True)
+            t_d2h = time.perf_counter()
+            reqtrace.note_stage("device", t_d2h - t_dev, t0=t_dev)
             m, mean, mx, mn, med = jax.device_get(out)
+            reqtrace.note_stage("d2h", time.perf_counter() - t_d2h,
+                                t0=t_d2h)
         reg.counter("serve.score.rows", **self.labels).inc(n)
         return ScoreResult(
             model_scores=np.asarray(m)[:n],

@@ -120,12 +120,35 @@ class Scorer:
         return self.fleet.retry_after_seconds()
 
     def score_batch(self, records: Sequence[dict],
-                    timeout: Optional[float] = DEFAULT_SCORE_TIMEOUT_S
-                    ) -> ScoreResult:
+                    timeout: Optional[float] = DEFAULT_SCORE_TIMEOUT_S,
+                    trace=None) -> ScoreResult:
         """Score raw records; blocks until the micro-batch containing
-        them completes. Raises RejectedError on shed (429 analog)."""
-        return self.fleet.score_batch(records, timeout=timeout,
-                                      extra_columns=self.extra_columns)
+        them completes. Raises RejectedError on shed (429 analog).
+
+        Tracing: with an explicit `trace` (the HTTP path) the CALLER
+        finishes it; without one, a trace is created per request when
+        tracing or SLO accounting is armed, and finished here — so
+        in-process embeddings (bench, tests) get the same per-stage
+        evidence the HTTP front end gets."""
+        from shifu_tpu.obs import reqtrace
+
+        own = None
+        if trace is None:
+            buf = reqtrace.buffer()
+            if buf.active or self.fleet.slo.enabled:
+                own = trace = reqtrace.RequestTrace(
+                    sampled=buf.head_sampled())
+        try:
+            return self.fleet.score_batch(records, timeout=timeout,
+                                          extra_columns=self.extra_columns,
+                                          trace=trace)
+        except Exception as e:
+            if own is not None:
+                own.annotate(status=type(e).__name__)
+            raise
+        finally:
+            if own is not None:
+                self.fleet.finish_trace(own)
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         """Stop admitting and drain every in-flight request fleet-wide."""
@@ -427,7 +450,30 @@ class ScoringServer:
                             "driftedColumns": v["driftedColumns"],
                             "threshold": v["threshold"],
                         }
+                    # SLO burn rate rides /healthz: burning the error
+                    # budget faster than sustainable is a degrade
+                    # REASON (computed, not sticky — it clears the
+                    # moment the window recovers)
+                    slo = server.registry.slo
+                    if slo.enabled:
+                        snap = slo.snapshot()
+                        health["slo"] = snap
+                        if snap["burning"] and health["status"] == "ok":
+                            health["status"] = "degraded"
+                            health["reason"] = (
+                                f"SLO burn rate {snap['burnRate']:.2f} "
+                                f"(>{slo.slo_ms:g}ms beyond the "
+                                f"{slo.target:g} objective)")
                     self._reply(code, health)
+                    return
+                if self.path == "/admin/traces":
+                    from shifu_tpu.obs import reqtrace
+
+                    buf = reqtrace.buffer()
+                    self._reply(200, {
+                        **buf.snapshot(),
+                        "traces": buf.traces(),
+                    })
                     return
                 if self.path == "/metrics":
                     self._reply(
@@ -444,6 +490,8 @@ class ScoringServer:
                 self._reply(404, {"error": f"unknown path {self.path}"})
 
             def do_POST(self):
+                from shifu_tpu.obs import reqtrace
+
                 if self.path in ("/admin/stage", "/admin/promote"):
                     self._do_admin()
                     return
@@ -459,28 +507,66 @@ class ScoringServer:
                 if not records:
                     self._reply(400, {"error": "no records in body"})
                     return
+                # trace id contract: an inbound X-Shifu-Trace header is
+                # honored (and FORCES retention — the caller asked for
+                # this trace), otherwise one is generated under the
+                # head-sampling/slow-capture policy; the id is echoed in
+                # the response header either way
+                hdr = reqtrace.clean_trace_id(
+                    self.headers.get(reqtrace.TRACE_HEADER))
+                buf = reqtrace.buffer()
+                trace = None
+                if (hdr or buf.active
+                        or server.registry.slo.enabled):
+                    trace = reqtrace.RequestTrace(
+                        trace_id=hdr,
+                        sampled=bool(hdr) or buf.head_sampled())
                 try:
-                    res = server.scorer.score_batch(records)
+                    res = server.scorer.score_batch(records, trace=trace)
                 except RejectedError as e:
+                    # the trace header echoes on ERROR replies too —
+                    # correlating a shed/timeout with its server-side
+                    # trace is exactly when the caller needs the link
+                    err_headers = {}
+                    if trace is not None:
+                        trace.annotate(status="rejected", reason=e.reason)
+                        server.registry.finish_trace(trace)
+                        err_headers[reqtrace.TRACE_HEADER] = trace.trace_id
                     # Retry-After from the FLEET drain rate (total
                     # backlog / summed per-replica drain rates, clamped)
                     # — the hint describes the fleet's capacity to
                     # absorb the retry, not one replica's
                     hint = server.scorer.retry_after_seconds()
+                    err_headers["Retry-After"] = str(int(math.ceil(hint)))
                     self._reply(429, {"error": str(e),
                                       "reason": e.reason,
                                       "retryAfterSeconds": round(hint, 3)},
-                                extra_headers={
-                                    "Retry-After":
-                                        str(int(math.ceil(hint)))})
+                                extra_headers=err_headers)
                     return
                 except TimeoutError as e:
-                    self._reply(503, {"error": str(e)})
+                    err_headers = {}
+                    if trace is not None:
+                        trace.annotate(status="timeout")
+                        server.registry.finish_trace(trace)
+                        err_headers[reqtrace.TRACE_HEADER] = trace.trace_id
+                    self._reply(503, {"error": str(e)},
+                                extra_headers=err_headers)
                     return
-                self._reply(200, {
-                    "models": server.registry.model_names,
-                    "scores": _result_rows(res),
-                })
+                doc = {"models": server.registry.model_names,
+                       "scores": None}
+                if trace is None:
+                    doc["scores"] = _result_rows(res)
+                    self._reply(200, doc)
+                    return
+                # serialize is a measured stage: the response-row build
+                # + JSON encode is host work the client waits on
+                with trace.stage("serialize"):
+                    doc["scores"] = _result_rows(res)
+                    doc["trace"] = trace.trace_id
+                    body = json.dumps(doc).encode("utf-8")
+                server.registry.finish_trace(trace)
+                self._reply(200, body, extra_headers={
+                    reqtrace.TRACE_HEADER: trace.trace_id})
 
             def _do_admin(self):
                 """Rollout control plane: stage a candidate as the shadow
@@ -583,7 +669,23 @@ class ScoringServer:
                 extra["drift"] = self.drift.verdict()
             if self.traffic is not None:
                 extra["traffic"] = self.traffic.snapshot()
+            if self.registry.slo.enabled:
+                extra["slo"] = self.registry.slo.snapshot()
             seq = ledger.next_seq("serve")
+            # retained request traces serialize as a Perfetto-loadable
+            # file next to the manifest; the manifest carries the
+            # summary `shifu trace` / `shifu runs --traces` read
+            from shifu_tpu.obs import reqtrace
+
+            buf = reqtrace.buffer()
+            if buf.active or buf.count:
+                traces_path = os.path.join(
+                    ledger.dir, f"serve-{seq}.traces.json")
+                written = buf.write_traces(traces_path)
+                extra["traces"] = dict(
+                    buf.snapshot(),
+                    path=(os.path.relpath(written, self.root)
+                          if written else None))
             path = ledger.write(
                 "serve", seq,
                 status="ok",

@@ -139,8 +139,9 @@ class TestTrafficLog:
         meta = json.load(open(
             tmp_path / ".shifu/runs/traffic/_meta.json"))
         assert meta["schema"] == "shifu.traffic/1"
-        assert meta["columns"][-3:] == ["shifu_score_mean",
-                                        "shifu_model_sha", "shifu_ts"]
+        assert meta["columns"][-4:] == ["shifu_score_mean",
+                                        "shifu_model_sha", "shifu_trace",
+                                        "shifu_ts"]
 
     def test_seq_grows_across_restart(self, tmp_path):
         from shifu_tpu.loop.traffic import TrafficLog, traffic_columns
@@ -190,8 +191,8 @@ class TestTrafficLog:
                    _FakeResult(1), "s")
         (path,) = glob.glob(str(tmp_path / ".shifu/runs/traffic/*.psv"))
         line = open(path).read().rstrip("\n")
-        # 2 feature fields + score + sha + ts = exactly 5 fields
-        assert len(line.split("|")) == 5
+        # 2 feature fields + score + sha + trace + ts = exactly 6 fields
+        assert len(line.split("|")) == 6
         assert "bad;val ue" in line
 
     def test_readback_is_an_ordinary_chunk_stream(self, tmp_path):

@@ -1,0 +1,696 @@
+"""Request-scoped tracing (obs/reqtrace.py) + per-stage tail attribution:
+the RequestTrace/TraceBuffer layer, the serve-path stage timeline
+(featurize/route/queue/coalesce/device/d2h/serialize), head-sampling +
+slow-tail retention bounds, stage histograms with trace-id exemplars,
+the X-Shifu-Trace header contract, SLO burn accounting, traffic-log
+lineage, the `shifu trace` CLI, the span-tracer event ring, and the
+concurrent-thread Chrome-trace export (per-thread tracks + parenting
+must survive spans opened on router/batcher/prefetch threads).
+
+The acceptance pin lives in TestSlowFeaturizeAttribution: a deliberately
+slowed featurize path must show up IN THE TRACES as the dominant stage,
+and `shifu trace --slowest --stage featurize` must surface it.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from shifu_tpu.utils import environment
+
+
+class _Props:
+    def __init__(self, **props):
+        self.props = {k.replace("_", "."): v for k, v in props.items()}
+
+    def __enter__(self):
+        for k, v in self.props.items():
+            environment.set_property(k, v)
+        from shifu_tpu import obs
+
+        obs.reset()  # buffers/tracers re-read knobs at construction
+        return self
+
+    def __exit__(self, *exc):
+        for k in self.props:
+            environment.set_property(k, "")
+        from shifu_tpu import obs
+
+        obs.reset()
+
+
+@pytest.fixture(scope="module")
+def models_dir(tmp_path_factory):
+    """Tiny 2-bag NN set written directly (tracing mechanics don't need
+    trained weights)."""
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+
+    d = str(tmp_path_factory.mktemp("trace_models"))
+    cols = [f"c{i}" for i in range(5)]
+    sizes = [len(cols), 4, 1]
+    for b in range(2):
+        specs = [{"name": c, "kind": "value", "outNames": [c],
+                  "mean": 0.1 * i, "std": 1.0, "fill": 0.0, "zscore": True}
+                 for i, c in enumerate(cols)]
+        NNModelSpec(layer_sizes=sizes, activations=["tanh"],
+                    input_columns=cols, norm_specs=specs,
+                    params=init_params(sizes, seed=b),
+                    ).save(os.path.join(d, f"model{b}.nn"))
+    return d
+
+
+def _scorer(models_dir, **kw):
+    from shifu_tpu.serve.queue import AdmissionQueue
+    from shifu_tpu.serve.registry import ModelRegistry
+    from shifu_tpu.serve.server import Scorer
+
+    reg = ModelRegistry(models_dir)
+    sc = Scorer(reg, AdmissionQueue(64), **kw)
+    reg.warm([1, 4])
+    return sc
+
+
+def _rec(i=0):
+    return {f"c{k}": f"{0.1 * (i + k):.3f}" for k in range(5)}
+
+
+# ---------------------------------------------------------------------------
+# RequestTrace + TraceBuffer mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestRequestTrace:
+    def test_stages_totals_and_summary(self):
+        from shifu_tpu.obs.reqtrace import RequestTrace
+
+        t = RequestTrace(sampled=True)
+        t.add_stage("featurize", 0.002)
+        t.add_stage("featurize", 0.001)  # components of one stage SUM
+        with t.stage("device"):
+            time.sleep(0.001)
+        t.annotate(replica="3", rows=7)
+        total = t.finish()
+        assert total >= 0.001
+        tot = t.stage_totals()
+        assert tot["featurize"] == pytest.approx(0.003)
+        assert tot["device"] >= 0.001
+        s = t.summary()
+        assert s["id"] == t.trace_id
+        assert s["stages"]["featurize"] == pytest.approx(3.0, abs=0.01)
+        assert s["attrs"] == {"replica": "3", "rows": 7}
+        assert [e[0] for e in s["timeline"]] == ["featurize", "featurize",
+                                                 "device"]
+        # finish is idempotent — a second call keeps the first total
+        assert t.finish() == total
+
+    def test_trace_ids_unique_and_header_sanitized(self):
+        from shifu_tpu.obs.reqtrace import RequestTrace, clean_trace_id
+
+        ids = {RequestTrace().trace_id for _ in range(500)}
+        assert len(ids) == 500
+        assert clean_trace_id("  ok-id_1.2:3 ") == "ok-id_1.2:3"
+        assert clean_trace_id('evil"id\nwith|stuff') == "evil_id_with_stuff"
+        assert clean_trace_id("x" * 200) == "x" * 64
+        assert clean_trace_id("") is None
+        assert clean_trace_id(None) is None
+
+    def test_head_sampling_stride_and_slow_capture(self):
+        from shifu_tpu.obs.reqtrace import RequestTrace, TraceBuffer
+
+        buf = TraceBuffer(capacity=100, sample=0.25, slow_ms=0)
+        draws = [buf.head_sampled() for _ in range(100)]
+        assert sum(draws) == 25  # deterministic every-4th stride
+        # slow capture keeps an unsampled trace that crossed slowMs
+        buf = TraceBuffer(capacity=10, sample=0.0, slow_ms=5.0)
+        fast = RequestTrace(sampled=False)
+        fast.total_seconds = 0.001
+        assert buf.offer(fast) is False
+        slow = RequestTrace(sampled=False)
+        slow.total_seconds = 0.050
+        assert buf.offer(slow) is True
+        assert buf.count == 1
+        assert buf.get(slow.trace_id)["id"] == slow.trace_id
+        assert buf.snapshot()["offered"] == 2
+
+    def test_ring_bound_and_drop_counter(self):
+        from shifu_tpu import obs
+        from shifu_tpu.obs.reqtrace import RequestTrace, TraceBuffer
+
+        obs.reset()
+        buf = TraceBuffer(capacity=4, sample=1.0, slow_ms=0)
+        traces = [RequestTrace(sampled=True) for _ in range(7)]
+        for t in traces:
+            t.total_seconds = 0.001
+            buf.offer(t)
+        assert buf.count == 4  # bounded
+        snap = buf.snapshot()
+        assert snap["dropped"] == 3
+        kept_ids = {s["id"] for s in buf.traces()}
+        assert kept_ids == {t.trace_id for t in traces[3:]}  # newest kept
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serve.trace.dropped") == 3.0
+
+    def test_slowest_ranking_by_total_and_stage(self):
+        from shifu_tpu.obs.reqtrace import slowest_summaries
+
+        sums = [
+            {"id": "a", "totalMs": 10.0, "stages": {"featurize": 9.0}},
+            {"id": "b", "totalMs": 30.0, "stages": {"device": 29.0}},
+            {"id": "c", "totalMs": 20.0, "stages": {"featurize": 1.0}},
+        ]
+        assert [s["id"] for s in slowest_summaries(sums, 2)] == ["b", "c"]
+        by_feat = slowest_summaries(sums, 3, stage="featurize")
+        assert [s["id"] for s in by_feat] == ["a", "c", "b"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the serve path produces full stage timelines
+# ---------------------------------------------------------------------------
+
+
+class TestServePathTracing:
+    def test_stages_convoy_and_exemplars(self, models_dir):
+        from shifu_tpu import obs
+        from shifu_tpu.obs import reqtrace
+
+        with _Props(shifu_trace_sample="1.0", shifu_trace_slowMs="0"):
+            sc = _scorer(models_dir)
+            n_threads = 4
+
+            def client(ti):
+                for k in range(3):
+                    sc.score_batch([_rec(ti + k)])
+
+            threads = [threading.Thread(target=client, args=(ti,))
+                       for ti in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            sc.close()
+            buf = reqtrace.buffer()
+            assert buf.count == 12
+            sha = sc.registry.sha
+            for s in buf.traces():
+                assert set(s["stages"]) >= {"featurize", "route", "queue",
+                                            "coalesce", "device", "d2h"}
+                assert s["attrs"]["replica"] == "0"
+                # version lineage: the trace names the sha that scored it
+                assert s["attrs"]["scoredSha"] == sha
+            # convoy witness: batch records name the coalesced traces
+            ct = buf.to_chrome_trace()
+            batch_events = [e for e in ct["traceEvents"]
+                            if e["name"].startswith("batch[")]
+            assert batch_events
+            witnessed = {tid for e in batch_events
+                         for tid in e["args"]["traces"]}
+            assert witnessed == {s["id"] for s in buf.traces()}
+            # per-request tracks: one metadata thread-name per trace
+            names = [e for e in ct["traceEvents"]
+                     if e.get("name") == "thread_name"]
+            assert len([e for e in names
+                        if e["args"]["name"].startswith("req ")]) == 12
+            # stage histograms with exemplar ids on /metrics
+            prom = obs.registry().to_prometheus()
+            assert "serve_stage_seconds_bucket" in prom
+            assert "trace_id=" in prom
+            from shifu_tpu.obs.metrics import parse_prometheus
+
+            assert parse_prometheus(prom) == obs.registry().flatten()
+
+    def test_unsampled_requests_not_retained_but_measured(self, models_dir):
+        from shifu_tpu import obs
+        from shifu_tpu.obs import reqtrace
+
+        with _Props(shifu_trace_sample="0", shifu_trace_slowMs="60000"):
+            sc = _scorer(models_dir)
+            for i in range(5):
+                sc.score_batch([_rec(i)])
+            sc.close()
+            assert reqtrace.buffer().count == 0  # nothing retained...
+            snap = obs.registry().snapshot()
+            hists = [k for k in snap["histograms"]
+                     if k.startswith("serve.stage_seconds")]
+            assert hists  # ...but every request fed the stage histograms
+            key = [k for k in hists if 'stage="device"' in k][0]
+            assert snap["histograms"][key]["count"] == 5
+
+    def test_tracing_off_is_off(self, models_dir):
+        from shifu_tpu.obs import reqtrace
+
+        with _Props(shifu_trace_sample="0", shifu_trace_slowMs="0"):
+            sc = _scorer(models_dir)
+            sc.score_batch([_rec()])
+            sc.close()
+            buf = reqtrace.buffer()
+            assert not buf.active
+            assert buf.count == 0
+            assert buf.snapshot()["offered"] == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: a slowed featurize path is correctly attributed
+# ---------------------------------------------------------------------------
+
+
+class TestSlowFeaturizeAttribution:
+    def test_slow_featurize_dominates_and_cli_surfaces_it(
+            self, models_dir, tmp_path, monkeypatch, capsys):
+        from shifu_tpu.obs import reqtrace
+        from shifu_tpu.serve import registry as registry_mod
+
+        slow_call = registry_mod._PlanFeaturizer.__call__
+
+        def slowed(self, data, code_cache=None, numeric_cache=None):
+            time.sleep(0.04)  # the deliberately slowed host featurize
+            return slow_call(self, data, code_cache, numeric_cache)
+
+        monkeypatch.setattr(registry_mod._PlanFeaturizer, "__call__",
+                            slowed)
+        with _Props(shifu_trace_sample="1.0", shifu_trace_slowMs="0"):
+            sc = _scorer(models_dir)
+            for i in range(4):
+                sc.score_batch([_rec(i)])
+            sc.close()
+            buf = reqtrace.buffer()
+            summaries = buf.traces()
+            assert len(summaries) >= 4
+            for s in summaries:
+                stages = s["stages"]
+                # featurize dominates every other stage in every trace
+                others = max(v for k, v in stages.items()
+                             if k != "featurize")
+                assert stages["featurize"] >= 40.0  # the injected 40 ms
+                assert stages["featurize"] > others
+            # --slowest --stage featurize surfaces them via the ledger
+            # file exactly as `shifu trace` reads it
+            path = os.path.join(str(tmp_path), ".shifu", "runs",
+                                "serve-1.traces.json")
+            assert buf.write_traces(path) == path
+            monkeypatch.chdir(tmp_path)
+            from shifu_tpu.cli import main
+
+            assert main(["trace", "--slowest", "2",
+                         "--stage", "featurize", "--json"]) == 0
+            doc = json.loads(capsys.readouterr().out)
+            assert len(doc["traces"]) == 2
+            top = doc["traces"][0]
+            assert top["stages"]["featurize"] >= 40.0
+            # human table names featurize as the dominant stage
+            assert main(["trace", "--slowest", "2",
+                         "--stage", "featurize"]) == 0
+            out = capsys.readouterr().out
+            assert "featurize" in out
+            # --show renders the per-stage timeline for the slowest id
+            assert main(["trace", "--show", top["id"]]) == 0
+            assert "featurize" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# HTTP contract: X-Shifu-Trace honored, echoed, retained, logged
+# ---------------------------------------------------------------------------
+
+
+class TestHttpTraceContract:
+    def test_header_forces_retention_and_lineage(self, tmp_path):
+        from shifu_tpu.models.nn import NNModelSpec, init_params
+        from shifu_tpu.obs import reqtrace
+        from shifu_tpu.serve.server import ScoringServer
+
+        root = str(tmp_path)
+        cols = [f"c{i}" for i in range(4)]
+        sizes = [4, 3, 1]
+        specs = [{"name": c, "kind": "value", "outNames": [c],
+                  "mean": 0.0, "std": 1.0, "fill": 0.0, "zscore": True}
+                 for c in cols]
+        os.makedirs(os.path.join(root, "models"))
+        NNModelSpec(layer_sizes=sizes, activations=["tanh"],
+                    input_columns=cols, norm_specs=specs,
+                    params=init_params(sizes, seed=0),
+                    ).save(os.path.join(root, "models", "model0.nn"))
+        with _Props(shifu_trace_sample="0", shifu_trace_slowMs="0",
+                    shifu_loop_logSample="1.0",
+                    shifu_serve_sloMs="60000"):
+            server = ScoringServer(root=root, port=0)
+            server.registry.warm([1])
+            server.start()
+            try:
+                url = f"http://127.0.0.1:{server.port}"
+                body = json.dumps(
+                    {"records": [{c: "0.5" for c in cols}]}).encode()
+                req = urllib.request.Request(
+                    f"{url}/score", data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Shifu-Trace": "pin-trace-7"})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    doc = json.loads(r.read().decode())
+                    assert r.headers.get("X-Shifu-Trace") == "pin-trace-7"
+                assert doc["trace"] == "pin-trace-7"
+                # headerless request under sample=0: measured (SLO armed)
+                # but NOT retained
+                req2 = urllib.request.Request(
+                    f"{url}/score", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req2, timeout=60) as r:
+                    assert "trace" in json.loads(r.read().decode())
+                with urllib.request.urlopen(f"{url}/admin/traces",
+                                            timeout=10) as r:
+                    at = json.loads(r.read().decode())
+                assert at["count"] == 1
+                assert at["traces"][0]["id"] == "pin-trace-7"
+                assert set(at["traces"][0]["stages"]) >= {
+                    "featurize", "route", "queue", "coalesce", "device",
+                    "d2h", "serialize"}
+                # SLO sections: healthz + gauge armed, both requests good
+                with urllib.request.urlopen(f"{url}/healthz",
+                                            timeout=10) as r:
+                    h = json.loads(r.read().decode())
+                assert h["slo"]["good"] == 2 and not h["slo"]["burning"]
+                # shed path: the error reply still echoes the trace
+                # header (correlating a 429 with its server-side trace
+                # is when the link matters most), the forced-retention
+                # trace is captured with status=rejected, and the shed
+                # counts BAD against the SLO despite being fast
+                server.scorer.fleet.close(5)
+                req3 = urllib.request.Request(
+                    f"{url}/score", data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-Shifu-Trace": "pin-shed-1"})
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req3, timeout=60)
+                assert ei.value.code == 429
+                assert ei.value.headers.get(
+                    "X-Shifu-Trace") == "pin-shed-1"
+            finally:
+                manifest = server.shutdown()
+            m = json.load(open(manifest))
+            assert m["traces"]["count"] == 2
+            assert m["slo"] == dict(m["slo"], good=2, bad=1)
+            tdoc = json.load(open(
+                os.path.join(root, m["traces"]["path"])))
+            assert tdoc["schema"] == reqtrace.TRACES_SCHEMA
+            by_id = {s["id"]: s for s in tdoc["shifuTraces"]}
+            assert set(by_id) == {"pin-trace-7", "pin-shed-1"}
+            shed = by_id["pin-shed-1"]
+            assert shed["attrs"]["status"] == "rejected"
+            assert shed["attrs"].get("replica") is None  # never placed
+            # traffic-log lineage: the row carries the trace id and
+            # trace_lineage() reads it back
+            from shifu_tpu.loop.traffic import trace_lineage
+
+            lin = trace_lineage(root)
+            assert lin["tracedRows"] >= 1
+            assert "pin-trace-7" in lin["sampleTraceIds"]
+            assert lin["rows"] == 2  # the headerless row logs empty
+
+
+# ---------------------------------------------------------------------------
+# SLO tracker
+# ---------------------------------------------------------------------------
+
+
+class TestSloTracker:
+    def test_disabled_by_default(self):
+        from shifu_tpu.serve.health import SloTracker
+
+        t = SloTracker()
+        assert not t.enabled
+        t.observe(10.0)  # no-op, no counters
+        assert t.burn_rate() == 0.0
+
+    def test_good_bad_and_burn_rate(self):
+        from shifu_tpu import obs
+        from shifu_tpu.serve.health import SloTracker
+
+        obs.reset()
+        t = SloTracker(slo_ms=50.0, target=0.9)
+        for _ in range(8):
+            t.observe(0.010)   # good
+        for _ in range(2):
+            t.observe(0.200)   # bad
+        c = obs.registry().snapshot()["counters"]
+        assert c["serve.slo.good"] == 8.0
+        assert c["serve.slo.bad"] == 2.0
+        # bad fraction 0.2 over budget 0.1 -> burn rate 2.0
+        assert t.burn_rate() == pytest.approx(2.0)
+        snap = t.snapshot()
+        assert snap["burning"] and snap["burnRate"] == pytest.approx(2.0)
+        assert obs.registry().snapshot()["gauges"][
+            "serve.slo.burn_rate"] == pytest.approx(2.0)
+
+    def test_window_recovery(self):
+        from shifu_tpu.serve.health import SloTracker
+
+        t = SloTracker(slo_ms=50.0, target=0.9, window_s=0.05)
+        t.observe(0.200)  # bad
+        assert t.burn_rate() > 1.0
+        time.sleep(0.08)  # the bad request ages out of the window
+        assert t.burn_rate() == 0.0
+
+    def test_failed_requests_count_bad_regardless_of_latency(
+            self, models_dir):
+        """A shed/failed request got NO score: it must burn SLO budget
+        even though it completed in sub-millisecond time — otherwise a
+        fleet shedding 90% of its traffic with fast 429s reads as
+        healthy on exactly the overload the SLO exists to catch."""
+        from shifu_tpu import obs
+        from shifu_tpu.obs.reqtrace import RequestTrace
+        from shifu_tpu.serve.health import SloTracker
+
+        obs.reset()
+        t = SloTracker(slo_ms=50.0, target=0.9)
+        t.observe(0.001, ok=False)  # fast but failed
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("serve.slo.bad") == 1.0
+        assert "serve.slo.good" not in c
+        # fleet seam: a trace carrying a `status` attr (the error
+        # paths' marker) counts bad through finish_trace
+        with _Props(shifu_serve_sloMs="60000", shifu_trace_sample="0",
+                    shifu_trace_slowMs="0"):
+            sc = _scorer(models_dir)
+            tr = RequestTrace(sampled=False)
+            tr.annotate(status="rejected")
+            sc.fleet.finish_trace(tr)
+            c = obs.registry().snapshot()["counters"]
+            assert c.get("serve.slo.bad") == 1.0
+            sc.close()
+
+    def test_unrouted_trace_stage_label(self, models_dir):
+        """A trace that never reached a replica labels its stage
+        samples replica="unrouted", never an empty replica="" series."""
+        from shifu_tpu import obs
+        from shifu_tpu.obs.reqtrace import RequestTrace
+
+        with _Props(shifu_trace_sample="1.0", shifu_trace_slowMs="0"):
+            sc = _scorer(models_dir)
+            tr = RequestTrace(sampled=True)
+            tr.add_stage("featurize", 0.001)
+            tr.annotate(status="rejected")
+            sc.fleet.finish_trace(tr)
+            sc.close()
+            hists = obs.registry().snapshot()["histograms"]
+            assert any('replica="unrouted"' in k for k in hists), hists
+            assert not any('replica=""' in k for k in hists)
+
+
+# ---------------------------------------------------------------------------
+# span tracer: bounded ring + concurrent-thread Chrome export
+# ---------------------------------------------------------------------------
+
+
+class TestTracerRing:
+    def test_max_events_ring_and_drop_counter(self):
+        from shifu_tpu import obs
+        from shifu_tpu.obs.tracing import Tracer
+
+        obs.reset()
+        tr = Tracer(max_events=4)
+        for i in range(7):
+            with tr.span(f"s{i}"):
+                pass
+        events = tr.events
+        assert len(events) == 4
+        assert [e["name"] for e in events] == ["s3", "s4", "s5", "s6"]
+        assert tr.dropped == 3
+        c = obs.registry().snapshot()["counters"]
+        assert c.get("trace.dropped") == 3.0
+
+    def test_max_events_knob(self):
+        from shifu_tpu.obs.tracing import Tracer
+
+        with _Props(shifu_trace_maxEvents="2"):
+            tr = Tracer()
+            assert tr.max_events == 2
+            for i in range(3):
+                with tr.span(f"s{i}"):
+                    pass
+            assert len(tr.events) == 2
+
+    def test_concurrent_thread_export_round_trips(self, tmp_path):
+        """Satellite pin: spans opened on router, batcher-worker and
+        prefetch threads round-trip through the Chrome-trace export with
+        correct per-thread tracks and parenting, and the exported file
+        is valid Perfetto JSON."""
+        from shifu_tpu.obs.tracing import Tracer
+
+        tr = Tracer()
+        barrier = threading.Barrier(3)
+        tids = {}
+
+        def worker(name):
+            barrier.wait()
+            with tr.span(f"{name}.outer", role=name):
+                with tr.span(f"{name}.inner"):
+                    time.sleep(0.002)
+            tids[name] = threading.get_ident()
+
+        threads = [threading.Thread(target=worker, args=(n,))
+                   for n in ("router", "batcher-worker", "prefetch")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        path = str(tmp_path / "spans.trace.json")
+        assert tr.save(path) == path
+        doc = json.load(open(path))  # valid JSON by construction
+        assert set(doc) >= {"traceEvents", "displayTimeUnit"}
+        events = doc["traceEvents"]
+        assert len(events) == 6
+        for e in events:  # Perfetto complete-event schema
+            assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid",
+                              "args"}
+            assert e["ph"] == "X"
+        for name in ("router", "batcher-worker", "prefetch"):
+            mine = [e for e in events if e["name"].startswith(name)]
+            assert len(mine) == 2
+            # both spans recorded on THAT thread's track
+            assert {e["tid"] for e in mine} == {tids[name]}
+            inner = [e for e in mine if e["name"].endswith(".inner")][0]
+            outer = [e for e in mine if e["name"].endswith(".outer")][0]
+            # parenting: inner names its parent path; outer is a root
+            assert inner["args"]["parent"] == f"{name}.outer"
+            assert "parent" not in outer["args"]
+            assert outer["args"]["role"] == name
+            # the inner span nests temporally inside the outer one
+            assert outer["ts"] <= inner["ts"]
+            assert (inner["ts"] + inner["dur"]
+                    <= outer["ts"] + outer["dur"] + 50)  # 50 µs slack
+
+
+# ---------------------------------------------------------------------------
+# exemplars: JSON + Prometheus round-trips
+# ---------------------------------------------------------------------------
+
+
+class TestExemplars:
+    def test_exemplar_round_trips(self):
+        from shifu_tpu.obs import MetricsRegistry, parse_prometheus
+
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", buckets=(0.01, 0.1, 1.0), stage="device")
+        h.observe(0.005, exemplar="tr-fast")
+        h.observe(0.5, exemplar="tr-slow")
+        h.observe(0.6)  # exemplar-less observe keeps the last id
+        d = h.as_dict()
+        assert d["exemplars"]["0"] == [0.005, "tr-fast"]
+        assert d["exemplars"]["2"] == [0.5, "tr-slow"]
+        prom = reg.to_prometheus()
+        slow_line = [ln for ln in prom.splitlines()
+                     if 'le="1.0"' in ln][0]
+        assert '# {trace_id="tr-slow"} 0.5' in slow_line
+        # the annotation never breaks the parser round-trip
+        assert parse_prometheus(prom) == reg.flatten()
+        # ...and the JSON round-trip is still lossless, exemplars incl.
+        clone = MetricsRegistry.from_json(reg.to_json())
+        assert clone.snapshot() == reg.snapshot()
+        assert clone.to_prometheus() == prom
+
+    def test_nan_observe_counts_no_bucket(self):
+        """The bisect rewrite must keep the old linear scan's NaN
+        semantics: a NaN observation lands in NO bucket (bisect alone
+        would mis-place it in bucket 0)."""
+        from shifu_tpu.obs.metrics import Histogram
+
+        h = Histogram(buckets=(0.01, 1.0))
+        h.observe(float("nan"))
+        d = h.as_dict()
+        assert sum(d["counts"]) == 0
+        assert d["count"] == 1  # still counted in the totals
+
+    def test_label_value_with_exemplar_lookalike_parses(self):
+        """A user-supplied label value containing ' # ' (eval-set
+        names escape only backslash and quote) must survive the
+        exemplar strip — the strip anchors on the end-of-line exemplar
+        shape, never a bare ' # '."""
+        from shifu_tpu.obs import MetricsRegistry, parse_prometheus
+
+        reg = MetricsRegistry()
+        reg.counter("evals", set="a # b").inc(3)
+        h = reg.histogram("lat", buckets=(1.0,), set="x # {y} z")
+        h.observe(0.5, exemplar="tr-1")
+        prom = reg.to_prometheus()
+        assert parse_prometheus(prom) == reg.flatten()
+
+
+# ---------------------------------------------------------------------------
+# lineage: promote reads the retrain manifest's trace evidence
+# ---------------------------------------------------------------------------
+
+
+class TestPromoteLineage:
+    def test_retrain_lineage_matches_candidate_sha(self, tmp_path):
+        from shifu_tpu.loop.promote import retrain_lineage
+
+        runs = tmp_path / ".shifu" / "runs"
+        runs.mkdir(parents=True)
+        for seq, cand in ((1, "aaaa"), (2, "bbbb")):
+            (runs / f"retrain-{seq}.json").write_text(json.dumps({
+                "step": "retrain", "seq": seq, "startedAtUnix": float(seq),
+                "retrain": {
+                    "parent": {"modelSetSha": "pppp"},
+                    "candidate": {"modelSetSha": cand},
+                    "source": {"kind": "traffic"},
+                    "lineage": {"traceColumn": "shifu_trace",
+                                "tracedRows": seq,
+                                "sampleTraceIds": [f"t-{seq}"]},
+                }}))
+        lin = retrain_lineage(str(tmp_path), "aaaa")
+        assert lin["candidateModelSetSha"] == "aaaa"
+        assert lin["retrainManifest"] == "retrain-1.json"
+        assert lin["traffic"]["sampleTraceIds"] == ["t-1"]
+        # unknown sha: newest retrain wins
+        lin = retrain_lineage(str(tmp_path), None)
+        assert lin["candidateModelSetSha"] == "bbbb"
+        # no match at all
+        assert retrain_lineage(str(tmp_path), "cccc") is None
+
+
+# ---------------------------------------------------------------------------
+# ledger surfaces: runs --traces column
+# ---------------------------------------------------------------------------
+
+
+class TestRunsTracesColumn:
+    def test_traces_column(self):
+        from shifu_tpu.obs.ledger import format_runs
+
+        manifests = [
+            {"step": "serve", "seq": 1, "status": "ok",
+             "elapsedSeconds": 1.0, "startedAt": "2026-08-04T00:00:00",
+             "metrics": {},
+             "traces": {"count": 3, "slowestMs": 12.5}},
+            {"step": "train", "seq": 2, "status": "ok",
+             "elapsedSeconds": 2.0, "startedAt": "2026-08-04T00:00:01",
+             "metrics": {}},
+        ]
+        out = format_runs(manifests, show_traces=True)
+        assert "TRACES" in out.splitlines()[0]
+        assert "3@12.5ms" in out
+        assert " - " in out  # trace-less runs show a dash
+        plain = format_runs(manifests)
+        assert "TRACES" not in plain
