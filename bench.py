@@ -98,6 +98,18 @@ SERVE_FLEET = dict(cols=8, hidden=256, depth=8, bags=2, rows=512,
                    replica_counts=(1, 2, 8), threads_per_replica=2,
                    per_thread=16, queue_depth=64, reps=2,
                    eff2_floor=0.7, eff8_floor=0.7, fleet_vs_ceiling=0.75)
+# failover is self-relative (failure-domain mechanics, not throughput):
+# a 2-replica in-process fleet under closed-loop load has replica 1's
+# device killed persistently (`device_dead@replica=1`), and the gates
+# are correctness properties — zero unanswered / zero double-answered
+# requests across the trip, the breaker opens, and after healing the
+# half-open probes close it again (recovery time reported). Small probe
+# backoffs so the full closed->open->half-open->closed arc fits the
+# scenario.
+FAILOVER = dict(cols=10, hidden=[16], bags=2, concurrency=8,
+                per_thread=30, queue_depth=512,
+                breaker_failures=3, probe_base_ms=40, probe_cap_ms=200,
+                recover_timeout_s=30)
 # continuous_loop is self-relative too (warm-start vs cold-start on the
 # same shifted stream, GBT append vs scratch, serve p99 with the drift
 # fold on vs off): every number is a ratio of two runs inside the
@@ -1318,6 +1330,164 @@ def bench_serve_fleet():
     return out
 
 
+def bench_failover():
+    """Failure-domain scenario (shifu_tpu/serve/ breaker + failover):
+    closed-loop load on a 2-replica fleet while replica 1's device dies
+    persistently (`device_dead@replica=1` — the chaos grammar's
+    replica-targeted seam). Measures p50/p99 before and during the trip
+    and the recovery-to-closed time through half-open probing after the
+    device heals. GATED: every request of every phase answered exactly
+    once (zero unanswered, zero double-answered — per-replica resolved
+    counters sum to submissions), the breaker trips open, and recovery
+    reaches closed within the timeout."""
+    import shutil
+    import tempfile
+    import threading
+
+    from shifu_tpu import obs
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+    from shifu_tpu.resilience import faults
+    from shifu_tpu.serve.fleet import ReplicaFleet
+    from shifu_tpu.serve.health import BREAKER_CLOSED, BREAKER_OPEN
+    from shifu_tpu.utils import environment
+
+    spec = FAILOVER
+    cols = [f"c{i}" for i in range(spec["cols"])]
+    tmp = tempfile.mkdtemp(prefix="bench-failover-")
+    props = {
+        "shifu.serve.breaker.failures": str(spec["breaker_failures"]),
+        "shifu.serve.breaker.probeBaseMs": str(spec["probe_base_ms"]),
+        "shifu.serve.breaker.probeCapMs": str(spec["probe_cap_ms"]),
+    }
+    try:
+        rng = np.random.default_rng(0)
+        sizes = [spec["cols"]] + list(spec["hidden"]) + [1]
+        for b in range(spec["bags"]):
+            norm_specs = [
+                {"name": c, "kind": "value", "outNames": [c],
+                 "mean": float(rng.normal()), "std": 1.0, "fill": 0.0,
+                 "zscore": True}
+                for c in cols
+            ]
+            NNModelSpec(
+                layer_sizes=sizes, activations=["tanh"],
+                input_columns=cols, norm_specs=norm_specs,
+                params=init_params(sizes, seed=b),
+            ).save(os.path.join(tmp, f"model{b}.nn"))
+        for k, v in props.items():
+            environment.set_property(k, v)
+        fleet = ReplicaFleet.build(tmp, n_replicas=2,
+                                   queue_depth=spec["queue_depth"])
+        fleet.warm([1, spec["concurrency"]])
+        victim = fleet.replicas[1]
+
+        def record(i):
+            return {c: f"{0.1 * (i % 7) - 0.3:.4f}" for c in cols}
+
+        submitted = [0]
+        failed = []
+
+        def run_phase(tag):
+            conc, per = spec["concurrency"], spec["per_thread"]
+            lat = [[] for _ in range(conc)]
+
+            def client(ti):
+                for k in range(per):
+                    t0 = time.perf_counter()
+                    try:
+                        res = fleet.score_batch([record(k)], timeout=60)
+                        assert len(res.mean) == 1
+                    except Exception as e:  # noqa: BLE001 - gated below
+                        failed.append((tag, repr(e)))
+                    lat[ti].append(time.perf_counter() - t0)
+
+            threads = [threading.Thread(target=client, args=(ti,))
+                       for ti in range(conc)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            submitted[0] += conc * per
+            flat = np.asarray([v for ts in lat for v in ts])
+            return {
+                "p50_ms": round(float(np.percentile(flat, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(flat, 99)) * 1e3, 3),
+                "qps": round(len(flat) / elapsed, 1),
+            }
+
+        baseline = run_phase("baseline")
+        # ---- the trip: replica 1's device dies persistently ----
+        t_arm = time.perf_counter()
+        with faults.activate(faults.FaultPlan.parse(
+                "device_dead@replica=1")):
+            during = run_phase("device_dead")
+            tripped = victim.breaker.state == BREAKER_OPEN
+            breaker_snap = victim.breaker.snapshot()
+        # ---- healed: light traffic carries the half-open probes ----
+        t_heal = time.perf_counter()
+        recovered_in = None
+        deadline = t_heal + spec["recover_timeout_s"]
+        i = 0
+        while time.perf_counter() < deadline:
+            try:
+                fleet.score_batch([record(i)], timeout=60)
+            except Exception as e:  # noqa: BLE001 - gated below
+                failed.append(("recovery", repr(e)))
+            submitted[0] += 1
+            i += 1
+            if victim.breaker.state == BREAKER_CLOSED:
+                recovered_in = time.perf_counter() - t_heal
+                break
+            time.sleep(0.005)
+        counters = obs.registry().snapshot()["counters"]
+        resolved = sum(v for k, v in counters.items()
+                       if k.startswith("serve.requests{"))
+        failovers = sum(v for k, v in counters.items()
+                        if k.startswith("serve.failover.requests"))
+        fleet.close(30)
+        gates = {
+            # answered exactly once each: no unanswered (every
+            # score_batch returned), no double-answered (resolved
+            # counters == submissions), no errors surfaced to clients
+            "zero_unanswered": not failed,
+            "zero_double_answered": resolved == submitted[0],
+            "breaker_tripped": bool(tripped),
+            "recovered_to_closed": recovered_in is not None,
+        }
+        out = {
+            "baseline": baseline,
+            "during_trip": during,
+            "requests": submitted[0],
+            "resolved": int(resolved),
+            "failed_requests": len(failed),
+            "failovers": int(failovers),
+            "breaker_at_trip": breaker_snap,
+            "trip_window_s": round(t_heal - t_arm, 3),
+            "recovery_to_closed_s": (None if recovered_in is None
+                                     else round(recovered_in, 3)),
+            "gates": gates,
+            "note": ("closed-loop 1-record requests on a 2-replica "
+                     "fleet; during_trip has replica 1 failing every "
+                     "dispatch (device_dead@replica=1) — its batches "
+                     "fail over to replica 0 under the bounded budget, "
+                     "so clients see latency, never errors; recovery = "
+                     "disarm to breaker-closed via jittered half-open "
+                     "probes riding live traffic"),
+        }
+        if not all(gates.values()):
+            raise RuntimeError(
+                f"failover gates failed: {gates} "
+                f"{json.dumps({k: v for k, v in out.items() if k != 'note'})}"
+            )
+        return out
+    finally:
+        for k in props:
+            environment.set_property(k, "")
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_serve_latency():
     """Online scoring (shifu_tpu/serve/): p50/p99 single-record latency +
     QPS at several closed-loop concurrency levels, through the full
@@ -1934,6 +2104,7 @@ def main() -> None:
     # subprocess sweep: sanitizer/obs wrappers stay in the children
     sharded_stats = bench_sharded_stats()
     serve_fleet = bench_serve_fleet()
+    failover = _with_obs_metrics(bench_failover, "failover")
     serve_latency = _with_obs_metrics(
         bench_serve_latency, "serve_latency", transfer_clean=True)
     ro = serve_latency.get("race_overhead") or {}
@@ -2033,6 +2204,7 @@ def main() -> None:
                if k.startswith("concurrency_") or k == "registry"},
             "batching": serve_latency.get("batching"),
             "replica_sweep": serve_fleet,
+            "failover": failover,
             "race_overhead": serve_latency.get("race_overhead"),
             "stage_breakdown": serve_latency.get("stage_breakdown"),
             "tracing_overhead": serve_latency.get("tracing_overhead"),

@@ -129,6 +129,15 @@ KNOBS: List[Knob] = [
        "per-seam backoff base override"),
     _K("shifu.retry.*.capMs", "float", "shifu.retry.capMs",
        "per-seam backoff cap override"),
+    # ---- failure domains (PR 14): heartbeat leases ----
+    _K("shifu.lease.ttlMs", "float", "5000",
+       "serve-process heartbeat lease TTL — a process that misses "
+       "renewal this long is expired for its peers (0 disables leases)"),
+    _K("shifu.lease.renewMs", "float", "0 (= ttlMs / 3)",
+       "lease renewal cadence"),
+    _K("shifu.lease.sweepAfterMs", "float", "0 (= 20 x ttlMs)",
+       "expired leases older than this are garbage-collected by any "
+       "scanner (until then they surface as a degrade reason)"),
     # ---- serve (PR 5, PR 7, PR 12) ----
     _K("shifu.serve.replicas", "int", "0 (= all local devices)",
        "scoring replicas, one per device (replica i -> device i mod "
@@ -158,6 +167,21 @@ KNOBS: List[Knob] = [
     _K("shifu.serve.sloTarget", "float", "0.99",
        "SLO objective (fraction of requests that must meet sloMs); "
        "burn rate = windowed bad fraction / (1 - target)"),
+    # ---- failure domains (PR 14): replica circuit breaker ----
+    _K("shifu.serve.breaker.failures", "int", "3",
+       "consecutive device-dispatch failures that trip a replica's "
+       "circuit breaker open (the router then treats it as absent)"),
+    _K("shifu.serve.breaker.probeBaseMs", "float", "500",
+       "first open->half-open probe backoff window (jittered "
+       "exponential, the resilience/retry.py formula)"),
+    _K("shifu.serve.breaker.probeCapMs", "float", "30000",
+       "probe backoff ceiling"),
+    _K("shifu.serve.breaker.probeOks", "int", "2",
+       "consecutive successful half-open probes before the breaker "
+       "closes"),
+    _K("shifu.serve.breaker.failoverMax", "int", "2",
+       "times one request may be replayed on another replica after its "
+       "batch failed, before it is answered with the error"),
     # ---- continuous loop (PR 9) ----
     _K("shifu.loop.logSample", "float", "0 (= off)",
        "fraction of served rows written to the traffic log"),
@@ -180,6 +204,10 @@ KNOBS: List[Knob] = [
        "min shadow-scored rows before a promote decision binds"),
     _K("shifu.loop.appendTrees", "int", "10",
        "GBT retrain: trees appended on new chunks"),
+    _K("shifu.promote.roundDeadlineMs", "float", "0 (= one lease TTL)",
+       "fleet-atomic promotion round ack deadline — raise it when a "
+       "candidate's fleet-wide stage+warm outlasts a lease TTL (fence "
+       "safety is re-checked at commit regardless)"),
 ]
 
 

@@ -238,9 +238,19 @@ class SwappableRegistry:
         return self.shadow_snapshot()
 
     def unstage(self) -> None:
+        """Drop the staged candidate (rollback to active-only). Counted
+        per sha — an aborted fleet-promotion round's rollback must be as
+        visible in the ledger as the stage that preceded it."""
         with self._lock:
-            self._shadow = None
+            shadow, self._shadow = self._shadow, None
             self._shadow_stats = None
+        if shadow is not None:
+            from shifu_tpu.obs import registry as obs_registry
+
+            obs_registry().counter("serve.swap.unstaged",
+                                   sha=shadow.sha).inc()
+            log.info("unstaged shadow model set %s (rolled back to "
+                     "active %s)", shadow.sha, self._active.sha)
 
     def observe(self, data, result) -> None:
         """Post-resolution hook (batcher observer): sample live batches

@@ -25,12 +25,28 @@ Execution: with `--serve-url` the promotion is a POST /admin/promote
 offline atomic dir swap: `models/` -> `models.previous/`, candidate ->
 `models/` (os.replace-based, torn-state-proof via a rename sequence that
 always leaves a loadable models dir).
+
+Fleet mode (failure domains, round 14): when live process leases exist
+under `.shifu/runs/peers/` (N `shifu serve` processes share this model
+set), the offline path becomes a FLEET-ATOMIC two-phase commit
+(loop/rounds.py): a prepare record fans out the sha-bound candidate to
+every live leaseholder, each stages + validates it on its whole replica
+fleet (the in-process pre-roll validation is phase one) and acks, and
+the commit record lands only on unanimous acks from the lease-fenced
+peer set — re-checked against the live leases immediately before — all
+within one lease TTL. Any nack, missing ack, fence break (a peer died
+or restarted mid-round) or deadline pass aborts the round and every
+staged process rolls back to active: a half-promoted fleet is
+impossible. `--serve-url` against a root where MULTIPLE processes hold
+leases is refused — promoting one process of a fleet is exactly the
+half-promotion the protocol exists to prevent.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import time
 import urllib.request
 from typing import Optional
 
@@ -201,6 +217,108 @@ def _models_sha(models_dir: Optional[str]) -> Optional[str]:
         return None
 
 
+def live_peers(root: str) -> list:
+    """Live (un-expired) process leases under the root — the set a
+    fleet-atomic promotion must fence."""
+    from shifu_tpu.resilience import lease
+
+    return [p for p in lease.scan(root) if not p["expired"]]
+
+
+def round_deadline_ms_setting() -> float:
+    """shifu.promote.roundDeadlineMs — promotion-round ack deadline
+    (0 = one lease TTL). Raise it for candidates whose fleet-wide
+    stage + warm outlasts a TTL: fence SAFETY does not depend on the
+    deadline (the fence is re-checked against the live lease files
+    immediately before commit, and participants renew right after their
+    device-heavy stage) — the TTL default is just the tightest deadline
+    that cannot outlive its own liveness evidence."""
+    from shifu_tpu.utils import environment
+
+    return environment.get_float("shifu.promote.roundDeadlineMs", 0.0)
+
+
+def run_promotion_round(root: str, candidate_dir: str,
+                        candidate_sha: str, peers: list) -> dict:
+    """The two-phase commit coordinator (loop/rounds.py records).
+
+    Prepare fences the CURRENT live incarnations (leaseId/token/epoch);
+    every fenced peer must stage + validate the sha-bound candidate and
+    ack before the deadline (one lease TTL out, or
+    -Dshifu.promote.roundDeadlineMs). The commit record is written only
+    after (a) unanimous ok-acks, (b) a fence re-check against the live
+    lease files, (c) no abort record exists (a participant that
+    self-aborted at deadline+grace writes one — its rollback must win),
+    and (d) the deadline has not passed. Everything else aborts."""
+    from shifu_tpu.loop import rounds
+    from shifu_tpu.resilience import lease
+
+    fence = [{"leaseId": p["leaseId"], "token": p["token"],
+              "epoch": p["epoch"]} for p in peers]
+    ttl_s = max(float(p.get("ttlMs", 5000.0)) for p in peers) / 1000.0
+    deadline_s = round_deadline_ms_setting() / 1000.0 or ttl_s
+    rid = rounds.new_round_id()
+    deadline = time.time() + deadline_s
+    rounds.write_prepare(root, rid, candidate_dir, candidate_sha,
+                         fence, deadline)
+    log.info("promotion round %s: prepared for %d peer(s), deadline in "
+             "%.1f s", rid, len(fence), deadline_s)
+    want = {f["leaseId"] for f in fence}
+    out = {"round": rid, "peers": fence, "acks": {}, "committed": False,
+           "deadlineUnix": deadline}
+
+    def _abort(reason: str) -> dict:
+        rounds.write_abort(root, rid, reason)
+        out["reason"] = reason
+        log.warning("promotion round %s aborted: %s", rid, reason)
+        return out
+
+    while True:
+        state = rounds.read_round(root, rid)
+        out["acks"] = state["acks"]
+        nacks = [a for a in state["acks"].values() if not a.get("ok")]
+        if nacks:
+            return _abort("peer " + nacks[0]["leaseId"] + " refused: "
+                          + str(nacks[0].get("reason")))
+        bad_sha = [a for a in state["acks"].values()
+                   if a.get("stagedSha") != candidate_sha]
+        if bad_sha:
+            return _abort(f"peer {bad_sha[0]['leaseId']} staged "
+                          f"{bad_sha[0].get('stagedSha')}, not the "
+                          f"candidate {candidate_sha}")
+        if want <= set(state["acks"]):
+            break
+        if time.time() >= deadline:
+            missing = sorted(want - set(state["acks"]))
+            return _abort("no ack from " + ", ".join(missing)
+                          + " within the lease TTL")
+        time.sleep(rounds.ROUND_POLL_S)
+    # unanimous — but only the SAME incarnations that acked may commit:
+    # a peer that died (lease expired/vanished) or restarted (token or
+    # epoch changed) after acking cannot apply the commit, and a fleet
+    # minus one is a half-promoted fleet
+    broken = lease.fence_check(root, fence)
+    if broken:
+        return _abort("; ".join(broken))
+    if rounds.read_round(root, rid)["abort"] is not None:
+        # a participant self-aborted (it judged the coordinator dead at
+        # deadline+grace) — its rollback already happened and MUST win;
+        # committing over it would split the fleet
+        out["reason"] = "a participant aborted the round first"
+        log.warning("promotion round %s: not committing — %s",
+                    rid, out["reason"])
+        return out
+    if time.time() >= deadline:
+        # participants may already be rolling back — committing now
+        # could split the fleet
+        return _abort("unanimous acks arrived after the deadline")
+    rounds.write_commit(root, rid, candidate_sha)
+    out["committed"] = True
+    log.info("promotion round %s: committed %s on %d peer(s)",
+             rid, candidate_sha, len(fence))
+    return out
+
+
 def offline_swap(root: str, candidate_dir: str) -> dict:
     """Atomic-enough dir swap for a non-running model set: the current
     `models/` moves aside to `models.previous/`, the candidate renames
@@ -240,7 +358,17 @@ def run_promote(root: str, candidate_dir: Optional[str],
     t0 = time.time()
     shadow = None
     active_sha = None
-    mode = "http" if serve_url else "offline"
+    peers = live_peers(root)
+    if serve_url and len(peers) > 1:
+        # promoting ONE process of a multi-process fleet through its
+        # /admin plane is exactly the half-promotion the lease-fenced
+        # round exists to prevent
+        log.error("promote: %d live serve processes hold leases under "
+                  "%s — drop --serve-url and run the fleet-atomic "
+                  "promote instead", len(peers), root)
+        return 2
+    mode = ("http" if serve_url
+            else "fleet" if peers else "offline")
     try:
         if serve_url:
             serve_url = serve_url.rstrip("/")
@@ -267,28 +395,77 @@ def run_promote(root: str, candidate_dir: Optional[str],
                               require_drift=require_drift,
                               candidate_sha=candidate_sha,
                               active_sha=active_sha)
-    if force and not decision["promote"]:
-        decision["forced"] = True
-        decision["promote"] = True
     swap = None
     error = None
-    if decision["promote"]:
-        try:
-            if serve_url:
-                # bind the swap to the sha the gates evaluated: a
-                # re-staged shadow between the gate read and this POST
-                # is refused server-side (409), never rolled out blind
-                swap = _http_json(f"{serve_url}/admin/promote",
-                                  {"sha": (shadow or {}).get("sha")})
-            else:
-                if not candidate_dir:
+    round_info = None
+    if mode == "fleet":
+        # the two-phase round IS the shadow-validation gate here: every
+        # live leaseholder must stage the sha-bound candidate on its
+        # whole replica fleet and ack. Ledger shadow evidence (if an
+        # operator staged earlier) stays in the manifest as context.
+        # `--force` can override the DRIFT gate, never a failed round —
+        # unanimity is a safety property, not an operator preference.
+        drift_ok = decision["gates"]["drift"]["ok"]
+        if force and not drift_ok:
+            decision["forced"] = True
+        decision["promote"] = False
+        if drift_ok or force:
+            try:
+                if not candidate_dir or candidate_sha is None:
                     raise ValueError(
-                        "offline promote needs a candidate dir "
+                        "fleet promote needs a readable candidate dir "
                         "(default models.candidate is missing)")
-                swap = offline_swap(root, candidate_dir)
-        except (OSError, ValueError) as e:  # failed swap: held + ledgered
-            error = f"{type(e).__name__}: {e}"
-            decision["promote"] = False
+                round_info = run_promotion_round(
+                    root, os.path.abspath(candidate_dir),
+                    candidate_sha, peers)
+            except (OSError, ValueError) as e:
+                error = f"{type(e).__name__}: {e}"
+                round_info = None
+            committed = bool(round_info and round_info["committed"])
+            decision["gates"]["shadow"] = {
+                "ok": committed,
+                "reason": (None if committed else
+                           (round_info or {}).get("reason", error)),
+                "fleetValidated": committed,
+                "acks": len((round_info or {}).get("acks", {})),
+                "round": (round_info or {}).get("round"),
+            }
+            decision["promote"] = committed
+            if committed:
+                try:
+                    # the commit record is the atomic decision; the dir
+                    # swap makes it durable for future process starts
+                    swap = offline_swap(root, candidate_dir)
+                    swap.update({"mode": "fleet",
+                                 "round": round_info["round"],
+                                 "peers": len(round_info["peers"])})
+                except (OSError, ValueError) as e:
+                    # the fleet IS promoted (every live process swapped);
+                    # only the on-disk layout lags — surfaced loudly for
+                    # the operator, re-running promote converges it
+                    error = (f"committed but dir swap failed: "
+                             f"{type(e).__name__}: {e}")
+    else:
+        if force and not decision["promote"]:
+            decision["forced"] = True
+            decision["promote"] = True
+        if decision["promote"]:
+            try:
+                if serve_url:
+                    # bind the swap to the sha the gates evaluated: a
+                    # re-staged shadow between the gate read and this POST
+                    # is refused server-side (409), never rolled out blind
+                    swap = _http_json(f"{serve_url}/admin/promote",
+                                      {"sha": (shadow or {}).get("sha")})
+                else:
+                    if not candidate_dir:
+                        raise ValueError(
+                            "offline promote needs a candidate dir "
+                            "(default models.candidate is missing)")
+                    swap = offline_swap(root, candidate_dir)
+            except (OSError, ValueError) as e:  # failed swap: held + ledgered
+                error = f"{type(e).__name__}: {e}"
+                decision["promote"] = False
     # the audit trail: every promote attempt is a ledger manifest,
     # carrying the serve->train lineage of the candidate it gated
     try:
@@ -310,6 +487,7 @@ def run_promote(root: str, candidate_dir: Optional[str],
                                "candidateDir": candidate_dir,
                                "decision": decision,
                                "lineage": lineage,
+                               "round": round_info,
                                "swap": swap}},
         )
         log.info("promote manifest -> %s", path)

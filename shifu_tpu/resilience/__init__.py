@@ -18,6 +18,11 @@ as a library, threaded through every long-running path:
                  preempted host resumes from (chunk_index, fold_state)
                  instead of row zero, bit-identical to an uninterrupted
                  run.
+  lease.py       process heartbeat leases (the ZooKeeper-ephemeral-node
+                 analog on the shared .shifu/runs root): N serve
+                 processes on one model set observe each other's
+                 liveness through atomic lease files — the membership
+                 layer the fleet-atomic promotion rounds fence against.
 
 All three record into the obs metrics registry, so every injected fault,
 retry attempt and checkpoint write lands in the run-ledger manifest.
@@ -37,6 +42,7 @@ from shifu_tpu.resilience.faults import (
     fault_point,
     plan_active,
 )
+from shifu_tpu.resilience.lease import ProcessLease
 from shifu_tpu.resilience.retry import retry_call
 
 __all__ = [
@@ -44,6 +50,7 @@ __all__ = [
     "FaultSpecError",
     "InjectedFaultError",
     "PreemptionError",
+    "ProcessLease",
     "StreamCheckpoint",
     "atomic_save_npy",
     "atomic_write",

@@ -10,17 +10,24 @@ resume instead of hoping.
 
 Spec grammar (comma-separated clauses)::
 
-    clause  := seam [ "@" counter "=" N ] ( ":" key "=" value )*
+    clause  := seam [ "@" trigger "=" N ] ( ":" key "=" value )*
     seam    := io | prefetch | device | ckpt | serve | preempt | slow
-    key     := p (probability, default 0.01; slow defaults to 1.0)
+             | device_dead | lease_stall | peer_kill
+    trigger := a counter name (fire at that counter's Nth event), or
+               the literal `replica` — then N is a TARGET, not a
+               schedule: the clause applies only to events fired by
+               replica N (any seam may be replica-targeted)
+    key     := p (probability, default 0.01; slow/lease_stall/
+               device_dead default to 1.0)
              | seed (rng seed, default 0)
-             | ms (sleep milliseconds, slow only, default 50)
-             | max (max firings, 0 = unlimited; scheduled/preempt
-               clauses default to 1, probabilistic ones to 0)
+             | ms (sleep milliseconds, slow/lease_stall, default 50)
+             | max (max firings, 0 = unlimited; scheduled, preempt and
+               peer_kill clauses default to 1, probabilistic ones to 0)
 
 Examples::
 
     -Dshifu.faults=io:p=0.01:seed=7,device,preempt@chunk=40,slow:ms=250
+    -Dshifu.faults=device_dead@replica=1,lease_stall:ms=800,peer_kill@lease=5
 
   * `io:p=0.01:seed=7` — 1% of chunk-reader pulls raise a transient
     `InjectedFaultError` (the retry layer's job to absorb).
@@ -29,6 +36,14 @@ Examples::
     `PreemptionError` (the SIGTERM analog): the step dies with a failure
     manifest and must be resumable.
   * `slow:ms=250` — every chunk pull stalls 250 ms (latency injection).
+  * `device_dead@replica=1` — serving replica 1's device dispatches fail
+    PERSISTENTLY (p=1, unlimited): the circuit-breaker scenario — the
+    replica must trip open, its requests must fail over, and half-open
+    probes keep failing until the clause is disarmed.
+  * `lease_stall:ms=800` — every heartbeat-lease renewal stalls 800 ms
+    (a wedged process whose lease expires while it keeps running).
+  * `peer_kill@lease=5` — SIGKILL this process at its 5th lease
+    heartbeat (the mid-promotion process-death scenario).
 
 Each seam calls `fault_point(counter)`; scheduled clauses fire when the
 1-based per-process event count reaches N. Counts are per process, so a
@@ -39,13 +54,15 @@ is shorter than the preemption schedule. A caller may pass an absolute
 pure function of (seed, counter, index) rather than of how many events
 this process happened to see.
 
-Every firing increments `fault.injected{seam=...}`; recoveries count
+Every firing increments `fault.injected{seam=...}` (plus a `replica=`
+label when the firing seam carried a replica context); recoveries count
 `fault.survived{seam=...}` (the retry layer and the resume loaders bump
 it). Both land in the run-ledger manifest with the rest of the registry.
 """
 
 from __future__ import annotations
 
+import os
 import signal
 import time
 import zlib
@@ -61,7 +78,14 @@ log = get_logger(__name__)
 
 FAULTS_PROPERTY = "shifu.faults"
 
-SEAMS = ("io", "prefetch", "device", "ckpt", "serve", "preempt", "slow")
+SEAMS = ("io", "prefetch", "device", "ckpt", "serve", "preempt", "slow",
+         "device_dead", "lease_stall", "peer_kill")
+
+# seams that sleep instead of raising (latency injection)
+SLEEP_SEAMS = ("slow", "lease_stall")
+# seams whose bare clause means "always" (persistent/deterministic),
+# not the probabilistic default
+CERTAIN_SEAMS = ("slow", "lease_stall", "device_dead", "peer_kill")
 
 DEFAULT_P = 0.01
 DEFAULT_SLOW_MS = 50.0
@@ -87,13 +111,17 @@ class PreemptionError(Exception):
 
 
 class FaultClause:
-    """One parsed clause: which counter it listens on and what it does."""
+    """One parsed clause: which counter it listens on and what it does.
+    `replica` (from the `@replica=N` trigger form) narrows ANY seam to
+    events fired with that replica context — the per-replica targeting
+    the serving-fleet failure-domain seams need."""
 
     __slots__ = ("seam", "counter", "at", "p", "seed", "ms", "max",
-                 "fired", "_rng")
+                 "replica", "fired", "_rng")
 
     def __init__(self, seam: str, counter: str, at: Optional[int],
-                 p: float, seed: int, ms: float, max_firings: int) -> None:
+                 p: float, seed: int, ms: float, max_firings: int,
+                 replica: Optional[int] = None) -> None:
         self.seam = seam
         self.counter = counter
         self.at = at
@@ -101,6 +129,7 @@ class FaultClause:
         self.seed = seed
         self.ms = ms
         self.max = max_firings
+        self.replica = replica
         self.fired = 0
         self._rng = np.random.default_rng(seed)
 
@@ -122,35 +151,50 @@ class FaultClause:
     def describe(self) -> str:
         trig = (f"@{self.counter}={self.at}" if self.at is not None
                 else f":p={self.p}")
+        if self.replica is not None:
+            trig += f"@replica={self.replica}"
         return f"{self.seam}{trig}"
 
 
 def _parse_clause(text: str) -> FaultClause:
     head, *params = text.strip().split(":")
+    replica: Optional[int] = None
+    at: Optional[int] = None
+    counter = ""
     if "@" in head:
         seam, trigger = head.split("@", 1)
         if "=" not in trigger:
             raise FaultSpecError(
-                f"'{text}': scheduled trigger must be @counter=N")
+                f"'{text}': trigger must be @counter=N or @replica=N")
         counter, at_s = trigger.split("=", 1)
         try:
-            at: Optional[int] = int(at_s)
+            at = int(at_s)
         except ValueError:
             raise FaultSpecError(f"'{text}': trigger ordinal must be int")
+        if counter.strip() == "replica":
+            # @replica=N is a TARGET (which replica's events), not a
+            # schedule — the clause listens on its seam's default
+            # counter and fires only for that replica's events
+            replica, at, counter = at, None, ""
     else:
-        seam, counter, at = head, "", None
+        seam = head
     seam = seam.strip()
     if seam not in SEAMS:
         raise FaultSpecError(
             f"'{text}': unknown seam '{seam}' (one of {', '.join(SEAMS)})")
     if not counter:
         # default listening counter: preempt fires at chunk boundaries,
-        # slow stalls the reader, everything else listens on its own seam
-        counter = {"preempt": "chunk", "slow": "io"}.get(seam, seam)
-    p = 1.0 if seam == "slow" else DEFAULT_P
+        # slow stalls the reader, the lease seams listen on the
+        # heartbeat, device_dead on the replica dispatch; everything
+        # else listens on its own seam
+        counter = {"preempt": "chunk", "slow": "io",
+                   "lease_stall": "lease", "peer_kill": "lease",
+                   "device_dead": "serve.dispatch"}.get(seam, seam)
+    p = 1.0 if seam in CERTAIN_SEAMS else DEFAULT_P
     seed = 0
     ms = DEFAULT_SLOW_MS
-    max_firings = 1 if (at is not None or seam == "preempt") else 0
+    max_firings = 1 if (at is not None
+                        or seam in ("preempt", "peer_kill")) else 0
     for param in params:
         if "=" not in param:
             raise FaultSpecError(f"'{text}': parameter '{param}' needs k=v")
@@ -173,7 +217,8 @@ def _parse_clause(text: str) -> FaultClause:
             raise FaultSpecError(f"'{text}': bad value for '{k}': {v}")
     if not 0.0 <= p <= 1.0:
         raise FaultSpecError(f"'{text}': p must be in [0, 1]")
-    return FaultClause(seam, counter.strip(), at, p, seed, ms, max_firings)
+    return FaultClause(seam, counter.strip(), at, p, seed, ms, max_firings,
+                       replica=replica)
 
 
 class FaultPlan:
@@ -191,17 +236,23 @@ class FaultPlan:
         clauses = [_parse_clause(c) for c in spec.split(",") if c.strip()]
         return cls(clauses, spec=spec)
 
-    def fire(self, counter: str, index: Optional[int] = None) -> None:
+    def fire(self, counter: str, index: Optional[int] = None,
+             replica: Optional[int] = None) -> None:
         """Evaluate every clause listening on `counter` for this event.
-        Raises InjectedFaultError / PreemptionError or sleeps (slow).
+        Raises InjectedFaultError / PreemptionError, sleeps (the sleep
+        seams), or SIGKILLs the process (peer_kill). `replica` is the
+        firing seam's replica context: replica-targeted clauses act only
+        on matching events, and every firing counter gains a `replica=`
+        label when the context is present.
 
         Only ONE raising clause can act per event; `fired` budgets are
         charged only on clauses that actually act, so a preempt clause
         sharing a counter with a probabilistic clause is deferred to a
-        later event rather than silently consumed. Every slow clause due
-        on the event still sleeps (latency composes), and preemption
-        outranks transient faults (the more severe, usually explicitly
-        scheduled, action wins)."""
+        later event rather than silently consumed. Every sleep clause
+        due on the event still sleeps (latency composes), and severity
+        ranks the raisers: peer_kill > preempt > transient faults (the
+        most severe, usually explicitly scheduled, action wins)."""
+        severity = {"peer_kill": 0, "preempt": 1}
         with self._lock:
             if index is not None:
                 ordinal = index + 1
@@ -210,20 +261,29 @@ class FaultPlan:
                 self._counts[counter] = ordinal
             due = [c for c in self.clauses
                    if c.counter == counter
+                   and (c.replica is None or c.replica == replica)
                    and c.should_fire(ordinal, absolute=index is not None)]
-            sleeps = [c for c in due if c.seam == "slow"]
-            raisers = sorted((c for c in due if c.seam != "slow"),
-                             key=lambda c: c.seam != "preempt")
+            sleeps = [c for c in due if c.seam in SLEEP_SEAMS]
+            raisers = sorted((c for c in due if c.seam not in SLEEP_SEAMS),
+                             key=lambda c: severity.get(c.seam, 2))
             acting = sleeps + raisers[:1]
             for c in acting:
                 c.fired += 1
         from shifu_tpu.obs import registry
 
+        rep_label = ({} if replica is None
+                     else {"replica": str(replica)})
         for c in acting:
-            registry().counter("fault.injected", seam=c.seam).inc()
-            if c.seam == "slow":
+            registry().counter("fault.injected", seam=c.seam,
+                               **rep_label).inc()
+            if c.seam in SLEEP_SEAMS:
                 time.sleep(c.ms / 1000.0)
                 continue
+            if c.seam == "peer_kill":
+                log.warning("fault injection: SIGKILL self at %s event %d",
+                            counter, ordinal)
+                os.kill(os.getpid(), signal.SIGKILL)
+                continue  # pragma: no cover - unreachable after SIGKILL
             if c.seam == "preempt":
                 log.warning("fault injection: preempting at %s event %d",
                             counter, ordinal)
@@ -266,14 +326,17 @@ def plan_active() -> bool:
     return bool(spec.strip())
 
 
-def fault_point(counter: str, index: Optional[int] = None) -> None:
+def fault_point(counter: str, index: Optional[int] = None,
+                replica: Optional[int] = None) -> None:
     """Seam hook: a no-op unless a plan is armed. `index` is the absolute
     0-based event index when the caller tracks one (chunk loops) — it
     makes scheduled triggers resume-safe and probabilistic draws a pure
-    function of the event."""
+    function of the event. `replica` is the replica context serving
+    seams pass, enabling `seam@replica=N` targeting and the `replica=`
+    label on firing counters."""
     plan = _current_plan()
     if plan is not None:
-        plan.fire(counter, index=index)
+        plan.fire(counter, index=index, replica=replica)
 
 
 def reset() -> None:

@@ -66,14 +66,32 @@ def backoff_ms(seam: str) -> Tuple[float, float]:
     return max(base, 0.0), max(cap, base)
 
 
-def backoff_delay(seam: str, attempt: int,
-                  rng: Optional[random.Random] = None) -> float:
-    """Seconds to sleep before retry number `attempt` (1-based): full
-    jitter over an exponentially growing, capped window."""
-    base, cap = backoff_ms(seam)
-    window = min(cap, base * (2.0 ** (attempt - 1)))
+def backoff_window_ms(base_ms: float, cap_ms: float, attempt: int) -> float:
+    """The exponentially growing, capped backoff window for attempt
+    number `attempt` (1-based) — the one backoff formula in the repo.
+    The retry loop AND the serve circuit breaker's open->half-open probe
+    schedule both draw their jitter over it, so a fleet of breakers
+    tripped by one shared-backend brownout does not probe it back down
+    in lockstep."""
+    return min(max(cap_ms, 0.0),
+               max(base_ms, 0.0) * (2.0 ** (attempt - 1)))
+
+
+def full_jitter_delay(base_ms: float, cap_ms: float, attempt: int,
+                      rng: Optional[random.Random] = None) -> float:
+    """Seconds to wait before attempt number `attempt` (1-based): FULL
+    jitter over the backoff window."""
+    window = backoff_window_ms(base_ms, cap_ms, attempt)
     draw = (rng or random).random()
     return (window * draw) / 1000.0
+
+
+def backoff_delay(seam: str, attempt: int,
+                  rng: Optional[random.Random] = None) -> float:
+    """Seconds to sleep before retry number `attempt` (1-based), under
+    the seam's configured base/cap."""
+    base, cap = backoff_ms(seam)
+    return full_jitter_delay(base, cap, attempt, rng=rng)
 
 
 def retry_call(

@@ -32,15 +32,19 @@ from shifu_tpu.serve.fleet import (
     ReplicaFleet,
     ScoringReplica,
 )
+from shifu_tpu.serve.health import CircuitBreaker
+from shifu_tpu.serve.peers import PeerRegistry
 from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
 from shifu_tpu.serve.registry import ModelRegistry
 from shifu_tpu.serve.server import Scorer, ScoringServer
 
 __all__ = [
     "AdmissionQueue",
+    "CircuitBreaker",
     "DrainAwareRouter",
     "MicroBatcher",
     "ModelRegistry",
+    "PeerRegistry",
     "RejectedError",
     "ReplicaFleet",
     "ScoreRequest",

@@ -38,11 +38,16 @@ One worker thread keeps ordering FIFO and the device queue depth at one
 batch; requests resolve through a per-request event (`ScoreRequest.wait`).
 
 Self-healing (resilience layer): the worker runs under a supervisor —
-an unexpected crash fails the in-flight batch's requests INDIVIDUALLY
-(each client gets an error response, never a hang), preserves the
-admission queue, and restarts the worker up to
-`shifu.serve.maxWorkerRestarts` times (health flips to `degraded` until
-clean batches accumulate). Every admitted request also carries a
+an unexpected crash disposes of the in-flight batch's requests
+INDIVIDUALLY through the fleet failover hook when one is wired (each
+rider replays on a healthy replica, or gets an explicit error once the
+budget is spent; standalone batchers answer with the error directly —
+either way never a hang), preserves the admission queue, and restarts
+the worker up to `shifu.serve.maxWorkerRestarts` times (health flips to
+`degraded` until clean batches accumulate). Every batch outcome is also
+reported to the replica's circuit breaker (`serve/health.py`): repeated
+dispatch failures quarantine the replica out of the routing set
+entirely — the failure domain worker restarts cannot heal. Every admitted request also carries a
 deadline (`shifu.serve.deadlineMs`): a request that outlives it is shed
 with an explicit error before dispatch instead of wasting a wedged
 backend's time. The observed drain rate feeds the 429 Retry-After hint
@@ -143,7 +148,7 @@ class ScoreRequest:
     batch-level featurize/device/d2h durations out per request."""
 
     __slots__ = ("data", "n_rows", "enqueued_at", "popped_at", "deadline",
-                 "_done", "result", "error", "trace")
+                 "_done", "result", "error", "trace", "failovers")
 
     def __init__(self, data: ColumnarData,
                  deadline_s: Optional[float] = None,
@@ -158,6 +163,11 @@ class ScoreRequest:
         self.result: Optional[ScoreResult] = None
         self.error: Optional[BaseException] = None
         self.trace = trace
+        # times this request was replayed on another replica after its
+        # batch failed (fleet failover; bounded by the failover budget).
+        # Scoring is pure, so a replay can never double-answer — resolve
+        # and fail go through the same one-shot event either way.
+        self.failovers = 0
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -230,13 +240,32 @@ class MicroBatcher:
                  observer: Optional[Callable[[ColumnarData, ScoreResult],
                                              None]] = None,
                  batching: Optional[str] = None,
-                 labels: Optional[dict] = None) -> None:
+                 labels: Optional[dict] = None,
+                 breaker=None) -> None:
         self.score_fn = score_fn
         self.admission = admission
+        # device-dispatch circuit breaker (serve/health.CircuitBreaker),
+        # owned by the replica: every batch outcome is reported so
+        # repeated dispatch failures quarantine the replica
+        self.breaker = breaker
+        # fleet failover hook, assigned by ReplicaFleet after
+        # construction: called with (request, error) when a batch fails —
+        # replays the request on a healthy replica or fails it under the
+        # bounded per-request budget. None = fail directly (standalone
+        # batchers outside a fleet).
+        self.failover: Optional[Callable[[ScoreRequest, BaseException],
+                                         None]] = None
         # metric identity: the fleet passes {"replica": "<i>"} so every
         # serve.* sample this batcher records is attributable to its
         # replica on one shared /metrics page
         self.labels = dict(labels or {})
+        try:
+            self._replica_index: Optional[int] = int(
+                self.labels["replica"])
+        except (KeyError, ValueError):
+            # no replica identity: per-replica fault targeting
+            # (`seam@replica=N`) can't match this batcher's events
+            self._replica_index = None
         self.batching = batching_setting() if batching is None else (
             BATCHING_BARRIER if str(batching).lower() == BATCHING_BARRIER
             else BATCHING_CONTINUOUS)
@@ -280,6 +309,20 @@ class MicroBatcher:
         self.admission.put(req)
         return req
 
+    def _dispose(self, req: ScoreRequest, error: BaseException) -> None:
+        """A request whose batch failed: hand it to the fleet failover
+        (replay on a healthy replica, budget-bounded) or answer it with
+        the error — never leave it unanswered."""
+        fo = self.failover
+        if fo is None:
+            req.fail(error)
+            return
+        try:
+            fo(req, error)
+        except Exception as fe:  # failover trouble must still answer
+            log.warning("failover handler failed: %s", fe)
+            req.fail(error)
+
     def join(self, timeout: Optional[float] = None) -> None:
         """Wait for drain: meaningful only after admission.close().
         Event-based, not thread-based — the worker thread may have been
@@ -305,11 +348,18 @@ class MicroBatcher:
             log.warning("serve scoring worker crashed: %s: %s",
                         type(e).__name__, e)
             # the batch being scored when the worker died: every request
-            # gets an individual error response — crashed != hung
+            # gets an individual answer — failed over to a healthy
+            # replica when a fleet is around it, an error response when
+            # not; crashed != hung either way
             inflight, self._inflight = self._inflight, None
+            err = RuntimeError(f"scoring worker crashed mid-batch: {e}")
             for r in inflight or []:
-                r.fail(RuntimeError(
-                    f"scoring worker crashed mid-batch: {e}"))
+                self._dispose(r, err)
+            if self.breaker is not None and inflight:
+                # a crash WITH a batch in flight is a dispatch failure:
+                # the device (or the program around it) ate the batch
+                self.breaker.note_failure(
+                    f"worker crash: {type(e).__name__}")
             self.health.note_crash(
                 f"scoring worker crashed: {type(e).__name__}")
             if self.restarts >= self.max_restarts:
@@ -318,14 +368,16 @@ class MicroBatcher:
                 self.health.set_draining("worker restart budget exhausted")
                 self.admission.close()
                 # answer everything still queued — zero requests may be
-                # left admitted-but-unanswered
+                # left admitted-but-unanswered (in a fleet the backlog
+                # fails over to the surviving replicas)
+                drain_err = RuntimeError(
+                    "scoring worker unavailable (restart budget "
+                    "exhausted)")
                 while True:
                     req = self.admission.get(timeout=0)
                     if req is None:
                         break
-                    req.fail(RuntimeError(
-                        "scoring worker unavailable (restart budget "
-                        "exhausted)"))
+                    self._dispose(req, drain_err)
                 self._drained.set()
                 return
             self.restarts += 1
@@ -440,14 +492,25 @@ class MicroBatcher:
                 with reqtrace.capture_stages(enabled=bool(traced)) as cap:
                     with reg.timer("serve.batch.score",
                                    **self.labels).time():
+                        # the device_dead chaos seam: a persistent
+                        # per-replica dispatch failure fires HERE, inside
+                        # the per-batch guard — a failed batch, not a
+                        # crashed worker (that is the `serve` seam above)
+                        faults.fault_point("serve.dispatch",
+                                           replica=self._replica_index)
                         concat = _concat_batches([r.data for r in batch])
                         result = self.score_fn(concat)
-            except Exception as e:  # fan the failure out per request
+            except Exception as e:  # fan the failure out per request:
+                # failover replays each rider on a healthy replica (or
+                # answers it with the error), and the breaker counts the
+                # dispatch failure toward quarantining this replica
                 log.warning("serve batch of %d requests failed: %s",
                             len(batch), e)
                 reg.counter("serve.batch.errors", **self.labels).inc()
+                if self.breaker is not None:
+                    self.breaker.note_failure(f"{type(e).__name__}: {e}")
                 for r in batch:
-                    r.fail(e)
+                    self._dispose(r, e)
                 self._inflight = None
                 continue
             if cap:
@@ -475,6 +538,8 @@ class MicroBatcher:
             with self._drain_lock:
                 self._drain_log.append((now, len(batch)))
             self.health.note_ok()
+            if self.breaker is not None:
+                self.breaker.note_ok()
             if traced:
                 # the convoy witness: which traces shared this bucket
                 reqtrace.buffer().note_batch(

@@ -58,9 +58,12 @@ from shifu_tpu.serve.batcher import (
     ScoreRequest,
 )
 from shifu_tpu.serve.health import (
+    BREAKER_CLOSED,
+    BREAKER_OPEN,
     DEGRADED,
     DRAINING,
     OK,
+    CircuitBreaker,
     HealthMonitor,
     SloTracker,
 )
@@ -72,11 +75,20 @@ from shifu_tpu.utils.log import get_logger
 log = get_logger(__name__)
 
 DEFAULT_ROUTER_PENALTY = 4.0
+DEFAULT_FAILOVER_MAX = 2
 
 
 def replicas_setting() -> int:
     """shifu.serve.replicas — scoring replicas (0 = all local devices)."""
     return environment.get_int("shifu.serve.replicas", 0)
+
+
+def failover_max_setting() -> int:
+    """shifu.serve.breaker.failoverMax — times one request may be
+    replayed on another replica after its batch failed, before it is
+    answered with the error."""
+    return environment.get_int("shifu.serve.breaker.failoverMax",
+                               DEFAULT_FAILOVER_MAX)
 
 
 def router_penalty_setting() -> float:
@@ -109,6 +121,10 @@ class ScoringReplica:
                           if admission is None else admission)
         self.health = (HealthMonitor(labels=labels)
                        if health is None else health)
+        # device-dispatch circuit breaker: repeated batch failures
+        # quarantine THIS replica (the router treats it as absent) until
+        # half-open probes prove the device back
+        self.breaker = CircuitBreaker(labels=labels)
         if observer is None:
             batch_observer = None
         else:
@@ -122,13 +138,14 @@ class ScoringReplica:
             max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
             health=self.health, max_restarts=max_restarts,
             deadline_ms=deadline_ms, observer=batch_observer,
-            batching=batching, labels=labels)
+            batching=batching, labels=labels, breaker=self.breaker)
 
     def snapshot(self) -> dict:
         snap = {
             "replica": self.name,
             **self.registry.snapshot(),
             "health": self.health.snapshot(),
+            "breaker": self.breaker.snapshot(),
             "queueDepth": len(self.admission),
             "workerRestarts": self.batcher.restarts,
         }
@@ -156,26 +173,56 @@ class DrainAwareRouter:
         self._lock = tracked_lock("serve.router")
         self._rr = 0
 
-    def order(self) -> List[ScoringReplica]:
-        """Routable replicas, best placement first."""
+    def order(self, exclude: Optional[ScoringReplica] = None
+              ) -> List[ScoringReplica]:
+        """Routable replicas, best placement first.
+
+        Circuit-breaker policy: an OPEN breaker inside its backoff makes
+        the replica ABSENT (not merely penalized — its device is known
+        bad); a breaker due for its half-open probe ranks FIRST, because
+        the probe must be an actual request and ranking it last would
+        starve recovery behind healthy replicas forever. The probe rides
+        the normal failover protection, so a failed probe costs one
+        replay, never an unanswered client."""
         now = time.perf_counter()
+        mono = time.monotonic()
         with self._lock:
             rr = self._rr
             self._rr += 1
         n = max(1, len(self.replicas))
         ranked = []
         for rep in self.replicas:
+            if rep is exclude:
+                continue  # failover: never replay onto the failing replica
             state = rep.health.state
             if state == DRAINING:
                 continue  # 503 territory: never place new work here
+            if not rep.breaker.routable(mono):
+                continue  # quarantined: absent from the routing set
+            probe = rep.breaker.probe_due(mono)
             wait = rep.batcher.expected_wait(now)
             if state == DEGRADED:
                 # de-prioritize, don't eject: the +epsilon keeps an IDLE
                 # degraded replica (wait 0.0) behind idle healthy ones
                 wait = (wait + 1e-3) * self.penalty
-            ranked.append((wait, (rep.index - rr) % n, rep))
-        ranked.sort(key=lambda t: (t[0], t[1]))
-        return [t[2] for t in ranked]
+            ranked.append((0 if probe else 1, wait,
+                           (rep.index - rr) % n, rep))
+        ranked.sort(key=lambda t: (t[0], t[1], t[2]))
+        return [t[3] for t in ranked]
+
+    def _place(self, rep: ScoringReplica, put: Callable) -> bool:
+        """One placement attempt under the replica's breaker grant.
+        `put` raises RejectedError on shed."""
+        grant = rep.breaker.admit()
+        if grant is None:
+            return False  # tripped between order() and here
+        try:
+            put()
+        except RejectedError:
+            # give back a consumed probe slot: the probe never dispatched
+            rep.breaker.cancel(grant)
+            raise
+        return True
 
     def submit(self, data, trace=None) -> ScoreRequest:
         """Admit one request on the best replica, spilling past full
@@ -188,8 +235,12 @@ class DrainAwareRouter:
         reg = registry()
         last: Optional[RejectedError] = None
         for i, rep in enumerate(order):
+            req = ScoreRequest(data,
+                               deadline_s=rep.batcher.deadline_s or None,
+                               trace=trace)
             try:
-                req = rep.batcher.submit(data, trace=trace)
+                if not self._place(rep, lambda: rep.admission.put(req)):
+                    continue
             except RejectedError as e:
                 last = e
                 if i == 0:
@@ -204,6 +255,28 @@ class DrainAwareRouter:
                 trace.annotate(replica=rep.name, spilled=bool(i))
             return req
         raise last if last is not None else RejectedError("closed")
+
+    def resubmit(self, req: ScoreRequest,
+                 exclude: Optional[ScoringReplica] = None) -> bool:
+        """Failover placement of an ALREADY-admitted request whose batch
+        failed: the same ScoreRequest object (same completion event —
+        replay can never double-answer) re-enters another replica's
+        queue. Returns False when no replica could take it."""
+        from shifu_tpu.obs import registry
+
+        for rep in self.order(exclude=exclude):
+            try:
+                if not self._place(rep, lambda: rep.admission.put(req)):
+                    continue
+            except RejectedError:
+                continue
+            registry().counter("serve.failover.rerouted",
+                               replica=rep.name).inc()
+            if req.trace is not None:
+                req.trace.annotate(failovers=req.failovers,
+                                   replica=rep.name)
+            return True
+        return False
 
 
 class ReplicaFleet:
@@ -248,9 +321,41 @@ class ReplicaFleet:
         # when the obs registry is swapped (reset) under us.
         self._stage_hists: dict = {}
         self._stage_hists_reg = None
+        # request failover: a batch that failed on one replica replays
+        # its requests on the others (scoring is pure — replay is safe),
+        # bounded per request so a fleet-wide outage still answers
+        # everything with the error instead of ping-ponging forever
+        self.failover_max = failover_max_setting()
+        for rep in self.replicas:
+            rep.batcher.failover = (
+                lambda req, error, _src=rep:
+                self._failover(_src, req, error))
         from shifu_tpu.obs import registry
 
         registry().gauge("serve.replicas").set(len(self.replicas))
+
+    def _failover(self, src: ScoringReplica, req: ScoreRequest,
+                  error: BaseException) -> None:
+        """Batcher hook for a failed-batch request: replay it on another
+        replica (never the failing one), or answer it with the error
+        once the per-request budget is spent — zero unanswered, and the
+        one-shot completion event makes double-answering impossible."""
+        from shifu_tpu.obs import registry
+
+        reg = registry()
+        if req.failovers >= self.failover_max or len(self.replicas) < 2:
+            if req.failovers:
+                reg.counter("serve.failover.exhausted",
+                            replica=src.name).inc()
+            req.fail(error)
+            return
+        req.failovers += 1
+        reg.counter("serve.failover.requests", replica=src.name).inc()
+        if not self.router.resubmit(req, exclude=src):
+            # nothing else could take it (all quarantined/draining/full)
+            reg.counter("serve.failover.exhausted",
+                        replica=src.name).inc()
+            req.fail(error)
 
     @contextmanager
     def _control(self, op: str):
@@ -396,8 +501,15 @@ class ReplicaFleet:
             s = rep.health.snapshot()
             s.update({"replica": rep.name,
                       "sha": rep.registry.sha,
+                      "breaker": rep.breaker.snapshot(),
                       "queueDepth": len(rep.admission),
                       "workerRestarts": rep.batcher.restarts})
+            if s["breaker"]["state"] != BREAKER_CLOSED and s["status"] == OK:
+                # a quarantined device is a degraded replica even when
+                # its worker is healthy — the breaker names the domain
+                s["status"] = DEGRADED
+                s["reason"] = (s.get("reason")
+                               or f"breaker {s['breaker']['state']}")
             per.append(s)
         bad = [p for p in per if p["status"] != OK]
         if (fleet["status"] == DRAINING
@@ -425,9 +537,13 @@ class ReplicaFleet:
     def retry_after_seconds(self) -> float:
         """Fleet Retry-After: TOTAL backlog over the SUMMED per-replica
         drain rates — the hint a shed client gets describes the fleet's
-        capacity to absorb it, not one replica's. Exported as the
-        unlabeled serve.retry_after_seconds gauge (per-replica labeled
-        gauges come from each batcher)."""
+        capacity to absorb it, not one replica's. Open-breaker replicas
+        are EXCLUDED on both sides: their drain-rate history is stale
+        (measured before the device died) and their backlog is being
+        failed over — counting either would tell clients to come back
+        for capacity that no longer exists. Exported as the unlabeled
+        serve.retry_after_seconds gauge (per-replica labeled gauges come
+        from each batcher)."""
         from shifu_tpu.obs import registry
 
         now = time.perf_counter()
@@ -435,6 +551,8 @@ class ReplicaFleet:
         rate_total = 0.0
         rated = False
         for rep in self.replicas:
+            if rep.breaker.state == BREAKER_OPEN:
+                continue  # quarantined: not surviving capacity
             depth, rate = rep.batcher.drain_stats(now)
             depth_total += depth
             if rate is not None:

@@ -32,13 +32,55 @@ OK = "ok"
 DEGRADED = "degraded"
 DRAINING = "draining"
 
+# circuit-breaker states (CircuitBreaker below): CLOSED passes traffic,
+# OPEN quarantines the replica, HALF_OPEN lets single probes through
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
 DEFAULT_OK_AFTER = 3
+
+DEFAULT_BREAKER_FAILURES = 3
+DEFAULT_PROBE_BASE_MS = 500.0
+DEFAULT_PROBE_CAP_MS = 30_000.0
+DEFAULT_PROBE_OKS = 2
+# a half-open probe that never produced an outcome (e.g. it was
+# deadline-shed before dispatch) is abandoned after this long, so a
+# lost probe cannot wedge the replica in half-open forever
+PROBE_ABANDON_S = 60.0
 
 DEFAULT_SLO_TARGET = 0.99
 DEFAULT_SLO_WINDOW_S = 60.0
 # rolling-window event bound: at 4096 requests the window estimate is
 # already statistical, and the deque stays O(KB) at any uptime
 SLO_WINDOW_EVENTS = 4096
+
+
+def breaker_failures_setting() -> int:
+    """shifu.serve.breaker.failures — consecutive dispatch failures that
+    trip a replica's breaker open."""
+    return environment.get_int("shifu.serve.breaker.failures",
+                               DEFAULT_BREAKER_FAILURES)
+
+
+def breaker_probe_base_ms_setting() -> float:
+    """shifu.serve.breaker.probeBaseMs — first open->half-open probe
+    backoff window."""
+    return environment.get_float("shifu.serve.breaker.probeBaseMs",
+                                 DEFAULT_PROBE_BASE_MS)
+
+
+def breaker_probe_cap_ms_setting() -> float:
+    """shifu.serve.breaker.probeCapMs — probe backoff ceiling."""
+    return environment.get_float("shifu.serve.breaker.probeCapMs",
+                                 DEFAULT_PROBE_CAP_MS)
+
+
+def breaker_probe_oks_setting() -> int:
+    """shifu.serve.breaker.probeOks — consecutive successful half-open
+    probes before the breaker closes."""
+    return environment.get_int("shifu.serve.breaker.probeOks",
+                               DEFAULT_PROBE_OKS)
 
 
 def slo_ms_setting() -> float:
@@ -240,3 +282,223 @@ class HealthMonitor:
         with self._lock:
             return {"status": self._state, "reason": self._reason,
                     "workerCrashes": self._crashes}
+
+
+class CircuitBreaker:
+    """Per-replica circuit breaker over device-dispatch outcomes.
+
+    The health monitor above answers "is the WORKER alive"; this answers
+    "is the DEVICE trustworthy". A replica whose dispatches keep failing
+    (dead device, poisoned compile cache, wedged runtime) must leave the
+    routing set entirely — restarts alone put it straight back in line
+    to eat the next batch. Classic three-state machine:
+
+      closed     normal: failures count a consecutive streak; reaching
+                 `shifu.serve.breaker.failures` TRIPS the breaker.
+      open       quarantined: the router treats the replica as absent.
+                 Each trip schedules a probe a jittered exponential
+                 backoff away (resilience/retry.py's backoff window —
+                 equal-jitter over it, so a fleet of tripped breakers
+                 does not probe a recovering backend in lockstep, and a
+                 probe is never scheduled at zero delay).
+      half_open  the backoff elapsed: the router sends exactly ONE live
+                 request as the probe. `shifu.serve.breaker.probeOks`
+                 consecutive successes close the breaker; any failure
+                 re-opens it with a doubled (capped) backoff.
+
+    A failed probe request is not sacrificed: the fleet's failover path
+    replays it on a healthy replica like any other failed-batch rider.
+    Every transition counts `serve.breaker.transitions{to=,replica=}`
+    and flips the `serve.breaker.open{replica=}` gauge."""
+
+    def __init__(self, failures: Optional[int] = None,
+                 probe_base_ms: Optional[float] = None,
+                 probe_cap_ms: Optional[float] = None,
+                 probe_oks: Optional[int] = None,
+                 labels: Optional[dict] = None,
+                 rng=None) -> None:
+        import random
+
+        self.labels = dict(labels or {})
+        self.failures = (breaker_failures_setting() if failures is None
+                         else int(failures))
+        self.probe_base_ms = (breaker_probe_base_ms_setting()
+                              if probe_base_ms is None
+                              else float(probe_base_ms))
+        self.probe_cap_ms = (breaker_probe_cap_ms_setting()
+                             if probe_cap_ms is None
+                             else float(probe_cap_ms))
+        self.probe_oks = max(1, breaker_probe_oks_setting()
+                             if probe_oks is None else int(probe_oks))
+        self._rng = rng or random.Random()
+        self._lock = tracked_lock("serve.breaker")
+        self._state = BREAKER_CLOSED
+        self._fail_streak = 0
+        self._ok_streak = 0
+        self._open_attempts = 0   # consecutive trips without a close
+        self._open_until = 0.0    # monotonic deadline of the quarantine
+        self._probe_inflight = False
+        self._probe_started = 0.0
+        self._trips = 0
+        self._last_error = ""
+
+    @guarded_by("_lock")
+    def _probe_busy(self, now: float) -> bool:
+        return (self._probe_inflight
+                and now - self._probe_started < PROBE_ABANDON_S)
+
+    @guarded_by("_lock")
+    def _transition(self, state: str) -> None:
+        # caller holds the lock (declared + race-checked via @guarded_by)
+        if self._state == state:
+            return
+        self._state = state
+        from shifu_tpu.obs import registry
+
+        reg = registry()
+        reg.counter("serve.breaker.transitions", to=state,
+                    **self.labels).inc()
+        reg.gauge("serve.breaker.open", **self.labels).set(
+            0.0 if state == BREAKER_CLOSED else 1.0)
+
+    @guarded_by("_lock")
+    def _probe_delay_s(self) -> float:
+        from shifu_tpu.resilience.retry import backoff_window_ms
+
+        window = backoff_window_ms(self.probe_base_ms, self.probe_cap_ms,
+                                   max(1, self._open_attempts))
+        # equal jitter: at least half the window, never zero — a probe
+        # scheduled at 0 ms would re-dispatch into the failure instantly
+        return (window * (0.5 + 0.5 * self._rng.random())) / 1000.0
+
+    def admit(self, now: Optional[float] = None) -> Optional[str]:
+        """Router placement gate. Returns a grant token — "closed"
+        (normal traffic) or "probe" (this request IS the half-open
+        probe) — or None when the replica is quarantined. A granted
+        probe that is never dispatched (the queue shed it) must be
+        returned via cancel()."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return "closed"
+            if self._state == BREAKER_OPEN:
+                if now < self._open_until:
+                    return None
+                self._transition(BREAKER_HALF_OPEN)
+                self._probe_inflight = True
+                self._probe_started = now
+                return "probe"
+            # half-open: one probe at a time
+            if self._probe_busy(now):
+                return None
+            self._probe_inflight = True
+            self._probe_started = now
+            return "probe"
+
+    def cancel(self, grant: Optional[str]) -> None:
+        """Give back an admit() grant whose request never dispatched."""
+        if grant != "probe":
+            return
+        with self._lock:
+            self._probe_inflight = False
+
+    def note_ok(self) -> None:
+        """One successful dispatch on this replica."""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                self._fail_streak = 0
+                return
+            if self._state == BREAKER_OPEN:
+                # a straggler batch from before the trip: not a probe,
+                # proves nothing about the device NOW
+                return
+            self._probe_inflight = False
+            self._ok_streak += 1
+            if self._ok_streak < self.probe_oks:
+                return
+            self._fail_streak = 0
+            self._open_attempts = 0
+            self._last_error = ""
+            self._transition(BREAKER_CLOSED)
+
+    def note_failure(self, error: str = "") -> None:
+        """One failed dispatch on this replica."""
+        from shifu_tpu.obs import registry
+
+        tripped = False
+        with self._lock:
+            if error:
+                self._last_error = error
+            if self._state == BREAKER_OPEN:
+                return  # straggler from before the trip
+            if self._state == BREAKER_HALF_OPEN:
+                # the probe failed: back to quarantine, longer backoff
+                self._probe_inflight = False
+                self._ok_streak = 0
+                self._open_attempts += 1
+                self._open_until = time.monotonic() + self._probe_delay_s()
+                self._transition(BREAKER_OPEN)
+                return
+            self._fail_streak += 1
+            if self._fail_streak < self.failures:
+                return
+            self._ok_streak = 0
+            self._open_attempts += 1
+            self._trips += 1
+            self._open_until = time.monotonic() + self._probe_delay_s()
+            self._transition(BREAKER_OPEN)
+            tripped = True
+        if tripped:
+            registry().counter("serve.breaker.trips", **self.labels).inc()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def trips(self) -> int:
+        with self._lock:
+            return self._trips
+
+    def probe_due(self, now: Optional[float] = None) -> bool:
+        """True when the router should PREFER this replica for one
+        request (the probe): open past its backoff, or half-open with no
+        probe in flight."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            if self._state == BREAKER_OPEN:
+                return now >= self._open_until
+            if self._state == BREAKER_HALF_OPEN:
+                return not self._probe_busy(now)
+            return False
+
+    def routable(self, now: Optional[float] = None) -> bool:
+        """False when the replica must be treated as absent (open and
+        inside its backoff, or half-open with the probe slot taken)."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state == BREAKER_OPEN:
+                return now >= self._open_until
+            return not self._probe_busy(now)
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            snap = {
+                "state": self._state,
+                "trips": self._trips,
+                "failStreak": self._fail_streak,
+                "openAttempts": self._open_attempts,
+            }
+            if self._state == BREAKER_OPEN:
+                snap["probeInMs"] = round(
+                    max(0.0, (self._open_until - now) * 1000.0), 1)
+            if self._last_error:
+                snap["lastError"] = self._last_error
+            return snap

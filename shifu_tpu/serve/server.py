@@ -277,6 +277,33 @@ class ScoringServer:
         self.httpd = ThreadingHTTPServer((host, port),
                                          self._handler_class())
         self.httpd.daemon_threads = True
+        # process heartbeat lease + peer view + fleet-promotion-round
+        # participant (serve/peers.py): N serve processes on one model
+        # set observe each other through `.shifu/runs/peers/`, and a
+        # fleet-atomic `shifu promote` drives stage/promote/unstage on
+        # every live process through these hooks. Built AFTER the HTTP
+        # listener so the advertised port is the bound one; disabled by
+        # -Dshifu.lease.ttlMs=0.
+        from shifu_tpu.serve.peers import PeerRegistry
+
+        self.peers = PeerRegistry(
+            self.root,
+            stage_cb=self.stage_candidate,
+            promote_cb=self.promote_candidate,
+            unstage_cb=self.registry.unstage,
+            info_cb=self._peer_info)
+
+    def _peer_info(self) -> dict:
+        """The health summary renewed into this process's lease file —
+        a peer scan is a cheap fleet-of-processes health view."""
+        return {
+            "port": self.port,
+            "status": self.scorer.health.state,
+            "sha": self.registry.sha,
+            "replicas": len(self.registry.replicas),
+            "queueDepth": sum(len(r.admission)
+                              for r in self.registry.replicas),
+        }
 
     # ---- continuous-loop seams ----
     def _load_configs(self):
@@ -464,6 +491,21 @@ class ScoringServer:
                                 f"SLO burn rate {snap['burnRate']:.2f} "
                                 f"(>{slo.slo_ms:g}ms beyond the "
                                 f"{slo.target:g} objective)")
+                    # fleet-of-processes view: every peer lease (live +
+                    # expired with ages and last-renewed health info).
+                    # An EXPIRED peer is a computed degrade reason —
+                    # this process keeps serving, but the balancer and
+                    # the operator see the process fleet lost a member
+                    # (it clears if the peer's lease is swept or it
+                    # comes back)
+                    if server.peers.enabled:
+                        health["peers"] = server.peers.snapshot()
+                        expired = server.peers.expired_peers()
+                        if expired and health["status"] == "ok":
+                            health["status"] = "degraded"
+                            health["reason"] = (
+                                "peer lease(s) expired: "
+                                + ", ".join(expired))
                     self._reply(code, health)
                     return
                 if self.path == "/admin/traces":
@@ -624,6 +666,10 @@ class ScoringServer:
                 return None
             self._shutdown_started = True
         try:
+            # release the heartbeat lease FIRST: a draining process must
+            # leave the fleet cleanly (file removed), not expire into a
+            # survivor's degrade reason
+            self.peers.close()
             self.scorer.close(drain_timeout)
             self.httpd.shutdown()
             self.httpd.server_close()
@@ -671,6 +717,10 @@ class ScoringServer:
                 extra["traffic"] = self.traffic.snapshot()
             if self.registry.slo.enabled:
                 extra["slo"] = self.registry.slo.snapshot()
+            if self.peers.enabled:
+                # last peer view before the lease released: the manifest
+                # records what the process fleet looked like at drain
+                extra["peers"] = self.peers.snapshot()
             seq = ledger.next_seq("serve")
             # retained request traces serialize as a Perfetto-loadable
             # file next to the manifest; the manifest carries the

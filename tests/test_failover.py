@@ -1,0 +1,540 @@
+"""Replica circuit breakers + request failover (serve/health.py
+CircuitBreaker, serve/fleet.py failover path, resilience/faults.py
+per-replica targeting).
+
+The acceptance pins live here: repeated device-dispatch failures trip a
+replica open (closed -> open -> half-open with jittered exponential
+probe backoff), the router treats open replicas as absent, a tripped
+batch's requests fail over to healthy replicas under the bounded
+per-request budget with ZERO unanswered and ZERO double-answered
+requests under concurrent load, budget exhaustion answers the request
+with the error, and the `device_dead@replica=N` chaos seam drives all
+of it deterministically."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from shifu_tpu.utils import environment
+
+
+class _Props:
+    def __init__(self, **props):
+        self.props = {k.replace("_", "."): v for k, v in props.items()}
+
+    def __enter__(self):
+        for k, v in self.props.items():
+            environment.set_property(k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k in self.props:
+            environment.set_property(k, "")
+
+
+def _wait_for(pred, timeout=15.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine (pure, clock injected — no sleeps)
+# ---------------------------------------------------------------------------
+
+
+def _breaker(**kw):
+    from shifu_tpu.serve.health import CircuitBreaker
+
+    kw.setdefault("failures", 3)
+    kw.setdefault("probe_base_ms", 100)
+    kw.setdefault("probe_cap_ms", 1000)
+    kw.setdefault("probe_oks", 2)
+    kw.setdefault("labels", {"replica": "0"})
+    return CircuitBreaker(**kw)
+
+
+class TestCircuitBreaker:
+    def test_trips_at_threshold_not_before(self):
+        from shifu_tpu.serve.health import BREAKER_CLOSED, BREAKER_OPEN
+
+        b = _breaker()
+        b.note_failure("boom")
+        b.note_failure("boom")
+        assert b.state == BREAKER_CLOSED
+        assert b.admit() == "closed"
+        b.note_failure("boom")
+        assert b.state == BREAKER_OPEN
+        assert b.trips == 1
+        assert b.admit(now=time.monotonic()) is None
+        assert not b.routable()
+
+    def test_success_resets_the_failure_streak(self):
+        from shifu_tpu.serve.health import BREAKER_CLOSED
+
+        b = _breaker()
+        for _ in range(5):
+            b.note_failure("x")
+            b.note_ok()  # never 3 consecutive
+            b.note_failure("x")
+        assert b.state == BREAKER_CLOSED
+
+    def test_open_to_half_open_probe_then_close(self):
+        from shifu_tpu.serve.health import (
+            BREAKER_CLOSED,
+            BREAKER_HALF_OPEN,
+            BREAKER_OPEN,
+        )
+
+        b = _breaker()
+        for _ in range(3):
+            b.note_failure("x")
+        assert b.state == BREAKER_OPEN
+        now = time.monotonic()
+        # inside the backoff: quarantined; past the cap: probe due
+        assert not b.probe_due(now)
+        late = now + 10.0
+        assert b.probe_due(late)
+        assert b.admit(now=late) == "probe"
+        assert b.state == BREAKER_HALF_OPEN
+        # exactly ONE probe at a time
+        assert b.admit(now=late) is None
+        assert not b.routable(late)
+        b.note_ok()   # probe 1 succeeded
+        assert b.state == BREAKER_HALF_OPEN  # probeOks=2
+        assert b.admit(now=late) == "probe"
+        b.note_ok()   # probe 2
+        assert b.state == BREAKER_CLOSED
+        assert b.admit() == "closed"
+
+    def test_failed_probe_reopens_with_longer_backoff(self):
+        from shifu_tpu.serve.health import BREAKER_OPEN
+
+        b = _breaker()
+        for _ in range(3):
+            b.note_failure("x")
+        late = time.monotonic() + 10.0
+        assert b.admit(now=late) == "probe"
+        b.note_failure("still dead")
+        assert b.state == BREAKER_OPEN
+        snap = b.snapshot()
+        assert snap["openAttempts"] == 2
+        assert snap["lastError"] == "still dead"
+
+    def test_probe_backoff_is_jittered_exponential_never_zero(self):
+        import random
+
+        b = _breaker(rng=random.Random(7))
+        delays = []
+        for attempt in (1, 2, 3, 4, 5):
+            with b._lock:
+                b._open_attempts = attempt
+                delays.append(b._probe_delay_s())
+        # equal jitter over the retry.py window: in [w/2, w], never 0
+        for attempt, d in zip((1, 2, 3, 4, 5), delays):
+            window = min(1000, 100 * 2 ** (attempt - 1)) / 1000.0
+            assert window / 2 <= d <= window, (attempt, d)
+
+    def test_cancel_returns_the_probe_slot(self):
+        b = _breaker()
+        for _ in range(3):
+            b.note_failure("x")
+        late = time.monotonic() + 10.0
+        grant = b.admit(now=late)
+        assert grant == "probe"
+        assert b.admit(now=late) is None
+        b.cancel(grant)  # the probe never dispatched (queue shed it)
+        assert b.admit(now=late) == "probe"
+
+    def test_straggler_outcomes_ignored_while_open(self):
+        from shifu_tpu.serve.health import BREAKER_OPEN
+
+        b = _breaker()
+        for _ in range(3):
+            b.note_failure("x")
+        # results from batches dispatched BEFORE the trip prove nothing
+        b.note_ok()
+        b.note_failure("x")
+        assert b.state == BREAKER_OPEN
+        assert b.snapshot()["openAttempts"] == 1
+
+    def test_transitions_and_gauge_recorded(self):
+        from shifu_tpu import obs
+
+        obs.reset()
+        b = _breaker()
+        for _ in range(3):
+            b.note_failure("x")
+        late = time.monotonic() + 10.0
+        b.admit(now=late)
+        b.note_ok()
+        b.note_ok()
+        snap = obs.registry().snapshot()
+        c = snap["counters"]
+        assert c.get('serve.breaker.transitions{replica="0",to="open"}') \
+            == 1.0
+        assert c.get(
+            'serve.breaker.transitions{replica="0",to="half_open"}') == 1.0
+        assert c.get(
+            'serve.breaker.transitions{replica="0",to="closed"}') == 1.0
+        assert c.get('serve.breaker.trips{replica="0"}') == 1.0
+        assert snap["gauges"]['serve.breaker.open{replica="0"}'] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# fault grammar: per-replica targeting + the new seams
+# ---------------------------------------------------------------------------
+
+
+class TestFaultGrammar:
+    def test_device_dead_parses_persistent_and_replica_targeted(self):
+        from shifu_tpu.resilience.faults import FaultPlan
+
+        plan = FaultPlan.parse("device_dead@replica=1")
+        (c,) = plan.clauses
+        assert c.seam == "device_dead"
+        assert c.replica == 1
+        assert c.at is None
+        assert c.counter == "serve.dispatch"
+        assert c.p == 1.0 and c.max == 0  # persistent, not transient
+        assert "replica=1" in c.describe()
+
+    def test_replica_targeting_is_generic_across_seams(self):
+        from shifu_tpu.resilience.faults import FaultPlan, InjectedFaultError
+
+        # targeting composes with the normal params (p stays the seam's
+        # own default — only device_dead/lease_stall/peer_kill are
+        # certain by default)
+        plan = FaultPlan.parse("io@replica=2:p=1")
+        # replica 0's events never match; replica 2's always raise
+        plan.fire("io", replica=0)
+        plan.fire("io")  # no replica context at all
+        with pytest.raises(InjectedFaultError):
+            plan.fire("io", replica=2)
+
+    def test_device_dead_fires_only_on_target_replica_with_label(self):
+        from shifu_tpu import obs
+        from shifu_tpu.resilience.faults import FaultPlan, InjectedFaultError
+
+        obs.reset()
+        plan = FaultPlan.parse("device_dead@replica=1")
+        for _ in range(3):
+            plan.fire("serve.dispatch", replica=0)  # healthy replica
+        with pytest.raises(InjectedFaultError) as ei:
+            plan.fire("serve.dispatch", replica=1)
+        assert ei.value.seam == "device_dead"
+        # persistent: fires EVERY time, not once
+        with pytest.raises(InjectedFaultError):
+            plan.fire("serve.dispatch", replica=1)
+        c = obs.registry().snapshot()["counters"]
+        assert c.get(
+            'fault.injected{replica="1",seam="device_dead"}') == 2.0
+
+    def test_lease_stall_sleeps_on_the_lease_counter(self):
+        from shifu_tpu.resilience.faults import FaultPlan
+
+        plan = FaultPlan.parse("lease_stall:ms=80")
+        (c,) = plan.clauses
+        assert c.counter == "lease" and c.p == 1.0
+        t0 = time.perf_counter()
+        plan.fire("lease")
+        assert time.perf_counter() - t0 >= 0.07
+
+    def test_peer_kill_parses_scheduled_once(self):
+        from shifu_tpu.resilience.faults import FaultPlan
+
+        plan = FaultPlan.parse("peer_kill@lease=5")
+        (c,) = plan.clauses
+        assert c.seam == "peer_kill" and c.counter == "lease"
+        assert c.at == 5 and c.max == 1
+        # events 1-4 must NOT kill the process (trigger is the 5th);
+        # the test obviously cannot drive the 5th
+        for _ in range(4):
+            plan.fire("lease")
+
+    def test_bare_peer_kill_defaults_to_single_firing(self):
+        from shifu_tpu.resilience.faults import FaultPlan
+
+        (c,) = FaultPlan.parse("peer_kill").clauses
+        assert c.p == 1.0 and c.max == 1 and c.counter == "lease"
+
+    def test_old_grammar_unchanged(self):
+        from shifu_tpu.resilience.faults import FaultPlan
+
+        plan = FaultPlan.parse("io:p=0.01:seed=7,preempt@chunk=40")
+        io, pre = plan.clauses
+        assert io.p == 0.01 and io.replica is None
+        assert pre.counter == "chunk" and pre.at == 40
+
+
+# ---------------------------------------------------------------------------
+# failover through fake replicas (no models, fast)
+# ---------------------------------------------------------------------------
+
+
+def _fake_result(values):
+    from shifu_tpu.eval.scorer import ScoreResult
+
+    m = np.asarray(values, np.float64)[:, None]
+    return ScoreResult(model_scores=m, mean=m[:, 0], max=m[:, 0],
+                       min=m[:, 0], median=m[:, 0],
+                       model_names=["fake"], model_widths=[1])
+
+
+def _one_row(v):
+    from shifu_tpu.data.reader import ColumnarData
+
+    return ColumnarData(names=["v"],
+                        raw={"v": np.asarray([str(v)], object)}, n_rows=1)
+
+
+class _FlakyRegistry:
+    """Registry whose scoring fails while `dead` is set."""
+
+    def __init__(self, dead=False):
+        self.dead = dead
+        self.sha = "fake"
+        self.input_columns = ["v"]
+        self.scored = 0
+
+    def score_raw(self, data):
+        if self.dead:
+            raise RuntimeError("device dead (injected)")
+        self.scored += data.n_rows
+        return _fake_result([float(x) for x in data.column("v")])
+
+    def snapshot(self):
+        return {"sha": self.sha}
+
+
+def _fake_fleet(n=2, dead=(), depth=256, **breaker_props):
+    from shifu_tpu.serve.fleet import ReplicaFleet, ScoringReplica
+    from shifu_tpu.serve.queue import AdmissionQueue
+
+    props = {"shifu_serve_breaker_probeBaseMs": "30",
+             "shifu_serve_breaker_probeCapMs": "120",
+             **breaker_props}
+    with _Props(**props):
+        reps = [
+            ScoringReplica(
+                _FlakyRegistry(dead=i in dead), index=i,
+                admission=AdmissionQueue(depth,
+                                         labels={"replica": str(i)}),
+                max_batch_rows=8, max_wait_ms=1)
+            for i in range(n)
+        ]
+        return ReplicaFleet(reps)
+
+
+class TestFailover:
+    def test_tripped_batch_fails_over_zero_unanswered(self):
+        """Acceptance: replica 0 persistently failing under concurrent
+        load — every request answered exactly once (sum of per-replica
+        resolved counters == submitted), breaker tripped open, router
+        drains around."""
+        from shifu_tpu import obs
+        from shifu_tpu.serve.health import BREAKER_OPEN
+
+        obs.reset()
+        fleet = _fake_fleet(2, dead={0})
+        n_threads, per_thread = 4, 25
+        errors = []
+
+        def client(ti):
+            for k in range(per_thread):
+                try:
+                    res = fleet.submit(_one_row(ti * 100 + k)).wait(30)
+                    assert res.mean[0] == float(ti * 100 + k)
+                except Exception as e:  # noqa: BLE001 - collected
+                    errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        total = n_threads * per_thread
+        counters = obs.registry().snapshot()["counters"]
+        resolved = sum(v for k, v in counters.items()
+                       if k.startswith("serve.requests{"))
+        # zero unanswered AND zero double-answered: every submitted
+        # request resolved exactly once, all on the healthy replica
+        assert resolved == total
+        assert counters.get('serve.requests{replica="1"}') == total
+        assert fleet.replicas[0].breaker.state == BREAKER_OPEN
+        assert counters.get('serve.breaker.trips{replica="0"}') == 1.0
+        assert counters.get(
+            'serve.failover.requests{replica="0"}', 0) >= 1
+        fleet.close(10)
+
+    def test_budget_exhaustion_answers_with_the_error(self):
+        """Every replica dead: the request bounces failoverMax times,
+        then is ANSWERED with the error — never left hanging."""
+        from shifu_tpu import obs
+
+        obs.reset()
+        with _Props(shifu_serve_breaker_failoverMax="2"):
+            fleet = _fake_fleet(2, dead={0, 1})
+        req = fleet.submit(_one_row(1))
+        with pytest.raises(RuntimeError, match="device dead"):
+            req.wait(30)
+        assert req.failovers <= 2
+        counters = obs.registry().snapshot()["counters"]
+        assert sum(v for k, v in counters.items()
+                   if k.startswith("serve.failover.exhausted")) >= 1
+        fleet.close(10)
+
+    def test_single_replica_fleet_fails_directly(self):
+        fleet = _fake_fleet(1, dead={0})
+        req = fleet.submit(_one_row(1))
+        with pytest.raises(RuntimeError, match="device dead"):
+            req.wait(30)
+        assert req.failovers == 0  # nowhere to fail over
+        fleet.close(10)
+
+    def test_open_replica_recovers_through_half_open_probes(self):
+        """Heal the device: the next due probe goes through (probes rank
+        FIRST in the router so recovery is not starved), probeOks
+        successes close the breaker, traffic returns."""
+        from shifu_tpu.serve.health import BREAKER_CLOSED, BREAKER_OPEN
+
+        fleet = _fake_fleet(2, dead={0})
+        # trip replica 0
+        for i in range(6):
+            fleet.submit(_one_row(i)).wait(30)
+        assert fleet.replicas[0].breaker.state == BREAKER_OPEN
+        # heal, then keep offering light traffic so probes can ride
+        fleet.replicas[0].registry.dead = False
+
+        def pump():
+            deadline = time.monotonic() + 15
+            while (fleet.replicas[0].breaker.state != BREAKER_CLOSED
+                   and time.monotonic() < deadline):
+                fleet.submit(_one_row(9)).wait(30)
+                time.sleep(0.01)
+
+        pump()
+        assert fleet.replicas[0].breaker.state == BREAKER_CLOSED
+        # and it takes real traffic again
+        before = fleet.replicas[0].registry.scored
+        for i in range(8):
+            fleet.submit(_one_row(i)).wait(30)
+        assert fleet.replicas[0].registry.scored > before
+        fleet.close(10)
+
+    def test_health_snapshot_names_the_quarantined_replica(self):
+        from shifu_tpu.serve.health import DEGRADED
+
+        fleet = _fake_fleet(2)
+        for _ in range(3):
+            fleet.replicas[1].breaker.note_failure("boom")
+        snap = fleet.health_snapshot()
+        assert snap["status"] == DEGRADED
+        assert "replica 1" in snap["reason"]
+        per = {p["replica"]: p for p in snap["replicas"]}
+        assert per["1"]["breaker"]["state"] == "open"
+        assert per["1"]["status"] == DEGRADED
+        assert per["0"]["breaker"]["state"] == "closed"
+        fleet.close(10)
+
+    def test_retry_after_excludes_open_breaker_replicas(self):
+        """Satellite: the fleet Retry-After must describe SURVIVING
+        capacity — an open replica's stale drain rate and dead backlog
+        are both excluded."""
+        fleet = _fake_fleet(2)
+        # give replica 1 drain history (the surviving capacity)
+        for i in range(6):
+            fleet.submit(_one_row(i)).wait(30)
+
+        class _Stuck:
+            def drain_stats(self, now=None):
+                # a fat backlog with a once-great drain rate, all stale
+                return 10_000, 100_000.0
+
+        real0 = fleet.replicas[0].batcher
+        with_open = None
+        without = fleet.retry_after_seconds()
+        fleet.replicas[0].batcher = _Stuck()
+        # closed breaker: the stuck replica's fantasy stats poison the
+        # fleet hint (10k backlog / huge rate -> still min-clamped, so
+        # trip it and compare shape instead: the open replica must not
+        # contribute AT ALL)
+        for _ in range(3):
+            fleet.replicas[0].breaker.note_failure("dead")
+        with_open = fleet.retry_after_seconds()
+        # with the open replica excluded the hint is replica 1's alone:
+        # empty backlog, observed drain -> clamped to the 1 s floor
+        assert with_open == 1.0
+        assert without == 1.0
+        fleet.replicas[0].batcher = real0
+        fleet.close(10)
+
+
+# ---------------------------------------------------------------------------
+# end to end: device_dead@replica=N through a REAL fleet
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def models_dir(tmp_path_factory):
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+
+    d = str(tmp_path_factory.mktemp("failover_models"))
+    cols = [f"c{i}" for i in range(4)]
+    sizes = [len(cols), 3, 1]
+    specs = [{"name": c, "kind": "value", "outNames": [c],
+              "mean": 0.0, "std": 1.0, "fill": 0.0, "zscore": True}
+             for c in cols]
+    NNModelSpec(layer_sizes=sizes, activations=["tanh"],
+                input_columns=cols, norm_specs=specs,
+                params=init_params(sizes, seed=0),
+                ).save(os.path.join(d, "model0.nn"))
+    return d
+
+
+class TestDeviceDeadEndToEnd:
+    def test_injected_device_death_trips_fails_over_and_recovers(
+            self, models_dir):
+        """The bench `failover` scenario's mechanism, pinned as a test:
+        `device_dead@replica=1` trips replica 1, requests fail over with
+        zero unanswered, healing (disarming the plan) lets half-open
+        probes close the breaker."""
+        from shifu_tpu import obs
+        from shifu_tpu.resilience import faults
+        from shifu_tpu.serve.fleet import ReplicaFleet
+        from shifu_tpu.serve.health import BREAKER_CLOSED, BREAKER_OPEN
+
+        obs.reset()
+        with _Props(shifu_serve_breaker_probeBaseMs="30",
+                    shifu_serve_breaker_probeCapMs="120"):
+            fleet = ReplicaFleet.build(models_dir, n_replicas=2,
+                                       queue_depth=256)
+        cols = fleet.input_columns
+        rec = {c: "0.5" for c in cols}
+        with faults.activate(faults.FaultPlan.parse(
+                "device_dead@replica=1")):
+            for _ in range(30):
+                res = fleet.score_batch([rec], timeout=30)
+                assert res.mean.shape == (1,)
+            assert fleet.replicas[1].breaker.state == BREAKER_OPEN
+            counters = obs.registry().snapshot()["counters"]
+            assert counters.get(
+                'fault.injected{replica="1",seam="device_dead"}', 0) >= 3
+            assert counters.get(
+                'serve.failover.requests{replica="1"}', 0) >= 1
+        # healed: probes close it
+        deadline = time.monotonic() + 20
+        while (fleet.replicas[1].breaker.state != BREAKER_CLOSED
+               and time.monotonic() < deadline):
+            fleet.score_batch([rec], timeout=30)
+            time.sleep(0.01)
+        assert fleet.replicas[1].breaker.state == BREAKER_CLOSED
+        fleet.close(10)

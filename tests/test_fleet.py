@@ -349,8 +349,9 @@ class TestReplicaFleet:
     def test_crash_degrades_one_replica_fleet_drains_around(
             self, models_dir):
         """Acceptance: one replica's worker crash degrades only that
-        replica; the router routes new work around it and every request
-        still gets an answer."""
+        replica; the crashed batch's request FAILS OVER to the healthy
+        replica (round 14: an answer, not an error), the router routes
+        new work around it, and every request still gets an answer."""
         from shifu_tpu import obs
         from shifu_tpu.serve.health import DEGRADED, OK
 
@@ -378,8 +379,11 @@ class TestReplicaFleet:
 
         req = victim.batcher.submit(
             records_to_columnar(_records(cols, 1), cols))
-        with pytest.raises(RuntimeError, match="crashed"):
-            req.wait(10)
+        # pre-failover this answered with "worker crashed mid-batch";
+        # now the fleet replays it on replica 1 — same request object,
+        # an actual score
+        assert req.wait(10).mean.shape == (1,)
+        assert req.failovers == 1
         assert victim.health.state == DEGRADED
         assert fleet.replicas[1].health.state == OK
         snap = fleet.health_snapshot()

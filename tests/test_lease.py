@@ -1,0 +1,391 @@
+"""Process heartbeat leases (resilience/lease.py), the peer registry
+(serve/peers.py) and the fleet-atomic promotion protocol
+(loop/rounds.py + loop/promote.py fleet mode).
+
+The acceptance pins live here: N processes on one root observe each
+other through atomic lease files; an expired lease is detected, counted
+and surfaced while survivors keep working; a promotion round commits
+only on unanimous lease-fenced acks, and EVERY failure mode (nack, peer
+death mid-round, coordinator death mid-round) converges to all
+processes rolled back to the active version — a half-promoted fleet is
+impossible. Most tests drive real PeerRegistry heartbeat threads
+in-process (the protocol is file-based, so two registries in one
+process are indistinguishable from two processes)."""
+
+import json
+import os
+import time
+
+from shifu_tpu.utils import environment
+
+
+class _Props:
+    def __init__(self, **props):
+        self.props = {k.replace("_", "."): v for k, v in props.items()}
+
+    def __enter__(self):
+        for k, v in self.props.items():
+            environment.set_property(k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k in self.props:
+            environment.set_property(k, "")
+
+
+def _wait_for(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# lease files
+# ---------------------------------------------------------------------------
+
+
+class TestProcessLease:
+    def test_acquire_renew_release_roundtrip(self, tmp_path):
+        from shifu_tpu.resilience import lease
+
+        root = str(tmp_path)
+        pl = lease.ProcessLease(root, ttl_ms=5000)
+        path = pl.acquire(info={"port": 1234})
+        assert os.path.isfile(path)
+        doc = lease.read_lease(path)
+        assert doc["leaseId"] == pl.lease_id
+        assert doc["token"] == pl.token
+        assert doc["epoch"] == pl.epoch
+        assert doc["info"] == {"port": 1234}
+        t0 = doc["renewedAt"]
+        pl.renew(info={"port": 1234, "status": "ok"})
+        doc2 = lease.read_lease(path)
+        assert doc2["renewedAt"] >= t0
+        assert doc2["renewals"] == 1
+        # token + epoch NEVER change across renewals (the fence)
+        assert doc2["token"] == doc["token"]
+        assert doc2["epoch"] == doc["epoch"]
+        pl.release()
+        assert not os.path.isfile(path)
+
+    def test_scan_classifies_live_vs_expired(self, tmp_path):
+        from shifu_tpu.resilience import lease
+
+        root = str(tmp_path)
+        live = lease.ProcessLease(root, ttl_ms=60_000)
+        live.acquire()
+        dead = lease.ProcessLease(root, ttl_ms=100)
+        dead.acquire()
+        # a lease whose renewedAt is older than ITS OWN ttl is expired
+        now = time.time() + 1.0
+        peers = lease.scan(root, now=now)
+        by_id = {p["leaseId"]: p for p in peers}
+        assert not by_id[live.lease_id]["expired"]
+        assert by_id[dead.lease_id]["expired"]
+        assert by_id[dead.lease_id]["ageMs"] > 100
+        # exclude= drops the caller's own lease from a peer view
+        assert live.lease_id not in {
+            p["leaseId"] for p in lease.scan(root, now=now,
+                                             exclude=live.lease_id)}
+
+    def test_sweep_removes_only_long_expired(self, tmp_path):
+        from shifu_tpu.resilience import lease
+
+        root = str(tmp_path)
+        fresh = lease.ProcessLease(root, ttl_ms=50)
+        fresh.acquire()
+        # expired (age > ttl) but NOT long-expired: kept as evidence
+        assert lease.sweep_expired(root, now=time.time() + 0.2) == 0
+        assert len(lease.scan(root)) == 1
+        # age > 20 x ttl: garbage-collected
+        assert lease.sweep_expired(root, now=time.time() + 2.0) == 1
+        assert lease.scan(root) == []
+
+    def test_fence_check_detects_every_break(self, tmp_path):
+        from shifu_tpu.resilience import lease
+
+        root = str(tmp_path)
+        a = lease.ProcessLease(root, ttl_ms=60_000)
+        a.acquire()
+        fence = [{"leaseId": a.lease_id, "token": a.token,
+                  "epoch": a.epoch}]
+        assert lease.fence_check(root, fence) == []
+        # expiry breaks the fence
+        broken = lease.fence_check(root, fence, now=time.time() + 120)
+        assert broken and "expired" in broken[0]
+        # a restarted incarnation (same id, different token) breaks it
+        path = os.path.join(lease.peers_dir(root),
+                            a.lease_id + lease.LEASE_SUFFIX)
+        doc = json.load(open(path))
+        doc["token"] = "someone-else"
+        json.dump(doc, open(path, "w"))
+        broken = lease.fence_check(root, fence)
+        assert broken and "incarnation" in broken[0]
+        # a vanished lease breaks it
+        os.unlink(path)
+        broken = lease.fence_check(root, fence)
+        assert broken and "vanished" in broken[0]
+
+
+# ---------------------------------------------------------------------------
+# peer registry (heartbeat thread)
+# ---------------------------------------------------------------------------
+
+
+class TestPeerRegistry:
+    def test_two_registries_observe_each_other(self, tmp_path):
+        from shifu_tpu import obs
+        from shifu_tpu.serve.peers import PeerRegistry
+
+        obs.reset()
+        root = str(tmp_path)
+        a = PeerRegistry(root, ttl_ms=2000)
+        b = PeerRegistry(root, ttl_ms=2000)
+        try:
+            _wait_for(lambda: len(a.peers()) == 1 and len(b.peers()) == 1,
+                      msg="mutual peer discovery")
+            assert a.peers()[0]["leaseId"] == b.lease.lease_id
+            snap = a.snapshot()
+            assert snap["liveProcesses"] == 2
+            assert snap["expiredProcesses"] == 0
+        finally:
+            b.close()
+            a.close()
+        # clean shutdown RELEASES (no expired residue for survivors)
+        from shifu_tpu.resilience import lease
+
+        assert lease.scan(root) == []
+
+    def test_expired_peer_detected_and_counted_once(self, tmp_path):
+        from shifu_tpu import obs
+        from shifu_tpu.resilience import lease
+        from shifu_tpu.serve.peers import PeerRegistry
+
+        obs.reset()
+        root = str(tmp_path)
+        # a dead process's lease: acquired, never renewed, tiny ttl
+        dead = lease.ProcessLease(root, ttl_ms=50)
+        dead.acquire()
+        time.sleep(0.1)
+        a = PeerRegistry(root, ttl_ms=60_000)
+        try:
+            _wait_for(lambda: a.expired_peers() == [dead.lease_id],
+                      msg="expired peer detection")
+            # counted exactly once however many beats observe it
+            time.sleep(0.1)
+            counters = obs.registry().snapshot()["counters"]
+            assert counters.get("peer.lease.expired") == 1.0
+            snap = a.snapshot()
+            assert snap["expiredProcesses"] == 1
+            assert snap["liveProcesses"] == 1
+        finally:
+            a.close()
+
+    def test_disabled_by_zero_ttl(self, tmp_path):
+        from shifu_tpu.resilience import lease
+        from shifu_tpu.serve.peers import PeerRegistry
+
+        with _Props(shifu_lease_ttlMs="0"):
+            reg = PeerRegistry(str(tmp_path))
+            assert not reg.enabled
+            assert reg.snapshot() == {"enabled": False}
+            reg.close()
+        assert lease.scan(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# promotion rounds: the 2PC participant state machine
+# ---------------------------------------------------------------------------
+
+
+class _Participant:
+    """A PeerRegistry wired to recording callbacks (the server stand-in)."""
+
+    def __init__(self, root, ttl_ms=2000, sha="cand-sha",
+                 stage_error=None):
+        from shifu_tpu.serve.peers import PeerRegistry
+
+        self.staged = []
+        self.promoted = []
+        self.unstaged = 0
+        self.sha = sha
+        self.stage_error = stage_error
+
+        def stage_cb(candidate_dir):
+            if self.stage_error is not None:
+                raise self.stage_error
+            self.staged.append(candidate_dir)
+            return {"sha": self.sha}
+
+        def promote_cb(sha):
+            self.promoted.append(sha)
+
+        def unstage_cb():
+            self.unstaged += 1
+
+        self.reg = PeerRegistry(root, stage_cb=stage_cb,
+                                promote_cb=promote_cb,
+                                unstage_cb=unstage_cb, ttl_ms=ttl_ms)
+
+    def fence_entry(self):
+        pl = self.reg.lease
+        return {"leaseId": pl.lease_id, "token": pl.token,
+                "epoch": pl.epoch}
+
+    def close(self):
+        self.reg.close()
+
+
+class TestPromotionRounds:
+    def test_participant_stages_acks_and_commits(self, tmp_path):
+        from shifu_tpu import obs
+        from shifu_tpu.loop import rounds
+
+        obs.reset()
+        root = str(tmp_path)
+        part = _Participant(root)
+        try:
+            rid = rounds.new_round_id()
+            rounds.write_prepare(root, rid, str(tmp_path / "cand"),
+                                 "cand-sha", [part.fence_entry()],
+                                 time.time() + 10.0)
+            _wait_for(lambda: rounds.read_round(root, rid)["acks"],
+                      msg="participant ack")
+            state = rounds.read_round(root, rid)
+            (ack,) = state["acks"].values()
+            assert ack["ok"] and ack["stagedSha"] == "cand-sha"
+            assert ack["token"] == part.reg.lease.token
+            assert part.staged and not part.promoted
+            rounds.write_commit(root, rid, "cand-sha")
+            _wait_for(lambda: part.promoted == ["cand-sha"],
+                      msg="commit applied")
+            assert part.unstaged == 0
+            counters = obs.registry().snapshot()["counters"]
+            assert counters.get('promote.phase.ack{role="participant"}') \
+                == 1.0
+            assert counters.get(
+                'promote.phase.commit{role="participant"}') == 1.0
+        finally:
+            part.close()
+
+    def test_sha_mismatch_nacks_and_rolls_back(self, tmp_path):
+        from shifu_tpu.loop import rounds
+
+        root = str(tmp_path)
+        part = _Participant(root, sha="OTHER-sha")
+        try:
+            rid = rounds.new_round_id()
+            rounds.write_prepare(root, rid, str(tmp_path / "cand"),
+                                 "cand-sha", [part.fence_entry()],
+                                 time.time() + 10.0)
+            _wait_for(lambda: rounds.read_round(root, rid)["acks"],
+                      msg="nack")
+            (ack,) = rounds.read_round(root, rid)["acks"].values()
+            assert not ack["ok"]
+            assert "changed mid-round" in ack["reason"]
+            assert part.unstaged == 1  # its own stage rolled back
+            assert not part.promoted
+        finally:
+            part.close()
+
+    def test_abort_rolls_back_staged_candidate(self, tmp_path):
+        from shifu_tpu.loop import rounds
+
+        root = str(tmp_path)
+        part = _Participant(root)
+        try:
+            rid = rounds.new_round_id()
+            rounds.write_prepare(root, rid, str(tmp_path / "cand"),
+                                 "cand-sha", [part.fence_entry()],
+                                 time.time() + 10.0)
+            _wait_for(lambda: rounds.read_round(root, rid)["acks"],
+                      msg="ack")
+            rounds.write_abort(root, rid, "fence broken")
+            _wait_for(lambda: part.unstaged == 1, msg="rollback")
+            assert not part.promoted
+        finally:
+            part.close()
+
+    def test_dead_coordinator_self_aborts_after_deadline(self, tmp_path):
+        """No commit/abort ever lands (the coordinator died): the
+        participant re-reads one final time past deadline+grace, writes
+        the abort record itself, and rolls back to active."""
+        from shifu_tpu.loop import rounds
+
+        root = str(tmp_path)
+        part = _Participant(root, ttl_ms=600)
+        try:
+            rid = rounds.new_round_id()
+            rounds.write_prepare(root, rid, str(tmp_path / "cand"),
+                                 "cand-sha", [part.fence_entry()],
+                                 time.time() + 0.6)
+            _wait_for(lambda: part.unstaged == 1, timeout=15,
+                      msg="deadline self-abort")
+            assert not part.promoted
+            state = rounds.read_round(root, rid)
+            assert state["abort"] is not None
+            assert "deadline" in state["abort"]["reason"]
+        finally:
+            part.close()
+
+    def test_unfenced_participant_ignores_round(self, tmp_path):
+        from shifu_tpu.loop import rounds
+
+        root = str(tmp_path)
+        part = _Participant(root)
+        try:
+            rid = rounds.new_round_id()
+            # fence names some OTHER incarnation
+            rounds.write_prepare(root, rid, str(tmp_path / "cand"),
+                                 "cand-sha",
+                                 [{"leaseId": "ghost", "token": "t",
+                                   "epoch": 1}],
+                                 time.time() + 5.0)
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                assert not part.staged
+                time.sleep(0.05)
+            assert rounds.read_round(root, rid)["acks"] == {}
+        finally:
+            part.close()
+
+
+# ---------------------------------------------------------------------------
+# rounds record layer
+# ---------------------------------------------------------------------------
+
+
+class TestRoundRecords:
+    def test_round_roundtrip_and_sweep(self, tmp_path):
+        from shifu_tpu.loop import rounds
+
+        root = str(tmp_path)
+        ids = []
+        for i in range(10):
+            rid = f"{1000 + i:013d}-abc{i:03d}"
+            ids.append(rid)
+            rounds.write_prepare(root, rid, "/cand", f"sha{i}", [],
+                                 time.time() + 5)
+        # sweep keeps the newest KEEP_ROUNDS
+        assert rounds.latest_prepare(root)["round"] == ids[-1]
+        rounds.sweep_rounds(root, keep=2)
+        assert rounds.read_round(root, ids[0])["prepare"] is None
+        assert rounds.read_round(root, ids[-1])["prepare"] is not None
+
+    def test_read_round_collects_acks_and_verdict(self, tmp_path):
+        from shifu_tpu.loop import rounds
+
+        root = str(tmp_path)
+        rid = rounds.new_round_id()
+        rounds.write_prepare(root, rid, "/cand", "sha", [], time.time())
+        rounds.write_ack(root, rid, "p1", "t1", 1, ok=True,
+                         staged_sha="sha")
+        rounds.write_ack(root, rid, "p2", "t2", 2, ok=False, reason="no")
+        rounds.write_abort(root, rid, "one nack")
+        state = rounds.read_round(root, rid)
+        assert set(state["acks"]) == {"p1", "p2"}
+        assert state["commit"] is None
+        assert state["abort"]["reason"] == "one nack"
