@@ -78,6 +78,11 @@ STREAMED_STATS = dict(n=120_000, numeric=8, cat=2, chunk_rows=8192)
 # all), so it too stays out of BASELINE_MEASURED.json
 SERVE = dict(cols=30, hidden=[50], bags=3, requests=240,
              concurrency=(1, 4, 16), queue_depth=256)
+# model_zoo: 3 tenants whose working sets differ by hidden width, under
+# an HBM budget that fits only the two smallest — residency churns, the
+# ledger gates peak <= budget, warm p99 gates <= 1.10x single-tenant
+MODEL_ZOO = dict(cols=16, hiddens=(16, 32, 64), bags=2, requests=120,
+                 concurrency=4, reps=3)
 # serve_fleet sweeps FORCED host-device replica counts in subprocesses
 # (like sharded_stats — the device count must be fixed before jax
 # initializes). Children run single-thread XLA compute (thunk runtime +
@@ -1488,6 +1493,216 @@ def bench_failover():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_model_zoo():
+    """Multi-tenant model zoo on a bounded HBM budget (serve/zoo.py):
+    tenant-count x working-set sweep under a budget that fits only TWO
+    of the three tenants, so residency churns.
+
+    GATED: (1) every tenant's routed scores are BYTE-identical to a
+    single-tenant registry serving the same set; (2) the budget
+    ledger's peak occupancy stays <= budget at every sample — including
+    through a streamed shadow stage + promote on the near-full budget;
+    (3) the warm tenant's p99 stays within 1.10x of the single-tenant
+    baseline (interleaved best-of-reps, the tracing_overhead idiom).
+    Warm vs cold p50/p99 and the eviction rate are the reported
+    working-set numbers."""
+    import shutil
+    import tempfile
+    import threading
+
+    from shifu_tpu import obs
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+    from shifu_tpu.serve.registry import ModelRegistry
+    from shifu_tpu.serve.server import Scorer
+    from shifu_tpu.serve.zoo import ModelZoo
+
+    spec = MODEL_ZOO
+    cols = [f"c{i}" for i in range(spec["cols"])]
+    tmp = tempfile.mkdtemp(prefix="bench-zoo-")
+    rng = np.random.default_rng(0)
+
+    def build_set(name, hidden, seed):
+        d = os.path.join(tmp, name, "models")
+        os.makedirs(d)
+        sizes = [spec["cols"], hidden, 1]
+        for b in range(spec["bags"]):
+            norm_specs = [
+                {"name": c, "kind": "value", "outNames": [c],
+                 "mean": float(rng.normal()), "std": 1.0, "fill": 0.0,
+                 "zscore": True}
+                for c in cols
+            ]
+            NNModelSpec(
+                layer_sizes=sizes, activations=["tanh"],
+                input_columns=cols, norm_specs=norm_specs,
+                params=init_params(sizes, seed=seed + b),
+            ).save(os.path.join(d, f"model{b}.nn"))
+        return d
+
+    def record(i):
+        return {c: f"{0.07 * (i % 11) - 0.3:.4f}" for c in cols}
+
+    def closed_loop(score_one, n_requests, conc):
+        lat = [[] for _ in range(conc)]
+        per = n_requests // conc
+
+        def run(ti):
+            for k in range(per):
+                t0 = time.perf_counter()
+                score_one(ti * per + k)
+                lat[ti].append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=run, args=(ti,))
+                   for ti in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        flat = np.asarray([v for ts in lat for v in ts])
+        return (float(np.percentile(flat, 50)) * 1e3,
+                float(np.percentile(flat, 99)) * 1e3)
+
+    try:
+        tenants = {}
+        for name, hidden, seed in (("t0", spec["hiddens"][0], 0),
+                                   ("t1", spec["hiddens"][1], 100),
+                                   ("t2", spec["hiddens"][2], 200)):
+            tenants[name] = build_set(name, hidden, seed)
+        # reference scores + measured per-set cost from single-tenant
+        # registries (the bench's own memory_analysis read)
+        parity_recs = [record(i) for i in range(16)]
+        reference = {}
+        costs = {}
+        for name, mdir in tenants.items():
+            reg = ModelRegistry(mdir)
+            # the buckets live single-record traffic actually compiles
+            # (16-record parity batch -> 16; coalesced singles -> 8),
+            # so the bench-measured cost matches what the zoo charges
+            reg.warm([1, 8, 16])
+            reference[name] = reg.score_records(parity_recs)
+            costs[name] = reg.memory_analysis()["residentBytes"]
+            reg.release()
+        # budget: the two SMALLEST working sets fit, all three do not —
+        # residency must churn when the sweep touches every tenant
+        by_cost = sorted(costs.values())
+        budget_bytes = int(by_cost[0] + by_cost[1] + 0.5 * by_cost[2])
+        budget_mb = budget_bytes / (1024.0 * 1024.0)
+        zoo = ModelZoo(tmp, n_replicas=1, budget_mb=budget_mb)
+        for name, mdir in tenants.items():
+            zoo.register(name, os.path.dirname(mdir))
+        # ---- parity gate: routed zoo scores == single-tenant scores
+        parity = True
+        for name in tenants:
+            zoo.ensure_resident(name)  # LRU-evicts as needed
+            res = zoo.score_batch(name, parity_recs)
+            parity &= bool(
+                np.array_equal(res.model_scores,
+                               reference[name].model_scores)
+                and np.array_equal(res.mean, reference[name].mean))
+        # ---- warm p99 vs single-tenant baseline, interleaved reps
+        single_reg = ModelRegistry(tenants["t0"])
+        single = Scorer(single_reg)
+        single_reg.warm([1, 8])
+        zoo.ensure_resident("t0")
+        single_p99, zoo_p99 = [], []
+        single_p50, zoo_p50 = [], []
+        for _rep in range(spec["reps"]):
+            p50, p99 = closed_loop(
+                lambda i: single.score_batch([record(i)]),
+                spec["requests"], spec["concurrency"])
+            single_p50.append(p50)
+            single_p99.append(p99)
+            p50, p99 = closed_loop(
+                lambda i: zoo.score_batch("t0", [record(i)]),
+                spec["requests"], spec["concurrency"])
+            zoo_p50.append(p50)
+            zoo_p99.append(p99)
+        single.close()
+        warm_ratio = min(zoo_p99) / max(min(single_p99), 1e-9)
+        # ---- churn sweep: touch every tenant round-robin so the
+        # working set exceeds the budget and evictions happen; cold
+        # admissions are timed (the re-admission p99 the ROADMAP asks
+        # for), warm scores separately
+        cold_s = []
+        warm_ms = []
+        ledger_samples = []
+        c0 = obs.registry().snapshot()["counters"]
+        evict_before = sum(v for k, v in c0.items()
+                           if k.startswith("serve.zoo.evictions"))
+        order = ["t0", "t1", "t2", "t1", "t2", "t0", "t2", "t0", "t1"]
+        for i, name in enumerate(order):
+            if zoo._get(name).state != "resident":
+                t0 = time.perf_counter()
+                zoo.ensure_resident(name)
+                cold_s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            zoo.score_batch(name, [record(i)])
+            warm_ms.append((time.perf_counter() - t0) * 1e3)
+            ledger_samples.append(zoo.ledger.used)
+        c1 = obs.registry().snapshot()["counters"]
+        evictions = sum(v for k, v in c1.items()
+                        if k.startswith("serve.zoo.evictions")) \
+            - evict_before
+        # ---- streamed shadow stage + promote on the near-full budget
+        zoo.ensure_resident("t0")
+        staged = zoo.stage("t0", tenants["t1"])
+        ledger_samples.append(zoo.ledger.used)
+        swap = zoo.promote("t0", expected_sha=staged["sha"])
+        ledger_samples.append(zoo.ledger.used)
+        peak = zoo.ledger.peak
+        zoo.close()
+        gates = {
+            "parity_bit_identical": parity,
+            "peak_ledgered_le_budget": bool(
+                peak <= budget_bytes
+                and max(ledger_samples) <= budget_bytes),
+            "warm_p99_within_1_10x": bool(warm_ratio <= 1.10),
+        }
+        out = {
+            "tenants": {
+                name: {"hidden": h,
+                       "workingSetBytes": costs[name]}
+                for (name, h) in zip(("t0", "t1", "t2"),
+                                     spec["hiddens"])
+            },
+            "budget_bytes": budget_bytes,
+            "sum_working_sets_bytes": int(sum(costs.values())),
+            "peak_ledgered_bytes": int(peak),
+            "evictions": int(evictions),
+            "eviction_rate": round(evictions / len(order), 3),
+            "warm_p50_ms": round(min(zoo_p50), 3),
+            "warm_p99_ms": round(min(zoo_p99), 3),
+            "single_tenant_p50_ms": round(min(single_p50), 3),
+            "single_tenant_p99_ms": round(min(single_p99), 3),
+            "warm_p99_ratio": round(warm_ratio, 3),
+            "cold_admissions": len(cold_s),
+            "cold_admission_p50_ms": (round(
+                float(np.percentile(cold_s, 50)) * 1e3, 1)
+                if cold_s else None),
+            "cold_admission_p99_ms": (round(
+                float(np.percentile(cold_s, 99)) * 1e3, 1)
+                if cold_s else None),
+            "promote": {"from": swap["from"], "to": swap["to"]},
+            "gates": gates,
+            "note": ("3 tenants (working-set sweep via hidden width) "
+                     "under a budget fitting only 2: routed scores "
+                     "byte-identical to single-tenant serving per set, "
+                     "peak LEDGERED residency <= budget at every "
+                     "sample incl. the streamed shadow stage + "
+                     "promote, warm p99 within 1.10x single-tenant "
+                     "(interleaved best-of-reps), cold p50/p99 = "
+                     "admission (rebuild+warm) on re-admission, "
+                     "eviction rate over the churn sweep"),
+        }
+        if not all(gates.values()):
+            raise RuntimeError(
+                f"model_zoo gates failed: {gates} "
+                f"{json.dumps({k: v for k, v in out.items() if k != 'note'})}")
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_serve_latency():
     """Online scoring (shifu_tpu/serve/): p50/p99 single-record latency +
     QPS at several closed-loop concurrency levels, through the full
@@ -2105,6 +2320,7 @@ def main() -> None:
     sharded_stats = bench_sharded_stats()
     serve_fleet = bench_serve_fleet()
     failover = _with_obs_metrics(bench_failover, "failover")
+    model_zoo = _with_obs_metrics(bench_model_zoo, "model_zoo")
     serve_latency = _with_obs_metrics(
         bench_serve_latency, "serve_latency", transfer_clean=True)
     ro = serve_latency.get("race_overhead") or {}
@@ -2199,6 +2415,7 @@ def main() -> None:
                      "identical chunk stream (results bit-identical)"),
         },
         "sharded_stats": sharded_stats,
+        "model_zoo": model_zoo,
         "serve_latency": {
             **{k: v for k, v in serve_latency.items()
                if k.startswith("concurrency_") or k == "registry"},

@@ -161,6 +161,15 @@ KNOBS: List[Knob] = [
        "supervisor restart budget before the replica drains"),
     _K("shifu.serve.deadlineMs", "float", "30000",
        "per-request admission-to-dispatch budget (0 disables)"),
+    # ---- multi-tenant model zoo (PR 15) ----
+    _K("shifu.serve.hbmBudgetMB", "float", "0 (= unbounded)",
+       "model-zoo HBM budget: total device bytes the ledger admits "
+       "tenants against (weights + compiled-program temps per warm "
+       "bucket, from memory_analysis); admission past it evicts cold "
+       "tenants LRU"),
+    _K("shifu.serve.zoo.warmupMs", "float", "5000",
+       "cold-tenant Retry-After fallback before any admission has been "
+       "observed (after one, the observed warm-up time drives the hint)"),
     _K("shifu.serve.sloMs", "float", "0 (= off)",
        "request-latency SLO threshold in ms: arms serve.slo.good/bad "
        "counters + the burn-rate gauge wired into /healthz reasons"),

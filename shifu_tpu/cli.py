@@ -94,6 +94,12 @@ def build_parser() -> argparse.ArgumentParser:
                            dest="append_trees",
                            help="GBT: trees appended on the new chunks "
                                 "(default -Dshifu.loop.appendTrees=10)")
+    p_retrain.add_argument("--traffic-stream", default=None,
+                           dest="traffic_stream", metavar="SET",
+                           help="retrain from ONE model-zoo tenant's "
+                                "traffic stream "
+                                "(.shifu/runs/traffic/<SET>/ — zoo "
+                                "servers log per set)")
     p_retrain.add_argument("--resume", action="store_true",
                            help=_RESUME_HELP)
 
@@ -114,6 +120,11 @@ def build_parser() -> argparse.ArgumentParser:
                            help="with --serve-url: stage the candidate "
                                 "as the shadow first (then gates "
                                 "evaluate on its live shadow stats)")
+    p_promote.add_argument("--set", default=None, dest="set_name",
+                           metavar="NAME",
+                           help="with --serve-url against a model-zoo "
+                                "server: the tenant to stage/promote "
+                                "(default: the zoo's default set)")
     p_promote.add_argument("--agree-min", type=float, default=None,
                            dest="agree_min",
                            help="min shadow agreement rate (default "
@@ -245,6 +256,16 @@ def build_parser() -> argparse.ArgumentParser:
                               "retrain`; optional sample fraction "
                               "(default 1.0; same as "
                               "-Dshifu.loop.logSample)")
+    p_serve.add_argument("--zoo", action="append", default=None,
+                         metavar="NAME=PATH[,NAME=PATH...]",
+                         help="multi-tenant model zoo: serve N model "
+                              "sets behind per-set POST /score/<set> "
+                              "routes on one HBM budget "
+                              "(-Dshifu.serve.hbmBudgetMB; cold sets "
+                              "admit on demand, LRU-evicting past the "
+                              "budget). Repeatable or comma-separated; "
+                              "each PATH is a model-set root or models "
+                              "dir")
 
     p_trace = sub.add_parser(
         "trace", help="inspect captured request traces "
@@ -412,6 +433,7 @@ def dispatch(args: argparse.Namespace) -> int:
             from_traffic=args.from_traffic, data_path=args.data_path,
             candidate_dir=args.candidate_dir,
             append_trees=args.append_trees,
+            traffic_stream=args.traffic_stream or "",
         ).run()
     if cmd == "promote":
         from shifu_tpu.loop.promote import run_promote
@@ -424,7 +446,7 @@ def dispatch(args: argparse.Namespace) -> int:
             ".", candidate, serve_url=args.serve_url,
             agree_min=args.agree_min, min_rows=args.min_rows,
             require_drift=not args.no_drift_gate, force=args.force,
-            stage_first=args.stage,
+            stage_first=args.stage, set_name=args.set_name,
         )
     if cmd == "posttrain":
         from shifu_tpu.processor.posttrain import PostTrainProcessor
@@ -506,13 +528,40 @@ def dispatch(args: argparse.Namespace) -> int:
             san = sanitize.from_environment()
             sizes = ([int(s) for s in args.warm.split(",") if s.strip()]
                      if args.warm else [])
+            zoo_spec = None
+            if args.zoo:
+                # --zoo name=path[,name=path...] (repeatable): parse
+                # BEFORE binding the port, ordered — the first set is
+                # the default /score route
+                zoo_spec = {}
+                for chunk in args.zoo:
+                    for item in chunk.split(","):
+                        item = item.strip()
+                        if not item:
+                            continue
+                        name, sep, set_path = item.partition("=")
+                        if not sep or not name or not set_path:
+                            raise ValueError(
+                                f"--zoo entry {item!r} must be "
+                                "NAME=PATH")
+                        if name in zoo_spec:
+                            # silent last-wins would serve the wrong
+                            # set under the duplicated name
+                            raise ValueError(
+                                f"--zoo tenant {name!r} given twice")
+                        zoo_spec[name] = set_path
             server = ScoringServer(
                 root=".", models_dir=args.models_dir, host=args.host,
                 port=args.port, queue_depth=args.queue_depth,
                 max_batch_rows=args.max_batch_rows,
                 max_wait_ms=args.max_wait_ms,
-                replicas=args.replicas, batching=args.batching)
-        except (ValueError, OSError) as e:  # bad --warm / no models / port in use
+                replicas=args.replicas, batching=args.batching,
+                zoo=zoo_spec)
+        except (ValueError, OSError, RuntimeError, ShifuError) as e:
+            # bad --warm/--zoo / no models / over-budget tenant (incl.
+            # a default tenant whose ADMISSION overflows the budget —
+            # LedgerFullError is a RuntimeError) / port in use: fail
+            # the clean way, before "listening"
             log.error("serve: %s", e)
             return 1
         if sizes:

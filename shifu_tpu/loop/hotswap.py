@@ -55,11 +55,15 @@ SCORE_DELTA_BUCKETS = tuple(0.25 * 2 ** k for k in range(14)) + (
 
 
 class ShadowStats:
-    """Agreement accounting for one staged candidate."""
+    """Agreement accounting for one staged candidate. `labels`
+    (replica=, and tenant= in a zoo) ride the delta histogram and the
+    error counter so per-tenant shadow evidence stays separable."""
 
-    def __init__(self, tolerance: Optional[float] = None) -> None:
+    def __init__(self, tolerance: Optional[float] = None,
+                 labels: Optional[dict] = None) -> None:
         self.tolerance = (shadow_tolerance_setting() if tolerance is None
                           else float(tolerance))
+        self.labels = dict(labels or {})
         self._lock = tracked_lock("loop.hotswap.shadow_stats")
         self.batches = 0
         self.rows = 0
@@ -78,7 +82,8 @@ class ShadowStats:
         # (searchsorted would otherwise index past the last bucket)
         d = np.where(np.isfinite(d), d, np.inf)
         hist = registry().histogram("serve.shadow.score_delta",
-                                    buckets=SCORE_DELTA_BUCKETS)
+                                    buckets=SCORE_DELTA_BUCKETS,
+                                    **self.labels)
         if d.size:
             # one vectorized binning + one locked merge — this runs per
             # sampled batch on the single batch-resolution thread, where
@@ -98,7 +103,7 @@ class ShadowStats:
     def note_error(self) -> None:
         from shifu_tpu.obs import registry
 
-        registry().counter("serve.shadow.errors").inc()
+        registry().counter("serve.shadow.errors", **self.labels).inc()
         with self._lock:
             self.errors += 1
 
@@ -186,8 +191,41 @@ class SwappableRegistry:
         return self._active.model_names
 
     @property
+    def active_models_dir(self) -> str:
+        """Dir of the version currently serving — what an evicting zoo
+        must remember so re-admission rebuilds the PROMOTED version, not
+        the originally registered one."""
+        return self._active.models_dir
+
+    @property
     def fused(self) -> bool:
         return self._active.fused
+
+    def memory_analysis(self) -> dict:
+        """Active + staged-shadow resident cost (registry
+        memory_analysis, the zoo ledger's per-replica read)."""
+        with self._lock:  # paired read, like observe()
+            active, shadow = self._active, self._shadow
+        out = {"active": active.memory_analysis()}
+        total = out["active"]["residentBytes"]
+        if shadow is not None:
+            out["shadow"] = shadow.memory_analysis()
+            total += out["shadow"]["residentBytes"]
+        out["residentBytes"] = total
+        return out
+
+    def release(self) -> int:
+        """Eviction: release active AND any staged shadow (profiler
+        cache refs dropped, further scores refused). The owning fleet is
+        already drained when the zoo calls this."""
+        with self._lock:
+            active, shadow = self._active, self._shadow
+            self._shadow = None
+            self._shadow_stats = None
+        n = active.release()
+        if shadow is not None:
+            n += shadow.release()
+        return n
 
     @property
     def input_columns(self) -> List[str]:
@@ -204,15 +242,26 @@ class SwappableRegistry:
 
     # ---- shadow lifecycle ----
     def stage(self, models_dir: str, column_configs=None,
-              model_config=None, drift=None) -> dict:
+              model_config=None, drift=None, put_hook=None) -> dict:
         """Load + warm a candidate as the shadow; replaces any previously
-        staged candidate. Returns the shadow summary."""
+        staged candidate. Returns the shadow summary.
+
+        `put_hook(nbytes)` (serve/zoo.py) makes the stage STREAMED: the
+        candidate's weights land layer-group by layer-group, each group
+        ledger-acquired before its device_put — so staging on a
+        near-full HBM budget evicts cold tenants per group instead of
+        OOMing on a full second registry."""
         from shifu_tpu.obs import registry as obs_registry
 
         cand = ModelRegistry(models_dir, column_configs=column_configs,
                              model_config=model_config, drift=drift,
                              device=getattr(self._active, "device", None),
-                             labels=getattr(self._active, "labels", None))
+                             labels=getattr(self._active, "labels", None),
+                             put_hook=put_hook)
+        # the candidate inherits the active's residency-repricing seam:
+        # a bucket first compiled by shadow traffic must be accounted
+        # exactly like one compiled by live traffic
+        cand.cost_hook = getattr(self._active, "cost_hook", None)
         # staged: shadow scoring must not double-count drift rows the
         # active fold already saw; promotion flips the fold live
         cand.drift_live = False
@@ -229,10 +278,17 @@ class SwappableRegistry:
         if cand.fused and warmed:
             cand.warm(warmed)
         with self._lock:
-            self._shadow = cand
-            self._shadow_stats = ShadowStats()
+            prev, self._shadow = self._shadow, cand
+            self._shadow_stats = ShadowStats(labels=self.labels)
             self._shadow_tick = 0
-        obs_registry().counter("serve.swap.staged", sha=cand.sha).inc()
+        if prev is not None:
+            # a REPLACED candidate must free like an unstaged one: its
+            # profiler cost-cache refs would otherwise pin its compiled
+            # programs + device weights while every ledger sees only
+            # the new candidate's bytes
+            prev.release(refuse=False)
+        obs_registry().counter("serve.swap.staged", sha=cand.sha,
+                               **self.labels).inc()
         log.info("staged shadow model set %s from %s (warmed buckets %s)",
                  cand.sha, models_dir, warmed)
         return self.shadow_snapshot()
@@ -247,8 +303,13 @@ class SwappableRegistry:
         if shadow is not None:
             from shifu_tpu.obs import registry as obs_registry
 
+            # drop the profiler's strong refs so the unstaged weights
+            # and compiled programs actually free (refuse=False: a
+            # shadow score racing the unstage just errors into the
+            # observer's containment, or pays one fresh compile)
+            shadow.release(refuse=False)
             obs_registry().counter("serve.swap.unstaged",
-                                   sha=shadow.sha).inc()
+                                   sha=shadow.sha, **self.labels).inc()
             log.info("unstaged shadow model set %s (rolled back to "
                      "active %s)", shadow.sha, self._active.sha)
 
@@ -285,8 +346,8 @@ class SwappableRegistry:
         from shifu_tpu.obs import registry as obs_registry
 
         reg = obs_registry()
-        reg.counter("serve.shadow.batches").inc()
-        reg.counter("serve.shadow.records").inc(data.n_rows)
+        reg.counter("serve.shadow.batches", **self.labels).inc()
+        reg.counter("serve.shadow.records", **self.labels).inc(data.n_rows)
         stats.note(np.asarray(shadow_res.mean)
                    - np.asarray(result.mean))
 
@@ -326,8 +387,15 @@ class SwappableRegistry:
             self.swaps += 1
             new.drift_live = True
             old.drift_live = False
+        # the OLD version's compiled programs + device weights must not
+        # outlive the swap in the profiler's cost cache (the PR-9 residue:
+        # a promote used to leave residency doubled until cache churn).
+        # refuse=False: an in-flight batch that read the old active at
+        # the swap point finishes on it legally.
+        old.release(refuse=False)
         obs_registry().counter("serve.swap.promotions",
-                               from_sha=old.sha, to_sha=new.sha).inc()
+                               from_sha=old.sha, to_sha=new.sha,
+                               **self.labels).inc()
         log.info("promoted model set %s -> %s (swap #%d)", old.sha,
                  new.sha, self.swaps)
         return {"from": old.sha, "to": new.sha, "swaps": self.swaps,

@@ -346,7 +346,8 @@ def run_promote(root: str, candidate_dir: Optional[str],
                 min_rows: Optional[int] = None,
                 require_drift: bool = True,
                 force: bool = False,
-                stage_first: bool = False) -> int:
+                stage_first: bool = False,
+                set_name: Optional[str] = None) -> int:
     """The `shifu promote` entry point. Returns the process exit code:
     0 promoted, 1 held by a gate, 2 operational error."""
     import sys
@@ -358,6 +359,13 @@ def run_promote(root: str, candidate_dir: Optional[str],
     t0 = time.time()
     shadow = None
     active_sha = None
+    if set_name and not serve_url:
+        # a zoo tenant only exists inside a serve process: the offline
+        # and fleet-round paths swap the root's models/ dir, which has
+        # no per-set meaning
+        log.error("promote: --set %s needs --serve-url (model-zoo "
+                  "tenants live in a serving process)", set_name)
+        return 2
     peers = live_peers(root)
     if serve_url and len(peers) > 1:
         # promoting ONE process of a multi-process fleet through its
@@ -373,9 +381,14 @@ def run_promote(root: str, candidate_dir: Optional[str],
         if serve_url:
             serve_url = serve_url.rstrip("/")
             if stage_first and candidate_dir:
-                _http_json(f"{serve_url}/admin/stage",
-                           {"modelsDir": os.path.abspath(candidate_dir)})
-            resp = _http_json(f"{serve_url}/admin/shadow")
+                stage_doc = {"modelsDir": os.path.abspath(candidate_dir)}
+                if set_name:
+                    stage_doc["set"] = set_name
+                _http_json(f"{serve_url}/admin/stage", stage_doc)
+            shadow_url = f"{serve_url}/admin/shadow"
+            if set_name:
+                shadow_url += f"?set={set_name}"
+            resp = _http_json(shadow_url)
             shadow = resp.get("shadow")
             active_sha = resp.get("active")
         else:
@@ -455,8 +468,11 @@ def run_promote(root: str, candidate_dir: Optional[str],
                     # bind the swap to the sha the gates evaluated: a
                     # re-staged shadow between the gate read and this POST
                     # is refused server-side (409), never rolled out blind
+                    promote_doc = {"sha": (shadow or {}).get("sha")}
+                    if set_name:
+                        promote_doc["set"] = set_name
                     swap = _http_json(f"{serve_url}/admin/promote",
-                                      {"sha": (shadow or {}).get("sha")})
+                                      promote_doc)
                 else:
                     if not candidate_dir:
                         raise ValueError(
@@ -484,6 +500,7 @@ def run_promote(root: str, candidate_dir: Optional[str],
             argv=list(sys.argv), registry=obs.registry(),
             error=error,
             extra={"promote": {"mode": mode,
+                               "set": set_name,
                                "candidateDir": candidate_dir,
                                "decision": decision,
                                "lineage": lineage,

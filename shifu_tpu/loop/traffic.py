@@ -65,18 +65,23 @@ META_COLUMNS = (SCORE_COLUMN, SHA_COLUMN, TRACE_COLUMN, TS_COLUMN)
 _CHUNK_RE = re.compile(r"^traffic-(\d+)\.psv$")
 
 
-def traffic_dir(root: str) -> str:
-    return os.path.join(os.path.abspath(root), TRAFFIC_SUBDIR)
+def traffic_dir(root: str, stream: str = "") -> str:
+    """Traffic-log dir; `stream` (a zoo tenant name) keeps each model
+    set's logged traffic a SEPARATE stream under the shared ledger —
+    per-tenant retrain must never mix another tenant's rows."""
+    base = os.path.join(os.path.abspath(root), TRAFFIC_SUBDIR)
+    return os.path.join(base, stream) if stream else base
 
 
 def traffic_columns(base_columns: List[str]) -> List[str]:
     return list(base_columns) + list(META_COLUMNS)
 
 
-def list_chunks(root: str) -> List[str]:
+def list_chunks(root: str, stream: str = "") -> List[str]:
     """Chunk files in sequence order (the append order)."""
     out = []
-    for path in glob.glob(os.path.join(traffic_dir(root), "traffic-*.psv")):
+    for path in glob.glob(os.path.join(traffic_dir(root, stream),
+                                       "traffic-*.psv")):
         m = _CHUNK_RE.match(os.path.basename(path))
         if m:
             out.append((int(m.group(1)), path))
@@ -98,9 +103,10 @@ class TrafficLog:
     def __init__(self, root: str, columns: List[str],
                  sample: Optional[float] = None,
                  chunk_rows: Optional[int] = None,
-                 seed: int = 0) -> None:
+                 seed: int = 0, stream: str = "") -> None:
         self.root = os.path.abspath(root)
-        self.dir = traffic_dir(root)
+        self.stream = stream
+        self.dir = traffic_dir(root, stream)
         self.columns = list(columns)
         self.sample = (log_sample_setting() if sample is None
                        else float(sample))
@@ -286,27 +292,29 @@ class TrafficLog:
             }
 
 
-def log_meta(root: str) -> Tuple[dict, List[str]]:
+def log_meta(root: str, stream: str = "") -> Tuple[dict, List[str]]:
     """(parsed _meta.json, chunk paths) of the traffic log under `root`'s
     ledger — THE validation for every consumer (traffic_source, `shifu
     retrain`), so the operator guidance stays in one place. Raises
     FileNotFoundError when nothing was ever logged or no chunk has
     rotated out yet."""
-    meta_path = os.path.join(traffic_dir(root), META_FILE)
+    meta_path = os.path.join(traffic_dir(root, stream), META_FILE)
     if not os.path.isfile(meta_path):
         raise FileNotFoundError(
-            f"no traffic log under {traffic_dir(root)} — serve with "
-            f"--traffic-log (or -Dshifu.loop.logSample>0) first")
+            f"no traffic log under {traffic_dir(root, stream)} — serve "
+            f"with --traffic-log (or -Dshifu.loop.logSample>0) first")
     with open(meta_path) as fh:
         meta = json.load(fh)
-    chunks = list_chunks(root)
+    chunks = list_chunks(root, stream)
     if not chunks:
         raise FileNotFoundError(
-            f"traffic log {traffic_dir(root)} has no chunk files yet")
+            f"traffic log {traffic_dir(root, stream)} has no chunk "
+            "files yet")
     return meta, chunks
 
 
-def trace_lineage(root: str, limit: int = 8) -> Optional[dict]:
+def trace_lineage(root: str, limit: int = 8,
+                  stream: str = "") -> Optional[dict]:
     """Serve -> train lineage evidence from the traffic log: how many
     logged rows carry a request-trace id (obs/reqtrace.py) and a sample
     of the ids, so retrain/promote manifests can point back at the
@@ -314,7 +322,7 @@ def trace_lineage(root: str, limit: int = 8) -> Optional[dict]:
     chunk files are small and this runs once per retrain, not on any
     hot path. None when the log has no trace column (pre-trace logs)."""
     try:
-        meta, chunks = log_meta(root)
+        meta, chunks = log_meta(root, stream)
     except FileNotFoundError:
         return None
     columns = list(meta.get("columns", []))
@@ -353,17 +361,18 @@ def trace_lineage(root: str, limit: int = 8) -> Optional[dict]:
 
 def traffic_source(root: str, chunk_rows: Optional[int] = None,
                    columns: Optional[List[str]] = None,
-                   missing_values=None) -> Tuple[object, List[str]]:
+                   missing_values=None,
+                   stream: str = "") -> Tuple[object, List[str]]:
     """(chunk_source factory, column names) over the logged traffic — the
     seam that makes the log just another input stream. Raises
     FileNotFoundError when nothing was ever logged."""
     from shifu_tpu.data.reader import DEFAULT_MISSING
     from shifu_tpu.data.stream import chunk_source
 
-    meta, _ = log_meta(root)
+    meta, _ = log_meta(root, stream)
     names = list(meta["columns"])
     factory = chunk_source(
-        os.path.join(traffic_dir(root), "traffic-*.psv"),
+        os.path.join(traffic_dir(root, stream), "traffic-*.psv"),
         names,
         delimiter=meta.get("delimiter", DELIMITER),
         missing_values=(tuple(missing_values) if missing_values

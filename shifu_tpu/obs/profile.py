@@ -391,6 +391,46 @@ def _cost_entry(name: str, fn: Callable, sig, args: tuple,
     return entry
 
 
+def release_fn(fn: Callable) -> int:
+    """Drop every cached cost entry built for `fn` and return how many
+    were dropped. The entries hold STRONG references to `fn` and its
+    compiled executables (see _CostEntry) — correct for live programs,
+    but a program being evicted (the serve zoo's LRU, a promoted-away
+    registry version) must actually free its device buffers, and this
+    cache would otherwise pin the closure'd weights until 512 other
+    programs churned it out."""
+    fid = id(fn)
+    with _cost_lock:
+        keys = [k for k in _cost_cache if k[1] == fid]
+        for k in keys:
+            del _cost_cache[k]
+    return len(keys)
+
+
+def fn_memory(name: str, fn: Callable) -> List[Dict[str, float]]:
+    """memory_analysis() numbers of every compiled signature cached for
+    seam `name` + program `fn`: one dict per signature (= per row bucket
+    for the serve registry) with argBytes, peakBytes (args+out+temps
+    −aliases) and tempOutBytes (peak − args: what the program adds to
+    residency beyond its inputs). The serve zoo's HBM budget ledger
+    prices a tenant's compiled-program residency from these."""
+    fid = id(fn)
+    with _cost_lock:
+        entries = [e for k, e in _cost_cache.items()
+                   if k[0] == name and k[1] == fid]
+    out = []
+    for e in entries:
+        if e.peak_hbm is None:
+            continue
+        arg = float(e.arg_bytes or 0.0)
+        out.append({
+            "argBytes": arg,
+            "peakBytes": float(e.peak_hbm),
+            "tempOutBytes": max(0.0, float(e.peak_hbm) - arg),
+        })
+    return out
+
+
 def dispatch(name: str, fn: Callable, *args, sync: bool = True,
              static_argnums: Tuple[int, ...] = (),
              static_argnames: Tuple[str, ...] = (), **kwargs):

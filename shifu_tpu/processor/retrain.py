@@ -109,7 +109,8 @@ class RetrainProcessor(BasicProcessor):
     def __init__(self, root: str = ".", from_traffic: bool = False,
                  data_path: Optional[str] = None,
                  candidate_dir: Optional[str] = None,
-                 append_trees: Optional[int] = None) -> None:
+                 append_trees: Optional[int] = None,
+                 traffic_stream: str = "") -> None:
         super().__init__(root)
         if from_traffic and data_path is not None:
             raise ShifuError(
@@ -118,6 +119,16 @@ class RetrainProcessor(BasicProcessor):
                 "run can stream ONE source; drop --from-traffic to "
                 "retrain on the explicit path")
         self.from_traffic = from_traffic
+        # model-zoo tenants log to per-set streams under
+        # traffic/<set>/ (loop/traffic.py `stream`); --traffic-stream
+        # selects one so per-tenant retrain never mixes another set's
+        # rows
+        self.traffic_stream = traffic_stream or ""
+        if self.traffic_stream and data_path is not None:
+            raise ShifuError(
+                ErrorCode.ILLEGAL_ARGUMENT,
+                "--traffic-stream retrains from the traffic log — it "
+                "cannot combine with --data")
         self.data_path = data_path
         self.candidate_dir = os.path.abspath(
             candidate_dir
@@ -132,8 +143,10 @@ class RetrainProcessor(BasicProcessor):
         from shifu_tpu.loop.traffic import META_FILE, log_meta, traffic_dir
 
         ds = mc.data_set
-        meta_path = os.path.join(traffic_dir(self.root), META_FILE)
-        use_traffic = self.from_traffic or (
+        stream = self.traffic_stream
+        meta_path = os.path.join(traffic_dir(self.root, stream),
+                                 META_FILE)
+        use_traffic = self.from_traffic or bool(stream) or (
             self.data_path is None and os.path.isfile(meta_path))
         if self.data_path is not None:
             ds.data_path = self.data_path
@@ -143,7 +156,7 @@ class RetrainProcessor(BasicProcessor):
             # points at (a new data drop in place)
             return "data", None, None
         try:
-            meta, chunks = log_meta(self.root)
+            meta, chunks = log_meta(self.root, stream)
         except FileNotFoundError as e:
             raise ShifuError(ErrorCode.DATA_NOT_FOUND, str(e))
         names = list(meta["columns"])
@@ -154,7 +167,7 @@ class RetrainProcessor(BasicProcessor):
                 f"traffic log carries no `{target}` column — retraining "
                 f"needs label-joined traffic (serve from the model-set "
                 f"root so the log keeps the target column)")
-        ds.data_path = os.path.join(traffic_dir(self.root),
+        ds.data_path = os.path.join(traffic_dir(self.root, stream),
                                     "traffic-*.psv")
         ds.data_delimiter = meta.get("delimiter", "|")
         ds.header_path = None
@@ -282,7 +295,8 @@ class RetrainProcessor(BasicProcessor):
             from shifu_tpu.loop.traffic import trace_lineage
 
             try:
-                lineage = trace_lineage(self.root)
+                lineage = trace_lineage(self.root,
+                                        stream=self.traffic_stream)
             except (OSError, ValueError) as e:
                 log.warning("retrain: cannot read trace lineage: %s", e)
         self.manifest_extra["retrain"] = {

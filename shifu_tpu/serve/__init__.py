@@ -17,6 +17,11 @@ JSONL front end plus an in-process Scorer API (server.py).
     ...
     server.shutdown()                     # drain + run-ledger manifest
 
+Multi-tenant: `shifu serve --zoo name=path,...` serves N model sets
+behind one server on a bounded HBM budget (zoo.py) — per-set
+`POST /score/<set>` routes, budget-accounted LRU residency, streamed
+shadow staging.
+
 Knobs (all `-Dk=v` properties; full catalog in docs/KNOBS.md):
     shifu.serve.replicas       scoring replicas (0 = all local devices)
     shifu.serve.batching       continuous | barrier (default continuous)
@@ -24,6 +29,8 @@ Knobs (all `-Dk=v` properties; full catalog in docs/KNOBS.md):
     shifu.serve.maxBatchRows   micro-batch row cap (default 1024)
     shifu.serve.maxWaitMs      barrier-mode coalesce deadline (ms)
     shifu.serve.routerPenalty  degraded-replica expected-wait multiplier
+    shifu.serve.hbmBudgetMB    model-zoo residency budget (0 = unbounded)
+    shifu.serve.zoo.warmupMs   cold-tenant Retry-After fallback
 """
 
 from shifu_tpu.serve.batcher import MicroBatcher, ScoreRequest
@@ -37,13 +44,17 @@ from shifu_tpu.serve.peers import PeerRegistry
 from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
 from shifu_tpu.serve.registry import ModelRegistry
 from shifu_tpu.serve.server import Scorer, ScoringServer
+from shifu_tpu.serve.zoo import ColdStartError, HbmLedger, ModelZoo
 
 __all__ = [
     "AdmissionQueue",
     "CircuitBreaker",
+    "ColdStartError",
     "DrainAwareRouter",
+    "HbmLedger",
     "MicroBatcher",
     "ModelRegistry",
+    "ModelZoo",
     "PeerRegistry",
     "RejectedError",
     "ReplicaFleet",

@@ -111,12 +111,18 @@ class ScoringReplica:
                  deadline_ms: Optional[float] = None,
                  batching: Optional[str] = None,
                  queue_depth: Optional[int] = None,
-                 observer: Optional[Callable] = None) -> None:
+                 observer: Optional[Callable] = None,
+                 labels: Optional[dict] = None) -> None:
         self.index = int(index)
         self.name = str(self.index)
         self.registry = registry
         self.device = getattr(registry, "device", None)
-        labels = {"replica": self.name}
+        # extra identity labels (the zoo passes {"tenant": "<set>"}) ride
+        # UNDER the replica label on every serve.* metric this replica's
+        # stack records — one /metrics page stays attributable per
+        # (tenant, replica) without a second exporter
+        labels = {**dict(labels or {}), "replica": self.name}
+        self.labels = labels
         self.admission = (AdmissionQueue(queue_depth, labels=labels)
                           if admission is None else admission)
         self.health = (HealthMonitor(labels=labels)
@@ -248,9 +254,9 @@ class DrainAwareRouter:
                     # drain-around (counted so routing-around-a-backlog
                     # is visible on /metrics)
                     reg.counter("serve.router.spill",
-                                replica=rep.name).inc()
+                                **rep.labels).inc()
                 continue
-            reg.counter("serve.router.routed", replica=rep.name).inc()
+            reg.counter("serve.router.routed", **rep.labels).inc()
             if trace is not None:
                 trace.annotate(replica=rep.name, spilled=bool(i))
             return req
@@ -271,7 +277,7 @@ class DrainAwareRouter:
             except RejectedError:
                 continue
             registry().counter("serve.failover.rerouted",
-                               replica=rep.name).inc()
+                               **rep.labels).inc()
             if req.trace is not None:
                 req.trace.annotate(failovers=req.failovers,
                                    replica=rep.name)
@@ -290,11 +296,17 @@ class ReplicaFleet:
     used to hold."""
 
     def __init__(self, replicas: Sequence[ScoringReplica],
-                 router: Optional[DrainAwareRouter] = None) -> None:
+                 router: Optional[DrainAwareRouter] = None,
+                 labels: Optional[dict] = None) -> None:
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         self.replicas = list(replicas)
         self.router = router or DrainAwareRouter(self.replicas)
+        # fleet-identity labels ({"tenant": "<set>"} in a model zoo):
+        # ride the fleet-LEVEL metrics (serve.replicas, the fleet
+        # Retry-After gauge, SLO counters, stage histograms) the same
+        # way each replica's labels ride its own
+        self.labels = dict(labels or {})
         # fleet-level health: sticky drift degrades and shutdown live
         # here; per-replica crash/restart state lives on each replica's
         # own monitor and aggregates in health_snapshot()
@@ -311,7 +323,7 @@ class ReplicaFleet:
         # request-latency SLO accounting (serve/health.py SloTracker):
         # armed by -Dshifu.serve.sloMs, read by /healthz and the
         # shutdown manifest; a no-op object when the knob is unset
-        self.slo = SloTracker()
+        self.slo = SloTracker(labels=self.labels)
         # per-(stage, replica) histogram cache: finish_trace runs once
         # per request, and seven registry get-or-create lookups (label
         # sort + registry lock each) per request are measurable GIL
@@ -332,7 +344,8 @@ class ReplicaFleet:
                 self._failover(_src, req, error))
         from shifu_tpu.obs import registry
 
-        registry().gauge("serve.replicas").set(len(self.replicas))
+        registry().gauge("serve.replicas",
+                         **self.labels).set(len(self.replicas))
 
     def _failover(self, src: ScoringReplica, req: ScoreRequest,
                   error: BaseException) -> None:
@@ -346,15 +359,15 @@ class ReplicaFleet:
         if req.failovers >= self.failover_max or len(self.replicas) < 2:
             if req.failovers:
                 reg.counter("serve.failover.exhausted",
-                            replica=src.name).inc()
+                            **src.labels).inc()
             req.fail(error)
             return
         req.failovers += 1
-        reg.counter("serve.failover.requests", replica=src.name).inc()
+        reg.counter("serve.failover.requests", **src.labels).inc()
         if not self.router.resubmit(req, exclude=src):
             # nothing else could take it (all quarantined/draining/full)
             reg.counter("serve.failover.exhausted",
-                        replica=src.name).inc()
+                        **src.labels).inc()
             req.fail(error)
 
     @contextmanager
@@ -382,34 +395,60 @@ class ReplicaFleet:
               max_restarts: Optional[int] = None,
               deadline_ms: Optional[float] = None,
               batching: Optional[str] = None,
-              observer: Optional[Callable] = None) -> "ReplicaFleet":
+              observer: Optional[Callable] = None,
+              tenant: Optional[str] = None,
+              put_hook=None, cost_hook=None) -> "ReplicaFleet":
         """One replica per device (replica i -> jax.devices()[i % ndev]),
         each loading the model set onto ITS device with its own compiled
         program cache. `n_replicas` falls back to -Dshifu.serve.replicas,
-        then to every local device."""
+        then to every local device. `tenant` labels every metric the
+        fleet's stack records (the zoo's per-set identity); `put_hook`
+        streams each replica's weight groups through the zoo's budget
+        ledger before they land on device."""
         import jax
 
         devices = jax.devices()
         n = n_replicas if n_replicas is not None else replicas_setting()
         n = int(n) if n and int(n) > 0 else len(devices)
+        extra = {"tenant": tenant} if tenant else {}
         replicas = []
-        for i in range(n):
-            dev = devices[i % len(devices)]
-            reg = ModelRegistry(
-                models_dir, scale=scale, column_configs=column_configs,
-                model_config=model_config, drift=drift, device=dev,
-                labels={"replica": str(i)})
-            from shifu_tpu.loop.hotswap import SwappableRegistry
+        try:
+            for i in range(n):
+                dev = devices[i % len(devices)]
+                reg = ModelRegistry(
+                    models_dir, scale=scale,
+                    column_configs=column_configs,
+                    model_config=model_config, drift=drift, device=dev,
+                    labels={**extra, "replica": str(i)},
+                    put_hook=put_hook)
+                reg.cost_hook = cost_hook
+                from shifu_tpu.loop.hotswap import SwappableRegistry
 
-            sw = SwappableRegistry(reg, labels={"replica": str(i)})
-            replicas.append(ScoringReplica(
-                sw, index=i, queue_depth=queue_depth,
-                max_batch_rows=max_batch_rows, max_wait_ms=max_wait_ms,
-                max_restarts=max_restarts, deadline_ms=deadline_ms,
-                batching=batching, observer=observer))
-        log.info("serving fleet: %d replica(s) over %d device(s)",
-                 n, min(n, len(devices)))
-        return cls(replicas)
+                sw = SwappableRegistry(reg, labels={**extra,
+                                                    "replica": str(i)})
+                replicas.append(ScoringReplica(
+                    sw, index=i, queue_depth=queue_depth,
+                    max_batch_rows=max_batch_rows,
+                    max_wait_ms=max_wait_ms,
+                    max_restarts=max_restarts, deadline_ms=deadline_ms,
+                    batching=batching, observer=observer, labels=extra))
+        except BaseException:
+            # a later replica's build failing (e.g. the zoo's budget
+            # acquire raising mid-stream) must not leak the earlier
+            # replicas' worker threads and device weights — the caller
+            # releases its ledger charge on this exception, so the
+            # bytes have to actually free
+            for rep in replicas:
+                rep.admission.close()
+                rep.batcher.join(1.0)
+                rel = getattr(rep.registry, "release", None)
+                if rel is not None:
+                    rel()
+            raise
+        log.info("serving fleet%s: %d replica(s) over %d device(s)",
+                 f" (tenant {tenant})" if tenant else "", n,
+                 min(n, len(devices)))
+        return cls(replicas, labels=extra)
 
     def __len__(self) -> int:
         return len(self.replicas)
@@ -450,7 +489,8 @@ class ReplicaFleet:
             if hist is None:
                 hist = reg.histogram("serve.stage_seconds",
                                      buckets=LATENCY_BUCKETS,
-                                     stage=stage, replica=replica)
+                                     stage=stage, replica=replica,
+                                     **self.labels)
                 self._stage_hists[(stage, replica)] = hist
             hist.observe(dur, exemplar=exemplar)
         # `status` is set only by the error paths (rejected/timeout/
@@ -563,7 +603,8 @@ class ReplicaFleet:
         else:
             hint = RETRY_AFTER_MIN_S  # no drain history: cheap optimism
         hint = min(max(hint, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
-        registry().gauge("serve.retry_after_seconds").set(hint)
+        registry().gauge("serve.retry_after_seconds",
+                         **self.labels).set(hint)
         return hint
 
     # ---- rollout: stage / shadow evidence / rolling promote ----
@@ -573,16 +614,19 @@ class ReplicaFleet:
         self.replicas[0].registry.observe(data, result)
 
     def stage(self, models_dir: str, column_configs=None,
-              model_config=None, drift=None) -> Optional[dict]:
+              model_config=None, drift=None,
+              put_hook=None) -> Optional[dict]:
         """Stage + warm the candidate as the shadow on EVERY replica
         (each loads it onto its own device and pre-compiles its live
         buckets). Returns the aggregated shadow snapshot. Refused while
-        another rollout operation (stage/promote) is in flight."""
+        another rollout operation (stage/promote) is in flight.
+        `put_hook` makes the stage streamed (zoo budget ledger — see
+        SwappableRegistry.stage)."""
         with self._control("stage"):
             staged = [rep.registry.stage(models_dir,
                                          column_configs=column_configs,
                                          model_config=model_config,
-                                         drift=drift)
+                                         drift=drift, put_hook=put_hook)
                       for rep in self.replicas]
             shas = {s["sha"] for s in staged}
             if len(shas) != 1:  # same dir: only a mid-stage redeploy
@@ -664,7 +708,7 @@ class ReplicaFleet:
                 step = {"replica": rep.name, **swap}
                 steps.append(step)
                 registry().counter("serve.swap.steps",
-                                   replica=rep.name).inc()
+                                   **rep.labels).inc()
                 if step_cb is not None:
                     try:
                         step_cb(rep, step)
@@ -677,6 +721,41 @@ class ReplicaFleet:
             return {"from": steps[0]["from"], "to": sha,
                     "replicas": len(steps), "steps": steps,
                     "shadow": shadow}
+
+    @property
+    def active_models_dir(self) -> str:
+        """Dir of the version currently serving (replica 0 canonical —
+        the pre-roll sha validation keeps replicas consistent)."""
+        reg = self.replicas[0].registry
+        return getattr(reg, "active_models_dir", None) or reg.models_dir
+
+    def memory_analysis(self) -> dict:
+        """Fleet resident cost: per-replica registry memory_analysis
+        summed — what the zoo's HBM budget ledger trues a tenant's
+        charge up to after admission/stage (each replica's weights and
+        compiled programs live on its own device, but the budget bounds
+        the DEPLOYMENT'S total)."""
+        per = []
+        total = 0
+        for rep in self.replicas:
+            ma = getattr(rep.registry, "memory_analysis", None)
+            if ma is None:
+                continue
+            m = ma()
+            per.append({"replica": rep.name, **m})
+            total += int(m.get("residentBytes", 0))
+        return {"replicas": per, "residentBytes": total}
+
+    def release(self) -> int:
+        """Eviction seam (zoo): release every replica's registries —
+        compiled-program cache entries and device weights drop together.
+        Call after close()."""
+        n = 0
+        for rep in self.replicas:
+            rel = getattr(rep.registry, "release", None)
+            if rel is not None:
+                n += rel()
+        return n
 
     def snapshot(self) -> dict:
         """Manifest/bench view: fleet summary + per-replica registry

@@ -108,11 +108,15 @@ class SloTracker:
 
     def __init__(self, slo_ms: Optional[float] = None,
                  target: Optional[float] = None,
-                 window_s: float = DEFAULT_SLO_WINDOW_S) -> None:
+                 window_s: float = DEFAULT_SLO_WINDOW_S,
+                 labels: Optional[dict] = None) -> None:
         self.slo_ms = slo_ms_setting() if slo_ms is None else float(slo_ms)
         target = slo_target_setting() if target is None else float(target)
         self.target = min(max(target, 0.0), 0.9999)
         self.window_s = float(window_s)
+        # fleet-identity labels ({"tenant": ...} in a zoo): per-tenant
+        # SLO series stay separable on one /metrics page
+        self.labels = dict(labels or {})
         self._lock = tracked_lock("serve.slo")
         self._events: deque = deque(maxlen=SLO_WINDOW_EVENTS)
         self._good = 0
@@ -139,7 +143,8 @@ class SloTracker:
                 self._good += 1
             else:
                 self._bad += 1
-        registry().counter("serve.slo.good" if ok else "serve.slo.bad").inc()
+        registry().counter("serve.slo.good" if ok else "serve.slo.bad",
+                           **self.labels).inc()
 
     def burn_rate(self, now: Optional[float] = None) -> float:
         """Bad fraction over the rolling window / (1 - target); exported
@@ -158,7 +163,7 @@ class SloTracker:
         else:
             bad = sum(1 for ok in recent if not ok)
             rate = (bad / len(recent)) / max(1e-9, 1.0 - self.target)
-        registry().gauge("serve.slo.burn_rate").set(rate)
+        registry().gauge("serve.slo.burn_rate", **self.labels).set(rate)
         return rate
 
     def snapshot(self) -> dict:

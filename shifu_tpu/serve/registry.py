@@ -153,16 +153,58 @@ class _PlanFeaturizer:
         return out
 
 
-def _build_plan_device_consts(plan, device=None):
+def _ndarray_nbytes(obj) -> int:
+    """Total numpy-array bytes reachable under `obj` (lists/tuples/dicts
+    walked; everything else ignored) — the host-side weight-size count
+    the zoo's budget ledger charges before anything touches the device."""
+    if isinstance(obj, np.ndarray):
+        return int(obj.nbytes)
+    if isinstance(obj, dict):
+        return sum(_ndarray_nbytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_ndarray_nbytes(v) for v in obj)
+    return 0
+
+
+def _spec_weight_bytes(spec) -> int:
+    """One spec's host weight bytes: its params tree, or — for non-NN
+    specs (trees, adapters) without one — every array reachable on the
+    spec. ONE definition for the registration-time estimate AND the
+    resident charge, so the ledger's admission math can't diverge."""
+    n = _ndarray_nbytes(getattr(spec, "params", None))
+    if not n and hasattr(spec, "__dict__"):
+        n = _ndarray_nbytes(vars(spec))
+    return n
+
+
+def estimate_weights_bytes(models_dir: str, column_configs=None,
+                           model_config=None) -> int:
+    """Host-only weight-byte estimate of a model set (specs loaded, no
+    device work): what `serve/zoo.py` prices a tenant at registration
+    time, before admission decides whether it can ever be resident."""
+    paths = find_model_paths(models_dir)
+    if not paths:
+        raise ValueError(f"no models under {models_dir}")
+    return sum(_spec_weight_bytes(load_model(p, column_configs,
+                                             model_config))
+               for p in paths)
+
+
+def _build_plan_device_consts(plan, device=None, put_hook=None):
     """Static per-plan tensors the fused program closes over, pre-staged
     as jnp arrays so no constant crosses the host->device boundary at
     call time. `device` pins them to one replica's device (None keeps
-    default placement)."""
+    default placement). `put_hook(nbytes)` fires before each device_put
+    — the zoo's budget ledger acquires each group's bytes there, so
+    staging can never overshoot the budget between two puts."""
     import jax
     import jax.numpy as jnp
 
     def put(a, dtype):
-        return jax.device_put(np.asarray(a, dtype), device)
+        arr = np.asarray(a, dtype)
+        if put_hook is not None:
+            put_hook(int(arr.nbytes))
+        return jax.device_put(arr, device)
 
     value_specs = [s for s in plan.specs if s.kind == "value"]
     table_specs = [s for s in plan.specs if s.kind == "table"]
@@ -239,7 +281,8 @@ class ModelRegistry:
                  scale: float = DEFAULT_SCORE_SCALE,
                  column_configs=None, model_config=None,
                  drift=None, device=None,
-                 labels: Optional[dict] = None) -> None:
+                 labels: Optional[dict] = None,
+                 put_hook=None) -> None:
         self.models_dir = models_dir
         self.paths = find_model_paths(models_dir)
         if not self.paths:
@@ -250,6 +293,18 @@ class ModelRegistry:
         # per-batch device_put) targets this device; None = default
         self.device = device
         self.labels = dict(labels or {})
+        # streamed staging seam (serve/zoo.py): fires with each weight
+        # group's byte count BEFORE that group is device_put, so an HBM
+        # budget ledger admits the set layer-group by layer-group instead
+        # of discovering a full second registry after the fact
+        self._put_hook = put_hook
+        # residency-repricing seam: fires (no args) after a score that
+        # compiled a NEW row bucket — the zoo re-reads memory_analysis()
+        # and trues the tenant's ledger charge up, so buckets first seen
+        # by live traffic (not warm()) still end up accounted
+        self.cost_hook = None
+        self.weights_bytes = 0
+        self._released = False
         self.model_names = [os.path.basename(p) for p in self.paths]
         self.specs = [load_model(p, column_configs, model_config)
                       for p in self.paths]
@@ -270,6 +325,12 @@ class ModelRegistry:
         else:
             # mixed/tree/WDL/reference sets: still served, via the offline
             # scorer's per-model dispatch (one ModelRunner, loaded once)
+            self.weights_bytes = sum(_spec_weight_bytes(s)
+                                     for s in self.specs)
+            if self._put_hook is not None:
+                # fallback sets load in one piece (host-resident runner):
+                # the ledger still sees the whole cost, just not streamed
+                self._put_hook(self.weights_bytes)
             self._runner = ModelRunner(
                 self.paths, scale=scale, column_configs=column_configs,
                 model_config=model_config)
@@ -316,11 +377,27 @@ class ModelRegistry:
                 self._featurizers.append(_PlanFeaturizer(plan))
             self._model_plan_idx.append(plan_keys.index(key))
 
-        consts = [_build_plan_device_consts(p, self.device)
+        def put_group(arr):
+            """One weight group's device_put, ledger-visible: the hook
+            (zoo budget acquire) runs BEFORE the bytes land on device,
+            so at no instant does device residency exceed what the
+            ledger already accounts for."""
+            arr = np.asarray(arr)
+            self.weights_bytes += int(arr.nbytes)
+            if self._put_hook is not None:
+                self._put_hook(int(arr.nbytes))
+            return jax.device_put(arr, self.device)
+
+        def count_const(nbytes):
+            self.weights_bytes += int(nbytes)
+            if self._put_hook is not None:
+                self._put_hook(int(nbytes))
+
+        consts = [_build_plan_device_consts(p, self.device,
+                                            put_hook=count_const)
                   for p in self._plans]
         params = [
-            [{"W": jax.device_put(np.asarray(layer["W"]), self.device),
-              "b": jax.device_put(np.asarray(layer["b"]), self.device)}
+            [{"W": put_group(layer["W"]), "b": put_group(layer["b"])}
              for layer in spec.params]
             for spec in self.specs
         ]
@@ -338,8 +415,10 @@ class ModelRegistry:
             # the monitor is fleet-shared; ITS constants must live on
             # THIS replica's device or the fused dispatch would mix
             # committed devices
-            drift_consts = jax.device_put(drift.device_consts(),
-                                          self.device)
+            host_consts = drift.device_consts()
+            count_const(sum(_ndarray_nbytes(np.asarray(v))
+                            for v in jax.tree_util.tree_leaves(host_consts)))
+            drift_consts = jax.device_put(host_consts, self.device)
 
         def fused(plan_inputs, drift_ops=None):
             import jax.numpy as jnp
@@ -435,6 +514,11 @@ class ModelRegistry:
         back; one explicit device_put in, one explicit device_get out."""
         import time
 
+        if self._released:
+            raise ValueError(
+                f"registry {self.sha} was released (evicted) — re-admit "
+                "the tenant before scoring")
+
         from shifu_tpu.obs import registry as obs_registry
         from shifu_tpu.obs import reqtrace
 
@@ -489,7 +573,8 @@ class ModelRegistry:
             valid[:n] = 1.0
             drift_host = (d_vals, d_codes, valid)
         key = (self.sha, bucket)
-        if key not in self._warm_buckets:
+        new_bucket = key not in self._warm_buckets
+        if new_bucket:
             self._warm_buckets.add(key)
             reg.counter("serve.program_compiles", **self.labels).inc()
             reg.gauge("serve.registry.buckets", **self.labels).set(
@@ -554,6 +639,13 @@ class ModelRegistry:
             reqtrace.note_stage("d2h", time.perf_counter() - t_d2h,
                                 t0=t_d2h)
         reg.counter("serve.score.rows", **self.labels).inc(n)
+        if new_bucket and self.cost_hook is not None:
+            # the compiled entry for this bucket exists now: let the
+            # owner (zoo ledger) re-price this registry's residency
+            try:
+                self.cost_hook()
+            except Exception as he:  # accounting must not fail scoring
+                log.warning("registry cost hook failed: %s", he)
         return ScoreResult(
             model_scores=np.asarray(m)[:n],
             mean=np.asarray(mean)[:n],
@@ -564,6 +656,49 @@ class ModelRegistry:
             model_widths=list(self.model_widths),
         )
 
+    def memory_analysis(self) -> dict:
+        """Resident-cost accounting for the zoo's HBM budget ledger
+        (serve/zoo.py): `weightsBytes` is the exact host-side count of
+        every array this registry device_put at build (params + norm
+        plan constants + drift constants), `programs` are the compiled
+        fused program's PR-6 `memory_analysis()` numbers per cached
+        signature (= per warm row bucket), and `residentBytes` is the
+        high-water cost of keeping the registry warm AND scoring its
+        largest compiled bucket: weights + max(args+temps+out)."""
+        programs: List[dict] = []
+        if self.fused and getattr(self, "_program", None) is not None:
+            from shifu_tpu.obs import profile
+
+            programs = profile.fn_memory("serve.fused_score",
+                                         self._program)
+        peak = max((p["peakBytes"] for p in programs), default=0.0)
+        return {
+            "weightsBytes": int(self.weights_bytes),
+            "programs": programs,
+            "programPeakBytes": int(peak),
+            "residentBytes": int(self.weights_bytes + peak),
+        }
+
+    def release(self, refuse: bool = True) -> int:
+        """Eviction seam: drop the profiler cost cache's strong
+        references to this registry's fused program, so the compiled
+        executables AND the closure'd device weights free as soon as
+        in-flight dispatches finish and the caller drops the registry
+        object. Compiled-program cache entries and device weights go
+        together. With `refuse` (the eviction path, fleet already
+        drained) new scores raise; `refuse=False` (a promoted-away or
+        unstaged version that may have one in-flight batch racing the
+        swap) keeps scoring legal — a straggler just pays one fresh
+        AOT compile. Returns how many cached signatures were dropped."""
+        from shifu_tpu.obs import profile
+
+        n = 0
+        if self.fused and getattr(self, "_program", None) is not None:
+            n = profile.release_fn(self._program)
+        if refuse:
+            self._released = True
+        return n
+
     def snapshot(self) -> dict:
         """Registry state for manifests/bench output: compiled buckets
         prove the steady-state compile bound."""
@@ -573,6 +708,7 @@ class ModelRegistry:
             "fused": self.fused,
             "inputColumns": len(self.input_columns),
             "warmBuckets": sorted(b for (_s, b) in self._warm_buckets),
+            "weightsBytes": int(self.weights_bytes),
             "driftMonitored": (len(self.drift.cols)
                                if self.drift is not None else 0),
         }

@@ -40,6 +40,7 @@ from shifu_tpu.serve.fleet import ReplicaFleet, ScoringReplica
 from shifu_tpu.serve.health import DRAINING
 from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
 from shifu_tpu.serve.registry import ModelRegistry
+from shifu_tpu.serve.zoo import ColdStartError
 from shifu_tpu.utils.log import get_logger
 
 log = get_logger(__name__)
@@ -212,13 +213,62 @@ class ScoringServer:
                  max_wait_ms: Optional[float] = None,
                  replicas: Optional[int] = None,
                  batching: Optional[str] = None,
-                 column_configs=None, model_config=None) -> None:
+                 column_configs=None, model_config=None,
+                 zoo: Optional[dict] = None) -> None:
         from shifu_tpu.loop import drift_check_batches_setting, \
             log_sample_setting
         from shifu_tpu.loop.drift import DriftMonitor
         from shifu_tpu.loop.traffic import TrafficLog, traffic_columns
 
         self.root = os.path.abspath(root)
+        self._observe_lock = tracked_lock("serve.server.observe")
+        self._observed_batches = 0
+        self._last_drift_verdict: Optional[dict] = None
+        self.zoo = None
+        if zoo:
+            # multi-tenant mode (serve/zoo.py): N model sets behind this
+            # one server on a bounded HBM budget. Per-tenant drift
+            # windows / traffic streams / shadow gates live in the zoo;
+            # the DEFAULT (first-registered) tenant doubles as this
+            # server's registry facade so the single-tenant surfaces
+            # (/healthz identity, peers, manifests) keep working.
+            from shifu_tpu.serve.zoo import ModelZoo
+
+            self.zoo = ModelZoo(
+                self.root, n_replicas=replicas,
+                queue_depth=queue_depth,
+                max_batch_rows=max_batch_rows,
+                max_wait_ms=max_wait_ms, batching=batching)
+            for name, set_path in zoo.items():
+                self.zoo.register(name, set_path)
+            default = self.zoo.default_tenant
+            # the default tenant MUST fit (the server needs one resident
+            # fleet); later tenants admit best-effort in registration
+            # order and stay cold past the budget
+            self.zoo.ensure_resident(default)
+            for name in list(zoo):
+                if name == default:
+                    continue
+                try:
+                    # evict=False: pre-warming tenant N must not evict
+                    # the tenants just admitted — only scored demand
+                    # earns an eviction
+                    self.zoo.ensure_resident(name, evict=False)
+                except Exception as e:  # best-effort warm-up: past-
+                    # budget tenants legitimately stay cold at startup
+                    log.info("zoo: tenant %s stays cold at startup "
+                             "(%s)", name, e)
+            tenant = self.zoo._get(default)
+            self.column_configs = tenant.column_configs
+            self.model_config = tenant.model_config
+            self.drift = None       # per-tenant, owned by the zoo
+            self.traffic = None     # per-tenant streams, ditto
+            self._registry = self.zoo.fleet_of(default)
+            self._scorer = tenant.scorer
+            self._drift_check_every = max(
+                1, drift_check_batches_setting())
+            self._finish_init(host, port)
+            return
         # the loop seams read the model-set configs when the server runs
         # inside one (the CLI path); an explicit models_dir outside a
         # model set still serves, just without drift/label plumbing
@@ -237,7 +287,7 @@ class ScoringServer:
         # local devices; 1 is the exact pre-fleet behavior). It is also
         # the registry facade this server reads (sha/model_names/warm/
         # stage/promote) — replica 0 is the canonical read.
-        self.registry = ReplicaFleet.build(
+        self._registry = ReplicaFleet.build(
             models_dir or os.path.join(self.root, "models"),
             n_replicas=replicas,
             column_configs=column_configs, model_config=model_config,
@@ -261,14 +311,39 @@ class ScoringServer:
             self.traffic = TrafficLog(self.root, traffic_columns(
                 list(input_columns) + label_cols))
         self._drift_check_every = max(1, drift_check_batches_setting())
-        # N replica workers observe concurrently now — the cadence
-        # counter needs its own lock (the drift monitor and traffic log
-        # are internally locked already)
-        self._observe_lock = tracked_lock("serve.server.observe")
-        self._observed_batches = 0
-        self._last_drift_verdict: Optional[dict] = None
-        self.scorer = Scorer(fleet=self.registry,
-                             extra_columns=label_cols)
+        self._scorer = Scorer(fleet=self.registry,
+                              extra_columns=label_cols)
+        self._finish_init(host, port)
+
+    @property
+    def registry(self):
+        """The default serving fleet. In zoo mode the DEFAULT tenant's
+        fleet is re-resolved on every read: budget pressure may have
+        evicted and re-admitted the tenant since startup, and a stale
+        reference to its torn-down fleet would misreport /admin/shadow,
+        peer health and manifests (falls back to the last-known fleet
+        while the tenant is cold)."""
+        if self.zoo is not None:
+            from shifu_tpu.serve import zoo as zoo_mod
+
+            tenant = self.zoo._get(self.zoo.default_tenant)
+            if (tenant.state == zoo_mod.RESIDENT
+                    and tenant.fleet is not None):
+                self._registry = tenant.fleet
+                self._scorer = tenant.scorer
+        return self._registry
+
+    @property
+    def scorer(self):
+        """The default Scorer (re-resolved like `registry`)."""
+        if self.zoo is not None:
+            self.registry  # refresh both references
+        return self._scorer
+
+    def _finish_init(self, host: str, port: int) -> None:
+        """Shared tail of construction: HTTP listener + heartbeat lease
+        (built AFTER the listener so the advertised port is the bound
+        one)."""
         self.started_at = time.time()
         self._serve_thread: Optional[threading.Thread] = None
         self._shutdown_lock = tracked_lock("serve.server.shutdown")
@@ -290,15 +365,30 @@ class ScoringServer:
             self.root,
             stage_cb=self.stage_candidate,
             promote_cb=self.promote_candidate,
-            unstage_cb=self.registry.unstage,
+            unstage_cb=self._unstage_default,
             info_cb=self._peer_info)
+
+    def _unstage_default(self) -> None:
+        """Aborted-round rollback: in zoo mode route through the ZOO so
+        the ledger's shadow charge and the tenant's shadow_staged flag
+        roll back with the device state (a bare fleet.unstage would
+        leave the charge inflated and the tenant unevictable forever);
+        single-tenant goes straight to the fleet — through the property,
+        not a bound method, since the default fleet can be replaced by
+        an evict/re-admit cycle."""
+        if self.zoo is not None:
+            self.zoo.unstage(self.zoo.default_tenant)
+        else:
+            self.registry.unstage()
 
     def _peer_info(self) -> dict:
         """The health summary renewed into this process's lease file —
         a peer scan is a cheap fleet-of-processes health view."""
         return {
             "port": self.port,
-            "status": self.scorer.health.state,
+            "status": (self.zoo.fleet_health_snapshot()["status"]
+                       if self.zoo is not None
+                       else self.scorer.health.state),
             "sha": self.registry.sha,
             "replicas": len(self.registry.replicas),
             "queueDepth": sum(len(r.admission)
@@ -310,26 +400,11 @@ class ScoringServer:
         """Best-effort model-set configs from the serving root — the
         drift baseline (ColumnConfig bins + counts) and the traffic log's
         label columns come from here. Absent/corrupt configs degrade to
-        plain serving, never to a failed startup."""
-        ccs = mc = None
-        try:
-            cc_path = os.path.join(self.root, "ColumnConfig.json")
-            if os.path.isfile(cc_path):
-                from shifu_tpu.config import load_column_config_list
+        plain serving, never to a failed startup. ONE loader for the
+        single-tenant and zoo paths (serve/zoo.py owns it)."""
+        from shifu_tpu.serve.zoo import load_set_configs
 
-                ccs = load_column_config_list(cc_path)
-        except Exception as e:  # malformed config degrades, never kills
-            log.warning("serve: cannot load ColumnConfig.json (%s); "
-                        "drift monitoring off", e)
-        try:
-            mc_path = os.path.join(self.root, "ModelConfig.json")
-            if os.path.isfile(mc_path):
-                from shifu_tpu.config import ModelConfig
-
-                mc = ModelConfig.load(mc_path)
-        except Exception as e:  # malformed config degrades, never kills
-            log.warning("serve: cannot load ModelConfig.json (%s)", e)
-        return ccs, mc
+        return load_set_configs(self.root)
 
     def _observe(self, replica, data, result) -> None:
         """Per-replica post-resolution observer: traffic log + shadow
@@ -361,15 +436,22 @@ class ScoringServer:
                 self.scorer.health, self.root,
                 model_sha=self.registry.sha)
 
-    def stage_candidate(self, models_dir: str) -> dict:
+    def stage_candidate(self, models_dir: str,
+                        set_name: Optional[str] = None) -> dict:
         """Load + warm a candidate model set as the shadow version on
-        EVERY replica (each onto its own device)."""
+        EVERY replica (each onto its own device). In zoo mode the stage
+        is per-tenant and STREAMED through the budget ledger
+        (`set_name`; default tenant when omitted)."""
+        if self.zoo is not None:
+            return self.zoo.stage(set_name or self.zoo.default_tenant,
+                                  models_dir)
         return self.registry.stage(models_dir,
                                    column_configs=self.column_configs,
                                    model_config=self.model_config,
                                    drift=self.drift)
 
-    def promote_candidate(self, expected_sha: Optional[str] = None) -> dict:
+    def promote_candidate(self, expected_sha: Optional[str] = None,
+                          set_name: Optional[str] = None) -> dict:
         """ROLLING hot-swap: the fleet promotes one replica at a time
         (requests keep flowing on the others), and each replica step
         stamps a sha-bound `swap-<seq>.json` audit manifest — from/to
@@ -381,6 +463,12 @@ class ScoringServer:
         by the old run's already-seen columns. `expected_sha` (from the
         gate evidence) must match the staged shadow on every replica, or
         the roll is refused before the first swap."""
+        if self.zoo is not None:
+            # per-tenant promote: the zoo also releases the old active
+            # version's ledger charge and renames the shadow's
+            return self.zoo.promote(
+                set_name or self.zoo.default_tenant, expected_sha,
+                step_cb=self._write_swap_manifest)
         swap = self.registry.promote(expected_sha,
                                      step_cb=self._write_swap_manifest)
         self.scorer.health.clear_degraded()
@@ -388,6 +476,17 @@ class ScoringServer:
             self.drift.reset()
         self._last_drift_verdict = None
         return swap
+
+    def _fleet_for(self, set_name: Optional[str] = None):
+        """The fleet that owns a request's trace/Retry-After surfaces:
+        the named tenant's when resident, else the default registry (a
+        shed cold-tenant request still gets a coherent answer)."""
+        if self.zoo is None or not set_name:
+            return self.registry
+        try:
+            return self.zoo.fleet_of(set_name)
+        except (KeyError, ValueError):
+            return self.registry
 
     def _write_swap_manifest(self, replica, step: dict) -> None:
         """One sha-bound audit manifest per replica promote step."""
@@ -446,8 +545,14 @@ class ScoringServer:
                     # aggregate fleet health: one degraded replica =
                     # degraded fleet with the replica named in `reason`
                     # and the per-replica states under `replicas`; ALL
-                    # replicas draining (or fleet shutdown) = draining
-                    health = server.scorer.health_snapshot()
+                    # replicas draining (or fleet shutdown) = draining.
+                    # Zoo mode aggregates over RESIDENT tenants instead
+                    # — an evicted tenant's torn-down fleet must not
+                    # 503 the whole process
+                    if server.zoo is not None:
+                        health = server.zoo.fleet_health_snapshot()
+                    else:
+                        health = server.scorer.health_snapshot()
                     # draining replies 503 so load balancers stop routing
                     # here; ok AND degraded stay 200 (degraded still
                     # scores — it is a de-prioritization hint, not an
@@ -506,6 +611,18 @@ class ScoringServer:
                             health["reason"] = (
                                 "peer lease(s) expired: "
                                 + ", ".join(expired))
+                    if server.zoo is not None:
+                        # the zoo section: budget occupancy + per-tenant
+                        # states, with an in-flight admission surfaced
+                        # as a NON-STICKY cold_start degrade reason (it
+                        # clears the moment the tenant lands resident)
+                        z = server.zoo.health_snapshot()
+                        health["zoo"] = z
+                        if z["admitting"] and health["status"] == "ok":
+                            health["status"] = "degraded"
+                            health["reason"] = (
+                                "cold_start: warming tenant(s) "
+                                + ", ".join(z["admitting"]))
                     self._reply(code, health)
                     return
                 if self.path == "/admin/traces":
@@ -523,10 +640,31 @@ class ScoringServer:
                         obs_registry().to_prometheus().encode("utf-8"),
                         content_type="text/plain; version=0.0.4")
                     return
-                if self.path == "/admin/shadow":
+                if (self.path == "/admin/shadow"
+                        or self.path.startswith("/admin/shadow?")):
+                    from urllib.parse import parse_qs, urlsplit
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    set_name = (q.get("set") or [None])[0]
+                    if set_name and server.zoo is None:
+                        # match the POST plane: silently answering the
+                        # single shadow for ?set= would let promote
+                        # --set gate on the WRONG tenant's evidence
+                        self._reply(409, {"error": "this server is "
+                                                   "single-tenant "
+                                                   "(no --zoo)"})
+                        return
+                    if set_name:
+                        try:
+                            fleet = server.zoo.fleet_of(set_name)
+                        except (KeyError, ValueError) as e:
+                            self._reply(404, {"error": str(e)})
+                            return
+                    else:
+                        fleet = server.registry
                     self._reply(200, {
-                        "active": server.registry.sha,
-                        "shadow": server.registry.shadow_snapshot(),
+                        "active": fleet.sha,
+                        "shadow": fleet.shadow_snapshot(),
                     })
                     return
                 self._reply(404, {"error": f"unknown path {self.path}"})
@@ -534,11 +672,30 @@ class ScoringServer:
             def do_POST(self):
                 from shifu_tpu.obs import reqtrace
 
-                if self.path in ("/admin/stage", "/admin/promote"):
+                if self.path in ("/admin/stage", "/admin/promote",
+                                 "/admin/evict"):
                     self._do_admin()
                     return
-                if self.path != "/score":
+                # /score (single-tenant, or the zoo's default set) and
+                # /score/<set> (one tenant of the model zoo)
+                set_name = None
+                if self.path.startswith("/score/"):
+                    set_name = self.path[len("/score/"):]
+                elif self.path != "/score":
                     self._reply(404, {"error": f"unknown path {self.path}"})
+                    return
+                if server.zoo is not None:
+                    set_name = set_name or server.zoo.default_tenant
+                    if set_name not in server.zoo.tenants():
+                        self._reply(404, {
+                            "error": f"unknown model set {set_name!r}",
+                            "sets": server.zoo.tenants()})
+                        return
+                elif set_name is not None:
+                    self._reply(404, {
+                        "error": "this server is single-tenant — "
+                                 "POST /score (start with --zoo for "
+                                 "per-set routes)"})
                     return
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
@@ -563,8 +720,39 @@ class ScoringServer:
                     trace = reqtrace.RequestTrace(
                         trace_id=hdr,
                         sampled=bool(hdr) or buf.head_sampled())
+                fleet = server._fleet_for(set_name)
                 try:
-                    res = server.scorer.score_batch(records, trace=trace)
+                    if server.zoo is not None:
+                        res = server.zoo.score_batch(set_name, records,
+                                                     trace=trace)
+                    else:
+                        res = server.scorer.score_batch(records,
+                                                        trace=trace)
+                except ColdStartError as e:
+                    # cold-tenant compile stall: 429 NOW with a
+                    # Retry-After from OBSERVED warm-up time — the
+                    # admission queue never blocks behind the build
+                    # (which proceeds in the background)
+                    err_headers = {}
+                    if trace is not None:
+                        trace.annotate(status="cold_start",
+                                       tenant=set_name)
+                        # the cold tenant has NO fleet: offer the trace
+                        # to the ring directly instead of feeding
+                        # another tenant's stage histograms/SLO under
+                        # the wrong tenant= label (the PR-13 "never
+                        # fabricate a wrong series" rule)
+                        trace.finish()
+                        reqtrace.buffer().offer(trace)
+                        err_headers[reqtrace.TRACE_HEADER] = trace.trace_id
+                    err_headers["Retry-After"] = str(
+                        int(math.ceil(e.retry_after_s)))
+                    self._reply(429, {
+                        "error": str(e), "reason": e.reason,
+                        "set": set_name,
+                        "retryAfterSeconds": round(e.retry_after_s, 3)},
+                        extra_headers=err_headers)
+                    return
                 except RejectedError as e:
                     # the trace header echoes on ERROR replies too —
                     # correlating a shed/timeout with its server-side
@@ -572,13 +760,14 @@ class ScoringServer:
                     err_headers = {}
                     if trace is not None:
                         trace.annotate(status="rejected", reason=e.reason)
-                        server.registry.finish_trace(trace)
+                        fleet.finish_trace(trace)
                         err_headers[reqtrace.TRACE_HEADER] = trace.trace_id
                     # Retry-After from the FLEET drain rate (total
                     # backlog / summed per-replica drain rates, clamped)
                     # — the hint describes the fleet's capacity to
-                    # absorb the retry, not one replica's
-                    hint = server.scorer.retry_after_seconds()
+                    # absorb the retry, not one replica's. Per-tenant in
+                    # a zoo: the tenant's own fleet answers.
+                    hint = fleet.retry_after_seconds()
                     err_headers["Retry-After"] = str(int(math.ceil(hint)))
                     self._reply(429, {"error": str(e),
                                       "reason": e.reason,
@@ -589,12 +778,14 @@ class ScoringServer:
                     err_headers = {}
                     if trace is not None:
                         trace.annotate(status="timeout")
-                        server.registry.finish_trace(trace)
+                        fleet.finish_trace(trace)
                         err_headers[reqtrace.TRACE_HEADER] = trace.trace_id
                     self._reply(503, {"error": str(e)},
                                 extra_headers=err_headers)
                     return
-                doc = {"models": server.registry.model_names,
+                # the tenant that actually scored (zoo) names its models
+                fleet = server._fleet_for(set_name)
+                doc = {"models": fleet.model_names,
                        "scores": None}
                 if trace is None:
                     doc["scores"] = _result_rows(res)
@@ -606,14 +797,16 @@ class ScoringServer:
                     doc["scores"] = _result_rows(res)
                     doc["trace"] = trace.trace_id
                     body = json.dumps(doc).encode("utf-8")
-                server.registry.finish_trace(trace)
+                fleet.finish_trace(trace)
                 self._reply(200, body, extra_headers={
                     reqtrace.TRACE_HEADER: trace.trace_id})
 
             def _do_admin(self):
                 """Rollout control plane: stage a candidate as the shadow
-                version, or promote the staged one (zero-downtime swap).
-                `shifu promote` drives these."""
+                version, promote the staged one (zero-downtime swap), or
+                — zoo mode — evict a resident tenant. `shifu promote`
+                drives stage/promote; `set` selects the tenant (zoo
+                default when omitted)."""
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     body = self.rfile.read(length) if length else b"{}"
@@ -621,7 +814,25 @@ class ScoringServer:
                 except ValueError as e:
                     self._reply(400, {"error": f"bad request body: {e}"})
                     return
+                set_name = doc.get("set") or None
+                if set_name is not None and server.zoo is None:
+                    self._reply(409, {"error": "this server is single-"
+                                               "tenant (no --zoo)"})
+                    return
                 try:
+                    if self.path == "/admin/evict":
+                        if server.zoo is None:
+                            self._reply(409, {"error": "eviction needs "
+                                                       "zoo mode"})
+                            return
+                        if not set_name:
+                            self._reply(400, {"error": "set required"})
+                            return
+                        server.zoo.evict(set_name, reason="admin")
+                        self._reply(200, {
+                            "evicted": set_name,
+                            "zoo": server.zoo.health_snapshot()})
+                        return
                     if self.path == "/admin/stage":
                         models_dir = doc.get("modelsDir")
                         if not models_dir:
@@ -629,10 +840,13 @@ class ScoringServer:
                                         {"error": "modelsDir required"})
                             return
                         self._reply(200, {
-                            "staged": server.stage_candidate(models_dir)})
+                            "staged": server.stage_candidate(
+                                models_dir, set_name=set_name)})
                         return
                     self._reply(200, server.promote_candidate(
-                        doc.get("sha")))
+                        doc.get("sha"), set_name=set_name))
+                except KeyError as e:
+                    self._reply(404, {"error": str(e)})
                 except (ValueError, OSError) as e:
                     self._reply(409, {"error": str(e)})
 
@@ -670,7 +884,12 @@ class ScoringServer:
             # leave the fleet cleanly (file removed), not expire into a
             # survivor's degrade reason
             self.peers.close()
-            self.scorer.close(drain_timeout)
+            if self.zoo is not None:
+                # drains EVERY resident tenant (incl. the default fleet
+                # the scorer wraps) and flushes per-tenant traffic
+                self.zoo.close(drain_timeout)
+            else:
+                self.scorer.close(drain_timeout)
             self.httpd.shutdown()
             self.httpd.server_close()
             if self._serve_thread is not None:
@@ -700,6 +919,11 @@ class ScoringServer:
                 log.warning("cannot snapshot profiler: %s", pe)
                 profile_snap = None
             extra = {"serve": self.registry.snapshot()}
+            if self.zoo is not None:
+                # budget ledger + per-tenant detail: evictions, cold
+                # starts and peak occupancy are reconstructible from the
+                # shutdown manifest alone
+                extra["zoo"] = self.zoo.snapshot()
             from shifu_tpu.analysis import sanitize
 
             san = sanitize.current()

@@ -1,0 +1,520 @@
+"""Multi-tenant model zoo (shifu_tpu/serve/zoo.py): budget ledger,
+LRU eviction, streamed shadow staging, cold-start 429s.
+
+The acceptance pins live here: a tenant larger than the whole budget is
+rejected at registration with ILLEGAL_ARGUMENT; evicting a tenant
+mid-promote (or with a staged shadow) is refused; the LRU tie-break is
+deterministic (registration order, then name); a tenant re-admitted
+after eviction scores BIT-identically to never-evicted; a streamed
+shadow stage + promote on a near-full budget keeps the ledger's peak
+inside the budget at every instant; cold tenants answer 429 with an
+observed-warm-up Retry-After instead of hanging; and all serve.*
+metrics carry tenant= labels on one valid exporter page.
+
+Runs under the conftest-forced 8-virtual-device CPU mesh; zoo fleets
+pin replicas=1 or 2 to stay fast.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from shifu_tpu.utils import environment
+from shifu_tpu.utils.errors import ErrorCode, ShifuError
+
+
+def _make_set(d, cols_n=4, hidden=3, bags=1, seed=0):
+    from shifu_tpu.models.nn import NNModelSpec, init_params
+
+    cols = [f"c{i}" for i in range(cols_n)]
+    sizes = [cols_n, hidden, 1]
+    models = os.path.join(d, "models")
+    os.makedirs(models, exist_ok=True)
+    for b in range(bags):
+        specs = [{"name": c, "kind": "value", "outNames": [c],
+                  "mean": 0.0, "std": 1.0, "fill": 0.0, "zscore": True}
+                 for c in cols]
+        NNModelSpec(layer_sizes=sizes, activations=["tanh"],
+                    input_columns=cols, norm_specs=specs,
+                    params=init_params(sizes, seed=seed + b),
+                    ).save(os.path.join(models, f"model{b}.nn"))
+    return cols
+
+
+def _records(cols, n=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{c: f"{v:.5f}" for c, v in zip(cols, row)}
+            for row in rng.normal(size=(n, len(cols)))]
+
+
+def _set_cost(models_dir, buckets=(1, 8)):
+    """Measured resident cost of one set at replicas=1 (weights +
+    compiled-program peak), via the same memory_analysis the ledger
+    prices with."""
+    from shifu_tpu.serve.registry import ModelRegistry
+
+    reg = ModelRegistry(models_dir)
+    reg.warm(buckets)
+    cost = reg.memory_analysis()["residentBytes"]
+    reg.release()
+    return cost
+
+
+@pytest.fixture()
+def three_sets(tmp_path):
+    root = str(tmp_path)
+    cols = _make_set(os.path.join(root, "a"), seed=0)
+    _make_set(os.path.join(root, "b"), seed=7)
+    _make_set(os.path.join(root, "c"), seed=13)
+    return root, cols
+
+
+def _zoo(root, budget_mb, **kw):
+    from shifu_tpu import obs
+    from shifu_tpu.serve.zoo import ModelZoo
+
+    obs.reset()
+    zoo = ModelZoo(root, n_replicas=kw.pop("n_replicas", 1),
+                   budget_mb=budget_mb, **kw)
+    for name in ("a", "b", "c"):
+        zoo.register(name, os.path.join(root, name))
+    return zoo
+
+
+class TestRegistration:
+    def test_oversized_tenant_rejected_at_registration(self, tmp_path):
+        """A tenant whose weights alone exceed the whole budget can
+        never be resident — ILLEGAL_ARGUMENT at register, not a hang on
+        the first request."""
+        from shifu_tpu.serve.zoo import ModelZoo
+
+        root = str(tmp_path)
+        _make_set(os.path.join(root, "big"), cols_n=16, hidden=64,
+                  bags=2)
+        zoo = ModelZoo(root, n_replicas=1, budget_mb=0.001)  # ~1 KB
+        with pytest.raises(ShifuError) as ei:
+            zoo.register("big", os.path.join(root, "big"))
+        assert ei.value.code is ErrorCode.ILLEGAL_ARGUMENT
+        assert "big" not in zoo.tenants()
+
+    def test_bad_names_rejected(self, tmp_path):
+        from shifu_tpu.serve.zoo import ModelZoo
+
+        root = str(tmp_path)
+        _make_set(os.path.join(root, "a"))
+        zoo = ModelZoo(root, n_replicas=1, budget_mb=0)
+        for bad in ("", "a/b", "a b", ".hidden", "x" * 70):
+            with pytest.raises(ShifuError) as ei:
+                zoo.register(bad, os.path.join(root, "a"))
+            assert ei.value.code is ErrorCode.ILLEGAL_ARGUMENT
+
+    def test_duplicate_name_rejected(self, three_sets):
+        root, _cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        with pytest.raises(ShifuError) as ei:
+            zoo.register("a", os.path.join(root, "a"))
+        assert ei.value.code is ErrorCode.ILLEGAL_ARGUMENT
+
+
+class TestLruEviction:
+    def test_admission_past_budget_evicts_lru_and_ledgers_it(
+            self, three_sets):
+        from shifu_tpu import obs
+
+        root, cols = three_sets
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        zoo = _zoo(root, budget_mb=2.5 * cost / (1024 * 1024))
+        zoo.ensure_resident("a")
+        zoo.ensure_resident("b")
+        # touch a so b is the LRU
+        zoo.score_batch("a", _records(cols))
+        zoo.ensure_resident("c")  # must evict b
+        states = {n: zoo._get(n).state for n in zoo.tenants()}
+        assert states == {"a": "resident", "b": "cold", "c": "resident"}
+        counters = obs.registry().snapshot()["counters"]
+        assert counters.get(
+            'serve.zoo.evictions{reason="pressure",tenant="b"}') == 1
+        # budget invariant: the ledger's high-water mark never crossed
+        assert zoo.ledger.peak <= zoo.ledger.budget_bytes
+        zoo.close()
+
+    def test_lru_tie_break_is_deterministic(self, three_sets):
+        """Never-scored tenants tie at last_used=0.0 and break by
+        registration order — the FIRST-registered of the never-used
+        goes, reproducibly."""
+        root, _cols = three_sets
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        zoo = _zoo(root, budget_mb=2.5 * cost / (1024 * 1024))
+        zoo.ensure_resident("a")
+        zoo.ensure_resident("b")
+        # neither a nor b ever scored: tie — registration order says a
+        zoo.ensure_resident("c")
+        assert zoo._get("a").state == "cold"
+        assert zoo._get("b").state == "resident"
+        zoo.close()
+
+    def test_readmission_scores_bit_identically(self, three_sets):
+        root, cols = three_sets
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        zoo = _zoo(root, budget_mb=2.5 * cost / (1024 * 1024))
+        recs = _records(cols, n=4, seed=3)
+        zoo.ensure_resident("a")
+        zoo.ensure_resident("b")
+        before = zoo.score_batch("a", recs)
+        zoo.score_batch("b", recs)          # b now most-recent
+        zoo.ensure_resident("c")            # evicts a (LRU)
+        assert zoo._get("a").state == "cold"
+        after = zoo.score_batch("b", recs)  # b untouched by the churn
+        zoo.ensure_resident("a")            # re-admits a, evicting LRU
+        again = zoo.score_batch("a", recs)
+        assert zoo._get("a").evictions == 1
+        # BIT-identical: same files, same configs, same fused program
+        np.testing.assert_array_equal(before.model_scores,
+                                      again.model_scores)
+        np.testing.assert_array_equal(before.mean, again.mean)
+        del after
+        zoo.close()
+
+    def test_readmission_rewarns_remembered_buckets(self, three_sets):
+        root, cols = three_sets
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        zoo = _zoo(root, budget_mb=2.2 * cost / (1024 * 1024))
+        zoo.ensure_resident("a")
+        zoo.score_batch("a", _records(cols, n=1))
+        zoo.evict("a", reason="test")
+        assert 8 in zoo._get("a").warm_buckets  # SERVE_MIN_ROW_BUCKET
+        zoo.ensure_resident("a")
+        snap = zoo._get("a").fleet.snapshot()
+        assert 8 in snap["warmBuckets"]  # re-warmed, not re-discovered
+        zoo.close()
+
+    def test_evicting_mid_promote_tenant_is_refused(self, three_sets):
+        root, _cols = three_sets
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        zoo = _zoo(root, budget_mb=0)  # unbounded: isolate the refusal
+        zoo.ensure_resident("a")
+        tenant = zoo._get("a")
+        zoo._busy_guard(tenant, "promote")  # a promote is in flight
+        try:
+            with pytest.raises(ValueError, match="mid-promote"):
+                zoo.evict("a")
+            # nor may the LRU scan pick it
+            assert zoo._claim_victim() is None
+        finally:
+            zoo._busy_clear(tenant)
+        zoo.close()
+        del cost
+
+    def test_evicting_shadow_staged_tenant_is_refused(self, three_sets):
+        root, _cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        zoo.ensure_resident("a")
+        zoo.stage("a", os.path.join(root, "b", "models"))
+        with pytest.raises(ValueError, match="staged shadow"):
+            zoo.evict("a")
+        assert zoo._claim_victim() is None  # LRU scan skips it too
+        zoo.unstage("a")
+        zoo.evict("a")  # now legal
+        assert zoo._get("a").state == "cold"
+        zoo.close()
+
+    def test_cold_tenant_is_not_evictable(self, three_sets):
+        root, _cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        with pytest.raises(ValueError, match="not resident"):
+            zoo.evict("a")
+
+
+class TestBudgetLedger:
+    def test_ledger_never_exceeds_budget_through_stage_and_promote(
+            self, three_sets):
+        """The tentpole invariant: through admit -> streamed stage ->
+        promote on a near-full budget, the ledger's peak stays <=
+        budget at EVERY instant (acquire-before-put makes it so by
+        construction; this pins it end to end)."""
+        root, cols = three_sets
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        # room for ~1.8 sets: a resident + a streamed shadow does NOT
+        # fit as two full registries plus another resident set
+        budget = int(2.6 * cost)
+        zoo = _zoo(root, budget_mb=budget / (1024 * 1024))
+        zoo.ensure_resident("a")
+        zoo.ensure_resident("b")
+        zoo.score_batch("a", _records(cols))
+        # streamed stage of a candidate for a: must evict b (cold LRU)
+        # group by group rather than overshoot
+        zoo.stage("a", os.path.join(root, "c", "models"))
+        assert zoo.ledger.peak <= budget
+        assert zoo._get("b").state == "cold"  # made room for the stage
+        shadow = zoo.shadow_snapshot("a")
+        assert shadow is not None
+        swap = zoo.promote("a", expected_sha=shadow["sha"])
+        assert swap["to"] == shadow["sha"]
+        assert zoo.ledger.peak <= budget
+        # post-promote: one version's charge per tenant again
+        assert zoo.ledger.charge_of("a", "shadow") == 0
+        assert zoo.ledger.charge_of("a", "active") > 0
+        # the promoted dir is what re-admission must rebuild
+        assert zoo._get("a").active_dir == os.path.join(
+            root, "c", "models")
+        zoo.close()
+
+    def test_stage_is_streamed_in_groups(self, three_sets):
+        """The stage acquires the candidate layer-group by layer-group:
+        multiple ledger acquires, each bounded — not one monolithic
+        second-registry charge."""
+        root, _cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        zoo.ensure_resident("a")
+        groups = []
+        orig = zoo.ledger.acquire
+
+        def spy(tenant, kind, nbytes):
+            if kind == "shadow":
+                groups.append(int(nbytes))
+            return orig(tenant, kind, nbytes)
+
+        zoo.ledger.acquire = spy
+        try:
+            zoo.stage("a", os.path.join(root, "b", "models"))
+        finally:
+            zoo.ledger.acquire = orig
+        # norm consts + per-layer W/b for a 2-layer net = several
+        # separate acquires, all BEFORE the true-up
+        assert len(groups) >= 4, groups
+        zoo.unstage("a")
+        assert zoo.ledger.charge_of("a", "shadow") == 0
+        zoo.close()
+
+    def test_failed_admission_releases_charge(self, three_sets, tmp_path):
+        root, _cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        # register a valid set, then break its active dir before the
+        # admission (the on-disk set vanished between registrations)
+        tenant = zoo.register("broken", os.path.join(root, "b"))
+        tenant.active_dir = str(tmp_path / "vanished-models")
+        with pytest.raises(Exception):
+            zoo.ensure_resident("broken")
+        assert zoo.ledger.charge_of("broken") == 0
+        assert zoo._get("broken").state == "cold"
+        zoo.close()
+
+    def test_register_fails_fast_on_empty_dir(self, tmp_path):
+        from shifu_tpu.serve.zoo import ModelZoo
+
+        zoo = ModelZoo(str(tmp_path), n_replicas=1, budget_mb=0)
+        with pytest.raises(ValueError, match="no models"):
+            zoo.register("empty", str(tmp_path))
+
+
+class TestColdStart:
+    def test_cold_request_answers_coldstart_not_hang(self, three_sets):
+        from shifu_tpu.serve.zoo import ColdStartError
+
+        root, cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        t0 = time.perf_counter()
+        with pytest.raises(ColdStartError) as ei:
+            zoo.score_batch("a", _records(cols))
+        # answered IMMEDIATELY (the admission runs in the background)
+        assert time.perf_counter() - t0 < 1.0
+        assert ei.value.reason == "cold_start"
+        assert ei.value.retry_after_s >= 1.0
+        # the background admission completes and the tenant serves
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                res = zoo.score_batch("a", _records(cols))
+                break
+            except ColdStartError:
+                time.sleep(0.05)
+        else:
+            pytest.fail("background admission never completed")
+        assert res.mean.shape == (2,)
+        zoo.close()
+
+    def test_retry_after_uses_observed_warmup(self, three_sets):
+        root, cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        zoo.ensure_resident("a")
+        observed = zoo._get("a").warm_seconds
+        assert observed is not None and observed > 0
+        zoo.evict("a", reason="test")
+        # a fresh cold hint derives from the observed warm-up (clamped
+        # to the 1s floor for these tiny sets), not the 5s default
+        hint = zoo._cold_retry_after(zoo._get("a"))
+        assert hint == pytest.approx(max(observed, 1.0), abs=0.5)
+        zoo.close()
+
+
+class TestTenantMetricsAndHealth:
+    def test_tenant_labels_on_single_exporter_page(self, three_sets):
+        from shifu_tpu import obs
+
+        root, cols = three_sets
+        zoo = _zoo(root, budget_mb=0, n_replicas=2)
+        zoo.ensure_resident("a")
+        zoo.ensure_resident("b")
+        zoo.score_batch("a", _records(cols))
+        zoo.score_batch("b", _records(cols))
+        page = obs.registry().to_prometheus()
+        assert 'serve_requests_total{replica="0",tenant="a"}' in page
+        assert 'serve_requests_total{replica="0",tenant="b"}' in page
+        assert 'serve_queue_depth{replica="0",tenant="a"}' in page
+        assert 'serve_zoo_hbm_used_bytes' in page
+        assert 'serve_zoo_resident_tenants 2' in page
+        # one VALID exporter page: every TYPE declared exactly once
+        types = [ln.split()[2] for ln in page.splitlines()
+                 if ln.startswith("# TYPE")]
+        assert len(types) == len(set(types))
+        # round-trip through the repo's own parser (the PR-12 pin)
+        from shifu_tpu.obs.metrics import parse_prometheus
+
+        parsed = parse_prometheus(page)
+        assert any("tenant=\"a\"" in k for k in parsed)
+        zoo.close()
+
+    def test_health_snapshot_fields(self, three_sets):
+        root, cols = three_sets
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        zoo = _zoo(root, budget_mb=2.5 * cost / (1024 * 1024))
+        zoo.ensure_resident("a")
+        h = zoo.health_snapshot()
+        assert h["residentTenants"] == 1
+        assert h["hbmBudgetUsedMB"] > 0
+        assert h["hbmBudgetUsedMB"] <= h["hbmBudgetMB"]
+        assert h["tenants"]["a"]["state"] == "resident"
+        assert h["tenants"]["b"]["state"] == "cold"
+        zoo.close()
+
+
+class TestZooServer:
+    """HTTP surface: /score/<set> routes, cold 429 + Retry-After,
+    /healthz zoo section, per-tenant admin plane."""
+
+    @pytest.fixture()
+    def server(self, three_sets):
+        from shifu_tpu import obs
+
+        root, cols = three_sets
+        obs.reset()
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        environment.set_property(
+            "shifu.serve.hbmBudgetMB",
+            str(2.6 * cost / (1024 * 1024)))
+        environment.set_property("shifu.lease.ttlMs", "0")
+        from shifu_tpu.serve.server import ScoringServer
+
+        srv = ScoringServer(
+            root=root, port=0, replicas=1,
+            zoo={"a": os.path.join(root, "a"),
+                 "b": os.path.join(root, "b"),
+                 "c": os.path.join(root, "c")})
+        srv.start()
+        try:
+            yield srv, cols
+        finally:
+            srv.shutdown()
+            environment.set_property("shifu.serve.hbmBudgetMB", "")
+            environment.set_property("shifu.lease.ttlMs", "")
+
+    @staticmethod
+    def _post(srv, path, doc):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}",
+            json.dumps(doc).encode(),
+            {"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.load(r), dict(r.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.load(e), dict(e.headers)
+
+    @staticmethod
+    def _get(srv, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}") as r:
+            return r.status, json.load(r)
+
+    def test_per_set_routes_and_cold_429(self, server):
+        srv, cols = server
+        body = {"records": _records(cols)}
+        code, doc, _h = self._post(srv, "/score/a", body)
+        assert code == 200 and doc["scores"]
+        code, _doc, _h = self._post(srv, "/score", body)  # default = a
+        assert code == 200
+        # budget fits 2: c stayed cold at startup -> immediate 429 with
+        # a Retry-After header, then the background admission lands it
+        code, doc, hdrs = self._post(srv, "/score/c", body)
+        assert code == 429
+        assert doc["reason"] == "cold_start"
+        assert int(hdrs["Retry-After"]) >= 1
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            code, doc, _h = self._post(srv, "/score/c", body)
+            if code == 200:
+                break
+            time.sleep(0.1)
+        assert code == 200 and doc["scores"]
+        # an unknown set is a 404, not a hang
+        code, doc, _h = self._post(srv, "/score/nope", body)
+        assert code == 404 and "nope" in doc["error"]
+
+    def test_healthz_zoo_section(self, server):
+        srv, cols = server
+        code, h = self._get(srv, "/healthz")
+        assert code == 200
+        z = h["zoo"]
+        assert z["residentTenants"] >= 1
+        assert z["hbmBudgetUsedMB"] <= z["hbmBudgetMB"]
+        assert set(z["tenants"]) == {"a", "b", "c"}
+
+    def test_admin_evict_and_stage_by_set(self, server, three_sets):
+        root, _cols = three_sets
+        srv, cols = server
+        # evict b (resident, never scored): ledgered + state flips
+        code, doc, _h = self._post(srv, "/admin/evict", {"set": "b"})
+        assert code == 200, doc
+        assert doc["zoo"]["tenants"]["b"]["state"] == "cold"
+        # stage a candidate for a, read its shadow, promote it — all
+        # per-set through the admin plane
+        code, doc, _h = self._post(
+            srv, "/admin/stage",
+            {"set": "a", "modelsDir": os.path.join(root, "b", "models")})
+        assert code == 200, doc
+        sha = doc["staged"]["sha"]
+        code, doc = self._get(srv, "/admin/shadow?set=a")
+        assert code == 200 and doc["shadow"]["sha"] == sha
+        code, doc, _h = self._post(srv, "/admin/promote",
+                                   {"set": "a", "sha": sha})
+        assert code == 200 and doc["to"] == sha
+        # scoring the promoted set still answers
+        code, doc, _h = self._post(srv, "/score/a",
+                                   {"records": _records(cols)})
+        assert code == 200
+
+    def test_shutdown_manifest_carries_zoo_ledger(self, three_sets):
+        from shifu_tpu import obs
+
+        root, cols = three_sets
+        obs.reset()
+        environment.set_property("shifu.lease.ttlMs", "0")
+        from shifu_tpu.serve.server import ScoringServer
+
+        try:
+            srv = ScoringServer(root=root, port=0, replicas=1,
+                                zoo={"a": os.path.join(root, "a")})
+            srv.start()
+            self._post(srv, "/score/a", {"records": _records(cols)})
+            path = srv.shutdown()
+        finally:
+            environment.set_property("shifu.lease.ttlMs", "")
+        man = json.load(open(path))
+        assert "ledger" in man["zoo"]
+        assert man["zoo"]["tenants"]["a"]["requests"] == 1
+        assert "memory" in man["zoo"]["tenants"]["a"]
