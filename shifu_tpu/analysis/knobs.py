@@ -161,6 +161,11 @@ KNOBS: List[Knob] = [
        "supervisor restart budget before the replica drains"),
     _K("shifu.serve.deadlineMs", "float", "30000",
        "per-request admission-to-dispatch budget (0 disables)"),
+    _K("shifu.serve.wire.maxBodyMB", "float", "64",
+       "largest columnar binary request body (serve/wire.py) the "
+       "server will decode — a bounds check before any allocation "
+       "sized from untrusted header fields; oversize bodies answer "
+       "400"),
     # ---- multi-tenant model zoo (PR 15) ----
     _K("shifu.serve.hbmBudgetMB", "float", "0 (= unbounded)",
        "model-zoo HBM budget: total device bytes the ledger admits "

@@ -161,11 +161,25 @@ class LazyColumns:
         return ((name, self[name]) for name in self._frame.columns)
 
 
+def _strings_of_typed(arr: np.ndarray) -> np.ndarray:
+    """The canonical string form of a typed numeric column — EXACTLY what
+    the JSON path would have carried for the same values (str() of the
+    Python scalar; NaN is the "" missing token, JSON null's spelling), so
+    a typed column falling back to any string-consuming code path is
+    bit-identical to its stringly-typed twin."""
+    out = np.empty(len(arr), dtype=object)
+    if arr.dtype.kind == "f":
+        out[:] = ["" if v != v else str(v) for v in arr.tolist()]
+    else:
+        out[:] = [str(v) for v in arr.tolist()]
+    return out
+
+
 @dataclass
 class ColumnarData:
     """All columns as parallel numpy arrays of raw strings (or a lazy
-    frame-backed mapping), plus lazily-parsed numeric views cached per
-    column."""
+    frame-backed mapping, or — from the binary wire path — typed numeric
+    arrays), plus lazily-parsed numeric views cached per column."""
 
     names: List[str]
     raw: Dict[str, np.ndarray]
@@ -173,6 +187,7 @@ class ColumnarData:
     missing_values: Sequence[str] = DEFAULT_MISSING
     _numeric_cache: Dict[str, np.ndarray] = field(default_factory=dict, repr=False)
     _missing_cache: Dict[str, np.ndarray] = field(default_factory=dict, repr=False)
+    _string_cache: Dict[str, np.ndarray] = field(default_factory=dict, repr=False)
 
     @classmethod
     def from_frame(
@@ -187,14 +202,42 @@ class ColumnarData:
 
     def _series(self, name: str):
         """pandas Series view of a column WITHOUT materializing an object
-        array (arrow-backed when frame-backed)."""
+        array (arrow-backed when frame-backed). Typed wire columns enter
+        as their canonical strings so every .str consumer keeps working."""
         import pandas as pd
 
         if isinstance(self.raw, LazyColumns):
             return self.raw._frame[name]
-        return pd.Series(self.raw[name])
+        return pd.Series(self.column(name))
+
+    def typed_column(self, name: str) -> Optional[np.ndarray]:
+        """The column's typed numeric array (binary wire batches), else
+        None. Consumers that can stay vectorized branch on this; all
+        other paths transparently see the canonical strings."""
+        if isinstance(self.raw, dict):
+            arr = self.raw.get(name)
+            if isinstance(arr, np.ndarray) and arr.dtype.kind in "fiu":
+                return arr
+        return None
+
+    def _typed_fast_ok(self) -> bool:
+        """Typed shortcuts (isnan instead of token isin, astype instead
+        of to_numeric) are only bit-identical to the string path while no
+        missing token itself parses as a number — the same guard
+        flat_numeric_matrix applies. "" is exempt: str() of a typed value
+        is never empty."""
+        return not any(
+            _parses_as_number(m) for m in self.missing_values if m != ""
+        )
 
     def column(self, name: str) -> np.ndarray:
+        typed = self.typed_column(name)
+        if typed is not None:
+            cached = self._string_cache.get(name)
+            if cached is None:
+                cached = _strings_of_typed(typed)
+                self._string_cache[name] = cached
+            return cached
         return self.raw[name]
 
     def numeric(self, name: str) -> np.ndarray:
@@ -203,6 +246,15 @@ class ColumnarData:
         cached = self._numeric_cache.get(name)
         if cached is not None:
             return cached
+        typed = self.typed_column(name)
+        if typed is not None and self._typed_fast_ok():
+            # zero-parse path: the wire already delivered numbers.
+            # str(float) round-trips and str(int) parses exactly, so this
+            # equals to_numeric over the canonical strings bit-for-bit
+            vals = typed.astype(np.float64)
+            vals[~np.isfinite(vals)] = np.nan
+            self._numeric_cache[name] = vals
+            return vals
         import pandas as pd
 
         ser = self._series(name)
@@ -226,6 +278,19 @@ class ColumnarData:
         cached = self._missing_cache.get(name)
         if cached is not None:
             return cached
+        typed = self.typed_column(name)
+        if typed is not None and self._typed_fast_ok():
+            if typed.dtype.kind == "f" and "" in self.missing_values:
+                # NaN's canonical string is "", the missing token; every
+                # finite/inf value strings to something numeric, which
+                # the guard says is in no missing set
+                mask = np.isnan(typed)
+                self._missing_cache[name] = mask
+                return mask
+            if typed.dtype.kind != "f":
+                mask = np.zeros(len(typed), dtype=bool)
+                self._missing_cache[name] = mask
+                return mask
         ser = self._series(name).str.strip()
         mask = ser.isin(list(self.missing_values)).to_numpy()
         self._missing_cache[name] = mask
@@ -304,7 +369,31 @@ def flat_numeric_matrix(data: "ColumnarData",
     semantics (strip + missing-token set, non-finite -> NaN) over many
     columns in ONE flattened pandas parse. The serve featurizer and the
     drift monitor both bin against this parse; they MUST stay
-    bit-identical, which is why there is exactly one implementation."""
+    bit-identical, which is why there is exactly one implementation.
+
+    Typed columns (binary wire batches) skip the parse entirely — their
+    doubles ARE the parse result (same guard as the typed numeric()
+    path) — and only the string-backed remainder pays for tokenizing."""
+    if data._typed_fast_ok():
+        is_typed = [data.typed_column(c) is not None for c in names]
+        if any(is_typed):
+            out = np.empty((data.n_rows, len(names)), dtype=np.float64)
+            rest = [c for j, c in enumerate(names) if not is_typed[j]]
+            if rest:
+                sub = _flat_parse(data, rest)
+                k = 0
+                for j, c in enumerate(names):
+                    if not is_typed[j]:
+                        out[:, j] = sub[:, k]
+                        k += 1
+            for j, c in enumerate(names):
+                if is_typed[j]:
+                    out[:, j] = data.numeric(c)
+            return out
+    return _flat_parse(data, names)
+
+
+def _flat_parse(data: "ColumnarData", names: Sequence[str]) -> np.ndarray:
     import pandas as pd
 
     n = data.n_rows

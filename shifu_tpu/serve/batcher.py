@@ -148,13 +148,18 @@ class ScoreRequest:
     batch-level featurize/device/d2h durations out per request."""
 
     __slots__ = ("data", "n_rows", "enqueued_at", "popped_at", "deadline",
-                 "_done", "result", "error", "trace", "failovers")
+                 "_done", "result", "error", "trace", "failovers",
+                 "wire_format")
 
     def __init__(self, data: ColumnarData,
                  deadline_s: Optional[float] = None,
                  trace=None) -> None:
         self.data = data
         self.n_rows = data.n_rows
+        # which wire format carried this request (serve/wire.py stamps
+        # "binary" on decoded batches; everything else is "json") — the
+        # format= label on serve.requests / serve.latency_seconds
+        self.wire_format = getattr(data, "wire_format", "json")
         self.enqueued_at = time.perf_counter()
         self.popped_at = self.enqueued_at
         self.deadline = (self.enqueued_at + deadline_s
@@ -193,11 +198,22 @@ def _concat_batches(datas: Sequence[ColumnarData]) -> ColumnarData:
     if len(datas) == 1:
         return datas[0]
     names = datas[0].names
-    raw = {
-        name: np.concatenate([np.asarray(d.column(name), dtype=object)
-                              for d in datas])
-        for name in names
-    }
+    raw = {}
+    for name in names:
+        typed = [d.typed_column(name) for d in datas]
+        if (typed[0] is not None
+                and all(t is not None and t.dtype == typed[0].dtype
+                        for t in typed)):
+            # every rider delivered this column typed (binary wire or
+            # typed JSON) with one dtype: the coalesced batch stays
+            # typed and the featurizer never parses a string for it.
+            # Mixed dtypes (an i64 rider next to an f64 one) fall to
+            # strings below — promoting i64 would print "3" as "3.0"
+            # and shift its categorical identity.
+            raw[name] = np.concatenate(typed)
+        else:
+            raw[name] = np.concatenate([
+                np.asarray(d.column(name), dtype=object) for d in datas])
     return ColumnarData(names=list(names), raw=raw,
                         n_rows=sum(d.n_rows for d in datas),
                         missing_values=datas[0].missing_values)
@@ -526,13 +542,26 @@ class MicroBatcher:
                         r.trace.annotate(**cap.attrs)
             off = 0
             now = time.perf_counter()
-            lat = reg.histogram("serve.latency_seconds",
-                                buckets=LATENCY_BUCKETS, **self.labels)
+            # per-request latency and count carry the wire-format label —
+            # a coalesced batch can mix JSON and binary riders, so the
+            # split happens here, per rider, not per batch
+            lat_by_fmt: dict = {}
+            n_by_fmt: dict = {}
             for r in batch:
                 r.resolve(_slice_result(result, off, off + r.n_rows))
                 off += r.n_rows
+                fmt = r.wire_format
+                lat = lat_by_fmt.get(fmt)
+                if lat is None:
+                    lat = reg.histogram("serve.latency_seconds",
+                                        buckets=LATENCY_BUCKETS,
+                                        format=fmt, **self.labels)
+                    lat_by_fmt[fmt] = lat
                 lat.observe(now - r.enqueued_at)
-            reg.counter("serve.requests", **self.labels).inc(len(batch))
+                n_by_fmt[fmt] = n_by_fmt.get(fmt, 0) + 1
+            for fmt, cnt in n_by_fmt.items():
+                reg.counter("serve.requests", format=fmt,
+                            **self.labels).inc(cnt)
             reg.counter("serve.records", **self.labels).inc(rows)
             self._inflight = None
             with self._drain_lock:

@@ -49,6 +49,7 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from shifu_tpu.analysis.racetrack import tracked_lock
+from shifu_tpu.data.reader import ColumnarData
 from shifu_tpu.eval.scorer import DEFAULT_SCORE_SCALE, ScoreResult
 from shifu_tpu.serve.batcher import (
     LATENCY_BUCKETS,
@@ -785,18 +786,28 @@ class ReplicaFleet:
                     timeout: Optional[float] = None,
                     extra_columns: Optional[Sequence[str]] = None,
                     trace=None) -> ScoreResult:
-        """Routed in-process scoring of raw records. A `trace`
+        """Routed in-process scoring of raw records — a list of dicts
+        (the JSON path) or an already-columnar batch (a decoded binary
+        wire payload, serve/wire.py), which skips record conversion and
+        only conforms to the serving schema. A `trace`
         (obs/reqtrace.RequestTrace) rides through record conversion
         (featurize), placement (route) and the batcher stages; the
         CALLER finishes it (finish_trace) so it can stamp its own
         serialize stage first."""
         cols = list(self.input_columns) + [
             c for c in (extra_columns or []) if c not in self.input_columns]
+
+        def featurize():
+            if isinstance(records, ColumnarData):
+                from shifu_tpu.serve import wire
+
+                return wire.conform_columns(records, cols)
+            return records_to_columnar(records, cols)
+
         if trace is None:
-            data = records_to_columnar(records, cols)
-            return self.submit(data).wait(timeout)
+            return self.submit(featurize()).wait(timeout)
         with trace.stage("featurize"):
-            data = records_to_columnar(records, cols)
+            data = featurize()
         trace.annotate(rows=data.n_rows)
         t0 = time.perf_counter()
         req = self.submit(data, trace=trace)

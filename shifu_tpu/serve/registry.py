@@ -80,18 +80,21 @@ def model_set_sha(paths: Sequence[str]) -> str:
 def records_to_columnar(
     records: Sequence[dict], columns: Sequence[str],
 ) -> ColumnarData:
-    """JSON records -> the raw columnar batch the scorers consume.
-    Absent/None fields become the empty missing token; everything else is
-    stringified so numeric JSON values and raw CSV fields normalize
-    identically."""
+    """JSON records -> the raw columnar batch the scorers consume,
+    through the SAME per-column typing rule the binary wire format uses
+    (serve/wire.py:column_from_values): all-float/null columns become
+    typed f64 arrays (null = NaN = the missing token) and all-int
+    columns i64, so the featurizer never re-parses a value JSON already
+    parsed; anything else stringifies exactly as raw CSV fields would.
+    One typing rule for both wire formats is what makes JSON and binary
+    batches score bit-identically."""
+    from shifu_tpu.serve import wire
+
     n = len(records)
-    raw: Dict[str, np.ndarray] = {}
-    for c in columns:
-        col = np.empty(n, dtype=object)
-        for i, r in enumerate(records):
-            v = r.get(c)
-            col[i] = "" if v is None else str(v)
-        raw[c] = col
+    raw: Dict[str, np.ndarray] = {
+        c: wire.column_from_values([r.get(c) for r in records])
+        for c in columns
+    }
     return ColumnarData(names=list(columns), raw=raw, n_rows=n)
 
 
@@ -420,16 +423,52 @@ class ModelRegistry:
                             for v in jax.tree_util.tree_leaves(host_consts)))
             drift_consts = jax.device_put(host_consts, self.device)
 
-        def fused(plan_inputs, drift_ops=None):
+        # staging layout: EVERY fused input — each plan's values and
+        # codes, then the drift featurize and its valid column — rides
+        # one [bucket, C] float32 host buffer, preallocated per row
+        # bucket and reused, so a coalesced batch crosses host->device
+        # as a SINGLE contiguous device_put instead of one transfer per
+        # leaf of an input pytree. Codes travel as f32 (bin
+        # cardinalities sit far below 2**24, where f32 holds every
+        # integer exactly) and cast back to i32 on device.
+        off = 0
+        self._val_slices: List[Tuple[int, int]] = []
+        self._code_slices: List[Tuple[int, int]] = []
+        for feat in self._featurizers:
+            nv = len(feat.value_specs)
+            nc = len(feat.coded_specs)
+            self._val_slices.append((off, off + nv))
+            off += nv
+            self._code_slices.append((off, off + nc))
+            off += nc
+        self._drift_slices = None
+        if drift is not None:
+            nv = len(drift.numeric_cols)
+            nc = len(drift.coded_cols)
+            dv = (off, off + nv)
+            off += nv
+            dc = (off, off + nc)
+            off += nc
+            self._drift_slices = (dv, dc, off)  # last col: valid mask
+            off += 1
+        self._staging_cols = off
+        self._staging: Dict[int, np.ndarray] = {}
+        self._drift_dead_window = None
+        val_slices = self._val_slices
+        code_slices = self._code_slices
+        drift_slices = self._drift_slices
+
+        def fused(staging, drift_window=None):
             import jax.numpy as jnp
 
             from shifu_tpu.models.nn import forward
 
-            normed = [
-                _plan_norm_device(plan, c, vals, codes)
-                for plan, c, (vals, codes)
-                in zip(plans, consts, plan_inputs)
-            ]
+            normed = []
+            for plan, c, vs, cs in zip(plans, consts, val_slices,
+                                       code_slices):
+                vals = staging[:, vs[0]:vs[1]]
+                codes = staging[:, cs[0]:cs[1]].astype(jnp.int32)
+                normed.append(_plan_norm_device(plan, c, vals, codes))
             cols = []
             for mi, spec in enumerate(specs):
                 x = normed[model_plan_idx[mi]]
@@ -442,16 +481,19 @@ class ModelRegistry:
             outs = (m, m.mean(axis=1), m.max(axis=1), m.min(axis=1),
                     jnp.median(m, axis=1))
             # the branch is on the ARGUMENT'S PYTREE STRUCTURE (None vs
-            # 4-tuple), which jit treats as static — a registry without a
+            # array), which jit treats as static — a registry without a
             # drift monitor traces the no-fold program, one with it
             # traces the fused fold; no traced value is branched on
-            if drift_ops is not None:  # shifu: noqa[JX002]
+            if drift_window is not None:  # shifu: noqa[JX002]
                 # the drift fold, fused: live bin counts vs the training
                 # bins accumulate into the resident window with no extra
                 # dispatch and no per-batch transfer
-                d_vals, d_codes, valid, window = drift_ops
+                (dv0, dv1), (dc0, dc1), vcol = drift_slices
                 outs = outs + (drift.traced_fold(
-                    drift_consts, window, d_vals, d_codes, valid),)
+                    drift_consts, drift_window,
+                    staging[:, dv0:dv1],
+                    staging[:, dc0:dc1].astype(jnp.int32),
+                    staging[:, vcol]),)
             return outs
 
         # ONE jit for the whole registry, constructed once (never inside
@@ -551,27 +593,30 @@ class ModelRegistry:
         bucket = self.bucket(n)
         code_cache: dict = {}
         numeric_cache: dict = {}
-        plan_inputs = []
-        for feat in self._featurizers:
+        # fill the bucket's preallocated staging buffer in place — one
+        # vectorized pass per coalesced batch, no per-plan pad copies.
+        # Reuse is safe: the sync dispatch below returns only after the
+        # device has consumed the previous contents.
+        buf = self._staging.get(bucket)
+        if buf is None:
+            buf = np.zeros((bucket, self._staging_cols), dtype=np.float32)
+            self._staging[bucket] = buf
+        elif n < bucket:
+            # pad rows may hold the previous batch; the valid column and
+            # value/code columns beyond row n must read as zeros
+            buf[n:, :] = 0.0
+        for feat, vs, cs in zip(self._featurizers, self._val_slices,
+                                self._code_slices):
             vals, codes = feat(data, code_cache, numeric_cache)
-            extra = bucket - n
-            if extra:
-                vals = np.pad(vals, ((0, extra), (0, 0)))
-                codes = np.pad(codes, ((0, extra), (0, 0)))
-            plan_inputs.append((vals, codes))
-        drift_host = None
+            buf[:n, vs[0]:vs[1]] = vals
+            buf[:n, cs[0]:cs[1]] = codes
         if self.drift is not None:
             d_vals, d_codes = self.drift.featurize(data, code_cache,
                                                    numeric_cache)
-            extra = bucket - n
-            if extra:
-                # padded numeric rows are NaN -> missing slot, but the
-                # valid mask zero-weights them anyway
-                d_vals = np.pad(d_vals, ((0, extra), (0, 0)))
-                d_codes = np.pad(d_codes, ((0, extra), (0, 0)))
-            valid = np.zeros(bucket, dtype=np.float32)
-            valid[:n] = 1.0
-            drift_host = (d_vals, d_codes, valid)
+            (dv0, dv1), (dc0, dc1), vcol = self._drift_slices
+            buf[:n, dv0:dv1] = d_vals
+            buf[:n, dc0:dc1] = d_codes
+            buf[:n, vcol] = 1.0
         key = (self.sha, bucket)
         new_bucket = key not in self._warm_buckets
         if new_bucket:
@@ -579,20 +624,19 @@ class ModelRegistry:
             reg.counter("serve.program_compiles", **self.labels).inc()
             reg.gauge("serve.registry.buckets", **self.labels).set(
                 len(self._warm_buckets))
-        # the hot seam: inputs staged with ONE explicit device_put, then
+        # the hot seam: the whole batch — every plan's inputs AND the
+        # drift featurize — crosses in ONE contiguous device_put, then
         # the fused dispatch must move no other bytes
         # (-Dshifu.sanitize=transfer). Profiled sync: the device_get
         # below blocks on the result anyway, so the wait costs nothing
         # and serve manifests get real per-batch device seconds.
         from shifu_tpu.obs import profile
 
-        if drift_host is not None:
-            # ONE device_put covers the plan inputs AND the batch's drift
-            # inputs (a second put dispatch costs real latency on a
-            # hand-of-rows online batch); the window is already
-            # device-resident. A non-live registry (staged shadow) folds
-            # into a throwaway window so the shared monitor never
-            # double-counts sampled batches.
+        if self.drift is not None:
+            # the window is already device-resident. A non-live registry
+            # (staged shadow) folds into a throwaway window so the
+            # shared monitor never double-counts sampled batches — ONE
+            # dead window cached per registry, not a put per call.
             if self.drift_live:
                 # per-(replica, device) window: the fleet-shared monitor
                 # keeps one resident window PER folding replica (merged
@@ -602,19 +646,19 @@ class ModelRegistry:
                 window, drift_gen = self.drift.window(
                     self.device, owner=self.labels.get("replica"))
             else:
-                window = jax.device_put(
-                    np.zeros(self.drift.total_slots, np.float32),
-                    self.device)
+                if self._drift_dead_window is None:
+                    self._drift_dead_window = jax.device_put(
+                        np.zeros(self.drift.total_slots, np.float32),
+                        self.device)
+                window = self._drift_dead_window
                 drift_gen = None
-            dev_inputs, drift_put = jax.device_put(
-                (tuple(plan_inputs), drift_host), self.device)
-            drift_dev = tuple(drift_put) + (window,)
+            dev_staging = jax.device_put(buf, self.device)
             reqtrace.note_stage("featurize", time.perf_counter() - t_feat,
                                 t0=t_feat)
             t_dev = time.perf_counter()
             with sanitize.transfer_free("serve.score"):
                 out = profile.dispatch("serve.fused_score", self._program,
-                                       dev_inputs, drift_dev, sync=True)
+                                       dev_staging, window, sync=True)
             t_d2h = time.perf_counter()
             reqtrace.note_stage("device", t_d2h - t_dev, t0=t_dev)
             m, mean, mx, mn, med = jax.device_get(out[:5])
@@ -626,13 +670,13 @@ class ModelRegistry:
                                        owner=self.labels.get("replica"))
                 reg.counter("loop.drift.rows").inc(n)
         else:
-            dev_inputs = jax.device_put(tuple(plan_inputs), self.device)
+            dev_staging = jax.device_put(buf, self.device)
             reqtrace.note_stage("featurize", time.perf_counter() - t_feat,
                                 t0=t_feat)
             t_dev = time.perf_counter()
             with sanitize.transfer_free("serve.score"):
                 out = profile.dispatch("serve.fused_score", self._program,
-                                       dev_inputs, sync=True)
+                                       dev_staging, sync=True)
             t_d2h = time.perf_counter()
             reqtrace.note_stage("device", t_d2h - t_dev, t0=t_dev)
             m, mean, mx, mn, med = jax.device_get(out)
@@ -664,7 +708,8 @@ class ModelRegistry:
         fused program's PR-6 `memory_analysis()` numbers per cached
         signature (= per warm row bucket), and `residentBytes` is the
         high-water cost of keeping the registry warm AND scoring its
-        largest compiled bucket: weights + max(args+temps+out)."""
+        largest compiled bucket: weights + max(args+temps+out) +
+        `stagingBytes` (the per-bucket pinned host handoff buffers)."""
         programs: List[dict] = []
         if self.fused and getattr(self, "_program", None) is not None:
             from shifu_tpu.obs import profile
@@ -672,11 +717,17 @@ class ModelRegistry:
             programs = profile.fn_memory("serve.fused_score",
                                          self._program)
         peak = max((p["peakBytes"] for p in programs), default=0.0)
+        # pinned host staging buffers, one per warm bucket: each batch's
+        # single device_put mirrors exactly one of them on device, so
+        # the zoo ledger charges the handoff once, here, not per request
+        staging = sum(b.nbytes
+                      for b in getattr(self, "_staging", {}).values())
         return {
             "weightsBytes": int(self.weights_bytes),
             "programs": programs,
             "programPeakBytes": int(peak),
-            "residentBytes": int(self.weights_bytes + peak),
+            "stagingBytes": int(staging),
+            "residentBytes": int(self.weights_bytes + peak + staging),
         }
 
     def release(self, refuse: bool = True) -> int:
@@ -709,6 +760,8 @@ class ModelRegistry:
             "inputColumns": len(self.input_columns),
             "warmBuckets": sorted(b for (_s, b) in self._warm_buckets),
             "weightsBytes": int(self.weights_bytes),
+            "stagingBytes": int(sum(
+                b.nbytes for b in getattr(self, "_staging", {}).values())),
             "driftMonitored": (len(self.drift.cols)
                                if self.drift is not None else 0),
         }

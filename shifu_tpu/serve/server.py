@@ -38,6 +38,7 @@ from shifu_tpu.eval.scorer import ScoreResult
 from shifu_tpu.serve.batcher import MicroBatcher
 from shifu_tpu.serve.fleet import ReplicaFleet, ScoringReplica
 from shifu_tpu.serve.health import DRAINING
+from shifu_tpu.serve import wire
 from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
 from shifu_tpu.serve.registry import ModelRegistry
 from shifu_tpu.serve.zoo import ColdStartError
@@ -46,6 +47,16 @@ from shifu_tpu.utils.log import get_logger
 log = get_logger(__name__)
 
 DEFAULT_SCORE_TIMEOUT_S = 30.0
+
+# Content-Types parsed as JSON/JSONL. "" (no header) stays JSON so bare
+# clients keep working, and x-www-form-urlencoded is curl -d's default —
+# every pre-wire client POSTs with it. Anything outside this set and the
+# columnar type is a 415, not a guess.
+_JSON_CONTENT_TYPES = frozenset({
+    "", "application/json", "text/json", "application/jsonl",
+    "application/x-ndjson", "text/plain",
+    "application/x-www-form-urlencoded",
+})
 
 
 class Scorer:
@@ -697,15 +708,61 @@ class ScoringServer:
                                  "POST /score (start with --zoo for "
                                  "per-set routes)"})
                     return
+                # wire-format negotiation: the columnar binary protocol
+                # (serve/wire.py) rides its own Content-Type; the JSON
+                # family stays the default. Malformed bodies of either
+                # kind are a 400 and unknown types a 415 — always a JSON
+                # error body, never a 500 or a hung worker.
+                ctype = (self.headers.get("Content-Type") or "")
+                ctype = ctype.split(";", 1)[0].strip().lower()
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
-                    records = _parse_records(self.rfile.read(length))
-                except ValueError as e:
-                    self._reply(400, {"error": f"bad request body: {e}"})
+                except ValueError:
+                    self._reply(400, {"error": "bad Content-Length"})
                     return
-                if not records:
+                from shifu_tpu.obs import registry as obs_registry
+
+                if ctype == wire.CONTENT_TYPE:
+                    wire_fmt = "binary"
+                    limit = wire.max_body_bytes()
+                    if length > limit:
+                        self._reply(400, {
+                            "error": f"columnar body of {length} bytes "
+                                     f"exceeds shifu.serve.wire.maxBodyMB "
+                                     f"({limit} bytes)"})
+                        return
+                    body = self.rfile.read(length)
+                    try:
+                        records = wire.decode(body)
+                    except wire.WireFormatError as e:
+                        self._reply(400, {
+                            "error": f"bad columnar body: {e}"})
+                        return
+                    n_rows = records.n_rows
+                elif ctype in _JSON_CONTENT_TYPES:
+                    wire_fmt = "json"
+                    body = self.rfile.read(length)
+                    try:
+                        records = _parse_records(body)
+                    except ValueError as e:
+                        self._reply(400, {
+                            "error": f"bad request body: {e}"})
+                        return
+                    n_rows = len(records)
+                else:
+                    self._reply(415, {
+                        "error": f"unsupported Content-Type {ctype!r}",
+                        "accepts": sorted(
+                            t for t in _JSON_CONTENT_TYPES if t
+                        ) + [wire.CONTENT_TYPE]})
+                    return
+                if not n_rows:
                     self._reply(400, {"error": "no records in body"})
                     return
+                # the wire-format mix, by payload bytes — one counter
+                # next to the format-labeled serve.requests split
+                obs_registry().counter("serve.wire.bytes",
+                                       format=wire_fmt).inc(len(body))
                 # trace id contract: an inbound X-Shifu-Trace header is
                 # honored (and FORCES retention — the caller asked for
                 # this trace), otherwise one is generated under the

@@ -1,0 +1,299 @@
+"""Columnar binary scoring wire format (zero-copy request path).
+
+The JSON request path pays three per-row Python taxes before a batch
+ever reaches the fused program: json parse into dicts, a per-cell
+``str()`` in ``records_to_columnar``, and a per-cell pandas re-parse
+back into numbers. On a fleet whose device time is sub-millisecond that
+host work IS the p99 (the PR-13 stage breakdown measures featurize at
+~0.45-0.64 of it) — the reference kept data off the coordinator's
+interpreter entirely (Pig mappers moved bytes, not objects); this is
+that discipline on the wire.
+
+``POST /score`` (and ``/score/<set>``) accepts this format next to JSON,
+negotiated by Content-Type (``application/x-shifu-columnar``); JSON
+stays the default. A binary batch decodes into TYPED numpy column
+views via ``np.frombuffer`` — no per-value Python objects on the
+numeric path — and the typed columns short-circuit the featurize parse
+(data/reader.py), so both formats converge on bit-identical
+``(values, codes)`` arrays (parity pinned in tests/test_serve.py).
+
+Layout, all little-endian, one header then ``n_cols`` column blocks::
+
+    offset  size  field
+    0       4     magic  b"SHWB"
+    4       2     version (u16) = 1
+    6       4     n_rows  (u32)
+    10      4     n_cols  (u32)
+
+    per column, sequentially:
+    +0      2     name_len (u16)
+    +2      var   column name (UTF-8, name_len bytes)
+    ..      1     type code (u8)
+    ..      var   payload (by type, below)
+
+    type  code  payload
+    f64   1     n_rows x 8 bytes (IEEE doubles)
+    i64   2     n_rows x 8 bytes (two's-complement)
+    f32   3     n_rows x 4 bytes
+    i32   4     n_rows x 4 bytes
+    str   5     (n_rows+1) x 4 byte u32 offsets, then offsets[-1]
+                bytes of concatenated UTF-8; row i is
+                bytes[offsets[i]:offsets[i+1]]
+
+Parity discipline (why the encoder defaults to f64/i64, never f32/i32):
+the JSON path stringifies every value and re-parses, so a numeric wire
+column must decode to the SAME doubles that round-trip produces —
+``str(float)`` round-trips IEEE doubles exactly (f64 safe) and
+``str(int)`` has no ``.0`` suffix (so integers need i64, not f64, or
+their categorical string form would diverge). f32/i32 are accepted on
+decode for clients that know their columns are pure-numeric and can
+tolerate the narrower type. Missing values are NaN in float columns
+(the JSON ``null`` analog); integer and string columns carry no NaN —
+encode a column with missing integers as f64 or str.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from shifu_tpu.data.reader import ColumnarData
+from shifu_tpu.utils import environment
+
+MAGIC = b"SHWB"
+VERSION = 1
+CONTENT_TYPE = "application/x-shifu-columnar"
+
+TYPE_F64 = 1
+TYPE_I64 = 2
+TYPE_F32 = 3
+TYPE_I32 = 4
+TYPE_STR = 5
+
+_DTYPES = {
+    TYPE_F64: np.dtype("<f8"),
+    TYPE_I64: np.dtype("<i8"),
+    TYPE_F32: np.dtype("<f4"),
+    TYPE_I32: np.dtype("<i4"),
+}
+_TYPE_OF_KIND = {"<f8": TYPE_F64, "<i8": TYPE_I64,
+                 "<f4": TYPE_F32, "<i4": TYPE_I32}
+
+_HEADER = struct.Struct("<4sHII")
+
+DEFAULT_MAX_BODY_MB = 64.0
+
+
+def max_body_bytes() -> int:
+    """shifu.serve.wire.maxBodyMB — largest binary request body the
+    server will decode (a bounds check before any allocation sized
+    from untrusted header fields)."""
+    return int(environment.get_float("shifu.serve.wire.maxBodyMB",
+                                     DEFAULT_MAX_BODY_MB)
+               * 1024.0 * 1024.0)
+
+
+class WireFormatError(ValueError):
+    """Malformed binary batch — the server answers 400, never a 500."""
+
+
+# ---- shared column typing (the JSON path converges here) ----
+def column_from_values(values: Sequence) -> np.ndarray:
+    """One request column from raw JSON values -> the typed (or string)
+    array BOTH wire formats produce, so parity between them is
+    structural, not coincidental:
+
+      all float/None  -> f64 (None = NaN; str(float) round-trips, so
+                         the stringified-JSON path parses back to the
+                         identical double)
+      all int         -> i64 (kept integral: str(1.0) is "1.0" but a
+                         categorical column must see "1")
+      anything else   -> object strings, the pre-wire representation
+                         (None -> "" missing token, str(v) otherwise;
+                         bools and mixed int/float land here — their
+                         string forms are not float-reconstructible)
+    """
+    kinds = set(map(type, values))
+    if kinds and kinds <= {float, type(None)}:
+        return np.asarray([np.nan if v is None else v for v in values],
+                          dtype=np.float64)
+    if kinds == {int}:
+        try:
+            return np.asarray(values, dtype=np.int64)
+        except OverflowError:  # > 64-bit ints: stringify like JSON did
+            pass
+    return np.asarray(["" if v is None else str(v) for v in values],
+                      dtype=object)
+
+
+def conform_columns(data: ColumnarData,
+                    columns: Sequence[str]) -> ColumnarData:
+    """Reshape a decoded batch to the serving schema: keep the typed
+    arrays of columns the client sent, synthesize absent columns as the
+    empty missing token (exactly what an absent JSON field becomes).
+    Extra client columns are dropped."""
+    raw: Dict[str, np.ndarray] = {}
+    for c in columns:
+        if isinstance(data.raw, dict) and c in data.raw:
+            raw[c] = data.raw[c]
+        elif c in data.names:
+            raw[c] = np.asarray(data.column(c), dtype=object)
+        else:
+            raw[c] = np.full(data.n_rows, "", dtype=object)
+    out = ColumnarData(names=list(columns), raw=raw, n_rows=data.n_rows,
+                       missing_values=data.missing_values)
+    out.wire_format = getattr(data, "wire_format", "json")
+    return out
+
+
+# ---- encode ----
+def encode(data: ColumnarData) -> bytes:
+    """Reference encoder: a ColumnarData (typed or string columns) ->
+    one wire payload. Typed numeric columns serialize as raw
+    little-endian buffers; everything else as offset-indexed UTF-8."""
+    parts = [_HEADER.pack(MAGIC, VERSION, data.n_rows, len(data.names))]
+    for name in data.names:
+        col = (data.raw[name] if isinstance(data.raw, dict)
+               else data.column(name))
+        nb = name.encode("utf-8")
+        parts.append(struct.pack("<H", len(nb)))
+        parts.append(nb)
+        arr = np.asarray(col)
+        code = _TYPE_OF_KIND.get(arr.dtype.newbyteorder("<").str)
+        if code is not None:
+            parts.append(struct.pack("<B", code))
+            parts.append(np.ascontiguousarray(
+                arr.astype(arr.dtype.newbyteorder("<"),
+                           copy=False)).tobytes())
+            continue
+        encoded = [("" if v is None else str(v)).encode("utf-8")
+                   for v in col]
+        offsets = np.zeros(len(encoded) + 1, dtype=np.uint32)
+        np.cumsum([len(b) for b in encoded], out=offsets[1:])
+        parts.append(struct.pack("<B", TYPE_STR))
+        parts.append(offsets.tobytes())
+        parts.append(b"".join(encoded))
+    return b"".join(parts)
+
+
+def encode_records(records: Sequence[dict],
+                   columns: Optional[Sequence[str]] = None) -> bytes:
+    """JSON-style records -> one wire payload (the bench/CI client
+    side). Columns default to first-seen key order across records."""
+    if columns is None:
+        columns = []
+        for r in records:
+            for k in r:
+                if k not in columns:
+                    columns.append(k)
+    raw = {c: column_from_values([r.get(c) for r in records])
+           for c in columns}
+    return encode(ColumnarData(names=list(columns), raw=raw,
+                               n_rows=len(records)))
+
+
+# ---- decode ----
+def _need(payload: bytes, offset: int, size: int, what: str) -> None:
+    if size < 0 or offset + size > len(payload):
+        raise WireFormatError(
+            f"truncated payload: {what} needs {size} bytes at offset "
+            f"{offset}, body is {len(payload)} bytes")
+
+
+def _decode_strings(payload: bytes, offset: int,
+                    n_rows: int, name: str) -> tuple:
+    """(object array of row strings, next offset) — u32 offsets then
+    concatenated UTF-8."""
+    osize = (n_rows + 1) * 4
+    _need(payload, offset, osize, f"column {name!r} string offsets")
+    offs = np.frombuffer(payload, dtype="<u4", count=n_rows + 1,
+                         offset=offset)
+    offset += osize
+    if offs[0] != 0 or (np.diff(offs.astype(np.int64)) < 0).any():
+        raise WireFormatError(
+            f"column {name!r} string offsets are not monotone from 0")
+    nbytes = int(offs[-1])
+    _need(payload, offset, nbytes, f"column {name!r} string bytes")
+    blob = payload[offset:offset + nbytes]
+    offset += nbytes
+    try:
+        text = blob.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise WireFormatError(
+            f"column {name!r} string bytes are not UTF-8: {e}") from None
+    out = np.empty(n_rows, dtype=object)
+    if len(text) == nbytes:  # pure ASCII: byte offsets == char offsets
+        for i in range(n_rows):
+            out[i] = text[offs[i]:offs[i + 1]]
+    else:
+        for i in range(n_rows):
+            out[i] = blob[offs[i]:offs[i + 1]].decode("utf-8")
+    return out, offset
+
+
+def decode(payload: bytes) -> ColumnarData:
+    """One wire payload -> a ColumnarData whose numeric columns are
+    zero-copy ``np.frombuffer`` views (no per-value Python objects) and
+    whose string columns are object arrays. Every malformed shape —
+    short header, wrong magic, unknown version or type code, name/
+    offset/buffer overruns — raises WireFormatError (a 400, by
+    contract never a 500)."""
+    _need(payload, 0, _HEADER.size, "header")
+    magic, version, n_rows, n_cols = _HEADER.unpack_from(payload, 0)
+    if magic != MAGIC:
+        raise WireFormatError(f"bad magic {magic!r} (want {MAGIC!r})")
+    if version != VERSION:
+        raise WireFormatError(
+            f"unsupported wire version {version} (speak {VERSION})")
+    # a forged column count cannot force a huge allocation: every block
+    # below bounds-checks against the actual body before reading, and
+    # the minimum per-column cost (3 bytes) caps plausible n_cols
+    if n_cols * 3 > len(payload):
+        raise WireFormatError(
+            f"{n_cols} columns cannot fit a {len(payload)}-byte body")
+    offset = _HEADER.size
+    names: List[str] = []
+    raw: Dict[str, np.ndarray] = {}
+    for _ in range(n_cols):
+        _need(payload, offset, 2, "column name length")
+        (name_len,) = struct.unpack_from("<H", payload, offset)
+        offset += 2
+        _need(payload, offset, name_len, "column name")
+        try:
+            name = payload[offset:offset + name_len].decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise WireFormatError(f"column name is not UTF-8: {e}") \
+                from None
+        offset += name_len
+        if not name or name in raw:
+            raise WireFormatError(
+                f"empty or duplicate column name {name!r}")
+        _need(payload, offset, 1, f"column {name!r} type code")
+        type_code = payload[offset]
+        offset += 1
+        dtype = _DTYPES.get(type_code)
+        if dtype is not None:
+            size = n_rows * dtype.itemsize
+            _need(payload, offset, size, f"column {name!r} values")
+            # the zero-copy core: a typed view straight into the
+            # request body — the featurizer consumes it without one
+            # Python object per value
+            raw[name] = np.frombuffer(payload, dtype=dtype,
+                                      count=n_rows, offset=offset)
+            offset += size
+        elif type_code == TYPE_STR:
+            raw[name], offset = _decode_strings(payload, offset,
+                                                n_rows, name)
+        else:
+            raise WireFormatError(
+                f"column {name!r} has unknown type code {type_code}")
+        names.append(name)
+    if offset != len(payload):
+        raise WireFormatError(
+            f"{len(payload) - offset} trailing bytes after the last "
+            "column")
+    data = ColumnarData(names=names, raw=raw, n_rows=int(n_rows))
+    data.wire_format = "binary"
+    return data

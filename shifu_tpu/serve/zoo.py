@@ -820,7 +820,15 @@ class ModelZoo:
         """Score on tenant `name`. Resident: the ordinary routed path
         (LRU touched). Cold: kick a background admission and raise
         ColdStartError — the caller answers 429 + Retry-After; the
-        admission queue never blocks behind a compile."""
+        admission queue never blocks behind a compile.
+
+        `records` is a list of dicts (JSON) or an already-columnar
+        batch (a decoded binary wire payload) — both flow through the
+        tenant fleet unchanged. The per-bucket staging buffers a
+        tenant's registries allocate for the one-device_put handoff are
+        charged to this ledger exactly once, via memory_analysis()'s
+        stagingBytes inside residentBytes (the same true-up that prices
+        weights and compiled programs)."""
         from shifu_tpu.obs import registry
 
         from shifu_tpu.serve.queue import RejectedError

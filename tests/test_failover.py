@@ -368,7 +368,8 @@ class TestFailover:
         # zero unanswered AND zero double-answered: every submitted
         # request resolved exactly once, all on the healthy replica
         assert resolved == total
-        assert counters.get('serve.requests{replica="1"}') == total
+        assert counters.get(
+            'serve.requests{format="json",replica="1"}') == total
         assert fleet.replicas[0].breaker.state == BREAKER_OPEN
         assert counters.get('serve.breaker.trips{replica="0"}') == 1.0
         assert counters.get(

@@ -616,8 +616,8 @@ class TestFleetMetrics:
             fleet.score_batch(_records(cols, 2, seed=i), timeout=30)
         fleet.close(10)
         page = obs.registry().to_prometheus()
-        assert 'serve_requests_total{replica="0"}' in page
-        assert 'serve_requests_total{replica="1"}' in page
+        assert 'serve_requests_total{format="json",replica="0"}' in page
+        assert 'serve_requests_total{format="json",replica="1"}' in page
         assert 'serve_queue_depth{replica="0"}' in page
         assert 'serve_latency_seconds_bucket' in page
         # a VALID single exporter page: every TYPE declared exactly once

@@ -432,8 +432,10 @@ class TestScoringServer:
         assert m["step"] == "serve"
         assert m["serve"]["sha"] == srv.registry.sha
         # fleet PR: serve.* metrics carry a replica label (replica "0"
-        # is the whole fleet at the default single-replica test config)
-        assert m["metrics"]["counters"]['serve.requests{replica="0"}'] >= 2
+        # is the whole fleet at the default single-replica test config);
+        # wire PR: requests/latency additionally split by format=
+        assert m["metrics"]["counters"][
+            'serve.requests{format="json",replica="0"}'] >= 2
         assert m["metrics"]["counters"]['serve.records{replica="0"}'] >= 6
         # post-shutdown: in-process scoring is an explicit rejection
         from shifu_tpu.serve.queue import RejectedError
@@ -746,7 +748,7 @@ class TestLatencyHistogramBuckets:
         admission.close()
         batcher.join(10)
         snap = obs.registry().snapshot()["histograms"]
-        lat = snap["serve.latency_seconds"]
+        lat = snap['serve.latency_seconds{format="json"}']
         want = ["inf" if b == float("inf") else b for b in LATENCY_BUCKETS]
         assert lat["buckets"] == want
         assert lat["count"] == 1

@@ -1,0 +1,441 @@
+"""Columnar binary wire protocol (shifu_tpu/serve/wire.py): encode/
+decode roundtrips, malformed-payload fuzzing (400-never-500 contract),
+JSON<->binary scoring parity (bit-identical, incl. missing tokens,
+unseen categories, non-ASCII), the one-device_put-per-coalesced-batch
+pin, and the HTTP negotiation surface (Content-Type routing, 415/400
+JSON error bodies, format-labeled metrics, the zoo per-set route)."""
+
+import json
+import os
+import struct
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_model_set
+
+
+@pytest.fixture(scope="module")
+def model_set(tmp_path_factory):
+    from shifu_tpu.processor.init import InitProcessor
+    from shifu_tpu.processor.norm import NormProcessor
+    from shifu_tpu.processor.stats import StatsProcessor
+    from shifu_tpu.processor.train import TrainProcessor
+
+    root = str(tmp_path_factory.mktemp("wire_ms"))
+    make_model_set(root, n_rows=300)
+    mcp = os.path.join(root, "ModelConfig.json")
+    mc = json.load(open(mcp))
+    mc["normalize"]["normType"] = "HYBRID"  # value kernel + woe gather
+    mc["train"]["numTrainEpochs"] = 25
+    json.dump(mc, open(mcp, "w"), indent=2)
+    assert InitProcessor(root).run() == 0
+    assert StatsProcessor(root).run() == 0
+    assert NormProcessor(root).run() == 0
+    assert TrainProcessor(root).run() == 0
+    return root
+
+
+def _parity_records(cols, n=6, seed=3):
+    """Records exercising every parity-sensitive shape: plain floats,
+    ints, missing tokens, absent fields, unseen categories, non-ASCII
+    categorical values."""
+    rng = np.random.default_rng(seed)
+    recs = []
+    for i in range(n):
+        r = {}
+        for j, c in enumerate(cols):
+            if c.startswith("cat"):
+                r[c] = ["red", "green", "blüe-∅", "never-seen", "?"][
+                    (i + j) % 5]
+            else:
+                r[c] = float(np.round(rng.normal(), 5))
+        recs.append(r)
+    # row with explicit nulls and a row with absent fields
+    recs[0][cols[0]] = None
+    recs[1] = {k: v for k, v in recs[1].items() if k != cols[1]}
+    # an all-int numeric column value (i64 wire path)
+    recs[2][cols[0]] = 3
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# encode/decode roundtrip
+# ---------------------------------------------------------------------------
+
+
+class TestRoundtrip:
+    def test_typed_and_string_columns(self):
+        from shifu_tpu.serve import wire
+
+        records = [
+            {"f": 1.5, "i": 3, "s": "café", "m": None},
+            {"f": None, "i": -7, "s": "x", "m": 2.0},
+            {"f": -0.25, "i": 9, "s": "", "m": "tok"},
+        ]
+        data = wire.decode(wire.encode_records(records))
+        assert data.wire_format == "binary"
+        assert data.names == ["f", "i", "s", "m"]
+        assert data.n_rows == 3
+        # numeric columns decode to typed zero-copy views
+        f = data.typed_column("f")
+        assert f is not None and f.dtype == np.float64
+        np.testing.assert_array_equal(f, [1.5, np.nan, -0.25])
+        i = data.typed_column("i")
+        assert i is not None and i.dtype == np.int64
+        np.testing.assert_array_equal(i, [3, -7, 9])
+        # mixed column went through the string path, None -> ""
+        assert data.typed_column("m") is None
+        assert list(data.column("m")) == ["", "2.0", "tok"]
+        # non-ASCII categorical survives byte-exact
+        assert list(data.column("s")) == ["café", "x", ""]
+
+    def test_canonical_strings_match_json_path(self):
+        from shifu_tpu.serve import wire
+        from shifu_tpu.serve.registry import records_to_columnar
+
+        records = [{"a": 1.5, "b": 3, "c": "zé"},
+                   {"a": None, "b": -2, "c": None}]
+        cols = ["a", "b", "c"]
+        via_wire = wire.decode(wire.encode_records(records, cols))
+        via_json = records_to_columnar(records, cols)
+        for c in cols:
+            assert list(via_wire.column(c)) == list(via_json.column(c))
+            np.testing.assert_array_equal(via_wire.numeric(c),
+                                          via_json.numeric(c))
+            np.testing.assert_array_equal(via_wire.missing_mask(c),
+                                          via_json.missing_mask(c))
+
+    def test_conform_synthesizes_absent_columns(self):
+        from shifu_tpu.serve import wire
+
+        data = wire.decode(wire.encode_records([{"a": 1.0}, {"a": 2.0}]))
+        out = wire.conform_columns(data, ["a", "zzz"])
+        assert out.names == ["a", "zzz"]
+        assert out.wire_format == "binary"
+        assert list(out.column("zzz")) == ["", ""]
+        # the typed column rides through untouched
+        assert out.typed_column("a") is not None
+
+    def test_encoder_type_selection(self):
+        from shifu_tpu.serve import wire
+
+        # bools are NOT ints here: their strings aren't numeric
+        assert wire.column_from_values([True, False]).dtype == object
+        # mixed int/float stringifies (a "1" vs "1.0" categorical
+        # identity must not depend on its neighbors' types)
+        assert wire.column_from_values([1, 2.0]).dtype == object
+        # > 64-bit ints stringify like the JSON path did
+        assert wire.column_from_values([10 ** 30]).dtype == object
+        assert wire.column_from_values([1, 2]).dtype == np.int64
+        assert wire.column_from_values([1.0, None]).dtype == np.float64
+
+    def test_f32_i32_accepted_on_decode(self):
+        from shifu_tpu.data.reader import ColumnarData
+        from shifu_tpu.serve import wire
+
+        data = ColumnarData(
+            names=["a", "b"],
+            raw={"a": np.asarray([1.5, 2.5], np.float32),
+                 "b": np.asarray([3, 4], np.int32)},
+            n_rows=2)
+        out = wire.decode(wire.encode(data))
+        assert out.typed_column("a").dtype == np.float32
+        assert out.typed_column("b").dtype == np.int32
+        np.testing.assert_array_equal(out.numeric("a"), [1.5, 2.5])
+
+
+# ---------------------------------------------------------------------------
+# malformed payloads: WireFormatError always, anything else never
+# ---------------------------------------------------------------------------
+
+
+class TestMalformed:
+    def _payload(self):
+        from shifu_tpu.serve import wire
+
+        return wire.encode_records([
+            {"num": 1.5, "cat": "rouge"},
+            {"num": None, "cat": "vért"},
+        ])
+
+    def test_truncation_sweep(self):
+        """EVERY proper prefix of a valid payload must raise
+        WireFormatError — no IndexError, no struct.error, no hang."""
+        from shifu_tpu.serve import wire
+
+        payload = self._payload()
+        for cut in range(len(payload)):
+            with pytest.raises(wire.WireFormatError):
+                wire.decode(payload[:cut])
+
+    def test_wrong_magic_and_version(self):
+        from shifu_tpu.serve import wire
+
+        payload = self._payload()
+        with pytest.raises(wire.WireFormatError, match="magic"):
+            wire.decode(b"NOPE" + payload[4:])
+        bad_ver = payload[:4] + struct.pack("<H", 99) + payload[6:]
+        with pytest.raises(wire.WireFormatError, match="version"):
+            wire.decode(bad_ver)
+
+    def test_row_count_buffer_mismatch(self):
+        from shifu_tpu.serve import wire
+
+        payload = self._payload()
+        # forge n_rows upward: every numeric/offset buffer is now too
+        # short for the claimed rows
+        forged = payload[:6] + struct.pack("<I", 10 ** 6) + payload[10:]
+        with pytest.raises(wire.WireFormatError):
+            wire.decode(forged)
+        # forge n_cols upward: must fail the plausibility bound, not
+        # walk off the end
+        forged = payload[:10] + struct.pack("<I", 2 ** 31) + payload[14:]
+        with pytest.raises(wire.WireFormatError):
+            wire.decode(forged)
+
+    def test_trailing_bytes_and_unknown_type(self):
+        from shifu_tpu.serve import wire
+
+        payload = self._payload()
+        with pytest.raises(wire.WireFormatError, match="trailing"):
+            wire.decode(payload + b"\x00")
+        # corrupt the first column's type code (offset: header + name_len
+        # field + 3-byte name "num")
+        off = 14 + 2 + 3
+        bad = payload[:off] + b"\xee" + payload[off + 1:]
+        with pytest.raises(wire.WireFormatError):
+            wire.decode(bad)
+
+    def test_non_monotone_string_offsets(self):
+        from shifu_tpu.serve import wire
+
+        # one str column, 2 rows, offsets [0, 5, 3] (decreasing)
+        head = struct.pack("<4sHII", wire.MAGIC, wire.VERSION, 2, 1)
+        col = (struct.pack("<H", 1) + b"c" + struct.pack("<B", wire.TYPE_STR)
+               + np.asarray([0, 5, 3], np.uint32).tobytes() + b"abc")
+        with pytest.raises(wire.WireFormatError, match="monotone"):
+            wire.decode(head + col)
+
+    def test_duplicate_and_empty_column_names(self):
+        from shifu_tpu.serve import wire
+
+        head = struct.pack("<4sHII", wire.MAGIC, wire.VERSION, 1, 2)
+        one = (struct.pack("<H", 1) + b"a" + struct.pack("<B", wire.TYPE_I32)
+               + np.asarray([7], np.int32).tobytes())
+        with pytest.raises(wire.WireFormatError, match="duplicate"):
+            wire.decode(head + one + one)
+
+
+# ---------------------------------------------------------------------------
+# scoring parity: binary and JSON paths are bit-identical
+# ---------------------------------------------------------------------------
+
+
+class TestScoringParity:
+    def test_registry_bit_identical(self, model_set):
+        from shifu_tpu.serve import wire
+        from shifu_tpu.serve.registry import ModelRegistry
+
+        reg = ModelRegistry(os.path.join(model_set, "models"))
+        recs = _parity_records(reg.input_columns)
+        via_json = reg.score_records(recs)
+        decoded = wire.decode(wire.encode_records(recs))
+        via_bin = reg.score_raw(
+            wire.conform_columns(decoded, reg.input_columns))
+        # BIT-identical, not allclose: both formats must converge on the
+        # same (values, codes) arrays before the same fused program
+        np.testing.assert_array_equal(via_bin.model_scores,
+                                      via_json.model_scores)
+        np.testing.assert_array_equal(via_bin.mean, via_json.mean)
+        np.testing.assert_array_equal(via_bin.median, via_json.median)
+
+    @pytest.mark.parametrize("n_replicas", [1, 2])
+    def test_fleet_bit_identical(self, model_set, n_replicas):
+        from shifu_tpu.serve import wire
+        from shifu_tpu.serve.fleet import ReplicaFleet
+
+        fleet = ReplicaFleet.build(os.path.join(model_set, "models"),
+                                   n_replicas=n_replicas)
+        try:
+            cols = list(fleet.input_columns)
+            recs = _parity_records(cols)
+            via_json = fleet.score_batch(recs, timeout=30)
+            decoded = wire.decode(wire.encode_records(recs, cols))
+            via_bin = fleet.score_batch(decoded, timeout=30)
+            np.testing.assert_array_equal(via_bin.model_scores,
+                                          via_json.model_scores)
+            np.testing.assert_array_equal(via_bin.mean, via_json.mean)
+        finally:
+            fleet.close(10)
+
+    def test_one_device_put_per_coalesced_batch(self, model_set,
+                                                monkeypatch):
+        """The transfer seam, pinned: after warm-up, scoring one
+        coalesced batch issues EXACTLY one jax.device_put — the pinned
+        staging-buffer handoff. (The fused dispatch itself runs inside
+        the transfer_free sanitizer, so implicit copies already raise;
+        this pins the explicit side.)"""
+        import jax
+
+        from shifu_tpu.serve.registry import ModelRegistry
+
+        reg = ModelRegistry(os.path.join(model_set, "models"))
+        recs = _parity_records(reg.input_columns, n=5)
+        reg.score_records(recs)  # warm: compiles + allocates staging
+        real_put = jax.device_put
+        calls = []
+
+        def counting_put(x, *a, **kw):
+            calls.append(x)
+            return real_put(x, *a, **kw)
+
+        monkeypatch.setattr(jax, "device_put", counting_put)
+        reg.score_records(recs)
+        assert len(calls) == 1
+        # and the one put is the staging buffer itself: a single
+        # contiguous f32 matrix, not a pytree of per-plan leaves
+        assert isinstance(calls[0], np.ndarray)
+        assert calls[0].dtype == np.float32
+        assert calls[0].ndim == 2
+
+    def test_staging_buffer_reuse_and_accounting(self, model_set):
+        from shifu_tpu.serve.registry import ModelRegistry
+
+        reg = ModelRegistry(os.path.join(model_set, "models"))
+        recs = _parity_records(reg.input_columns, n=5)
+        r1 = reg.score_records(recs)
+        buf_id = id(reg._staging[reg.bucket(5)])
+        r2 = reg.score_records(recs)
+        # same bucket -> same preallocated buffer, same answers
+        assert id(reg._staging[reg.bucket(5)]) == buf_id
+        np.testing.assert_array_equal(r1.model_scores, r2.model_scores)
+        # a short batch after a longer one: stale pad rows must be wiped
+        r3 = reg.score_records(recs[:2])
+        np.testing.assert_array_equal(r3.model_scores,
+                                      r1.model_scores[:2])
+        mem = reg.memory_analysis()
+        assert mem["stagingBytes"] == sum(
+            b.nbytes for b in reg._staging.values())
+        assert mem["stagingBytes"] > 0
+        assert mem["residentBytes"] >= (mem["weightsBytes"]
+                                        + mem["stagingBytes"])
+        assert reg.snapshot()["stagingBytes"] == mem["stagingBytes"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: negotiation, error bodies, labeled metrics, zoo route
+# ---------------------------------------------------------------------------
+
+
+def _post_raw(url, body, ctype):
+    req = urllib.request.Request(
+        url, data=body if isinstance(body, bytes) else body.encode(),
+        headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestHTTPWire:
+    def test_binary_post_parity_errors_and_labels(self, model_set):
+        from shifu_tpu import obs
+        from shifu_tpu.serve import wire
+        from shifu_tpu.serve.server import ScoringServer
+
+        obs.reset()
+        srv = ScoringServer(root=model_set, max_wait_ms=1,
+                            replicas=1).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            recs = _parity_records(srv.registry.input_columns)
+            status, via_json = _post_raw(
+                f"{base}/score", json.dumps({"records": recs}),
+                "application/json")
+            assert status == 200
+            payload = wire.encode_records(recs)
+            status, via_bin = _post_raw(f"{base}/score", payload,
+                                        wire.CONTENT_TYPE)
+            assert status == 200
+            assert via_bin["scores"] == via_json["scores"]
+
+            # unknown Content-Type: 415 with a JSON error body
+            with pytest.raises(urllib.error.HTTPError) as he:
+                _post_raw(f"{base}/score", payload, "application/msgpack")
+            assert he.value.code == 415
+            err = json.loads(he.value.read())
+            assert "accepts" in err and wire.CONTENT_TYPE in err["accepts"]
+
+            # malformed binary payloads: 400 + JSON body, never a 500 —
+            # truncations, garbled magic, forged row counts
+            for bad in (payload[:7], payload[:-2], b"XXXX" + payload[4:],
+                        payload[:6] + struct.pack("<I", 10 ** 6)
+                        + payload[10:], b""):
+                with pytest.raises(urllib.error.HTTPError) as he:
+                    _post_raw(f"{base}/score", bad, wire.CONTENT_TYPE)
+                assert he.value.code == 400
+                assert "error" in json.loads(he.value.read())
+
+            snap = obs.registry().snapshot()["counters"]
+            assert snap['serve.requests{format="binary",replica="0"}'] == 1
+            assert snap['serve.requests{format="json",replica="0"}'] == 1
+            assert snap['serve.wire.bytes{format="binary"}'] == len(payload)
+            assert snap['serve.wire.bytes{format="json"}'] > 0
+            page = urllib.request.urlopen(f"{base}/metrics",
+                                          timeout=10).read().decode()
+            assert 'serve_wire_bytes_total{format="binary"}' in page
+            assert ('serve_requests_total'
+                    '{format="binary",replica="0"}') in page
+        finally:
+            manifest = srv.shutdown()
+        # both formats' labeled counters land in the shutdown manifest
+        m = json.load(open(manifest))
+        counters = m["metrics"]["counters"]
+        assert counters['serve.requests{format="binary",replica="0"}'] == 1
+        assert counters['serve.wire.bytes{format="binary"}'] == len(payload)
+
+    def test_oversize_binary_body_is_400(self, model_set):
+        from shifu_tpu.serve import wire
+        from shifu_tpu.serve.server import ScoringServer
+        from shifu_tpu.utils import environment
+
+        srv = ScoringServer(root=model_set, max_wait_ms=1,
+                            replicas=1).start()
+        environment.set_property("shifu.serve.wire.maxBodyMB", "0.00001")
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            payload = wire.encode_records(
+                _parity_records(srv.registry.input_columns, n=8))
+            assert len(payload) > wire.max_body_bytes()
+            with pytest.raises(urllib.error.HTTPError) as he:
+                _post_raw(f"{base}/score", payload, wire.CONTENT_TYPE)
+            assert he.value.code == 400
+            assert "maxBodyMB" in json.loads(he.value.read())["error"]
+        finally:
+            environment.set_property("shifu.serve.wire.maxBodyMB", "")
+            srv.shutdown()
+
+    def test_zoo_set_route_parity(self, model_set):
+        from shifu_tpu import obs
+        from shifu_tpu.serve import wire
+        from shifu_tpu.serve.server import ScoringServer
+
+        obs.reset()
+        srv = ScoringServer(root=model_set, port=0, replicas=1,
+                            zoo={"a": model_set}).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            cols = srv.registry.input_columns
+            recs = _parity_records(cols)
+            status, via_json = _post_raw(
+                f"{base}/score/a", json.dumps({"records": recs}),
+                "application/json")
+            assert status == 200
+            status, via_bin = _post_raw(
+                f"{base}/score/a", wire.encode_records(recs, cols),
+                wire.CONTENT_TYPE)
+            assert status == 200
+            assert via_bin["scores"] == via_json["scores"]
+        finally:
+            srv.shutdown()

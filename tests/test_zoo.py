@@ -363,8 +363,10 @@ class TestTenantMetricsAndHealth:
         zoo.score_batch("a", _records(cols))
         zoo.score_batch("b", _records(cols))
         page = obs.registry().to_prometheus()
-        assert 'serve_requests_total{replica="0",tenant="a"}' in page
-        assert 'serve_requests_total{replica="0",tenant="b"}' in page
+        assert ('serve_requests_total'
+                '{format="json",replica="0",tenant="a"}') in page
+        assert ('serve_requests_total'
+                '{format="json",replica="0",tenant="b"}') in page
         assert 'serve_queue_depth{replica="0",tenant="a"}' in page
         assert 'serve_zoo_hbm_used_bytes' in page
         assert 'serve_zoo_resident_tenants 2' in page
