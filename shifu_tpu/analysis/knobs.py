@@ -99,6 +99,21 @@ KNOBS: List[Knob] = [
     _K("shifu.trace.maxEvents", "int", "65536",
        "span-tracer event ring capacity (obs/tracing.py; overflow "
        "drops the oldest span, counted trace.dropped)"),
+    # ---- fleet observability plane (PR 17) ----
+    _K("shifu.obs.snapshotMs", "float", "0 (= off)",
+       "on-disk metrics time-series cadence: every this-many ms the "
+       "serve process rewrites a delta-encoded registry snapshot chunk "
+       "under .shifu/runs/obs/<leaseId>/ (atomic rotating files) — a "
+       "SIGKILLed process still leaves its last windows behind"),
+    _K("shifu.obs.chunkWindows", "int", "8",
+       "snapshot windows per time-series chunk file; every chunk opens "
+       "with a FULL snapshot, so retention can drop whole chunks"),
+    _K("shifu.obs.retainChunks", "int", "16",
+       "time-series chunk files kept per process (older ones deleted)"),
+    _K("shifu.obs.fleet.timeoutMs", "float", "1000",
+       "per-peer scrape timeout for the /fleet/metrics collector (live "
+       "peers over loopback HTTP, expired peers from their on-disk "
+       "time-series)"),
     # ---- sanitizers (PR 4, this PR) ----
     _K("shifu.sanitize", "str", "",
        "comma list of armed sanitizer modes: transfer,nan,recompile,race"
@@ -181,6 +196,12 @@ KNOBS: List[Knob] = [
     _K("shifu.serve.sloTarget", "float", "0.99",
        "SLO objective (fraction of requests that must meet sloMs); "
        "burn rate = windowed bad fraction / (1 - target)"),
+    _K("shifu.serve.slo.*.ms", "float", "shifu.serve.sloMs",
+       "per-tenant SLO threshold override (e.g. shifu.serve.slo.fraud"
+       ".ms) — each zoo tenant's SloTracker resolves its own budget"),
+    _K("shifu.serve.slo.*.target", "float", "shifu.serve.sloTarget",
+       "per-tenant SLO objective override (also drives the per-tenant "
+       "burn in /fleet/healthz and `shifu top`)"),
     # ---- failure domains (PR 14): replica circuit breaker ----
     _K("shifu.serve.breaker.failures", "int", "3",
        "consecutive device-dispatch failures that trip a replica's "

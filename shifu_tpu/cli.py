@@ -289,9 +289,38 @@ def build_parser() -> argparse.ArgumentParser:
                          help="print one trace's full per-stage "
                               "timeline (searches all trace files, "
                               "newest first)")
+    p_trace.add_argument("--fleet", action="store_true",
+                         help="stitch EVERY run/process trace file "
+                              "under .shifu/runs into ONE Perfetto "
+                              "export (.shifu/runs/fleet.traces.json) "
+                              "with a track group per process — a "
+                              "fleet promote round renders as one "
+                              "cross-process timeline")
+    p_trace.add_argument("--out", default=None, metavar="PATH",
+                         help="with --fleet: stitched export path")
     p_trace.add_argument("--json", action="store_true", dest="as_json",
                          help="emit the selected trace summaries as "
                               "JSON")
+
+    p_top = sub.add_parser(
+        "top", help="terminal dashboard over the fleet observability "
+                    "plane: polls one serve process's /fleet/healthz + "
+                    "/fleet/metrics (every process answers for the "
+                    "whole fleet) and renders fleet QPS, per-stage "
+                    "p50/p99, SLO burn, breaker states, per-tenant HBM "
+                    "residency and queue depths (jax-free)")
+    p_top.add_argument("--url", default="http://127.0.0.1:8080",
+                       help="any fleet member's base URL (default "
+                            "http://127.0.0.1:8080)")
+    p_top.add_argument("--interval", type=float, default=2.0,
+                       help="poll/refresh interval in seconds "
+                            "(default 2)")
+    p_top.add_argument("--once", action="store_true",
+                       help="render ONE frame and exit (no screen "
+                            "clear; for scripts and CI)")
+    p_top.add_argument("--json", action="store_true", dest="as_json",
+                       help="with --once: print the raw /fleet/healthz "
+                            "payload as JSON")
 
     p_runs = sub.add_parser(
         "runs", help="list run-ledger manifests (.shifu/runs)")
@@ -593,11 +622,14 @@ def dispatch(args: argparse.Namespace) -> int:
     if cmd == "trace":
         import json
 
+        from shifu_tpu.obs.ledger import runs_dir
         from shifu_tpu.obs.reqtrace import (
+            FLEET_TRACE_BASENAME,
             format_trace_detail,
             format_trace_table,
             load_trace_file,
             slowest_summaries,
+            stitch_trace_files,
             trace_files,
         )
 
@@ -606,6 +638,27 @@ def dispatch(args: argparse.Namespace) -> int:
             print("(no trace files under .shifu/runs — serve with "
                   "-Dshifu.trace.sample>0, -Dshifu.trace.slowMs>0 or an "
                   "X-Shifu-Trace header, then shut down cleanly)")
+            return 0
+        if args.fleet:
+            out_path = args.out or os.path.join(runs_dir("."),
+                                                FLEET_TRACE_BASENAME)
+            doc = stitch_trace_files(files, out_path)
+            if doc is None:
+                log.error("trace --fleet: none of %d trace file(s) "
+                          "were readable", len(files))
+                return 2
+            summ = doc["summary"]
+            if args.as_json:
+                print(json.dumps({"file": out_path, "summary": summ},
+                                 indent=2, sort_keys=True))
+            else:
+                print(f"stitched {summ['count']} trace(s) from "
+                      f"{len(summ['sources'])} file(s) -> {out_path}")
+                for src in summ["sources"]:
+                    print(f"  {src['label']:<28} {src['traces']:>5} "
+                          f"trace(s)")
+                print("open it in Perfetto (ui.perfetto.dev) for the "
+                      "per-process track groups")
             return 0
         if args.show:
             for path in files:
@@ -623,12 +676,27 @@ def dispatch(args: argparse.Namespace) -> int:
             log.error("trace id %s not found in %d trace file(s)",
                       args.show, len(files))
             return 1
-        try:
-            doc = load_trace_file(files[0])
-        except (OSError, ValueError) as e:
-            log.error("trace: cannot read %s: %s", files[0], e)
+        # the listing reads EVERY run/process trace file (newest file
+        # first), not just the newest run's — a fleet leaves one file
+        # per process behind
+        summaries = []
+        read_files = []
+        captured = dropped = 0
+        for path in files:
+            try:
+                doc = load_trace_file(path)
+            except (OSError, ValueError) as e:
+                log.warning("trace: cannot read %s: %s", path, e)
+                continue
+            read_files.append(path)
+            summaries.extend(doc.get("shifuTraces", []))
+            summ = doc.get("summary") or {}
+            captured += int(summ.get("count") or 0)
+            dropped += int(summ.get("dropped") or 0)
+        if not read_files:
+            log.error("trace: none of %d trace file(s) were readable",
+                      len(files))
             return 2
-        summaries = doc.get("shifuTraces", [])
         if args.slowest is not None:
             summaries = slowest_summaries(summaries, args.slowest,
                                           stage=args.stage)
@@ -636,17 +704,21 @@ def dispatch(args: argparse.Namespace) -> int:
             summaries = summaries[:args.last
                                   if args.last is not None else 10]
         if args.as_json:
-            print(json.dumps({"file": files[0],
-                              "summary": doc.get("summary"),
+            print(json.dumps({"files": read_files,
+                              "captured": captured,
+                              "dropped": dropped,
                               "traces": summaries},
                              indent=2, sort_keys=True))
         else:
-            print(f"{files[0]} "
-                  f"({(doc.get('summary') or {}).get('count', '?')} "
-                  f"trace(s), dropped "
-                  f"{(doc.get('summary') or {}).get('dropped', 0)})")
+            print(f"{len(read_files)} trace file(s), {captured} "
+                  f"trace(s), dropped {dropped}")
             print(format_trace_table(summaries))
         return 0
+    if cmd == "top":
+        from shifu_tpu.obs.top import run_top
+
+        return run_top(args.url, interval_s=args.interval,
+                       once=args.once, as_json=args.as_json)
     if cmd == "runs":
         import json
 

@@ -249,8 +249,15 @@ def run_promotion_round(root: str, candidate_dir: str,
     after (a) unanimous ok-acks, (b) a fence re-check against the live
     lease files, (c) no abort record exists (a participant that
     self-aborted at deadline+grace writes one — its rollback must win),
-    and (d) the deadline has not passed. Everything else aborts."""
+    and (d) the deadline has not passed. Everything else aborts.
+
+    The whole round runs under ONE trace id (`round-<rid>`), stamped
+    into the prepare record: this coordinator's prepare/acks/fence/
+    commit spans and every participant's stage/ack/commit spans share
+    it, so `shifu trace --fleet` renders the round as one stitched
+    cross-process timeline."""
     from shifu_tpu.loop import rounds
+    from shifu_tpu.obs import reqtrace
     from shifu_tpu.resilience import lease
 
     fence = [{"leaseId": p["leaseId"], "token": p["token"],
@@ -259,20 +266,31 @@ def run_promotion_round(root: str, candidate_dir: str,
     deadline_s = round_deadline_ms_setting() / 1000.0 or ttl_s
     rid = rounds.new_round_id()
     deadline = time.time() + deadline_s
-    rounds.write_prepare(root, rid, candidate_dir, candidate_sha,
-                         fence, deadline)
+    rt = reqtrace.RequestTrace(trace_id=f"round-{rid}", sampled=True)
+    rt.annotate(role="coordinator", round=rid, sha=candidate_sha,
+                peers=len(fence))
+    with rt.stage("prepare"):
+        rounds.write_prepare(root, rid, candidate_dir, candidate_sha,
+                             fence, deadline, trace=rt.trace_id)
     log.info("promotion round %s: prepared for %d peer(s), deadline in "
              "%.1f s", rid, len(fence), deadline_s)
     want = {f["leaseId"] for f in fence}
     out = {"round": rid, "peers": fence, "acks": {}, "committed": False,
-           "deadlineUnix": deadline}
+           "deadlineUnix": deadline, "trace": rt.trace_id}
+
+    def _finish(outcome: str) -> None:
+        rt.annotate(outcome=outcome)
+        reqtrace.buffer().offer(rt)
 
     def _abort(reason: str) -> dict:
-        rounds.write_abort(root, rid, reason)
+        with rt.stage("abort"):
+            rounds.write_abort(root, rid, reason)
         out["reason"] = reason
+        _finish("abort")
         log.warning("promotion round %s aborted: %s", rid, reason)
         return out
 
+    t_acks = time.perf_counter()
     while True:
         state = rounds.read_round(root, rid)
         out["acks"] = state["acks"]
@@ -293,11 +311,13 @@ def run_promotion_round(root: str, candidate_dir: str,
             return _abort("no ack from " + ", ".join(missing)
                           + " within the lease TTL")
         time.sleep(rounds.ROUND_POLL_S)
+    rt.add_stage("acks", time.perf_counter() - t_acks, t_acks)
     # unanimous — but only the SAME incarnations that acked may commit:
     # a peer that died (lease expired/vanished) or restarted (token or
     # epoch changed) after acking cannot apply the commit, and a fleet
     # minus one is a half-promoted fleet
-    broken = lease.fence_check(root, fence)
+    with rt.stage("fence"):
+        broken = lease.fence_check(root, fence)
     if broken:
         return _abort("; ".join(broken))
     if rounds.read_round(root, rid)["abort"] is not None:
@@ -305,6 +325,7 @@ def run_promotion_round(root: str, candidate_dir: str,
         # deadline+grace) — its rollback already happened and MUST win;
         # committing over it would split the fleet
         out["reason"] = "a participant aborted the round first"
+        _finish("stale")
         log.warning("promotion round %s: not committing — %s",
                     rid, out["reason"])
         return out
@@ -312,8 +333,10 @@ def run_promotion_round(root: str, candidate_dir: str,
         # participants may already be rolling back — committing now
         # could split the fleet
         return _abort("unanimous acks arrived after the deadline")
-    rounds.write_commit(root, rid, candidate_sha)
+    with rt.stage("commit"):
+        rounds.write_commit(root, rid, candidate_sha)
     out["committed"] = True
+    _finish("commit")
     log.info("promotion round %s: committed %s on %d peer(s)",
              rid, candidate_sha, len(fence))
     return out
@@ -508,6 +531,15 @@ def run_promote(root: str, candidate_dir: Optional[str],
                                "swap": swap}},
         )
         log.info("promote manifest -> %s", path)
+        if mode == "fleet" and round_info is not None:
+            # the coordinator's round spans, beside the manifest — the
+            # half `shifu trace --fleet` stitches with the participants'
+            from shifu_tpu.obs import reqtrace
+
+            traces_path = os.path.join(ledger.dir,
+                                       f"promote-{seq}.traces.json")
+            if reqtrace.buffer().write_traces(traces_path):
+                log.info("round trace -> %s", traces_path)
     except OSError as e:
         log.warning("cannot write promote manifest: %s", e)
     if error:

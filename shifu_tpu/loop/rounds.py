@@ -89,7 +89,11 @@ def _read_json(path: str) -> Optional[dict]:
 
 def write_prepare(root: str, round_id: str, candidate_dir: str,
                   candidate_sha: str, fence: List[Dict],
-                  deadline_unix: float) -> str:
+                  deadline_unix: float,
+                  trace: Optional[str] = None) -> str:
+    """`trace` is the coordinator's round trace id: participants open
+    their prepare/stage/ack/commit spans under the SAME id, so `shifu
+    trace --fleet` stitches one cross-process view of the round."""
     sweep_rounds(root)
     note_phase("prepare", "coordinator")
     return atomic_write_json(_path(root, f"{round_id}-prepare.json"), {
@@ -101,6 +105,7 @@ def write_prepare(root: str, round_id: str, candidate_dir: str,
         "deadlineUnix": deadline_unix,
         "startedAt": time.time(),
         "coordinatorPid": os.getpid(),
+        "trace": trace,
     })
 
 

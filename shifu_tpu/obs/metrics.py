@@ -171,6 +171,93 @@ class Histogram:
                     for i, (v, eid) in sorted(self._exemplars.items())}
             return out
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "Histogram":
+        """Rebuild a histogram from its as_dict() form (the snapshot/
+        JSON shape) — the read half of the lossless round-trip."""
+        buckets = tuple(float("inf") if b == "inf" else float(b)
+                        for b in d["buckets"])
+        hist = cls(buckets)
+        with hist._lock:
+            hist._counts = [int(c) for c in d["counts"]]
+            hist._sum = float(d["sum"])
+            hist._count = int(d["count"])
+            hist._min = (float(d["min"]) if d.get("min") is not None
+                         else float("inf"))
+            hist._max = (float(d["max"]) if d.get("max") is not None
+                         else float("-inf"))
+            hist._exemplars = {
+                int(i): (float(v), str(eid))
+                for i, (v, eid) in (d.get("exemplars") or {}).items()}
+        return hist
+
+    def merge(self, other: "Histogram") -> None:
+        """EXACT merge of another histogram into this one: per-bucket
+        counts, sum, count, min/max all add/combine losslessly — the ONE
+        way snapshots are ever folded together (fleet federation, shadow
+        evidence, bench report folding), so merged == recomputed-from-raw
+        holds by construction. Requires identical pinned bucket edges
+        (the serve path's exponential edges are pinned for exactly this)
+        and raises ValueError on any mismatch rather than resampling.
+
+        Exemplars: an existing local exemplar wins (it is linkable in
+        THIS process's trace evidence); empty slots adopt the other's."""
+        if self.buckets != other.buckets:
+            raise ValueError(
+                f"histogram bucket edges differ: {self.buckets} vs "
+                f"{other.buckets} — exact merge needs identical pinned "
+                "edges")
+        # sequential snapshot-then-apply (never nest the two same-named
+        # tracked locks): other's state is copied out under its lock,
+        # folded in under ours
+        with other._lock:
+            counts = list(other._counts)
+            o_sum, o_count = other._sum, other._count
+            o_min, o_max = other._min, other._max
+            o_ex = dict(other._exemplars)
+        with self._lock:
+            for i, c in enumerate(counts):
+                if c:
+                    self._counts[i] += c
+            self._sum += o_sum
+            self._count += o_count
+            self._min = min(self._min, o_min)
+            self._max = max(self._max, o_max)
+            for i, ex in o_ex.items():
+                self._exemplars.setdefault(i, ex)
+
+    def quantile(self, q: float) -> Optional[float]:
+        with self._lock:
+            return quantile_from_counts(self.buckets, self._counts, q)
+
+
+def quantile_from_counts(buckets, counts, q: float) -> Optional[float]:
+    """Bucket-interpolated quantile (the Prometheus histogram_quantile
+    rule: linear within the target bucket, the lower edge of the first
+    bucket as 0). Shared by Histogram.quantile, the fleet view and
+    `shifu top` (which recovers counts from scraped `_bucket{le=}`
+    cumulative samples). Returns None on an empty histogram; a quantile
+    landing in the +inf overflow bucket reports that bucket's lower
+    edge (the largest finite bound)."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    q = min(max(float(q), 0.0), 1.0)
+    rank = q * total
+    seen = 0.0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        seen += c
+        if seen >= rank:
+            hi = buckets[i]
+            lo = buckets[i - 1] if i else 0.0
+            if hi == float("inf"):
+                return float(lo)
+            frac = 1.0 - (seen - rank) / c
+            return float(lo + (hi - lo) * frac)
+    return float(buckets[-2]) if len(buckets) > 1 else None
+
 
 class Timer:
     """Wall-clock accumulator (seconds + call count) — the StageTimers kind."""
@@ -323,17 +410,7 @@ class MetricsRegistry:
             buckets = tuple(float("inf") if b == "inf" else float(b)
                             for b in h["buckets"])
             hist = reg.histogram(name, buckets=buckets, **labels)
-            with hist._lock:
-                hist._counts = list(h["counts"])
-                hist._sum = h["sum"]
-                hist._count = h["count"]
-                hist._min = (h["min"] if h["min"] is not None
-                             else float("inf"))
-                hist._max = (h["max"] if h["max"] is not None
-                             else float("-inf"))
-                hist._exemplars = {
-                    int(i): (float(v), str(eid))
-                    for i, (v, eid) in (h.get("exemplars") or {}).items()}
+            hist.merge(Histogram.from_dict(h))
         for key, t in snap.get("timers", {}).items():
             name, labels = _parse_key(key)
             reg.timer(name, **labels).add(t["seconds"], t["calls"])

@@ -468,16 +468,78 @@ def reset() -> None:
 
 
 def trace_files(root: str = ".") -> List[str]:
-    """`<step>-<seq>.traces.json` files under <root>/.shifu/runs, newest
-    (highest seq, then mtime) first."""
+    """`<step>-<seq>.traces.json` files under <root>/.shifu/runs — the
+    top-level ledger dir AND any per-run/per-process subdirectory one
+    level down (a fleet member may ledger under its own dir) — newest
+    (highest seq, then mtime) first, so `shifu trace --show <id>` and
+    `--fleet` accept ids from ANY run or process, not just the newest
+    serve run's file."""
     from shifu_tpu.obs.ledger import runs_dir
 
     out = []
-    for path in glob.glob(os.path.join(runs_dir(root), "*.traces.json")):
-        m = _FILE_RE.match(os.path.basename(path))
-        if m:
-            out.append((int(m.group("seq")), os.path.getmtime(path), path))
+    base = runs_dir(root)
+    for pattern in ("*.traces.json", os.path.join("*", "*.traces.json")):
+        for path in glob.glob(os.path.join(base, pattern)):
+            m = _FILE_RE.match(os.path.basename(path))
+            if m:
+                out.append((int(m.group("seq")),
+                            os.path.getmtime(path), path))
     return [p for _s, _t, p in sorted(out, reverse=True)]
+
+
+FLEET_TRACE_BASENAME = "fleet.traces.json"  # no -<seq>: never re-globbed
+
+
+def stitch_trace_files(paths: List[str], out_path: str) -> Optional[dict]:
+    """Merge many shifu.traces/1 exports (one per process/run) into ONE
+    Perfetto-loadable document: each source file becomes its own track
+    group (pids remapped per file, `process_name` metadata from the file
+    stem), with every span kept on the shared unix-µs timebase — so a
+    promote round's coordinator and participant spans, which share the
+    round trace id, line up across processes in one view. Returns the
+    stitched doc (None when no source file was readable); unreadable or
+    non-trace files are skipped, not fatal."""
+    events: List[dict] = []
+    summaries: List[dict] = []
+    sources: List[dict] = []
+    for path in paths:
+        try:
+            doc = load_trace_file(path)
+        except (OSError, ValueError):
+            continue
+        pid = len(sources) + 1
+        label = os.path.basename(path)
+        if label.endswith(".traces.json"):
+            label = label[: -len(".traces.json")]
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": label}})
+        for e in doc.get("traceEvents", []):
+            e = dict(e)
+            e["pid"] = pid
+            events.append(e)
+        for s in doc.get("shifuTraces", []):
+            s = dict(s)
+            s["file"] = label
+            summaries.append(s)
+        sources.append({"path": path, "label": label,
+                        "traces": len(doc.get("shifuTraces", []))})
+    if not sources:
+        return None
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    out = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "schema": TRACES_SCHEMA,
+        "shifuTraces": summaries,
+        "summary": {"count": len(summaries), "stitched": True,
+                    "sources": sources},
+    }
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh)
+    os.replace(tmp, out_path)
+    return out
 
 
 def load_trace_file(path: str) -> dict:

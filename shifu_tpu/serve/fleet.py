@@ -365,7 +365,18 @@ class ReplicaFleet:
             return
         req.failovers += 1
         reg.counter("serve.failover.requests", **src.labels).inc()
-        if not self.router.resubmit(req, exclude=src):
+        if req.trace is not None:
+            # the hop is a stage on the request's own trace (the
+            # X-Shifu-Trace id the caller sent rides through failover):
+            # the stitched timeline shows WHERE the retry happened, not
+            # just that latency appeared
+            with req.trace.stage("failover"):
+                req.trace.annotate(failoverFrom=src.name,
+                                   failoverError=type(error).__name__)
+                rerouted = self.router.resubmit(req, exclude=src)
+        else:
+            rerouted = self.router.resubmit(req, exclude=src)
+        if not rerouted:
             # nothing else could take it (all quarantined/draining/full)
             reg.counter("serve.failover.exhausted",
                         **src.labels).inc()
@@ -653,15 +664,26 @@ class ReplicaFleet:
         if any(p is None for p in per):
             return None
         if len(per) == 1:
-            return dict(per[0], replicas=per)
-        agg = _reduce_shadow_stats(self.replicas, per)
-        agg.update({
-            "sha": per[0]["sha"],
-            "models": per[0]["models"],
-            "fused": per[0]["fused"],
-            "tolerance": per[0]["tolerance"],
-            "replicas": per,
-        })
+            agg = dict(per[0], replicas=per)
+        else:
+            agg = _reduce_shadow_stats(self.replicas, per)
+            agg.update({
+                "sha": per[0]["sha"],
+                "models": per[0]["models"],
+                "fused": per[0]["fused"],
+                "tolerance": per[0]["tolerance"],
+                "replicas": per,
+            })
+        # the full fleet delta DISTRIBUTION, not just mean/max: the
+        # per-replica serve.shadow.score_delta histograms share pinned
+        # edges, so Histogram.merge folds them bucket-exact (merged ==
+        # recomputed-from-raw) — promote gates and the fleet view read
+        # one agreement histogram instead of N
+        delta = _merged_delta_histogram(self.replicas)
+        if delta.quantile(0.5) is not None:
+            agg["deltaHistogram"] = delta.as_dict()
+            agg["deltaP50"] = delta.quantile(0.50)
+            agg["deltaP99"] = delta.quantile(0.99)
         return agg
 
     def promote(self, expected_sha: Optional[str] = None,
@@ -813,6 +835,22 @@ class ReplicaFleet:
         req = self.submit(data, trace=trace)
         trace.add_stage("route", time.perf_counter() - t0, t0=t0)
         return req.wait(timeout)
+
+
+def _merged_delta_histogram(replicas: Sequence[ScoringReplica]):
+    """Fold every replica's staged-shadow score-delta histogram into one
+    fleet histogram via the single exact merge primitive."""
+    from shifu_tpu.loop.hotswap import SCORE_DELTA_BUCKETS
+    from shifu_tpu.obs import registry
+    from shifu_tpu.obs.metrics import Histogram
+
+    reg = registry()
+    merged = Histogram(SCORE_DELTA_BUCKETS)
+    for rep in replicas:
+        merged.merge(reg.histogram("serve.shadow.score_delta",
+                                   buckets=SCORE_DELTA_BUCKETS,
+                                   **rep.labels))
+    return merged
 
 
 def _reduce_shadow_stats(replicas: Sequence[ScoringReplica],

@@ -96,6 +96,21 @@ def slo_target_setting() -> float:
                                  DEFAULT_SLO_TARGET)
 
 
+def tenant_slo_ms(tenant: str) -> float:
+    """Per-tenant SLO threshold: shifu.serve.slo.<tenant>.ms, falling
+    back to the fleet-wide shifu.serve.sloMs — a latency-sensitive zoo
+    tenant gets its own objective without forking the fleet knob."""
+    return environment.get_float(f"shifu.serve.slo.{tenant}.ms",
+                                 slo_ms_setting())
+
+
+def tenant_slo_target(tenant: str) -> float:
+    """Per-tenant objective: shifu.serve.slo.<tenant>.target, falling
+    back to shifu.serve.sloTarget."""
+    return environment.get_float(f"shifu.serve.slo.{tenant}.target",
+                                 slo_target_setting())
+
+
 class SloTracker:
     """Good/bad SLO accounting + burn rate over a rolling window.
 
@@ -110,8 +125,17 @@ class SloTracker:
                  target: Optional[float] = None,
                  window_s: float = DEFAULT_SLO_WINDOW_S,
                  labels: Optional[dict] = None) -> None:
-        self.slo_ms = slo_ms_setting() if slo_ms is None else float(slo_ms)
-        target = slo_target_setting() if target is None else float(target)
+        # a zoo tenant's tracker resolves ITS knobs first (the labels
+        # carry the identity), so per-tenant objectives and the tenant=
+        # label on serve.slo.* land together
+        tenant = (labels or {}).get("tenant")
+        if slo_ms is None:
+            slo_ms = tenant_slo_ms(tenant) if tenant else slo_ms_setting()
+        self.slo_ms = float(slo_ms)
+        if target is None:
+            target = (tenant_slo_target(tenant) if tenant
+                      else slo_target_setting())
+        target = float(target)
         self.target = min(max(target, 0.0), 0.9999)
         self.window_s = float(window_s)
         # fleet-identity labels ({"tenant": ...} in a zoo): per-tenant

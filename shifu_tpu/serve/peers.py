@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, List, Optional
 
 from shifu_tpu.analysis.racetrack import tracked_lock
@@ -55,6 +56,17 @@ _HANDLED_ROUNDS_KEPT = 16
 # leaves staged is a rollout hazard, not a log line
 _ROLLBACK_ATTEMPTS = 5
 _ROLLBACK_RETRY_S = 0.3
+
+
+@contextmanager
+def _span(trace, name: str):
+    """Stage span on the participant's round trace; no-op without one
+    (a prepare record written by an older coordinator has no trace)."""
+    if trace is None:
+        yield
+        return
+    with trace.stage(name):
+        yield
 
 
 class PeerRegistry:
@@ -201,10 +213,19 @@ class PeerRegistry:
             return
         me = self.lease
         sha = prep.get("candidateSha")
+        # this participant's spans share the coordinator's round trace
+        # id (stamped in the prepare record), so `shifu trace --fleet`
+        # stitches both sides of the round into one timeline
+        from shifu_tpu.obs import reqtrace
+
+        tr = reqtrace.RequestTrace(trace_id=prep.get("trace"),
+                                   sampled=True)
+        tr.annotate(role="participant", round=rid, leaseId=me.lease_id)
         try:
             if self.stage_cb is None:
                 raise ValueError("this process cannot stage candidates")
-            staged = self.stage_cb(prep["candidateDir"]) or {}
+            with tr.stage("stage"):
+                staged = self.stage_cb(prep["candidateDir"]) or {}
             staged_sha = staged.get("sha")
             if sha and staged_sha != sha:
                 # sha-bound: the candidate dir changed since the
@@ -216,29 +237,34 @@ class PeerRegistry:
                     f"says {sha} — candidate dir changed mid-round")
         except Exception as e:  # a failed stage is a NACK, not a crash
             log.warning("promotion round %s: stage failed: %s", rid, e)
-            rounds.write_ack(self.root, rid, me.lease_id, me.token,
-                             me.epoch, ok=False, reason=str(e))
+            with tr.stage("ack"):
+                rounds.write_ack(self.root, rid, me.lease_id, me.token,
+                                 me.epoch, ok=False, reason=str(e))
+            self._offer_round_trace(tr, "nack")
             self._mark_handled(rid)
             return
         # renew IMMEDIATELY after the (device-heavy) stage: the fence
         # check at commit time must see this lease fresh
         self.lease.renew(info=self._info())
-        rounds.write_ack(self.root, rid, me.lease_id, me.token, me.epoch,
-                         ok=True, staged_sha=staged_sha,
-                         shadow=staged if isinstance(staged, dict) else None)
+        with tr.stage("ack"):
+            rounds.write_ack(self.root, rid, me.lease_id, me.token,
+                             me.epoch, ok=True, staged_sha=staged_sha,
+                             shadow=staged if isinstance(staged, dict)
+                             else None)
         grace = max((prep["deadlineUnix"] - time.time())
                     * ROUND_GRACE_FRACTION, self._renew_s)
         with self._lock:
             self._round = {"round": rid, "sha": sha,
                            "deadline": prep["deadlineUnix"],
-                           "grace": grace}
+                           "grace": grace, "trace": tr}
         log.info("promotion round %s: staged + acked candidate %s",
                  rid, staged_sha)
 
     def _check_verdict(self, active: dict) -> None:
         rid = active["round"]
+        trace = active.get("trace")
         state = rounds.read_round(self.root, rid)
-        verdict = self._apply_verdict(rid, state, active["sha"])
+        verdict = self._apply_verdict(rid, state, active["sha"], trace)
         if verdict:
             self._mark_handled(rid)
             return
@@ -249,23 +275,25 @@ class PeerRegistry:
         # deadline is durable and must win), then roll back — every
         # crash mode converges to the old version everywhere.
         state = rounds.read_round(self.root, rid)
-        if not self._apply_verdict(rid, state, active["sha"]):
+        if not self._apply_verdict(rid, state, active["sha"], trace):
             log.warning("promotion round %s: no verdict by deadline — "
                         "rolling back to active", rid)
             rounds.write_abort(self.root, rid,
                                "no verdict by deadline (coordinator "
                                "dead?)", role="participant")
-            self._rollback(rid)
+            self._rollback(rid, trace)
+            self._offer_round_trace(trace, "self-abort")
         self._mark_handled(rid)
 
     def _apply_verdict(self, rid: str, state: dict,
-                       sha: Optional[str]) -> bool:
+                       sha: Optional[str], trace=None) -> bool:
         """Apply a commit/abort record if one exists. True when the
         round reached a verdict (and was applied)."""
         if state["commit"] is not None:
             try:
-                if self.promote_cb is not None:
-                    self.promote_cb(state["commit"].get("sha") or sha)
+                with _span(trace, "commit"):
+                    if self.promote_cb is not None:
+                        self.promote_cb(state["commit"].get("sha") or sha)
                 rounds.note_phase("commit", "participant")
                 log.info("promotion round %s: committed -> %s", rid,
                          state["commit"].get("sha"))
@@ -274,32 +302,47 @@ class PeerRegistry:
                 # its old version and the operator re-runs promote
                 log.error("promotion round %s: commit apply failed: %s",
                           rid, e)
+            self._offer_round_trace(trace, "commit")
             return True
         if state["abort"] is not None:
-            self._rollback(rid)
+            self._rollback(rid, trace)
+            self._offer_round_trace(trace, "abort")
             return True
         return False
 
-    def _rollback(self, rid: str) -> None:
-        for attempt in range(_ROLLBACK_ATTEMPTS):
-            try:
-                if self.unstage_cb is not None:
-                    self.unstage_cb()
-                break
-            except Exception as e:  # rollback must never take the server
-                # down — but a staged candidate an aborted round leaves
-                # behind could later be promoted by an operator, so a
-                # transient refusal (the fleet control-plane flag held
-                # by a concurrent stage/promote) is retried, not shrugged
-                if attempt + 1 == _ROLLBACK_ATTEMPTS:
-                    log.error("promotion round %s: unstage failed after "
-                              "%d attempts — candidate may still be "
-                              "staged on this process: %s",
-                              rid, _ROLLBACK_ATTEMPTS, e)
-                else:
-                    self._stop.wait(_ROLLBACK_RETRY_S)
+    def _rollback(self, rid: str, trace=None) -> None:
+        with _span(trace, "rollback"):
+            for attempt in range(_ROLLBACK_ATTEMPTS):
+                try:
+                    if self.unstage_cb is not None:
+                        self.unstage_cb()
+                    break
+                except Exception as e:  # rollback must never take the
+                    # server down — but a staged candidate an aborted
+                    # round leaves behind could later be promoted by an
+                    # operator, so a transient refusal (the fleet
+                    # control-plane flag held by a concurrent
+                    # stage/promote) is retried, not shrugged
+                    if attempt + 1 == _ROLLBACK_ATTEMPTS:
+                        log.error("promotion round %s: unstage failed "
+                                  "after %d attempts — candidate may "
+                                  "still be staged on this process: %s",
+                                  rid, _ROLLBACK_ATTEMPTS, e)
+                    else:
+                        self._stop.wait(_ROLLBACK_RETRY_S)
         rounds.note_phase("rollback", "participant")
         log.info("promotion round %s: rolled back to active", rid)
+
+    def _offer_round_trace(self, trace, outcome: str) -> None:
+        """Retain the participant's round spans in the process trace
+        ring — they land in this process's `.traces.json` ledger export
+        at shutdown, where `shifu trace --fleet` finds them."""
+        if trace is None:
+            return
+        from shifu_tpu.obs import reqtrace
+
+        trace.annotate(outcome=outcome)
+        reqtrace.buffer().offer(trace)
 
     # ---- views ----
     def peers(self) -> List[dict]:
@@ -314,6 +357,10 @@ class PeerRegistry:
         with self._lock:
             peers = list(self._peers)
             active = dict(self._round) if self._round else None
+        if active is not None and active.get("trace") is not None:
+            # the live RequestTrace rides _round for the span calls;
+            # the JSON view carries only its id
+            active["trace"] = active["trace"].trace_id
         live = [p for p in peers if not p["expired"]]
         expired = [p for p in peers if p["expired"]]
         return {

@@ -378,6 +378,22 @@ class ScoringServer:
             promote_cb=self.promote_candidate,
             unstage_cb=self._unstage_default,
             info_cb=self._peer_info)
+        # on-disk metrics time-series (obs/timeseries.py): the process
+        # name is the lease id, so the fleet collector joins these dirs
+        # against the peer scan — a SIGKILLed process leaves its last
+        # windows (final counters) behind for survivors' /fleet views.
+        # Armed by -Dshifu.obs.snapshotMs; a lease-less server (ttlMs=0)
+        # still snapshots under a synthetic solo id.
+        import socket
+
+        from shifu_tpu.obs import registry as obs_registry
+        from shifu_tpu.obs.timeseries import MetricsSnapshotter
+
+        self.lease_id = (self.peers.lease.lease_id if self.peers.enabled
+                         else f"{socket.gethostname()}-{os.getpid()}-solo")
+        self.obs_snap = MetricsSnapshotter(self.root, self.lease_id,
+                                           registry_cb=obs_registry)
+        self.obs_snap.start()
 
     def _unstage_default(self) -> None:
         """Aborted-round rollback: in zoo mode route through the ZOO so
@@ -650,6 +666,38 @@ class ScoringServer:
                         200,
                         obs_registry().to_prometheus().encode("utf-8"),
                         content_type="text/plain; version=0.0.4")
+                    return
+                if self.path == "/admin/metrics.json":
+                    # the LOSSLESS snapshot (exact histogram state, not
+                    # the rendered Prometheus text) — what a peer's
+                    # fleet collector scrapes to merge bucket-exact
+                    from shifu_tpu.obs import fleetview
+
+                    self._reply(200, {
+                        "schema": fleetview.METRICS_JSON_SCHEMA,
+                        "leaseId": server.lease_id,
+                        "pid": os.getpid(),
+                        "ts": time.time(),
+                        "metrics": obs_registry().snapshot(),
+                    })
+                    return
+                if self.path in ("/fleet/metrics", "/fleet/healthz"):
+                    # ANY peer answers for the fleet: scan leases, merge
+                    # live peers' scraped snapshots + expired peers'
+                    # final on-disk windows (obs/fleetview.py). Folded
+                    # in sorted-leaseId order, so every process reports
+                    # bit-identical merged counter totals.
+                    from shifu_tpu.obs import fleetview
+
+                    reg, payload = fleetview.fleet_view(
+                        server.root, self_id=server.lease_id,
+                        self_snapshot=lambda: obs_registry().snapshot())
+                    if self.path == "/fleet/metrics":
+                        self._reply(
+                            200, reg.to_prometheus().encode("utf-8"),
+                            content_type="text/plain; version=0.0.4")
+                    else:
+                        self._reply(200, payload)
                     return
                 if (self.path == "/admin/shadow"
                         or self.path.startswith("/admin/shadow?")):
@@ -955,6 +1003,10 @@ class ScoringServer:
                 # buffered rows become a final (short) chunk — nothing
                 # logged is ever lost to shutdown
                 self.traffic.close()
+            # final time-series window AFTER the drain: the last chunk
+            # carries the terminal counter state a fleet survivor (or a
+            # post-mortem) reads for this process
+            self.obs_snap.stop()
             return self._write_manifest()
         finally:
             # whatever happens above, serve_forever() must unblock — a
@@ -1002,6 +1054,8 @@ class ScoringServer:
                 # last peer view before the lease released: the manifest
                 # records what the process fleet looked like at drain
                 extra["peers"] = self.peers.snapshot()
+            if self.obs_snap.enabled:
+                extra["obsTimeseries"] = self.obs_snap.snapshot()
             seq = ledger.next_seq("serve")
             # retained request traces serialize as a Perfetto-loadable
             # file next to the manifest; the manifest carries the

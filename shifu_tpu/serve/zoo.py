@@ -183,7 +183,8 @@ class HbmLedger:
             self._peak = max(self._peak, self._used)
             used = self._used
             peak = self._peak
-        self._publish(used, peak)
+            tb = self._tenant_bytes(tenant)
+        self._publish(used, peak, tenant, tb)
 
     def reduce(self, tenant: str, kind: str, nbytes: int) -> None:
         """Shrink a charge (measured cost came in under the streamed
@@ -195,7 +196,8 @@ class HbmLedger:
                 self._charges[(tenant, kind)] = have - cut
                 self._used -= cut
             used, peak = self._used, self._peak
-        self._publish(used, peak)
+            tb = self._tenant_bytes(tenant)
+        self._publish(used, peak, tenant, tb)
 
     def release(self, tenant: str, kind: str) -> int:
         """Drop the whole (tenant, kind) charge; returns it."""
@@ -203,7 +205,8 @@ class HbmLedger:
             freed = self._charges.pop((tenant, kind), 0)
             self._used -= freed
             used, peak = self._used, self._peak
-        self._publish(used, peak)
+            tb = self._tenant_bytes(tenant)
+        self._publish(used, peak, tenant, tb)
         return freed
 
     def transfer(self, tenant: str, src: str, dst: str) -> None:
@@ -215,7 +218,8 @@ class HbmLedger:
                 self._charges[(tenant, dst)] = (
                     self._charges.get((tenant, dst), 0) + amt)
             used, peak = self._used, self._peak
-        self._publish(used, peak)
+            tb = self._tenant_bytes(tenant)
+        self._publish(used, peak, tenant, tb)
 
     def charge_of(self, tenant: str, kind: Optional[str] = None) -> int:
         with self._lock:
@@ -234,7 +238,14 @@ class HbmLedger:
         with self._lock:
             return self._peak
 
-    def _publish(self, used: int, peak: int) -> None:
+    def _tenant_bytes(self, tenant: str) -> int:
+        # caller holds self._lock
+        return sum(v for (t, _k), v in self._charges.items()
+                   if t == tenant)
+
+    def _publish(self, used: int, peak: int,
+                 tenant: Optional[str] = None,
+                 tenant_bytes: int = 0) -> None:
         # gauges set OUTSIDE the ledger lock (the racetrack discipline:
         # tracked metric locks never nest under subsystem locks)
         from shifu_tpu.obs import registry
@@ -242,6 +253,13 @@ class HbmLedger:
         reg = registry()
         reg.gauge("serve.zoo.hbm_used_bytes").set(used)
         reg.gauge("serve.zoo.hbm_peak_bytes").set(peak)
+        if tenant is not None:
+            # per-tenant residency: the mutated tenant's new total
+            # (evicted = 0, so the series reads true, not stale) — the
+            # fleet view / `shifu top` attribute HBM occupancy per
+            # tenant per process from this one series
+            reg.gauge("serve.zoo.tenant_hbm_bytes",
+                      tenant=tenant).set(tenant_bytes)
 
     def snapshot(self) -> dict:
         with self._lock:

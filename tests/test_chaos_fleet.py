@@ -110,9 +110,12 @@ def test_sigkill_mid_promotion_never_half_promotes(tmp_path):
         assert rc == 1  # held: the round aborted
 
         # promote manifest: fleet mode, aborted round, sha-consistent
+        # (the coordinator also leaves promote-<seq>.traces.json beside
+        # the manifest — the round's trace spans, not a manifest)
         promotes = sorted(
             p for p in os.listdir(os.path.join(root, ".shifu", "runs"))
-            if p.startswith("promote-"))
+            if p.startswith("promote-")
+            and not p.endswith(".traces.json"))
         m = json.load(open(os.path.join(root, ".shifu", "runs",
                                         promotes[-1])))["promote"]
         assert m["mode"] == "fleet"
@@ -167,7 +170,8 @@ def test_sigkill_mid_promotion_never_half_promotes(tmp_path):
         assert new_sha != old_sha
         promotes = sorted(
             p for p in os.listdir(os.path.join(root, ".shifu", "runs"))
-            if p.startswith("promote-"))
+            if p.startswith("promote-")
+            and not p.endswith(".traces.json"))
         m2 = json.load(open(os.path.join(root, ".shifu", "runs",
                                          promotes[-1])))["promote"]
         assert m2["round"]["committed"]

@@ -1,0 +1,516 @@
+"""Fleet observability plane: on-disk metrics time-series
+(obs/timeseries.py), cross-process federation (obs/fleetview.py),
+per-tenant SLO burn, stitched promote-round traces (shifu trace
+--fleet) and the pure `shifu top` renderer.
+
+The acceptance pins live here: a window encode/apply round-trip is
+lossless; the single Histogram.merge primitive produces bucket-exact ==
+recomputed-from-raw results; the fleet merge sums counters bit-exact in
+ANY fold order, keeps an expired peer's final counters while dropping
+its gauges; per-tenant SLO burn isolates an antagonist tenant; and one
+promotion round driven through real PeerRegistry heartbeat threads
+yields coordinator + participant spans under ONE round trace id,
+stitched into ONE Perfetto file with per-process track groups. All
+jax-free."""
+
+import json
+import os
+import time
+
+import pytest
+
+from shifu_tpu.utils import environment
+
+
+class _Props:
+    def __init__(self, **props):
+        self.props = {k.replace("_", "."): v for k, v in props.items()}
+
+    def __enter__(self):
+        for k, v in self.props.items():
+            environment.set_property(k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k in self.props:
+            environment.set_property(k, "")
+
+
+def _wait_for(pred, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# on-disk time-series: delta encoding + snapshotter chunk files
+# ---------------------------------------------------------------------------
+
+
+class TestTimeseriesEncoding:
+    def test_window_roundtrip_is_lossless(self):
+        from shifu_tpu.obs import timeseries
+        from shifu_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("serve.requests", format="json").inc(3)
+        reg.counter("serve.slo.good", tenant="a").inc(10)
+        reg.gauge("serve.queue.depth", tenant="a").set(2)
+        reg.timer("stats").add(0.25, 4)
+        reg.histogram("serve.stage_seconds", stage="device").observe(0.03)
+        snap1 = reg.snapshot()
+
+        full = timeseries.encode_window(None, snap1, 1.0)
+        assert full["full"] is True
+        assert timeseries.apply_window(None, full) == snap1
+
+        reg.counter("serve.requests", format="json").inc(2)
+        reg.gauge("serve.queue.depth", tenant="a").set(7)
+        reg.histogram("serve.stage_seconds", stage="device").observe(0.5)
+        snap2 = reg.snapshot()
+        delta = timeseries.encode_window(snap1, snap2, 2.0)
+        assert not delta.get("full")
+        # the untouched counter is NOT re-shipped in the delta
+        assert 'serve.slo.good{tenant="a"}' not in delta.get("counters", {})
+        assert timeseries.apply_window(snap1, delta) == snap2
+
+    def test_idle_delta_is_ts_only(self):
+        from shifu_tpu.obs import timeseries
+        from shifu_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("serve.requests").inc(1)
+        snap = reg.snapshot()
+        assert timeseries.encode_window(snap, snap, 3.0) == {"ts": 3.0}
+
+
+class TestMetricsSnapshotter:
+    def _snapshotter(self, root, reg, **kw):
+        from shifu_tpu.obs import timeseries
+
+        kw.setdefault("snapshot_ms", 10_000)  # armed, ticked inline
+        kw.setdefault("chunk_windows", 2)
+        kw.setdefault("retain_chunks", 2)
+        return timeseries.MetricsSnapshotter(
+            str(root), "proc-a", lambda: reg, **kw)
+
+    def test_rotation_retention_and_reconstruction(self, tmp_path):
+        from shifu_tpu.obs import timeseries
+        from shifu_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        snap = self._snapshotter(tmp_path, reg)
+        for _ in range(10):
+            reg.counter("serve.requests").inc(1)
+            snap.tick()
+        root = str(tmp_path)
+        # 10 windows at 2/chunk = 5 chunks, retention keeps the last 2
+        assert len(timeseries.list_chunks(root, "proc-a")) == 2
+        assert timeseries.list_process_dirs(root) \
+            == [timeseries.obs_dir(root, "proc-a")]
+        windows = timeseries.read_windows(root, "proc-a")
+        counts = [w["metrics"]["counters"]["serve.requests"]
+                  for w in windows]
+        # retained chunks are self-contained: absolute values, in order
+        assert counts == sorted(counts) and counts[-1] == 10.0
+        last = timeseries.last_snapshot(root, "proc-a")
+        assert last["metrics"] == reg.snapshot()
+
+    def test_idle_ticks_write_nothing_new(self, tmp_path):
+        from shifu_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        snap = self._snapshotter(tmp_path, reg, chunk_windows=8)
+        reg.counter("serve.requests").inc(1)
+        snap.tick()
+        snap.tick()  # nothing changed: no window, no rewrite
+        snap.tick()
+        assert snap.snapshot()["windows"] == 1
+
+    def test_sigkill_leaves_last_windows_behind(self, tmp_path):
+        """No clean shutdown ever runs — the ticked chunks alone must
+        reconstruct the process's final counters (what the collector
+        folds for an expired peer)."""
+        from shifu_tpu.obs import timeseries
+        from shifu_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        snap = self._snapshotter(tmp_path, reg, chunk_windows=4)
+        reg.counter("serve.slo.bad", tenant="a").inc(3)
+        snap.tick()
+        reg.counter("serve.slo.bad", tenant="a").inc(2)
+        snap.tick()
+        del snap  # SIGKILL stand-in: no stop(), no final flush
+        last = timeseries.last_snapshot(str(tmp_path), "proc-a")
+        assert last["metrics"]["counters"]['serve.slo.bad{tenant="a"}'] \
+            == 5.0
+
+
+# ---------------------------------------------------------------------------
+# the single Histogram.merge primitive
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramMerge:
+    def test_merged_equals_recomputed_from_raw(self):
+        from shifu_tpu.obs.metrics import Histogram
+
+        edges = (0.01, 0.1, 1.0)
+        # power-of-two fractions: float sums are exact in any order
+        raw = [k / 64.0 for k in (1, 2, 3, 5, 6, 7, 9, 40, 64, 96, 100)]
+        h1, h2, hall = Histogram(edges), Histogram(edges), Histogram(edges)
+        for i, v in enumerate(raw):
+            (h1 if i % 2 else h2).observe(v)
+            hall.observe(v)
+        h1.merge(h2)
+        assert h1.as_dict() == hall.as_dict()
+        for q in (0.5, 0.9, 0.99):
+            assert h1.quantile(q) == hall.quantile(q)
+
+    def test_edge_mismatch_raises(self):
+        from shifu_tpu.obs.metrics import Histogram
+
+        a, b = Histogram((0.1, 1.0)), Histogram((0.2, 1.0))
+        b.observe(0.15)
+        with pytest.raises(ValueError):
+            a.merge(b)
+
+
+# ---------------------------------------------------------------------------
+# fleet federation: merge semantics + SLO summary (pure, no HTTP)
+# ---------------------------------------------------------------------------
+
+
+def _sample(lease_id, reg, live=True):
+    return {"leaseId": lease_id, "live": live, "source": "test",
+            "metrics": reg.snapshot(), "info": {}, "ageMs": 0.0}
+
+
+def _process_registry(requests, queue_depth, stage_ms):
+    from shifu_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("serve.requests", format="json", replica="0").inc(requests)
+    reg.gauge("serve.queue.depth", tenant="t1", replica="0") \
+        .set(queue_depth)
+    h = reg.histogram("serve.stage_seconds", stage="device", replica="0")
+    for ms in stage_ms:
+        h.observe(ms / 1e3)
+    return reg
+
+
+class TestFleetMerge:
+    def test_counters_sum_bit_exact_in_any_fold_order(self):
+        from shifu_tpu.obs import fleetview
+        from shifu_tpu.obs.metrics import _parse_key
+
+        a = _sample("proc-a", _process_registry(3, 2, [10, 20]))
+        b = _sample("proc-b", _process_registry(4, 5, [30]))
+        merged = fleetview.merge([a, b])
+        # any peer answering /fleet/metrics renders the SAME text
+        assert merged.to_prometheus() \
+            == fleetview.merge([b, a]).to_prometheus()
+        flat = merged.snapshot()
+        assert flat["counters"][
+            'serve.requests{format="json",replica="0"}'] == 7.0
+        # gauges: one series per process + min/max/sum aggregates
+        gauges = flat["gauges"]
+        per_proc = {k: v for k, v in gauges.items()
+                    if _parse_key(k)[0] == "serve.queue.depth"
+                    and "process" in _parse_key(k)[1]}
+        assert sorted(per_proc.values()) == [2.0, 5.0]
+        assert gauges[
+            'serve.queue.depth{agg="sum",replica="0",tenant="t1"}'] == 7.0
+        assert gauges[
+            'serve.queue.depth{agg="max",replica="0",tenant="t1"}'] == 5.0
+        # histograms merged bucket-exact across processes
+        hist = flat["histograms"][
+            'serve.stage_seconds{replica="0",stage="device"}']
+        assert hist["count"] == 3
+
+    def test_expired_peer_keeps_counters_drops_gauges(self):
+        from shifu_tpu.obs import fleetview
+
+        live = _sample("proc-a", _process_registry(3, 2, [10]))
+        dead = _sample("proc-b", _process_registry(9, 5, [30]), live=False)
+        flat = fleetview.merge([live, dead]).snapshot()
+        assert flat["counters"][
+            'serve.requests{format="json",replica="0"}'] == 12.0
+        assert not any("proc-b" in k for k in flat["gauges"])
+        assert flat["gauges"]["fleet.processes.live"] == 1.0
+        assert flat["gauges"]["fleet.processes.expired"] == 1.0
+
+    def test_collect_reads_expired_peer_from_disk(self, tmp_path):
+        """A SIGKILLed peer: stale lease file + the time-series windows
+        it ticked while alive. collect() must surface its last counters
+        from disk, marked expired."""
+        from shifu_tpu.obs import fleetview, timeseries
+        from shifu_tpu.obs.metrics import MetricsRegistry
+        from shifu_tpu.resilience import lease
+
+        root = str(tmp_path)
+        reg = MetricsRegistry()
+        reg.counter("serve.slo.bad", tenant="a").inc(4)
+        snap = timeseries.MetricsSnapshotter(
+            root, "dead-1", lambda: reg, snapshot_ms=10_000,
+            chunk_windows=4, retain_chunks=2)
+        snap.tick()
+        os.makedirs(lease.peers_dir(root), exist_ok=True)
+        with open(os.path.join(lease.peers_dir(root),
+                               "dead-1" + lease.LEASE_SUFFIX), "w") as fh:
+            json.dump({"schema": "shifu.lease/1", "leaseId": "dead-1",
+                       "host": "h", "pid": 1, "token": "tok", "epoch": 1,
+                       "ttlMs": 100.0, "renewedAt": time.time() - 60.0,
+                       "info": {}}, fh)
+
+        samples = fleetview.collect(root, self_id="me",
+                                    self_snapshot=MetricsRegistry().snapshot)
+        by_id = {s["leaseId"]: s for s in samples}
+        assert by_id["me"]["live"] and by_id["me"]["source"] == "local"
+        dead = by_id["dead-1"]
+        assert not dead["live"] and dead["source"] == "disk"
+        assert dead["metrics"]["counters"]['serve.slo.bad{tenant="a"}'] \
+            == 4.0
+
+
+class TestTenantSlo:
+    def test_tenant_knobs_fall_back_to_fleet_wide(self):
+        from shifu_tpu.serve import health
+
+        with _Props(shifu_serve_sloMs="50", shifu_serve_sloTarget="0.99"):
+            assert health.tenant_slo_ms("t9") == 50.0
+            assert health.tenant_slo_target("t9") == 0.99
+            with _Props(**{"shifu.serve.slo.t9.ms": "250",
+                           "shifu.serve.slo.t9.target": "0.5"}):
+                assert health.tenant_slo_ms("t9") == 250.0
+                assert health.tenant_slo_target("t9") == 0.5
+                # other tenants keep the fleet-wide objective
+                assert health.tenant_slo_ms("other") == 50.0
+
+    def test_burn_isolates_antagonist_tenant(self):
+        from shifu_tpu.obs import fleetview
+        from shifu_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("serve.slo.good", tenant="quiet").inc(99)
+        reg.counter("serve.slo.bad", tenant="quiet").inc(1)
+        reg.counter("serve.slo.good", tenant="ant").inc(10)
+        reg.counter("serve.slo.bad", tenant="ant").inc(90)
+        with _Props(**{"shifu.serve.sloTarget": "0.99",
+                       "shifu.serve.slo.ant.target": "0.5"}):
+            out = fleetview.slo_summary(reg)
+        ant, quiet = out["tenants"]["ant"], out["tenants"]["quiet"]
+        # the antagonist burns against ITS OWN relaxed target...
+        assert ant["bad"] == 90 and ant["target"] == 0.5
+        assert ant["burn"] == pytest.approx(0.9 / 0.5)
+        # ...and the quiet tenant's burn is untouched by the antagonist
+        assert quiet["burn"] == pytest.approx(0.01 / 0.01)
+        assert out["fleet"]["good"] == 109 and out["fleet"]["bad"] == 91
+        gauges = reg.snapshot()["gauges"]
+        assert gauges['fleet.slo.burn{tenant="ant"}'] \
+            == pytest.approx(ant["burn"])
+
+
+# ---------------------------------------------------------------------------
+# stitched promote-round traces: one id across coordinator + participants
+# ---------------------------------------------------------------------------
+
+
+class _Participant:
+    """A PeerRegistry wired to recording callbacks (the server
+    stand-in, as in test_lease.py)."""
+
+    def __init__(self, root, ttl_ms=2000, sha="cand-sha"):
+        from shifu_tpu.serve.peers import PeerRegistry
+
+        self.staged = []
+        self.promoted = []
+
+        def stage_cb(candidate_dir):
+            self.staged.append(candidate_dir)
+            return {"sha": sha}
+
+        self.reg = PeerRegistry(root, stage_cb=stage_cb,
+                                promote_cb=self.promoted.append,
+                                unstage_cb=lambda: None, ttl_ms=ttl_ms)
+
+    def close(self):
+        self.reg.close()
+
+
+class TestStitchedRoundTrace:
+    def test_snapshot_json_serializable_mid_round(self, tmp_path):
+        # the live RequestTrace rides PeerRegistry._round for the span
+        # calls; the /healthz + manifest snapshot must render its ID,
+        # not the object (a mid-round /healthz crashed on this once)
+        from shifu_tpu.obs import reqtrace
+
+        part = _Participant(str(tmp_path))
+        try:
+            tr = reqtrace.RequestTrace(trace_id="round-r1", sampled=True)
+            with part.reg._lock:
+                part.reg._round = {"round": "r1", "sha": "cand-sha",
+                                   "deadline": time.time() + 5,
+                                   "grace": 1.0, "trace": tr}
+            snap = part.reg.snapshot()
+            json.dumps(snap)
+            assert snap["round"]["trace"] == "round-r1"
+            with part.reg._lock:
+                part.reg._round = None
+        finally:
+            part.close()
+
+    def test_round_produces_one_stitched_perfetto_file(self, tmp_path):
+        from shifu_tpu import obs
+        from shifu_tpu.loop import promote
+        from shifu_tpu.obs import reqtrace
+        from shifu_tpu.obs.ledger import runs_dir
+
+        obs.reset()
+        root = str(tmp_path)
+        parts = [_Participant(root), _Participant(root)]
+        try:
+            _wait_for(lambda: len(promote.live_peers(root)) == 2,
+                      msg="both leases visible")
+            res = promote.run_promotion_round(
+                root, str(tmp_path / "cand"), "cand-sha",
+                promote.live_peers(root))
+            assert res["committed"]
+            tid = res["trace"]
+            assert tid == f"round-{res['round']}"
+            _wait_for(lambda: all(p.promoted == ["cand-sha"]
+                                  for p in parts), msg="commit applied")
+            # coordinator + both participants offered sampled traces
+            _wait_for(lambda: reqtrace.buffer().count >= 3,
+                      msg="3 round traces retained")
+        finally:
+            for p in parts:
+                p.close()
+
+        summaries = reqtrace.buffer().traces()
+        assert [s["id"] for s in summaries] == [tid] * 3
+        by_role = {}
+        for s in summaries:
+            by_role.setdefault(s["attrs"]["role"], []).append(s)
+        (coord,) = by_role["coordinator"]
+        assert coord["attrs"]["outcome"] == "commit"
+        for st in ("prepare", "acks", "fence", "commit"):
+            assert st in coord["stages"]
+        participants = by_role["participant"]
+        assert len(participants) == 2
+        for s in participants:
+            assert s["attrs"]["outcome"] == "commit"
+            for st in ("stage", "ack", "commit"):
+                assert st in s["stages"]
+        assert len({s["attrs"]["leaseId"] for s in participants}) == 2
+
+        # split by role into per-"process" trace files, the shapes the
+        # coordinator (promote-<seq>) and a serve peer (its own run
+        # subdir) actually write, then stitch
+        with reqtrace.buffer()._lock:
+            traces = list(reqtrace.buffer()._ring)
+        coord_buf = reqtrace.TraceBuffer(capacity=8, sample=1.0, slow_ms=0)
+        part_buf = reqtrace.TraceBuffer(capacity=8, sample=1.0, slow_ms=0)
+        for t in traces:
+            buf = (coord_buf if t.attrs.get("role") == "coordinator"
+                   else part_buf)
+            buf.offer(t)
+        runs = runs_dir(root)
+        f1 = coord_buf.write_traces(os.path.join(runs,
+                                                 "promote-1.traces.json"))
+        f2 = part_buf.write_traces(os.path.join(runs, "proc-b",
+                                                "serve-1.traces.json"))
+        assert f1 and f2
+        files = reqtrace.trace_files(root)
+        assert set(files) == {f1, f2}  # the subdir file is found too
+
+        out_path = os.path.join(runs, reqtrace.FLEET_TRACE_BASENAME)
+        doc = reqtrace.stitch_trace_files(files, out_path)
+        assert doc is not None and os.path.exists(out_path)
+        assert doc["summary"]["stitched"] is True
+        assert doc["summary"]["count"] == 3
+        assert len(doc["summary"]["sources"]) == 2
+        groups = [e for e in doc["traceEvents"]
+                  if e.get("name") == "process_name"]
+        assert len(groups) == 2
+        # every span still carries the ONE round id, across both pids
+        span_pids = {e["pid"] for e in doc["traceEvents"]
+                     if e.get("args", {}).get("trace") == tid}
+        assert span_pids == {1, 2}
+        assert all(s["id"] == tid for s in doc["shifuTraces"])
+        # the stitched export never re-globs itself
+        assert set(reqtrace.trace_files(root)) == {f1, f2}
+
+
+class TestTraceFileDiscovery:
+    def test_any_run_or_process_dir_resolves(self, tmp_path):
+        from shifu_tpu.obs import reqtrace
+        from shifu_tpu.obs.ledger import runs_dir
+
+        runs = runs_dir(str(tmp_path))
+        os.makedirs(os.path.join(runs, "proc-x"), exist_ok=True)
+        doc = {"schema": reqtrace.TRACES_SCHEMA, "traceEvents": [],
+               "shifuTraces": [{"id": "t1", "totalMs": 1.0}]}
+        top = os.path.join(runs, "serve-2.traces.json")
+        sub = os.path.join(runs, "proc-x", "serve-1.traces.json")
+        for p in (top, sub):
+            with open(p, "w") as fh:
+                json.dump(doc, fh)
+        with open(os.path.join(runs, "fleet.traces.json"), "w") as fh:
+            json.dump(doc, fh)  # stitched output: never listed
+        files = reqtrace.trace_files(str(tmp_path))
+        assert files == [top, sub]  # newest seq first, subdirs included
+
+
+# ---------------------------------------------------------------------------
+# `shifu top` renderer (pure — no server)
+# ---------------------------------------------------------------------------
+
+
+class TestTopRender:
+    def test_group_gauge_skips_aggregate_series(self):
+        from shifu_tpu.obs import top
+
+        samples = {
+            'serve_queue_depth{process="p1",tenant="a"}': 2.0,
+            'serve_queue_depth{process="p2",tenant="a"}': 3.0,
+            'serve_queue_depth{agg="sum",tenant="a"}': 5.0,
+        }
+        assert top._group_gauge(samples, "serve_queue_depth", "tenant") \
+            == {"a": 5.0}
+
+    def test_render_frame_pins_fleet_fields(self):
+        from shifu_tpu.obs import fleetview, top
+        from shifu_tpu.obs.metrics import parse_prometheus
+
+        a = _sample("proc-a", _process_registry(30, 2, [10, 20, 30]))
+        breg = _process_registry(12, 4, [40])
+        breg.gauge("serve.breaker.open", replica="0").set(1.0)
+        b = _sample("proc-b", breg)
+        reg = fleetview.merge([a, b])
+        with _Props(**{"shifu.serve.sloTarget": "0.99"}):
+            slo = fleetview.slo_summary(reg)
+        payload = {
+            "liveProcesses": 2, "expiredProcesses": 1,
+            "answeredBy": "proc-a", "slo": slo,
+            "stages": fleetview.stage_quantiles(reg),
+            "processes": [
+                {"leaseId": "proc-a", "live": True, "source": "local",
+                 "ageMs": 12.0, "info": {"status": "serving"}},
+                {"leaseId": "proc-c", "live": False, "source": "disk",
+                 "ageMs": 99000.0, "info": {}},
+            ],
+        }
+        samples = parse_prometheus(reg.to_prometheus())
+        assert top.total_requests(samples) == 42.0
+        frame = top.render_frame(payload, samples, qps=12.5)
+        assert "2 live / 1 expired" in frame
+        assert "answered by proc-a" in frame
+        assert "qps 12.5" in frame and "requests 42" in frame
+        assert "device" in frame            # stage table row
+        assert "t1" in frame                # tenant table row
+        assert "1/1 OPEN" in frame          # proc-b's breaker, named
+        assert "proc-c" in frame and "expired" in frame

@@ -443,6 +443,59 @@ class TestScoringServer:
         with pytest.raises(RejectedError):
             srv.scorer.score_batch(recs[:1])
 
+    def test_fleet_endpoints_answer_on_every_process(self, model_set,
+                                                     raw_data):
+        """PR 17: /admin/metrics.json serves the lossless snapshot the
+        fleet collector scrapes, and /fleet/metrics + /fleet/healthz
+        answer the MERGED view even on a fleet of one."""
+        from shifu_tpu import obs
+        from shifu_tpu.serve.server import ScoringServer
+
+        obs.reset()
+        srv = ScoringServer(root=model_set, max_wait_ms=1,
+                            replicas=1).start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            cols = srv.registry.input_columns
+            recs = [{c: str(raw_data.column(c)[i]) for c in cols}
+                    for i in range(2)]
+            status, _out = _post(f"{base}/score",
+                                 json.dumps({"records": recs}))
+            assert status == 200
+
+            with urllib.request.urlopen(f"{base}/admin/metrics.json",
+                                        timeout=10) as r:
+                doc = json.loads(r.read())
+            assert doc["schema"] == "shifu.obs.metrics/1"
+            assert doc["leaseId"] == srv.lease_id
+            local = doc["metrics"]["counters"][
+                'serve.requests{format="json",replica="0"}']
+            assert local >= 1
+
+            with urllib.request.urlopen(f"{base}/fleet/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            from shifu_tpu.obs.metrics import parse_prometheus
+
+            flat = parse_prometheus(text)
+            # fleet of one: merged counter == the local counter, and the
+            # membership gauges name this process
+            assert flat[
+                'serve_requests_total{format="json",replica="0"}'] \
+                >= local
+            assert flat["fleet_processes_live"] == 1.0
+
+            with urllib.request.urlopen(f"{base}/fleet/healthz",
+                                        timeout=10) as r:
+                hz = json.loads(r.read())
+            assert hz["answeredBy"] == srv.lease_id
+            assert hz["liveProcesses"] == 1
+            assert "fleet" in hz["slo"]
+            assert any(p["leaseId"] == srv.lease_id
+                       for p in hz["processes"])
+        finally:
+            srv.shutdown()
+
     def test_http_429_under_saturation_then_clean_drain(self, model_set,
                                                         raw_data):
         """Acceptance over HTTP: saturated queue -> 429 with Retry-After,
