@@ -136,6 +136,15 @@ CONTINUOUS = dict(n=40_000, d=30, hidden=[50], epochs=60, shift=0.35,
 # window stay at 1 (the psum tree) instead of O(S).
 SHARDED_STATS = dict(n=36_000, numeric=6, cat=2, chunk_rows=3072,
                      device_counts=(1, 2, 8), reps=2)
+# host_affinity (inside sharded_stats) runs the SAME child as one host
+# of a 2-process fleet, concurrently with its peer, against a shared
+# dataset. Scaling efficiency IS gated here (>= 0.7) — hosts are
+# separate processes, so the GIL excuse above does not apply — which
+# needs a parse-dominated workload: the per-run constant tax (stats
+# finalize, sketch merge, the two hostsync barriers) does not split,
+# so at sharded_stats' smoke scale it would eat the halved parse time.
+HOST_AFFINITY = dict(n=400_000, numeric=6, cat=2, chunk_rows=8192,
+                     reps=2)
 # tree_sweep probes -Dshifu.pallas.blk/.wmax shapings of the fused
 # Pallas histogram→split-scan kernel, one subprocess per shaping (the
 # built kernels and the trainer's program cache are per-process, so a
@@ -804,11 +813,16 @@ def bench_streamed_stats(reps: int):
 
 
 def _sharded_stats_child() -> None:
-    """Entry for `bench.py --sharded-stats-child`: one forced-device-count
-    measurement of the sharded streaming-stats fold. Runs in its own
-    process because the XLA host-device count must be fixed BEFORE jax
-    initializes — the parent sets XLA_FLAGS/JAX_PLATFORMS in this child's
-    environment. Prints ONE JSON line."""
+    """Entry for `bench.py --sharded-stats-child [workdir hosts hostIdx]`:
+    one forced-device-count measurement of the sharded streaming-stats
+    fold. Runs in its own process because the XLA host-device count must
+    be fixed BEFORE jax initializes — the parent sets
+    XLA_FLAGS/JAX_PLATFORMS in this child's environment. With the
+    optional trailing args the child is one HOST of a multi-process
+    data-plane run: the dataset lives in the shared `workdir`, the
+    lifecycle knobs pin this process's slot in the HostPlan, and the
+    parent launches all hosts CONCURRENTLY (the hostsync merge barrier
+    deadlocks a sequential schedule). Prints ONE JSON line."""
     import shutil
     import tempfile
 
@@ -819,8 +833,21 @@ def _sharded_stats_child() -> None:
     from shifu_tpu.data.stream import chunk_source
     from shifu_tpu.parallel.mesh import lifecycle_shards
     from shifu_tpu.stats.engine import compute_stats_streaming
+    from shifu_tpu.utils import environment
 
-    spec = SHARDED_STATS
+    argi = sys.argv.index("--sharded-stats-child")
+    rest = sys.argv[argi + 1:argi + 4]
+    workdir = rest[0] if rest else ""
+    n_hosts = int(rest[1]) if len(rest) > 1 else 1
+    host_index = int(rest[2]) if len(rest) > 2 else 0
+    if n_hosts > 1:
+        environment.set_property("shifu.lifecycle.hosts", str(n_hosts))
+        environment.set_property("shifu.lifecycle.hostIndex",
+                                 str(host_index))
+
+    # a workdir marks a host_affinity child (solo baseline or one host
+    # of the fleet) — those run the bigger parse-dominated spec
+    spec = HOST_AFFINITY if workdir else SHARDED_STATS
     n, chunk_rows = spec["n"], spec["chunk_rows"]
     rng = np.random.default_rng(0)
     y = (rng.random(n) < 0.3).astype(int)
@@ -830,12 +857,18 @@ def _sharded_stats_child() -> None:
     names = (["target"] + [f"n{j}" for j in range(spec["numeric"])]
              + [f"c{j}" for j in range(spec["cat"])])
 
-    tmp = tempfile.mkdtemp(prefix="bench-shstats-")
+    tmp = workdir or tempfile.mkdtemp(prefix="bench-shstats-")
     data_path = os.path.join(tmp, "data.txt")
-    with open(data_path, "w") as fh:
-        for i in range(n):
-            fh.write("|".join([str(y[i])] + [f"{v:.5f}" for v in num[i]]
-                              + list(cats[i])) + "\n")
+    if not os.path.exists(data_path):
+        # Only the solo baseline child ever writes (the parent runs it
+        # first); host children find the shared dataset already there.
+        staged = data_path + f".w{os.getpid()}"
+        with open(staged, "w") as fh:
+            for i in range(n):
+                fh.write("|".join([str(y[i])]
+                                  + [f"{v:.5f}" for v in num[i]]
+                                  + list(cats[i])) + "\n")
+        os.replace(staged, data_path)
 
     mc = new_model_config("BenchShardedStats", Algorithm.NN)
     mc.data_set.target_column_name = "target"
@@ -858,13 +891,17 @@ def _sharded_stats_child() -> None:
                            chunk_rows=chunk_rows)
     S = lifecycle_shards()
     K = -(-n // chunk_rows)
+    ck_root = os.path.join(tmp, "ck") if workdir else None
+    kwargs = {"checkpoint_root": ck_root} if ck_root else {}
     try:
-        compute_stats_streaming(mc, fresh_cols(), factory)  # warm compile
+        # warm compile (multi-host: every host must run the SAME number
+        # of folds — each one crosses the merge barrier)
+        compute_stats_streaming(mc, fresh_cols(), factory, **kwargs)
         times = []
         for _ in range(spec["reps"]):
             obs.reset()
             t0 = time.perf_counter()
-            compute_stats_streaming(mc, fresh_cols(), factory)
+            compute_stats_streaming(mc, fresh_cols(), factory, **kwargs)
             times.append(time.perf_counter() - t0)
         reg = obs.registry()  # counters of the LAST measured run
         shard_chunks = {
@@ -872,21 +909,29 @@ def _sharded_stats_child() -> None:
                                     stage=f"stats.{stage}").value)
                     for s in range(S)]
             for stage in ("pass1", "pass2")}
+        host_chunks = {
+            stage: int(reg.counter("host.chunks", host=str(host_index),
+                                   stage=f"stats.{stage}").value)
+            for stage in ("pass1", "pass2")}
         med = statistics.median(times)
         print(json.dumps({
             "devices": S,
+            "host": host_index,
+            "hosts": n_hosts,
             "chunks": K,
             "rows_per_s": n / med,
             "seconds": med,
             "shard_chunks": shard_chunks,
             "max_shard_chunks": max(max(v) for v in
                                     shard_chunks.values()),
+            "host_chunks": host_chunks,
             "d2h_syncs": int(reg.counter("device.d2h_syncs").value),
             "psum_windows": int(reg.counter(
                 "reduce.psum_windows").value),
         }))
     finally:
-        shutil.rmtree(tmp, ignore_errors=True)
+        if not workdir:  # shared workdirs are the parent's to clean
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _tree_sweep_child() -> None:
@@ -1072,6 +1117,7 @@ def bench_sharded_stats():
     return {
         "shard_counts": counts,
         "gates": gates,
+        "host_affinity": _bench_host_affinity(HOST_AFFINITY),
         "note": ("forced host-device sweep of the sharded lifecycle "
                  "fold; gated: each shard folds <= ceil(K/S)+1 chunks "
                  "and host d2h syncs per window == 1 (psum-tree "
@@ -1080,6 +1126,84 @@ def bench_sharded_stats():
                  "overlap here; the division + sync structure is what "
                  "carries to a real mesh"),
     }
+
+
+def _bench_host_affinity(spec):
+    """Pod-scale data plane: the identical streamed-stats workload run
+    by ONE process and then by TWO concurrent host processes
+    (-Dshifu.lifecycle.hosts=2) splitting the same chunk list by
+    HostPlan affinity. Gated: per-host chunk count <= ceil(K/H)+1 (the
+    work-division bound) and scaling efficiency t1/(H*max(t2)) >= 0.7.
+    Unlike shard scaling, host scaling IS gated on the CPU harness —
+    the hosts are separate processes, so the GIL excuse does not
+    apply; only the merge barrier and the per-host fold tax the
+    split."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    H = 2
+    workdir = tempfile.mkdtemp(prefix="bench-hostaff-")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=1"
+                        ).strip()
+
+    def launch(hosts, h):
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--sharded-stats-child", workdir, str(hosts), str(h)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+
+    def collect(proc, tag):
+        out, err = proc.communicate(timeout=1800)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"host_affinity child ({tag}) failed:\n{err[-2000:]}")
+        return json.loads(out.strip().splitlines()[-1])
+
+    try:
+        # solo first: it also writes the shared dataset the host
+        # children reuse (same bytes, same chunk list)
+        solo = collect(launch(1, 0), "solo")
+        # the two hosts MUST run concurrently — each streamed-stats pass
+        # ends at a hostsync merge barrier that waits for the peer
+        procs = [launch(H, h) for h in range(H)]
+        hosts_res = [collect(p, f"host{h}")
+                     for h, p in enumerate(procs)]
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    K = solo["chunks"]
+    bound = -(-K // H) + 1
+    per_host = {str(r["host"]): r["host_chunks"] for r in hosts_res}
+    max_host_chunks = max(max(c.values()) for c in per_host.values())
+    t2 = max(r["seconds"] for r in hosts_res)
+    eff = solo["seconds"] / (H * t2)
+    ha_gates = {
+        "host_division": max_host_chunks <= bound,
+        "scaling_efficiency": eff >= 0.7,
+    }
+    out = {
+        "hosts": H,
+        "chunks": K,
+        "solo_rows_per_s": round(solo["rows_per_s"], 1),
+        "fleet_rows_per_s": round(spec["n"] / t2, 1),
+        "scaling_efficiency": round(eff, 4),
+        "per_host_chunks": per_host,
+        "host_chunk_bound": bound,
+        "gates": ha_gates,
+        "note": ("1-process vs 2-concurrent-process streamed stats over "
+                 "the same dataset; per_host_chunks counts the LAST "
+                 "measured rep's host.chunks counters per pass — "
+                 "disjoint affinity slices summing to K"),
+    }
+    if not all(ha_gates.values()):
+        raise RuntimeError(
+            f"host_affinity gates failed: {json.dumps(out)}")
+    return out
 
 
 def _stage_breakdown(trace_summaries, total_latencies=None):

@@ -54,6 +54,21 @@ KNOBS: List[Knob] = [
        "background prefetch queue depth (0 = serial inline loop)"),
     _K("shifu.lifecycle.shards", "int", "0 (= all devices)",
        "row shards the lifecycle folds divide chunks over (ShardPlan)"),
+    # ---- pod-scale data plane (PR 18) ----
+    _K("shifu.lifecycle.hosts", "int", "1",
+       "processes the chunk list partitions over (HostPlan): each host "
+       "streams only its own slice; artifacts stay byte-identical"),
+    _K("shifu.lifecycle.hostIndex", "int", "-1 (= jax.process_index())",
+       "this process's slot in the HostPlan partition (0..hosts-1)"),
+    _K("shifu.lifecycle.hostWaitMs", "float", "600000",
+       "host merge barrier timeout (parallel/hostsync.py): how long a "
+       "host waits for peers' parts before failing loudly"),
+    _K("shifu.reduce.topology", "str", "auto",
+       "window_reduce collective shape: auto (hierarchical when the "
+       "mesh has a dcn axis) | hierarchical | flat (joint psum)"),
+    _K("shifu.loop.trafficScope", "str", "fleet",
+       "traffic-log reader scope: fleet (union every serve writer) or "
+       "one writer id (that process's chunks only)"),
     # ---- train ----
     _K("shifu.train.forceStreaming", "str", "",
        "\"true\"/\"1\" forces shard-streamed training"),

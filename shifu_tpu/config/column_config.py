@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
+import os
 from dataclasses import dataclass, field
 from typing import Any, List, Optional
 
@@ -198,9 +199,14 @@ def column_config_from_json(data: dict) -> ColumnConfig:
 
 
 def save_column_config_list(path: str, columns: List[ColumnConfig]) -> None:
-    with open(path, "w") as fh:
+    # tmp + replace: concurrent readers (a peer host process polling for
+    # the merge host's post-stats write, serve hot-reload) must see the
+    # old or the new complete file, never a torn one
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
         json.dump([column_config_to_json(c) for c in columns], fh, indent=2)
         fh.write("\n")
+    os.replace(tmp, path)
 
 
 def load_column_config_list(path: str) -> List[ColumnConfig]:

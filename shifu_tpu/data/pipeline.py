@@ -220,6 +220,79 @@ def prefetch_iter(
 # ---------------------------------------------------------------------------
 
 
+class HostPlan:
+    """Deterministic chunk -> HOST assignment: the per-process layer of
+    the pod-scale data plane, sitting ABOVE ShardPlan's per-device
+    round-robin.
+
+    Round-robin on the global chunk index: `host_of(ci) = ci % H`, so
+    with H hosts over K chunk files every process prefetches and folds
+    at most ceil(K/H) of them — the work-division bound the
+    host_affinity bench gates. Like ShardPlan the assignment is a pure
+    function of (ci, H): every process derives the identical partition
+    with zero coordination, keyed only by its own host index
+    (-Dshifu.lifecycle.hostIndex, or jax.process_index() on a real pod;
+    the PR-14 lease id names the process, the index orders it).
+    `local_index(ci) = ci // H` renumbers a host's own chunks densely so
+    the per-device ShardPlan composes underneath and every LOCAL shard
+    still folds ~1/S of the host's slice. H=1 is the degenerate
+    single-controller plan — same code path, every chunk owned.
+    """
+
+    def __init__(self, n_hosts: Optional[int] = None,
+                 host_index: Optional[int] = None) -> None:
+        from shifu_tpu.parallel.mesh import (
+            lifecycle_host_index,
+            lifecycle_hosts,
+        )
+
+        self.n_hosts = (lifecycle_hosts() if n_hosts is None
+                        else max(1, int(n_hosts)))
+        self.host_index = (lifecycle_host_index() if host_index is None
+                           else int(host_index))
+        if not (0 <= self.host_index < self.n_hosts):
+            raise ValueError(
+                f"host index {self.host_index} outside [0, {self.n_hosts})"
+                " — check -Dshifu.lifecycle.hostIndex vs"
+                " -Dshifu.lifecycle.hosts")
+
+    @property
+    def active(self) -> bool:
+        return self.n_hosts > 1
+
+    @property
+    def is_merge_host(self) -> bool:
+        """Host 0 merges the per-host partials in sorted-host order and
+        writes the final artifacts; every other host publishes its part
+        and leaves the shared files alone."""
+        return self.host_index == 0
+
+    def host_of(self, chunk_index: int) -> int:
+        return chunk_index % self.n_hosts
+
+    def owns(self, chunk_index: int) -> bool:
+        return chunk_index % self.n_hosts == self.host_index
+
+    def local_index(self, chunk_index: int) -> int:
+        """Dense ordinal of an OWNED chunk within this host's slice —
+        what the per-device ShardPlan round-robins on, so all S local
+        shards stay busy whatever H is."""
+        return chunk_index // self.n_hosts
+
+    def record(self, rows: int, stage: str) -> None:
+        """Per-host obs: host.chunks / host.rows land in every manifest
+        labeled by host and lifecycle stage — the counters the CI
+        affinity-division assertion reads (each process only ever
+        increments its OWN host label, so two processes' manifests are
+        disjoint by construction)."""
+        from shifu_tpu.obs import registry
+
+        reg = registry()
+        h = str(self.host_index)
+        reg.counter("host.chunks", host=h, stage=stage).inc()
+        reg.counter("host.rows", host=h, stage=stage).inc(rows)
+
+
 class ShardPlan:
     """Deterministic chunk -> row-shard assignment for the lifecycle
     folds (streaming stats, norm, eval scoring, init autotype).
@@ -232,37 +305,62 @@ class ShardPlan:
     with zero coordination, and a shard can prefetch exactly its own
     slice of the chunk stream (`shard_slice`). S=1 is the degenerate
     single-device plan — same code path, every chunk on shard 0.
+
+    With a multi-process HostPlan composed on top (`host=`), ownership
+    filters FIRST — this process only ever sees chunks with
+    `host_of(ci) == host_index` — and the round-robin runs on the host's
+    dense local ordinal (`ci // H`), so all S local shards divide the
+    host's slice evenly whatever H is. H=1 reduces every formula to the
+    original global one.
     """
 
-    def __init__(self, n_shards: Optional[int] = None) -> None:
+    def __init__(self, n_shards: Optional[int] = None,
+                 host: Optional[HostPlan] = None) -> None:
         from shifu_tpu.parallel.mesh import lifecycle_shards
 
         self.n_shards = (lifecycle_shards() if n_shards is None
                          else max(1, int(n_shards)))
+        self.host = HostPlan() if host is None else host
 
     def shard_of(self, chunk_index: int) -> int:
-        return chunk_index % self.n_shards
+        return self.host.local_index(chunk_index) % self.n_shards
 
     def group_of(self, chunk_index: int) -> int:
-        """Super-step index: group g holds chunks [g*S, (g+1)*S) — one
-        chunk per shard, the unit one sharded fold dispatch consumes."""
-        return chunk_index // self.n_shards
+        """Super-step index: group g holds this host's local chunks
+        [g*S, (g+1)*S) — one chunk per shard, the unit one sharded fold
+        dispatch consumes."""
+        return self.host.local_index(chunk_index) // self.n_shards
 
     def shard_slice(self, numbered: Iterable, shard: int) -> Iterator:
-        """Only the (ci, item) pairs assigned to `shard` — what a
-        multi-host shard would prefetch as its own slice."""
+        """Only the owned (ci, item) pairs assigned to `shard` — what a
+        multi-host shard prefetches as its own slice."""
         for ci, item in numbered:
-            if self.shard_of(ci) == shard:
+            if self.host.owns(ci) and self.shard_of(ci) == shard:
                 yield ci, item
+
+    def slices(self, items: Sequence) -> List[List[Tuple[int, Any]]]:
+        """Enumerate the chunk list ONCE and hand every shard its index
+        view: views[s] is the list of owned (ci, item) pairs shard s
+        folds. Replaces S separate `shard_slice` passes, each of which
+        re-enumerated (and re-filtered) the full K-chunk list — O(K)
+        instead of O(K*S) for per-shard fan-out over a materialized
+        list."""
+        views: List[List[Tuple[int, Any]]] = \
+            [[] for _ in range(self.n_shards)]
+        for ci, item in enumerate(items):
+            if self.host.owns(ci):
+                views[self.shard_of(ci)].append((ci, item))
+        return views
 
     def resume_slice(self, numbered: Iterable,
                      cursors: Sequence[int]) -> Iterator:
-        """Per-shard resume: yield (ci, item) pairs each shard has NOT
-        folded yet (ci > its cursor). Chunks below every cursor are
-        skipped before parse, exactly like the single-cursor
-        checkpoint.resume_slice."""
+        """Per-shard resume over this host's slice: yield owned
+        (ci, item) pairs each local shard has NOT folded yet (ci > its
+        cursor). Chunks below every cursor are skipped before parse,
+        exactly like the single-cursor checkpoint.resume_slice."""
         for pair in numbered:
-            if pair[0] > cursors[self.shard_of(pair[0])]:
+            ci = pair[0]
+            if self.host.owns(ci) and ci > cursors[self.shard_of(ci)]:
                 yield pair
 
     def record(self, shard: int, rows: int, stage: str) -> None:
@@ -345,12 +443,18 @@ class DeviceAccumulator:
         from shifu_tpu.obs import profile, registry
         from shifu_tpu.ops.binagg import window_reduce
 
+        from shifu_tpu.parallel.mesh import hierarchical_reduce
+
         reg = registry()
         # the reduce: ONE psum tree over the row axes closes all S shard
         # windows; the single device_get below is the window's ENTIRE d2h
         # budget — was one pull per shard
         reg.counter("reduce.psum_windows").inc()
         reg.counter("device.d2h_syncs").inc()
+        if hierarchical_reduce(self.mesh):
+            # explicit two-stage lowering: the window crossed DCN as ONE
+            # per-slice partial after the ICI psum (ops/binagg)
+            reg.counter("reduce.dcn_hops").inc()
         reduced = profile.dispatch(
             "pipeline.psum_reduce", window_reduce(self.mesh), self._acc,
             sync=False)

@@ -409,7 +409,8 @@ class DriftMonitor:
         }
 
     def check_degrade(self, health=None, ledger_root: Optional[str] = None,
-                      model_sha: str = "") -> Optional[dict]:
+                      model_sha: str = "",
+                      reporter: str = "") -> Optional[dict]:
         """Evaluate the degrade gate: on first breach flip /healthz to
         degraded and stamp ONE retrain recommendation into the ledger.
         Returns the verdict (None only when monitoring is disabled), so
@@ -435,11 +436,11 @@ class DriftMonitor:
         if health is not None:
             health.note_degraded(reason)
         if ledger_root and first:
-            self._write_recommendation(ledger_root, v, model_sha)
+            self._write_recommendation(ledger_root, v, model_sha, reporter)
         return v
 
     def _write_recommendation(self, root: str, verdict: dict,
-                              model_sha: str) -> None:
+                              model_sha: str, reporter: str = "") -> None:
         import sys
         import time
 
@@ -458,6 +459,11 @@ class DriftMonitor:
                     "action": "retrain",
                     "reason": "psi-drift",
                     "modelSetSha": model_sha,
+                    # which fleet process observed the drift — N serve
+                    # processes share one ledger, so recommendations
+                    # must be attributable (same id as its traffic
+                    # chunks' writer and its lease)
+                    "reporter": reporter,
                     "drift": verdict,
                 }},
             )

@@ -2,8 +2,11 @@
 
 Every scored micro-batch can leave a row-sampled trace of (raw features,
 mean score, model-set sha, unix timestamp) under
-`<root>/.shifu/runs/traffic/traffic-<seq>.psv`. Design constraints, in
-order:
+`<root>/.shifu/runs/traffic/traffic-[<writer>-]<seq>.psv`. The log is
+FLEET-SHARED: each serve process appends under its own lease-derived
+writer id with its own monotone sequence, and consumers (`shifu retrain
+--from-traffic`) read the union across writers — N replicas, one
+training stream. Design constraints, in order:
 
   * **Append-only + torn-write-proof.** A chunk file appears atomically
     (resilience.checkpoint.atomic_write: temp + os.replace) when its row
@@ -62,7 +65,34 @@ TS_COLUMN = "shifu_ts"
 # count of meta columns appended after the feature columns, in order
 META_COLUMNS = (SCORE_COLUMN, SHA_COLUMN, TRACE_COLUMN, TS_COLUMN)
 
-_CHUNK_RE = re.compile(r"^traffic-(\d+)\.psv$")
+# chunk names carry an optional WRITER id: a fleet of serve processes
+# appends to the same ledger dir as `traffic-<writer>-<seq>.psv`, each
+# writer owning its own monotone sequence — no cross-process seq race,
+# and readers union the writers. Legacy single-process chunks
+# (`traffic-<seq>.psv`, writer group empty) stay readable. Writer ids
+# are sanitized to [A-Za-z0-9_] and never all-digits (writer_id()), so
+# the name grammar is unambiguous.
+_CHUNK_RE = re.compile(r"^traffic-(?:([A-Za-z0-9_]+)-)?(\d+)\.psv$")
+
+
+def writer_id(value: str) -> str:
+    """Sanitize a lease id (resilience/lease.py: host-pid-token) into a
+    chunk-name-safe writer id. All-digit/empty results get a 'w' prefix
+    so a writer id can never parse as a bare sequence number."""
+    cleaned = re.sub(r"[^A-Za-z0-9_]", "_", value or "")
+    if not cleaned or cleaned[0].isdigit():
+        cleaned = "w" + cleaned  # never digit-led: can't parse as a seq
+    return cleaned
+
+
+def traffic_scope_setting() -> str:
+    """shifu.loop.trafficScope — which writers' chunks consumers read:
+    'fleet' (default) unions every serve process's log; a specific
+    writer id restricts to that process's chunks (replay/debug)."""
+    from shifu_tpu.utils import environment
+
+    v = environment.get_property("shifu.loop.trafficScope", "fleet")
+    return (v or "fleet").strip()
 
 
 def traffic_dir(root: str, stream: str = "") -> str:
@@ -77,15 +107,43 @@ def traffic_columns(base_columns: List[str]) -> List[str]:
     return list(base_columns) + list(META_COLUMNS)
 
 
-def list_chunks(root: str, stream: str = "") -> List[str]:
-    """Chunk files in sequence order (the append order)."""
+def list_chunks(root: str, stream: str = "",
+                scope: Optional[str] = None) -> List[str]:
+    """Chunk files in (sequence, writer) order — the fleet union by
+    default (`scope` falls back to shifu.loop.trafficScope), or one
+    writer's own append order when a writer id is given."""
+    scope = traffic_scope_setting() if scope is None else scope
     out = []
     for path in glob.glob(os.path.join(traffic_dir(root, stream),
                                        "traffic-*.psv")):
         m = _CHUNK_RE.match(os.path.basename(path))
+        if not m:
+            continue
+        writer = m.group(1) or ""
+        if scope != "fleet" and writer != scope:
+            continue
+        out.append(((int(m.group(2)), writer), path))
+    return [p for _k, p in sorted(out)]
+
+
+def chunk_writer(path: str) -> Optional[str]:
+    """Writer id a chunk file belongs to ('' for legacy unnamed chunks,
+    None when the name is not a traffic chunk at all)."""
+    m = _CHUNK_RE.match(os.path.basename(path))
+    return (m.group(1) or "") if m else None
+
+
+def list_writers(root: str, stream: str = "") -> List[str]:
+    """Distinct writer ids with chunks on disk (legacy unnamed chunks
+    report as '') — the retrain lineage manifest's evidence that the
+    union spanned the fleet."""
+    writers = set()
+    for path in glob.glob(os.path.join(traffic_dir(root, stream),
+                                       "traffic-*.psv")):
+        m = _CHUNK_RE.match(os.path.basename(path))
         if m:
-            out.append((int(m.group(1)), path))
-    return [p for _s, p in sorted(out)]
+            writers.add(m.group(1) or "")
+    return sorted(writers)
 
 
 def _sanitize(value: str) -> str:
@@ -103,10 +161,12 @@ class TrafficLog:
     def __init__(self, root: str, columns: List[str],
                  sample: Optional[float] = None,
                  chunk_rows: Optional[int] = None,
-                 seed: int = 0, stream: str = "") -> None:
+                 seed: int = 0, stream: str = "",
+                 writer: str = "") -> None:
         self.root = os.path.abspath(root)
         self.stream = stream
         self.dir = traffic_dir(root, stream)
+        self.writer = writer_id(writer) if writer else ""
         self.columns = list(columns)
         self.sample = (log_sample_setting() if sample is None
                        else float(sample))
@@ -163,12 +223,31 @@ class TrafficLog:
                     len(self.columns), moved, retired)
 
     # ---- layout ----
+    def set_writer(self, writer: str) -> None:
+        """Adopt a fleet writer id (the serve lease id) — called once
+        the lease exists, before traffic flows. The sequence restarts
+        from this WRITER'S own highest chunk, so N processes on one
+        ledger never contend for a sequence number."""
+        with self._lock:
+            self.writer = writer_id(writer)
+            self._seq = self._next_seq()
+            with self._write_cond:
+                self._next_write = self._seq
+
+    def _chunk_path(self, seq: int) -> str:
+        name = (f"traffic-{self.writer}-{seq:05d}.psv" if self.writer
+                else f"traffic-{seq:05d}.psv")
+        return os.path.join(self.dir, name)
+
     def _next_seq(self) -> int:
+        """Highest sequence among THIS writer's chunks + 1 (legacy
+        unnamed chunks when no writer is set) — restarts keep the
+        writer's own sequence monotone."""
         highest = 0
         for path in glob.glob(os.path.join(self.dir, "traffic-*.psv")):
             m = _CHUNK_RE.match(os.path.basename(path))
-            if m:
-                highest = max(highest, int(m.group(1)))
+            if m and (m.group(1) or "") == self.writer:
+                highest = max(highest, int(m.group(2)))
         return highest + 1
 
     def _write_meta(self) -> None:
@@ -245,7 +324,7 @@ class TrafficLog:
         if not self._buffer:
             return None
         seq = self._seq
-        path = os.path.join(self.dir, f"traffic-{seq:05d}.psv")
+        path = self._chunk_path(seq)
         rows, self._buffer = self._buffer, []
         self._seq += 1
         self._chunks += 1
@@ -286,13 +365,15 @@ class TrafficLog:
         with self._lock:
             return {
                 "dir": self.dir,
+                "writer": self.writer,
                 "sample": self.sample,
                 "chunks": self._chunks,
                 "bufferedRows": len(self._buffer),
             }
 
 
-def log_meta(root: str, stream: str = "") -> Tuple[dict, List[str]]:
+def log_meta(root: str, stream: str = "",
+             scope: Optional[str] = None) -> Tuple[dict, List[str]]:
     """(parsed _meta.json, chunk paths) of the traffic log under `root`'s
     ledger — THE validation for every consumer (traffic_source, `shifu
     retrain`), so the operator guidance stays in one place. Raises
@@ -305,7 +386,7 @@ def log_meta(root: str, stream: str = "") -> Tuple[dict, List[str]]:
             f"with --traffic-log (or -Dshifu.loop.logSample>0) first")
     with open(meta_path) as fh:
         meta = json.load(fh)
-    chunks = list_chunks(root, stream)
+    chunks = list_chunks(root, stream, scope=scope)
     if not chunks:
         raise FileNotFoundError(
             f"traffic log {traffic_dir(root, stream)} has no chunk "
@@ -362,17 +443,24 @@ def trace_lineage(root: str, limit: int = 8,
 def traffic_source(root: str, chunk_rows: Optional[int] = None,
                    columns: Optional[List[str]] = None,
                    missing_values=None,
-                   stream: str = "") -> Tuple[object, List[str]]:
+                   stream: str = "",
+                   scope: Optional[str] = None) -> Tuple[object, List[str]]:
     """(chunk_source factory, column names) over the logged traffic — the
-    seam that makes the log just another input stream. Raises
-    FileNotFoundError when nothing was ever logged."""
+    seam that makes the log just another input stream. The fleet UNION
+    by default (every writer's chunks; shifu.loop.trafficScope / `scope`
+    narrows to one writer). Raises FileNotFoundError when nothing was
+    ever logged."""
     from shifu_tpu.data.reader import DEFAULT_MISSING
     from shifu_tpu.data.stream import chunk_source
 
-    meta, _ = log_meta(root, stream)
+    scope = traffic_scope_setting() if scope is None else scope
+    meta, _ = log_meta(root, stream, scope=scope)
     names = list(meta["columns"])
+    pattern = ("traffic-*.psv" if scope == "fleet"
+               else f"traffic-{scope}-*.psv" if scope
+               else "traffic-[0-9]*.psv")
     factory = chunk_source(
-        os.path.join(traffic_dir(root, stream), "traffic-*.psv"),
+        os.path.join(traffic_dir(root, stream), pattern),
         names,
         delimiter=meta.get("delimiter", DELIMITER),
         missing_values=(tuple(missing_values) if missing_values

@@ -16,10 +16,11 @@ and under cleaned_data_dir() (tree-model input, bin codes not z-scores):
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 from dataclasses import dataclass
-from typing import Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -123,6 +124,91 @@ class ShardWriter:
                 np.zeros(0, dtype=np.float32),
             )
         return _write_meta(self.out_dir, self.columns, self.shard_rows,
+                           self.norm_type, self.extra)
+
+
+class HostPartWriter:
+    """Per-host stage of the pod-scale streaming norm (HostPlan,
+    data/pipeline.py): each host appends its OWN chunks as part files
+    keyed by GLOBAL chunk index —
+        .part-<prefix>-CCCCCCCC.npy  (+ .part-tags- / .part-weights-)
+    — and after the host barrier the merge host renames the fleet's
+    union into the sequential single-process shard layout. The rename
+    is a pure relabel ci -> rank(ci) over the sorted union, and np.save
+    of an identical array produces identical bytes, so every shard AND
+    the merged meta.json come out byte-identical to the 1-process run
+    regardless of how many hosts streamed. Parts live in the final
+    out_dir (the same shared filesystem the leases and hostsync parts
+    ride), so the merge is H*K renames, not a copy."""
+
+    def __init__(
+        self,
+        out_dir: str,
+        primary_prefix: str,
+        primary_dtype,
+        columns: List[str],
+        norm_type: str,
+        extra: Optional[dict] = None,
+    ):
+        os.makedirs(out_dir, exist_ok=True)
+        self.out_dir = out_dir
+        self.primary_prefix = primary_prefix
+        self.primary_dtype = primary_dtype
+        self.columns = columns
+        self.norm_type = norm_type
+        self.extra = extra
+        self.part_rows: Dict[int, int] = {}
+
+    def _part(self, prefix: str, ci: int) -> str:
+        return os.path.join(self.out_dir, f".part-{prefix}-{ci:08d}.npy")
+
+    def add(self, ci: int, primary: np.ndarray, tags: np.ndarray,
+            weights: np.ndarray) -> None:
+        np.save(self._part(self.primary_prefix, ci),
+                primary.astype(self.primary_dtype, copy=False))
+        np.save(self._part("tags", ci), tags.astype(np.int8, copy=False))
+        np.save(self._part("weights", ci),
+                weights.astype(np.float32, copy=False))
+        self.part_rows[int(ci)] = int(primary.shape[0])
+
+    def restore(self, part_rows: Dict) -> None:
+        """Resume after preemption: the stream checkpoint recorded these
+        parts as complete; a chunk killed mid-np.save sits past the
+        cursor and is reprocessed, overwriting any torn part in place."""
+        self.part_rows = {int(k): int(v) for k, v in part_rows.items()}
+
+    def merge(self, union_rows: Dict[int, int]) -> NormMeta:
+        """Merge host only, after the barrier: rename the fleet-wide
+        union of parts ({global ci: rows}, this host's included) into
+        the sequential shard layout and write the merged meta.json."""
+        shard_rows: List[int] = []
+        for sid, ci in enumerate(sorted(union_rows)):
+            for prefix in (self.primary_prefix, "tags", "weights"):
+                os.replace(
+                    self._part(prefix, ci),
+                    os.path.join(self.out_dir, f"{prefix}-{sid:05d}.npy"))
+            shard_rows.append(int(union_rows[ci]))
+        if not shard_rows:
+            # mirror ShardWriter.close(): one empty shard, never a
+            # missing-file crash for loaders
+            np.save(os.path.join(self.out_dir,
+                                 f"{self.primary_prefix}-00000.npy"),
+                    np.zeros((0, len(self.columns)),
+                             dtype=self.primary_dtype))
+            np.save(os.path.join(self.out_dir, "tags-00000.npy"),
+                    np.zeros(0, dtype=np.int8))
+            np.save(os.path.join(self.out_dir, "weights-00000.npy"),
+                    np.zeros(0, dtype=np.float32))
+            shard_rows.append(0)
+        # every host has published its part list by now, so any .part-*
+        # file not in the union is debris from a dead earlier run
+        for leftover in glob.glob(os.path.join(self.out_dir,
+                                               ".part-*.npy")):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
+        return _write_meta(self.out_dir, self.columns, shard_rows,
                            self.norm_type, self.extra)
 
 

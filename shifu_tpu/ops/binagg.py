@@ -238,23 +238,50 @@ def masked_window_add(mesh):
 
 def window_reduce(mesh):
     """Jitted reduce program: psum (pmin/pmax for extrema) of the stacked
-    [S, ...] windows over the mesh's row axes — ONE collective closes the
-    whole window, so the host pulls ONE replicated result instead of S
-    per-shard windows."""
-    from shifu_tpu.parallel.mesh import row_axes, shard_map_compat
+    [S, ...] windows over the mesh's row axes — ONE collective tree
+    closes the whole window, so the host pulls ONE replicated result
+    instead of S per-shard windows.
 
-    key = ("reduce", _mesh_key(mesh))
+    On a multi-slice (dcn, data) mesh the reduce is EXPLICITLY
+    hierarchical (unless -Dshifu.reduce.topology=flat): stage 1 psums
+    the heavy [S, ...] windows within each slice over ICI, stage 2 moves
+    exactly ONE per-slice partial across DCN — the In-Network-Aggregation
+    shallow-tree shape, spelled out instead of left to the joint-psum
+    lowering. A single-axis mesh keeps the flat one-stage psum (the
+    1-slice degenerate case). Either way the reduce is still one
+    collective dispatch and the caller still pays one d2h sync per
+    window."""
+    from shifu_tpu.parallel.mesh import (
+        hierarchical_reduce,
+        row_axes,
+        shard_map_compat,
+    )
+
+    staged = hierarchical_reduce(mesh)
+    key = ("reduce", _mesh_key(mesh), staged)
     prog = _WINDOW_PROGRAMS.get(key)
     if prog is not None:
         return prog
     axes = row_axes(mesh)
     sharded, replicated = window_specs(mesh)
 
-    def local(win):
-        out = [jax.lax.psum(w, axes) for w in win]
-        out[_MIN_FIELD] = jax.lax.pmin(win.vmin, axes)
-        out[_MAX_FIELD] = jax.lax.pmax(win.vmax, axes)
-        return BinAggregates(*out)
+    if staged:
+        ici = tuple(a for a in axes if a != "dcn")
+
+        def stage2(op, x):
+            return op(op(x, ici), "dcn")
+
+        def local(win):
+            out = [stage2(jax.lax.psum, w) for w in win]
+            out[_MIN_FIELD] = stage2(jax.lax.pmin, win.vmin)
+            out[_MAX_FIELD] = stage2(jax.lax.pmax, win.vmax)
+            return BinAggregates(*out)
+    else:
+        def local(win):
+            out = [jax.lax.psum(w, axes) for w in win]
+            out[_MIN_FIELD] = jax.lax.pmin(win.vmin, axes)
+            out[_MAX_FIELD] = jax.lax.pmax(win.vmax, axes)
+            return BinAggregates(*out)
 
     prog = jax.jit(shard_map_compat(
         local, mesh=mesh, in_specs=(sharded,), out_specs=replicated))

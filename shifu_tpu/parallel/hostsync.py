@@ -1,0 +1,167 @@
+"""Host part exchange: the merge fabric of the pod-scale data plane.
+
+A HostPlan (data/pipeline.py) hands every process its own chunk-file
+slice; this module is how the per-host partial results come back
+together. Each host publishes its partial (named numpy arrays + JSON
+meta + an optional pickled blob, e.g. pass-1 sketches) as ONE atomic
+npz under the model set's run ledger:
+
+    <root>/.shifu/runs/hosts/<step>/part-h000.npz
+
+and `await_parts` blocks until every host's part for the same stream
+identity (the caller's config sha) is present, returning them in
+SORTED-HOST order — the deterministic merge order that keeps
+multi-process artifacts byte-identical to the 1-process run. The
+filesystem is the exchange medium on purpose: it is the same shared
+ledger the PR-14 leases and the PR-17 metrics time-series already ride,
+it needs no sockets or rendezvous address, and `atomic_write` makes a
+mid-publish kill invisible (the previous complete part, or none, never
+a torn one).
+
+Parts are keyed by the caller's config sha, so an awaiting host ignores
+(keeps waiting past) parts left by a run with different chunk geometry
+or columns. Parts from a previous run of the IDENTICAL config are
+indistinguishable by design — the fold is deterministic, so a stale
+part equals the part its host is about to republish. A fresh (non
+resumed) run still calls `clear_part` before streaming so a crashed
+half-fleet never leaves one-run-old state behind longer than necessary.
+
+Metrics: host.parts_published, host.parts_merged, host.await_seconds.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from shifu_tpu.utils import environment
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+META_KEY = "__meta__"
+BLOB_KEY = "__blob__"
+
+HOSTS_SUBDIR = os.path.join(".shifu", "runs", "hosts")
+
+DEFAULT_WAIT_MS = 600_000
+
+Part = Tuple[Dict[str, np.ndarray], dict, Optional[bytes]]
+
+
+def host_wait_ms_setting() -> float:
+    """shifu.lifecycle.hostWaitMs — how long a host waits for its peers'
+    parts at a merge barrier before failing loudly (a dead peer must
+    surface as an error, not a hang)."""
+    return environment.get_float("shifu.lifecycle.hostWaitMs",
+                                 DEFAULT_WAIT_MS)
+
+
+def parts_dir(root: str, step: str) -> str:
+    return os.path.join(os.path.abspath(root), HOSTS_SUBDIR, step)
+
+
+def part_path(root: str, step: str, host_index: int) -> str:
+    return os.path.join(parts_dir(root, step), f"part-h{host_index:03d}.npz")
+
+
+def publish_part(root: str, step: str, host_plan, sha: str,
+                 arrays: Optional[Dict[str, np.ndarray]] = None,
+                 meta: Optional[dict] = None,
+                 blob: Optional[bytes] = None) -> str:
+    """Atomically publish this host's partial for `step`."""
+    from shifu_tpu.obs import registry
+    from shifu_tpu.resilience.checkpoint import atomic_write
+
+    payload: Dict[str, np.ndarray] = {}
+    for k, v in (arrays or {}).items():
+        assert not k.startswith("__"), k
+        payload[k] = np.asarray(v)
+    header = {
+        "host": host_plan.host_index,
+        "hosts": host_plan.n_hosts,
+        "configSha": sha,
+        "meta": meta or {},
+    }
+    payload[META_KEY] = np.frombuffer(
+        json.dumps(header, sort_keys=True).encode("utf-8"), dtype=np.uint8)
+    if blob is not None:
+        payload[BLOB_KEY] = np.frombuffer(blob, dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    path = atomic_write(part_path(root, step, host_plan.host_index),
+                        buf.getvalue())
+    registry().counter("host.parts_published", step=step,
+                       host=str(host_plan.host_index)).inc()
+    return path
+
+
+def clear_part(root: str, step: str, host_plan) -> None:
+    """Remove this host's OWN previous part (fresh runs call this before
+    streaming; other hosts' parts are their live state)."""
+    try:
+        os.unlink(part_path(root, step, host_plan.host_index))
+    except OSError:  # never published / already cleared
+        pass
+
+
+def _read_part(path: str, sha: str, n_hosts: int) -> Optional[Part]:
+    """(arrays, meta, blob) when the part is complete and belongs to this
+    stream (sha + host count match), else None — corrupt or foreign
+    parts read as 'not arrived yet' and the barrier keeps waiting for
+    the owner to republish."""
+    try:
+        with np.load(path) as z:
+            header = json.loads(bytes(z[META_KEY].tobytes()).decode())
+            arrays = {k: z[k] for k in z.files
+                      if k not in (META_KEY, BLOB_KEY)}
+            blob = z[BLOB_KEY].tobytes() if BLOB_KEY in z.files else None
+    except Exception:  # torn/in-flight part: reads as "not arrived yet"
+        return None
+    if header.get("configSha") != sha or header.get("hosts") != n_hosts:
+        return None
+    return arrays, header.get("meta", {}), blob
+
+
+def await_parts(root: str, step: str, host_plan, sha: str,
+                timeout_ms: Optional[float] = None,
+                poll_s: float = 0.05) -> List[Part]:
+    """Block until every host's part for (`step`, `sha`) exists; return
+    [(arrays, meta, blob)] in sorted-host order 0..H-1 — the merge order
+    the byte-parity contract fixes. Raises TimeoutError when a peer
+    never publishes (its process died before the barrier): a hang here
+    would be indistinguishable from progress."""
+    from shifu_tpu.obs import registry
+
+    H = host_plan.n_hosts
+    timeout_ms = host_wait_ms_setting() if timeout_ms is None else timeout_ms
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    parts: Dict[int, Part] = {}
+    t0 = time.monotonic()
+    while True:
+        for h in range(H):
+            if h in parts:
+                continue
+            got = _read_part(part_path(root, step, h), sha, H)
+            if got is not None:
+                parts[h] = got
+        if len(parts) == H:
+            break
+        if time.monotonic() >= deadline:
+            missing = sorted(set(range(H)) - set(parts))
+            raise TimeoutError(
+                f"host barrier '{step}' timed out after {timeout_ms:.0f}ms"
+                f" waiting for host part(s) {missing} under"
+                f" {parts_dir(root, step)} — peer process(es) dead or"
+                " launched with a different config"
+                " (-Dshifu.lifecycle.hostWaitMs raises the wait)")
+        time.sleep(poll_s)
+    reg = registry()
+    reg.timer("host.await_seconds", step=step).add(time.monotonic() - t0)
+    reg.counter("host.parts_merged", step=step).inc(H)
+    return [parts[h] for h in range(H)]

@@ -101,6 +101,51 @@ def lifecycle_shards() -> int:
     return max(1, len(jax.devices()))
 
 
+def lifecycle_hosts() -> int:
+    """Host (process) count of the pod-scale data plane:
+    `shifu.lifecycle.hosts` when set (>0), else 1 — the single-controller
+    degenerate case every pre-host run is."""
+    from shifu_tpu.utils import environment
+
+    return max(1, environment.get_int("shifu.lifecycle.hosts", 1))
+
+
+def lifecycle_host_index() -> int:
+    """This process's host index in [0, lifecycle_hosts()):
+    `shifu.lifecycle.hostIndex` when set, else `jax.process_index()` —
+    on a real multi-host pod the jax runtime numbers the processes; on a
+    CPU fleet of OS processes the launcher pins the index (the PR-14
+    lease id names the process, the index orders it)."""
+    from shifu_tpu.utils import environment
+
+    idx = environment.get_int("shifu.lifecycle.hostIndex", -1)
+    if idx >= 0:
+        return idx
+    import jax
+
+    return int(jax.process_index())
+
+
+def reduce_topology() -> str:
+    """shifu.reduce.topology — window-reduce lowering override:
+    `auto` (default: hierarchical when the mesh has a dcn axis, flat on a
+    single-slice mesh), `hierarchical`, or `flat` (forces the one-stage
+    joint psum even on a multi-slice mesh — the bit-parity reference)."""
+    from shifu_tpu.utils import environment
+
+    v = environment.get_property("shifu.reduce.topology", "auto")
+    v = (v or "auto").strip().lower()
+    return v if v in ("auto", "hierarchical", "flat") else "auto"
+
+
+def hierarchical_reduce(mesh) -> bool:
+    """Whether window_reduce on `mesh` lowers as the explicit two-stage
+    tree (psum over ICI/`data` first, then ONE partial per slice across
+    `dcn`). Flat is the 1-slice degenerate case: with no dcn axis there
+    is nothing to stage."""
+    return "dcn" in row_axes(mesh) and reduce_topology() != "flat"
+
+
 def lifecycle_mesh(n_shards: Optional[int] = None):
     """The (cached) mesh the lifecycle folds shard rows over: the first
     `n_shards` devices, (dcn, data) when the set spans slices so the

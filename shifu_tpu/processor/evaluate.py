@@ -81,6 +81,18 @@ class EvalProcessor(BasicProcessor):
         return list(mc.evals)
 
     def run_step(self) -> None:
+        from shifu_tpu.data.pipeline import HostPlan
+
+        hp = HostPlan()
+        if hp.active and not hp.is_merge_host:
+            # eval's shared reduce state is ONE append-order score file;
+            # under a multi-host lifecycle the merge host runs the whole
+            # eval (its output is byte-identical by construction) while
+            # the other processes skip — the pod-scale win lives in the
+            # stats/norm/autotype passes, which dominate the lifecycle
+            log.info("eval skipped on host %d/%d: the merge host runs "
+                     "the full eval pass", hp.host_index, hp.n_hosts)
+            return
         self.setup()
         mc = self.model_config
         assert mc is not None
@@ -276,11 +288,13 @@ class EvalProcessor(BasicProcessor):
         # resume truncates it back to the last snapshotted byte offset,
         # so rows the killed run appended after its final checkpoint are
         # dropped and re-scored ----
-        from shifu_tpu.data.pipeline import ShardPlan
+        from shifu_tpu.data.pipeline import HostPlan, ShardPlan
         from shifu_tpu.resilience import checkpoint as ckpt_mod
         from shifu_tpu.resilience import faults
 
-        shard_plan = ShardPlan()
+        # the merge host runs the WHOLE eval (run_step sends the other
+        # hosts home), so pin a 1-host plan regardless of the knobs
+        shard_plan = ShardPlan(host=HostPlan(n_hosts=1, host_index=0))
         S = shard_plan.n_shards
         cursors = [-1] * S
         shard_rows_s = [0] * S

@@ -48,6 +48,13 @@ def _read_names_file(path: Optional[str], root: str) -> Set[str]:
 class InitProcessor(BasicProcessor):
     step = "init"
 
+    def __init__(self, root: str = ".", host_plan=None):
+        super().__init__(root)
+        # explicit HostPlan override for in-process multi-host drivers
+        # (tests/bench); production processes read the lifecycle knobs
+        self.host_plan = host_plan
+        self._hp = None
+
     def run_step(self) -> None:
         self.setup(need_columns=False)
         mc = self.model_config
@@ -99,6 +106,14 @@ class InitProcessor(BasicProcessor):
 
         self._auto_type(columns, names, cate_cols)
         self.column_configs = columns
+        if self._hp is not None and self._hp.active \
+                and not self._hp.is_merge_host:
+            # every host merged the identical fleet-wide sketches, but
+            # only one process writes ColumnConfig.json / autotype json
+            log.info("autotype computed on host %d/%d; merge host writes "
+                     "ColumnConfig.json", self._hp.host_index,
+                     self._hp.n_hosts)
+            return
         self.save_column_configs()
         log.info(
             "ColumnConfig.json initialized: %d columns (%d categorical, target=%s).",
@@ -120,39 +135,67 @@ class InitProcessor(BasicProcessor):
         # the lifecycle ShardPlan like every other streaming fold: each
         # row shard folds its own chunks into its own sketches, merged
         # once at the end (exact union for HLL registers / count sums)
-        from shifu_tpu.data.pipeline import ShardPlan, prefetch_iter
+        from shifu_tpu.data.pipeline import HostPlan, ShardPlan, prefetch_iter
         from shifu_tpu.data.stream import iter_columnar_chunks
         from shifu_tpu.stats.sketch import AutoTypeSketch
 
+        hp = self.host_plan if self.host_plan is not None else HostPlan()
+        self._hp = hp
         candidates = [
             cc for cc in columns
             if not (cc.is_target() or cc.is_meta() or cc.is_weight())
         ]
         missing = tuple(ds.missing_or_invalid_values)
-        plan = ShardPlan()
+        plan = ShardPlan(host=hp)
         shard_sketches = [
             {cc.column_name: AutoTypeSketch(missing) for cc in candidates}
             for _ in range(plan.n_shards)]
         # parse overlaps the sketch folds via the prefetch thread; only the
         # candidate columns are parsed at all — target/meta/weight (fat
-        # padding fields included) never leave the CSV tokenizer
-        for ci, chunk in prefetch_iter(enumerate(iter_columnar_chunks(
-            self.resolve(ds.data_path),
-            names,
-            delimiter=ds.data_delimiter,
-            missing_values=missing,
-            max_rows=AUTOTYPE_MAX_ROWS,
-            columns=[cc.column_name for cc in candidates],
-        ))):
+        # padding fields included) never leave the CSV tokenizer; under a
+        # HostPlan each process parses ONLY its own chunk slice
+        no_cursor = [-1] * plan.n_shards
+        for ci, chunk in prefetch_iter(plan.resume_slice(
+                enumerate(iter_columnar_chunks(
+                    self.resolve(ds.data_path),
+                    names,
+                    delimiter=ds.data_delimiter,
+                    missing_values=missing,
+                    max_rows=AUTOTYPE_MAX_ROWS,
+                    columns=[cc.column_name for cc in candidates],
+                )), no_cursor)):
             s = plan.shard_of(ci)
             for cc in candidates:
                 shard_sketches[s][cc.column_name].update(
                     chunk._series(cc.column_name))
             plan.record(s, chunk.n_rows, "init.autotype")
+            hp.record(chunk.n_rows, "init.autotype")
+        if hp.active:
+            # all-gather the per-host sketch sets; every host merges the
+            # same H*S sets in host-major order, so the fleet agrees on
+            # every distinct count / numeric ratio bit-for-bit
+            import pickle
+
+            from shifu_tpu.parallel import hostsync
+            from shifu_tpu.resilience.checkpoint import config_sha
+
+            sha = config_sha({
+                "columns": [cc.column_name for cc in candidates],
+                "missing": list(missing),
+                "maxRows": AUTOTYPE_MAX_ROWS,
+                "shards": plan.n_shards,
+            })
+            hostsync.publish_part(
+                self.root, "init-autotype", hp, sha,
+                blob=pickle.dumps(shard_sketches))
+            parts = hostsync.await_parts(self.root, "init-autotype", hp, sha)
+            shard_sketches = []
+            for _arrays, _meta, blob in parts:
+                shard_sketches.extend(pickle.loads(blob))
         sketches = shard_sketches[0]
-        for s in range(1, plan.n_shards):
+        for other in shard_sketches[1:]:
             for name, sk in sketches.items():
-                sk.merge(shard_sketches[s][name])
+                sk.merge(other[name])
 
         threshold = ds.auto_type_threshold
         count_info = {}
@@ -181,6 +224,8 @@ class InitProcessor(BasicProcessor):
                     cc.column_type = ColumnType.N
             elif cc.column_type is None:
                 cc.column_type = ColumnType.N
+        if hp.active and not hp.is_merge_host:
+            return  # merge host writes the autotype artifact
         out = self.paths.autotype_path()
         self.paths.ensure(os.path.dirname(out))
         with open(out, "w") as fh:

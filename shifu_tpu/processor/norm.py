@@ -49,7 +49,7 @@ class NormProcessor(BasicProcessor):
     step = "norm"
 
     def __init__(self, root: str = ".", shuffle: bool = False, seed: int = 0,
-                 names_override=None):
+                 names_override=None, host_plan=None):
         super().__init__(root)
         self.shuffle = shuffle
         self.seed = seed
@@ -57,6 +57,9 @@ class NormProcessor(BasicProcessor):
         # layout (input columns + target/weight + score/sha/ts), which is
         # neither the configured header nor ColumnConfig order
         self.names_override = list(names_override) if names_override else None
+        # explicit HostPlan override for in-process multi-host drivers
+        # (tests/bench); production processes read the lifecycle knobs
+        self.host_plan = host_plan
 
     def run_step(self) -> None:
         self.setup()
@@ -76,6 +79,16 @@ class NormProcessor(BasicProcessor):
         if should_stream(self.resolve(ds.data_path)):
             self._run_streaming(names)
             return
+
+        from shifu_tpu.data.pipeline import HostPlan
+
+        hp = self.host_plan if self.host_plan is not None else HostPlan()
+        if hp.active:
+            raise ValueError(
+                "-Dshifu.lifecycle.hosts > 1 requires the streaming norm "
+                "path (dataset under the memory budget loads in one "
+                "process) — drop the hosts knob or lower "
+                "shifu.stream.memoryBudgetMb")
 
         data = read_columnar(
             self.resolve(ds.data_path),
@@ -202,11 +215,22 @@ class NormProcessor(BasicProcessor):
         shard per ingest chunk; with shuffle, a two-pass external shuffle
         (ShuffleShardWriter) produces a true uniform global permutation —
         the MR shuffle's contract (core/shuffle/MapReduceShuffle.java:47) —
-        with peak memory of one bucket."""
-        from shifu_tpu.data.pipeline import prefetch_iter
+        with peak memory of one bucket.
+
+        Multi-host (shifu.lifecycle.hosts > 1): each process streams only
+        its HostPlan slice of the chunk list, writing chunk-indexed part
+        files (HostPartWriter); after a hostsync barrier the merge host
+        renames the sorted union into the sequential shard layout, so
+        both artifacts are byte-identical to the 1-process run."""
+        from shifu_tpu.data.pipeline import HostPlan, prefetch_iter
         from shifu_tpu.data.stream import chunk_source, memory_budget_bytes
-        from shifu_tpu.norm.dataset import ShardWriter, ShuffleShardWriter
+        from shifu_tpu.norm.dataset import (
+            HostPartWriter,
+            ShardWriter,
+            ShuffleShardWriter,
+        )
         from shifu_tpu.obs import registry, span
+        from shifu_tpu.parallel import hostsync
         from shifu_tpu.stats.engine import _prepare_rows
 
         mc = self.model_config
@@ -216,7 +240,25 @@ class NormProcessor(BasicProcessor):
         slots = [_slots(c) for c in tree_cols]
         code_dtype = np.int16 if (not slots or max(slots) < 2**15) else np.int32
 
-        if self.shuffle:
+        hp = self.host_plan if self.host_plan is not None else HostPlan()
+        if self.shuffle and hp.active:
+            raise ValueError(
+                "-shuffle is not multi-host capable: the external-shuffle "
+                "writer owns the global permutation and cannot be split "
+                "across processes — run the shuffle norm on one process "
+                "or drop -Dshifu.lifecycle.hosts")
+        if hp.active:
+            feat_writer = HostPartWriter(
+                self.paths.normalized_data_dir(), "features", np.float32,
+                plan.out_names, mc.normalize.norm_type.value,
+                extra={"sourceOf": plan.source_of},
+            )
+            code_writer = HostPartWriter(
+                self.paths.cleaned_data_dir(), "codes", code_dtype,
+                [c.column_name for c in tree_cols], "CODES",
+                extra={"slots": slots},
+            )
+        elif self.shuffle:
             # bucket count so one bucket fits ~1/4 of the memory budget;
             # gz-compressed text typically expands ~4x when materialized
             from shifu_tpu.data.reader import _expand_paths
@@ -302,21 +344,22 @@ class NormProcessor(BasicProcessor):
         from shifu_tpu.resilience import checkpoint as ckpt_mod
         from shifu_tpu.resilience import faults
 
-        shard_plan = ShardPlan()
+        shard_plan = ShardPlan(host=hp)
         S = shard_plan.n_shards
         cursors = [-1] * S
         shard_rows_f = [0] * S
         ck = None
         n_rows = 0
         all_tag_counts: dict = {}
+        sha, sha_sections = self._stream_config_sha(plan, slots, S)
         if not self.shuffle and ckpt_mod.ckpt_stream_enabled():
-            sha, sha_sections = self._stream_config_sha(plan, slots, S)
             # keyed by self.step so a retrain's norm pass (step
             # "retrain-norm") never collides with a real `shifu norm`
             # resume on the same model set
             ck = ckpt_mod.ShardedStreamCheckpoint(
                 ckpt_mod.ckpt_base(self.root, self.step, "stream"),
-                sha, S, sections=sha_sections)
+                sha, S, sections=sha_sections,
+                n_hosts=hp.n_hosts, host_index=hp.host_index)
             if ckpt_mod.resume_requested():
                 loaded = ck.load()
                 if loaded is not None:
@@ -325,29 +368,44 @@ class NormProcessor(BasicProcessor):
                     shard_rows_f = [int(m.get("rows", 0))
                                     for _a, m, _b in per_shard]
                     meta = shared[1]
-                    feat_writer.restore(meta["featShardRows"])
-                    code_writer.restore(meta["codeShardRows"])
+                    if hp.active:
+                        feat_writer.restore(meta["featParts"])
+                        code_writer.restore(meta["codeParts"])
+                    else:
+                        feat_writer.restore(meta["featShardRows"])
+                        code_writer.restore(meta["codeShardRows"])
                     n_rows = int(meta["nRows"])
                     all_tag_counts = {int(k): int(v) for k, v in
                                       meta["tagCounts"].items()}
                     faults.survived("preempt")
-                    log.info("resuming streaming norm (shard cursors %s, "
-                             "%d shards on disk)", cursors,
-                             len(feat_writer.shard_rows))
+                    log.info("resuming streaming norm (shard cursors %s)",
+                             cursors)
             else:
                 ck.clear()
         elif self.shuffle and ckpt_mod.resume_requested():
             log.warning("--resume with -shuffle: the external-shuffle "
                         "writer appends to bucket files and cannot "
                         "resume mid-stream; restarting from row zero")
+        if hp.active and not ckpt_mod.resume_requested():
+            # fresh fleet run: drop this host's stale barrier part so a
+            # dead earlier run can't satisfy the merge barrier early
+            hostsync.clear_part(self.root, self.step, hp)
+
+        def _writer_state() -> dict:
+            if hp.active:
+                return {"featParts": {str(k): v for k, v in
+                                      feat_writer.part_rows.items()},
+                        "codeParts": {str(k): v for k, v in
+                                      code_writer.part_rows.items()}}
+            return {"featShardRows": list(feat_writer.shard_rows),
+                    "codeShardRows": list(code_writer.shard_rows)}
 
         def _ckpt_state():
             per_shard = [
                 (cursors[s], None, {"rows": shard_rows_f[s]}, None)
                 for s in range(S)]
             shared = (None,
-                      {"featShardRows": list(feat_writer.shard_rows),
-                       "codeShardRows": list(code_writer.shard_rows),
+                      {**_writer_state(),
                        "nRows": n_rows,
                        "tagCounts": {str(k): v for k, v in
                                      all_tag_counts.items()}},
@@ -364,13 +422,18 @@ class NormProcessor(BasicProcessor):
                 faults.fault_point("chunk")
                 ci, feats, codes, tags, weights = item
                 with timers.timer("write"):
-                    feat_writer.add(feats, tags, weights)
-                    code_writer.add(codes, tags, weights)
+                    if hp.active:
+                        feat_writer.add(ci, feats, tags, weights)
+                        code_writer.add(ci, codes, tags, weights)
+                    else:
+                        feat_writer.add(feats, tags, weights)
+                        code_writer.add(codes, tags, weights)
                 n_rows += len(tags)
                 shard = shard_plan.shard_of(ci)
                 cursors[shard] = ci
                 shard_rows_f[shard] += len(tags)
                 shard_plan.record(shard, len(tags), "norm")
+                hp.record(len(tags), "norm")
                 for t, c in zip(*np.unique(tags, return_counts=True)):
                     all_tag_counts[int(t)] = (
                         all_tag_counts.get(int(t), 0) + int(c))
@@ -379,9 +442,34 @@ class NormProcessor(BasicProcessor):
             sp["rows"] = n_rows
         if ck is not None:
             ck.clear()
-        reg.counter("norm.rows").inc(n_rows)
+        reg.counter("norm.rows").inc(n_rows)  # this host's streamed rows
         reg.gauge("norm.columns").set(len(plan.out_names))
         log.info("streaming norm pipeline: %s", timers.summary())
+
+        feat_union: dict = {}
+        code_union: dict = {}
+        if hp.active:
+            # all-gather the per-host part lists; every host learns the
+            # fleet union (and merged tag counts) in sorted-host order
+            hostsync.publish_part(
+                self.root, self.step, hp, sha,
+                meta={**_writer_state(),
+                      "nRows": n_rows,
+                      "tagCounts": {str(k): int(v) for k, v in
+                                    all_tag_counts.items()}})
+            parts = hostsync.await_parts(self.root, self.step, hp, sha)
+            merged_tags: dict = {}
+            n_rows = 0
+            for _arrays, pmeta, _blob in parts:
+                feat_union.update({int(k): int(v) for k, v in
+                                   pmeta["featParts"].items()})
+                code_union.update({int(k): int(v) for k, v in
+                                   pmeta["codeParts"].items()})
+                n_rows += int(pmeta["nRows"])
+                for k, v in pmeta["tagCounts"].items():
+                    merged_tags[int(k)] = merged_tags.get(int(k), 0) + int(v)
+            all_tag_counts = merged_tags
+
         if mc.is_multi_classification() and feat_writer.extra is not None:
             class_tags = [str(t) for t in mc.tags()]
             total = max(sum(all_tag_counts.values()), 1)
@@ -389,8 +477,18 @@ class NormProcessor(BasicProcessor):
             feat_writer.extra["classPriors"] = [
                 all_tag_counts.get(k, 0) / total for k in range(len(class_tags))
             ]
-        feat_meta = feat_writer.close()
-        code_writer.close()
+        if hp.active:
+            if not hp.is_merge_host:
+                log.info("streaming norm host %d/%d: %d parts staged; "
+                         "merge host writes the artifacts",
+                         hp.host_index, hp.n_hosts, len(_writer_state()
+                                                        ["featParts"]))
+                return
+            feat_meta = feat_writer.merge(feat_union)
+            code_writer.merge(code_union)
+        else:
+            feat_meta = feat_writer.close()
+            code_writer.close()
         log.info(
             "streaming norm: %d rows x %d cols (%s) -> %s [%d shards] "
             "+ bin codes -> %s",

@@ -139,8 +139,18 @@ class RetrainProcessor(BasicProcessor):
     # ---- source resolution ----
     def _resolve_source(self, mc):
         """(kind, names_override, traffic_chunks) — and mutates the
-        in-memory ModelConfig copy's data_set to point at the stream."""
-        from shifu_tpu.loop.traffic import META_FILE, log_meta, traffic_dir
+        in-memory ModelConfig copy's data_set to point at the stream.
+        The traffic source is the FLEET UNION by default: every serve
+        process's writer-scoped chunks under one ledger dir
+        (shifu.loop.trafficScope narrows to one writer); the writers
+        consumed land in the lineage manifest."""
+        from shifu_tpu.loop.traffic import (
+            META_FILE,
+            chunk_writer,
+            log_meta,
+            traffic_dir,
+            traffic_scope_setting,
+        )
 
         ds = mc.data_set
         stream = self.traffic_stream
@@ -167,10 +177,17 @@ class RetrainProcessor(BasicProcessor):
                 f"traffic log carries no `{target}` column — retraining "
                 f"needs label-joined traffic (serve from the model-set "
                 f"root so the log keeps the target column)")
+        scope = traffic_scope_setting()
+        pattern = ("traffic-*.psv" if scope == "fleet"
+                   else f"traffic-{scope}-*.psv")
         ds.data_path = os.path.join(traffic_dir(self.root, stream),
-                                    "traffic-*.psv")
+                                    pattern)
         ds.data_delimiter = meta.get("delimiter", "|")
         ds.header_path = None
+        # the distinct serve processes whose chunks this run consumes —
+        # provenance that the union really spanned the fleet
+        self._traffic_writers = sorted(
+            {chunk_writer(p) or "" for p in chunks})
         return "traffic", names, [os.path.basename(p) for p in chunks]
 
     # ---- warm-start seeding ----
@@ -303,6 +320,8 @@ class RetrainProcessor(BasicProcessor):
             "source": {"kind": kind,
                        "dataPath": sub_mc.data_set.data_path,
                        "trafficChunks": traffic_chunks,
+                       "trafficWriters": getattr(
+                           self, "_traffic_writers", None),
                        "rows": int(norm_meta.n_rows)},
             "lineage": lineage,
             "parent": {"modelSetSha": parent_sha,

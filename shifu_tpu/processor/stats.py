@@ -24,11 +24,15 @@ class StatsProcessor(BasicProcessor):
         correlation: bool = False,
         psi: bool = False,
         rebin: bool = False,
+        host_plan=None,
     ):
         super().__init__(root)
         self.correlation = correlation
         self.psi = psi
         self.rebin = rebin
+        # explicit HostPlan override for in-process multi-host drivers
+        # (tests/bench); production processes read the lifecycle knobs
+        self.host_plan = host_plan
 
     def _load_data(self):
         mc = self.model_config
@@ -85,10 +89,25 @@ class StatsProcessor(BasicProcessor):
                      n, target)
             return
 
+        from shifu_tpu.data.pipeline import HostPlan
         from shifu_tpu.data.stream import should_stream
 
+        hp = self.host_plan if self.host_plan is not None else HostPlan()
         ds = mc.data_set
         streaming = should_stream(self.resolve(ds.data_path))
+        if hp.active and not streaming:
+            raise ValueError(
+                "-Dshifu.lifecycle.hosts > 1 requires the streaming stats "
+                "path (dataset under the memory budget loads in one "
+                "process) — drop the hosts knob or lower "
+                "shifu.stream.memoryBudgetMb")
+        if hp.active and (self.correlation or self.psi):
+            raise ValueError(
+                "-correlation/-psi are not multi-host capable: the "
+                "correlation moments share one shift derived from the "
+                "globally first chunk, which no single host owns — run "
+                "the extra pass on one process (the stats pass itself "
+                "can stay multi-host)")
         if streaming:
             # bounded-memory path: two chunked passes, sketch-based bins
             from shifu_tpu.data.stream import chunk_source
@@ -112,7 +131,8 @@ class StatsProcessor(BasicProcessor):
 
             compute_stats_streaming(mc, self.column_configs, factory,
                                     checkpoint_root=self.root,
-                                    resume=resume_requested())
+                                    resume=resume_requested(),
+                                    host_plan=hp)
             data = None
         else:
             data = self._load_data()
@@ -198,6 +218,13 @@ class StatsProcessor(BasicProcessor):
                 compute_psi(data, self.column_configs, psi_col)
                 log.info("PSI computed against unit column %s", psi_col)
 
+        if hp.active and not hp.is_merge_host:
+            # every host computed the identical merged stats (the barrier
+            # all-gathers sketches and folds), but exactly one process
+            # writes ColumnConfig.json — artifact writes must not race
+            log.info("stats computed on host %d/%d; merge host writes "
+                     "ColumnConfig.json", hp.host_index, hp.n_hosts)
+            return
         self.save_column_configs()
         n_binned = sum(1 for c in self.column_configs if c.column_binning.length)
         log.info("stats written for %d columns.", n_binned)

@@ -328,32 +328,49 @@ class ShardedStreamCheckpoint:
     never resume from different cadence points than the shared reduce
     state they fold into.
 
-    In a real multi-host deployment each shard writes its own slot files
-    from its own host; the shared pointer is the reduce owner's. The
-    layout is identical here, so the resume contract carries over
-    unchanged. `clear` globs the whole family — including stale slot or
-    extra-shard files a previous run with a different shard count left —
-    so nothing phantom ever shows in `shifu runs --resumable`.
+    Under a multi-process HostPlan (`n_hosts` > 1) the family is
+    PER-HOST: host h's files live under `<base>-h00h-...`, carry only
+    h's own cursor slice and local fold state, and h resumes from them
+    alone — no host ever reads another host's cursors. The committed
+    stamp records the host count, and a host-count change between runs
+    rejects the family (`ckpt.rejected{reason=hosts}`) exactly like a
+    shard-count change does: the chunk -> host assignment moved, so
+    every stored cursor names a different slice. `n_hosts=1` keeps the
+    legacy un-prefixed file names byte-for-byte.
+
+    The layout is identical on a real pod, so the resume contract
+    carries over unchanged. `clear` globs the whole family — including
+    stale slot or extra-shard files a previous run with a different
+    shard count left — so nothing phantom ever shows in `shifu runs
+    --resumable` (a 1-host clear also sweeps leftover per-host families;
+    a multi-host clear touches only its OWN host's files — other hosts'
+    live families are theirs to clear).
     """
 
     _SLOTS = ("a", "b")
 
     def __init__(self, base: str, config_sha: str, n_shards: int,
                  every: Optional[int] = None,
-                 sections: Optional[Dict[str, str]] = None) -> None:
+                 sections: Optional[Dict[str, str]] = None,
+                 n_hosts: int = 1, host_index: int = 0) -> None:
         self.base = base
         self.n_shards = max(1, int(n_shards))
+        self.n_hosts = max(1, int(n_hosts))
+        self.host_index = int(host_index)
         self.config_sha = config_sha
         self.every = every_chunks_setting() if every is None else int(every)
         self._since = 0
         self._epoch = 0
+        family = (base if self.n_hosts == 1
+                  else f"{base}-h{self.host_index:03d}")
+        self._family = family
         self._shards = [
             {slot: StreamCheckpoint(
-                f"{base}-shard{s:05d}-{slot}{CKPT_SUFFIX}",
+                f"{family}-shard{s:05d}-{slot}{CKPT_SUFFIX}",
                 config_sha, every=0, sections=sections)
              for slot in self._SLOTS}
             for s in range(self.n_shards)]
-        self._shared = StreamCheckpoint(f"{base}-shared{CKPT_SUFFIX}",
+        self._shared = StreamCheckpoint(f"{family}-shared{CKPT_SUFFIX}",
                                         config_sha, every=0,
                                         sections=sections)
 
@@ -372,6 +389,9 @@ class ShardedStreamCheckpoint:
         epoch = self._epoch + 1
         slot = self._slot(epoch)
         stamp = {"epoch": epoch, "shards": self.n_shards}
+        if self.n_hosts > 1:
+            stamp["hosts"] = self.n_hosts
+            stamp["host"] = self.host_index
         for cks, (ci, arrays, meta, blob) in zip(self._shards, per_shard):
             cks[slot].save(ci, arrays=arrays,
                            meta={**(meta or {}), **stamp}, blob=blob)
@@ -422,6 +442,15 @@ class ShardedStreamCheckpoint:
                         shared[2].get("shards"), self.n_shards)
             registry().counter("ckpt.rejected", reason="shards").inc()
             return None
+        if shared[2].get("hosts", 1) != self.n_hosts:
+            # the chunk -> host assignment moved: every stored cursor
+            # names a slice this run will never be handed, so resuming
+            # would double- and drop-fold chunks at once
+            log.warning("sharded checkpoint %s was written with %s hosts "
+                        "(now %d); starting fresh", self._family,
+                        shared[2].get("hosts", 1), self.n_hosts)
+            registry().counter("ckpt.rejected", reason="hosts").inc()
+            return None
         loads = [cks[slot].load() for cks in self._shards]
         if any(ld is None for ld in loads):
             registry().counter("ckpt.rejected", reason="partial").inc()
@@ -442,14 +471,22 @@ class ShardedStreamCheckpoint:
     def clear(self) -> None:
         """Remove the WHOLE family — both slots, the pointer, and any
         stale `-shardNNNNN*` files a run with a different shard count
-        left behind (they would otherwise show as phantom resumables)."""
+        left behind (they would otherwise show as phantom resumables).
+        A 1-host clear also sweeps per-host (`-hNNN-*`) families from an
+        earlier multi-host run; a multi-host clear stays inside its own
+        host's family — the other hosts' files are live state owned by
+        running peers."""
         import glob as _glob
 
-        for path in _glob.glob(self.base + "-shard*" + CKPT_SUFFIX):
-            try:
-                os.unlink(path)
-            except OSError:  # already gone
-                pass
+        patterns = [self._family + "-shard*" + CKPT_SUFFIX]
+        if self.n_hosts == 1:
+            patterns.append(self.base + "-h*" + CKPT_SUFFIX)
+        for pattern in patterns:
+            for path in _glob.glob(pattern):
+                try:
+                    os.unlink(path)
+                except OSError:  # already gone
+                    pass
         self._shared.clear()
 
 

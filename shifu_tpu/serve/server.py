@@ -391,6 +391,18 @@ class ScoringServer:
 
         self.lease_id = (self.peers.lease.lease_id if self.peers.enabled
                          else f"{socket.gethostname()}-{os.getpid()}-solo")
+        # fleet-shared traffic log: adopt the lease id as this process's
+        # writer id so N replicas append to ONE ledger dir without ever
+        # contending for a chunk sequence number; `shifu retrain
+        # --from-traffic` reads the union across writers
+        if self.traffic is not None:
+            self.traffic.set_writer(self.lease_id)
+        if self.zoo is not None:
+            self.zoo.writer = self.lease_id
+            for name in self.zoo.tenants():
+                t = self.zoo._get(name)
+                if t.traffic is not None:
+                    t.traffic.set_writer(self.lease_id)
         self.obs_snap = MetricsSnapshotter(self.root, self.lease_id,
                                            registry_cb=obs_registry)
         self.obs_snap.start()
@@ -411,7 +423,7 @@ class ScoringServer:
     def _peer_info(self) -> dict:
         """The health summary renewed into this process's lease file —
         a peer scan is a cheap fleet-of-processes health view."""
-        return {
+        info = {
             "port": self.port,
             "status": (self.zoo.fleet_health_snapshot()["status"]
                        if self.zoo is not None
@@ -421,6 +433,11 @@ class ScoringServer:
             "queueDepth": sum(len(r.admission)
                               for r in self.registry.replicas),
         }
+        if self.traffic is not None and self.traffic.writer:
+            # which traffic-log chunks are this process's — the peer
+            # scan ties a lease to its slice of the fleet-shared log
+            info["trafficWriter"] = self.traffic.writer
+        return info
 
     # ---- continuous-loop seams ----
     def _load_configs(self):
@@ -461,7 +478,8 @@ class ScoringServer:
             # lock (it forces a d2h window flush, SH203)
             self._last_drift_verdict = self.drift.check_degrade(
                 self.scorer.health, self.root,
-                model_sha=self.registry.sha)
+                model_sha=self.registry.sha,
+                reporter=getattr(self, "lease_id", ""))
 
     def stage_candidate(self, models_dir: str,
                         set_name: Optional[str] = None) -> dict:

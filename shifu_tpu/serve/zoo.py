@@ -391,6 +391,10 @@ class ModelZoo:
         self.ledger = HbmLedger(hbm_budget_mb_setting()
                                 if budget_mb is None else budget_mb)
         self._lock = tracked_lock("serve.zoo")
+        # fleet traffic-log writer id (the server's lease id); set by the
+        # owning server once the lease exists, adopted by every tenant
+        # stream wired after that — see ServeServer._finish_init
+        self.writer = ""
         self._tenants: Dict[str, ZooTenant] = {}
         self._reg_seq = 0
         self._default_name: Optional[str] = None  # first registered
@@ -911,7 +915,7 @@ class ModelZoo:
         tenant.label_cols = label_cols
         tenant.traffic = TrafficLog(
             self.root, traffic_columns(input_columns + label_cols),
-            stream=tenant.name)
+            stream=tenant.name, writer=self.writer)
 
     def _observer(self, tenant: ZooTenant) -> Callable:
         """The per-replica post-resolution hook for ONE tenant: its own
@@ -937,7 +941,8 @@ class ModelZoo:
             if check:
                 # outside the cadence lock (forces a d2h flush, SH203)
                 tenant.last_drift_verdict = drift.check_degrade(
-                    fleet.health, self.root, model_sha=fleet.sha)
+                    fleet.health, self.root, model_sha=fleet.sha,
+                    reporter=self.writer)
 
         return observe
 

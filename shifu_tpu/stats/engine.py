@@ -404,6 +404,7 @@ def compute_stats_streaming(
     seed: int = 0,
     checkpoint_root: Optional[str] = None,
     resume: bool = False,
+    host_plan=None,
 ) -> None:
     """Bounded-memory stats: two passes over a re-iterable chunk stream.
 
@@ -447,6 +448,23 @@ def compute_stats_streaming(
     a resumed run is bit-identical to an uninterrupted one — the
     chaos-parity tests pin this under injected preemption, sharded and
     degenerate.
+
+    With a multi-process HostPlan (`host_plan`, or the
+    -Dshifu.lifecycle.hosts/-Dshifu.lifecycle.hostIndex knobs), BOTH
+    passes fold only this host's chunk-file slice (host_of(ci) = ci % H;
+    the per-device ShardPlan round-robins the host's dense local
+    ordinals underneath, so all S local shards stay busy). The per-host
+    partials meet at two filesystem barriers under the shared model-set
+    root (parallel/hostsync.py): after pass 1 every host publishes its S
+    sketch sets + row counters and every host merges ALL H*S sets in
+    sorted-host order (identical bins everywhere, no back-channel);
+    after pass 2 every host publishes its f64 fold and merges the H
+    partials the same way. The merge order is fixed, per-chunk work is
+    host-independent (sampling keys on the GLOBAL chunk index), and
+    counts are integer-exact, so the written artifacts are
+    byte-identical to the 1-process run — the CI two-process smoke pins
+    this. Checkpoints become per-host families: a preempted host resumes
+    its own cursor slice while its peers wait at the next barrier.
     """
     from shifu_tpu.config.model_config import BinningMethod
     from shifu_tpu.data.pipeline import (
@@ -481,8 +499,13 @@ def compute_stats_streaming(
     # ---- the shard plan: every fold below divides chunks over it ----
     from shifu_tpu.data.pipeline import ShardPlan
 
-    plan = ShardPlan()
+    plan = ShardPlan(host=host_plan)
     S = plan.n_shards
+    hp = plan.host
+    if hp.active and checkpoint_root is None:
+        raise ValueError(
+            "multi-host streaming stats needs the shared model-set root "
+            "(checkpoint_root) for the host part exchange")
 
     def _fresh_sketches() -> Dict[str, object]:
         out: Dict[str, object] = {}
@@ -522,11 +545,19 @@ def compute_stats_streaming(
     ck = None
     phase: Optional[str] = None
     resume_acc: Optional[tuple] = None
+    sha, sha_sections = _stats_config_sha(mc, stats_cols, seed, S)
+    if hp.active and not resume:
+        # fresh multi-host run: this host's stale barrier parts (from a
+        # crashed or earlier run) must not satisfy a peer's await
+        from shifu_tpu.parallel import hostsync
+
+        hostsync.clear_part(checkpoint_root, "stats-pass1", hp)
+        hostsync.clear_part(checkpoint_root, "stats-pass2", hp)
     if checkpoint_root is not None and ckpt_mod.ckpt_stream_enabled():
-        sha, sha_sections = _stats_config_sha(mc, stats_cols, seed, S)
         ck = ckpt_mod.ShardedStreamCheckpoint(
             ckpt_mod.ckpt_base(checkpoint_root, "stats", "stream"),
-            sha, S, sections=sha_sections)
+            sha, S, sections=sha_sections,
+            n_hosts=hp.n_hosts, host_index=hp.host_index)
         if resume:
             loaded = ck.load()
             if loaded is not None:
@@ -619,6 +650,7 @@ def compute_stats_streaming(
                                       weights if use_weights else None)
                 cursors1[s] = ci
                 plan.record(s, chunk.n_rows, "stats.pass1")
+                hp.record(chunk.n_rows, "stats.pass1")
                 if ck is not None:
                     ck.maybe_save(lambda: (
                         _shard_states(None, cursors1),
@@ -629,29 +661,55 @@ def compute_stats_streaming(
             # preemption anywhere in pass 2 never re-pays the first pass
             ck.save(_shard_states(None, [-1] * S),
                     (None, {"phase": "pass1-done"}, None))
-    n_valid_rows = int(shard_valid.sum())
-    n_pos = int(shard_pos.sum())
-    n_neg = int(shard_neg.sum())
+    if hp.active:
+        # ---- pass-1 host barrier: publish this host's S sketch sets +
+        # counters, then merge EVERY host's (all-gather: each host
+        # derives the identical merged sketches, so the finalized bins
+        # below agree everywhere with no bin back-channel) ----
+        from shifu_tpu.parallel import hostsync
+
+        hostsync.publish_part(
+            checkpoint_root, "stats-pass1", hp, sha,
+            arrays={"nValid": shard_valid, "nPos": shard_pos,
+                    "nNeg": shard_neg},
+            blob=pickle.dumps({"sketches": sketches}))
+        parts1 = hostsync.await_parts(checkpoint_root, "stats-pass1",
+                                      hp, sha)
+        sketch_sets: List[Dict[str, object]] = []
+        for arrays, _meta, blob in parts1:
+            sketch_sets.extend(pickle.loads(blob)["sketches"])
+        n_valid_rows = int(sum(a["nValid"].sum() for a, _m, _b in parts1))
+        n_pos = int(sum(a["nPos"].sum() for a, _m, _b in parts1))
+        n_neg = int(sum(a["nNeg"].sum() for a, _m, _b in parts1))
+    else:
+        sketch_sets = sketches
+        n_valid_rows = int(shard_valid.sum())
+        n_pos = int(shard_pos.sum())
+        n_neg = int(shard_neg.sum())
     reg.counter("stats.rows_valid").inc(n_valid_rows)
     reg.counter("stats.rows_pos").inc(n_pos)
     reg.counter("stats.rows_neg").inc(n_neg)
     reg.gauge("stats.columns").set(len(stats_cols))
     log.info("streaming stats pass 1 done: %d rows (%d pos / %d neg) "
-             "over %d shards", n_valid_rows, n_pos, n_neg, S)
+             "over %d shards x %d host(s)", n_valid_rows, n_pos, n_neg,
+             S, hp.n_hosts)
 
-    # ---- reduce the pass-1 map: merge per-shard sketches in shard
-    # order. With checkpointing armed, a COPY of shard 0 receives the
-    # merge — the per-shard sketches must stay pristine because pass-2
-    # snapshots keep writing them and a resume re-merges; without a
-    # checkpoint nothing ever rereads them, so shard 0 absorbs the merge
-    # in place and the pickle round-trip (multi-MB on wide sketch sets)
-    # is skipped ----
+    # ---- reduce the pass-1 map: merge per-shard sketches in sorted-
+    # host, shard-within-host order (the fixed order the byte-parity
+    # contract needs). With checkpointing armed, a COPY of the first set
+    # receives the merge — the per-shard sketches must stay pristine
+    # because pass-2 snapshots keep writing them and a resume re-merges;
+    # without a checkpoint nothing ever rereads them, so the first set
+    # absorbs the merge in place and the pickle round-trip (multi-MB on
+    # wide sketch sets) is skipped. Multi-host sets already came off the
+    # barrier as copies. ----
     merged: Dict[str, object] = (
-        pickle.loads(pickle.dumps(sketches[0])) if ck is not None
-        else sketches[0])
-    for s in range(1, S):
+        pickle.loads(pickle.dumps(sketch_sets[0]))
+        if ck is not None and not hp.active
+        else sketch_sets[0])
+    for other in sketch_sets[1:]:
         for name, sk in merged.items():
-            sk.merge(sketches[s][name])
+            sk.merge(other[name])
 
     # ---- finalize bins from the merged sketches ----
     for cc in stats_cols:
@@ -751,6 +809,7 @@ def compute_stats_streaming(
             cursors2[s] = item[5]
             shard_chunks[s] += 1
             plan.record(s, item[0], "stats.pass2")
+            hp.record(item[0], "stats.pass2")
         pending = {}
         pending_group = None
 
@@ -780,6 +839,36 @@ def compute_stats_streaming(
             acc = acc_dev.fetch()
         sp2["chunks"] = int(shard_chunks.sum())
     n_chunks = int(shard_chunks.sum())
+    if hp.active:
+        # ---- pass-2 host barrier: publish this host's f64 fold, merge
+        # every host's in sorted-host order (sum everywhere, min/max for
+        # the extrema) — the same combine the psum tree applies across
+        # shards, one level up ----
+        from shifu_tpu.parallel import hostsync
+
+        arrays = ({} if acc is None
+                  else {f"acc{k}": a for k, a in enumerate(acc)})
+        hostsync.publish_part(
+            checkpoint_root, "stats-pass2", hp, sha, arrays=arrays,
+            meta={"chunks": n_chunks})
+        parts2 = hostsync.await_parts(checkpoint_root, "stats-pass2",
+                                      hp, sha)
+        acc = None
+        for h_arrays, h_meta, _blob in parts2:
+            if "acc0" not in h_arrays:
+                continue  # that host's slice held no surviving rows
+            part = [np.asarray(h_arrays[f"acc{k}"], dtype=np.float64)
+                    for k in range(10)]
+            if acc is None:
+                acc = part
+            else:
+                acc = [
+                    np.minimum(a, p) if k == 6 else  # vmin
+                    np.maximum(a, p) if k == 7 else  # vmax
+                    a + p
+                    for k, (a, p) in enumerate(zip(acc, part))
+                ]
+        n_chunks = int(sum(m.get("chunks", 0) for _a, m, _b in parts2))
     reg.counter("stats.chunks").inc(n_chunks)
     log.info("streaming stats pipeline: %s", timers.summary())
     if ck is not None:

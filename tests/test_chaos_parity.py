@@ -504,3 +504,54 @@ def test_sigterm_mid_train_manifest_and_pinned_resume(tmp_path):
     a, _ = flatten_params(killed.params)
     b, _ = flatten_params(ref.params)
     np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# pod-scale data plane: kill one host, resume, byte-identical artifacts
+# ---------------------------------------------------------------------------
+
+
+def test_multi_host_kill_one_host_resume_byte_identical(tmp_path):
+    """ISSUE-18 chaos acceptance: one host of a 2-process streamed-stats
+    fleet is preempted mid-pass-1 (before its merge barrier, so no peer
+    is left hanging), then the WHOLE fleet runs with `resume` — the dead
+    host picks up its own per-host cursor slice — and the merged
+    ColumnConfig is byte-identical to the uninterrupted 1-process run."""
+    from shifu_tpu.data.pipeline import HostPlan
+    from shifu_tpu.stats.engine import compute_stats_streaming
+    from tests.test_sharded_lifecycle import (
+        _integral_stats_setup,
+        _run_hosts,
+    )
+
+    mc, fresh_cols, factory, K = _integral_stats_setup(tmp_path)
+    clean = fresh_cols()
+    compute_stats_streaming(mc, clean, factory)
+    ref = _cols_json(clean)
+
+    root = str(tmp_path / "fleet")
+    # host 1 runs ALONE and dies on its 3rd owned chunk — mid-pass-1,
+    # strictly before publishing its part (it owns ceil(K/2) > 3 chunks)
+    assert -(-K // 2) > 3
+    with _StreamEnv(**{"shifu.ckpt.everyChunks": "1",
+                       "shifu.lifecycle.hostWaitMs": "60000"}):
+        with faults.activate(FaultPlan.parse("preempt@chunk=3")):
+            with pytest.raises(PreemptionError):
+                compute_stats_streaming(
+                    mc, fresh_cols(), factory, checkpoint_root=root,
+                    host_plan=HostPlan(n_hosts=2, host_index=1))
+        # the kill left host 1's OWN per-host family, resumable
+        names = {e["name"] for e in ckpt_mod.list_resumable(root)}
+        assert "stats-stream-h001-shared" in names, sorted(names)
+        assert not any(n.startswith("stats-stream-h000") for n in names)
+
+        # full fleet, concurrent, resume=True: host 1 resumes its cursor
+        # slice, host 0 (no family) starts fresh
+        cols = {h: fresh_cols() for h in (0, 1)}
+        _run_hosts(lambda h: compute_stats_streaming(
+            mc, cols[h], factory, checkpoint_root=root, resume=True,
+            host_plan=HostPlan(n_hosts=2, host_index=h)))
+
+    assert _cols_json(cols[0]) == _cols_json(cols[1]) == ref
+    # completed hosts cleared their checkpoint families
+    assert ckpt_mod.list_resumable(root) == []

@@ -279,6 +279,94 @@ class TestTrafficLog:
             traffic_source(str(tmp_path))
 
 
+class TestFleetTrafficLog:
+    """ISSUE-18 fleet sharing: N serve processes append to ONE log under
+    their (sanitized) lease ids; consumers read the union."""
+
+    NAMES = ["a", "b"]
+
+    def _log(self, root, writer, n=4):
+        from shifu_tpu.loop.traffic import TrafficLog, traffic_columns
+
+        log = TrafficLog(str(root), traffic_columns(self.NAMES),
+                         sample=1.0, chunk_rows=4, writer=writer)
+        log.record(_fake_data(self.NAMES, n), _FakeResult(n), "sha0")
+        log.close()
+        return log
+
+    def test_writer_id_sanitizes_and_never_parses_as_seq(self):
+        from shifu_tpu.loop.traffic import _CHUNK_RE, writer_id
+
+        # lease ids are host-pid-token (resilience/lease.py)
+        wid = writer_id("box.example-4242-deadbeef")
+        assert wid == "box_example_4242_deadbeef"
+        for raw in ("12345", "", "007-x"):
+            wid = writer_id(raw)
+            m = _CHUNK_RE.match(f"traffic-{wid}-00001.psv")
+            assert m and m.group(1) == wid, (raw, wid)
+
+    def test_union_in_seq_then_writer_order_and_scope_filter(
+            self, tmp_path):
+        from shifu_tpu.loop.traffic import (
+            chunk_writer,
+            list_chunks,
+            list_writers,
+        )
+
+        self._log(tmp_path, "hostB_1_aa")
+        self._log(tmp_path, "hostA_2_bb")
+        self._log(tmp_path, "hostA_2_bb", n=4)  # second chunk, seq 2
+        union = [os.path.basename(p) for p in list_chunks(str(tmp_path))]
+        assert union == ["traffic-hostA_2_bb-00001.psv",
+                         "traffic-hostB_1_aa-00001.psv",
+                         "traffic-hostA_2_bb-00002.psv"]
+        assert list_writers(str(tmp_path)) == ["hostA_2_bb",
+                                               "hostB_1_aa"]
+        only_a = list_chunks(str(tmp_path), scope="hostA_2_bb")
+        assert [chunk_writer(p) for p in only_a] == ["hostA_2_bb"] * 2
+
+    def test_per_writer_sequences_are_independent(self, tmp_path):
+        """Two processes appending concurrently never race on a shared
+        sequence: each writer numbers its OWN chunks, and a restart
+        resumes after its own highest seq, ignoring the peer's."""
+        self._log(tmp_path, "w1")
+        self._log(tmp_path, "w2")
+        self._log(tmp_path, "w1")  # restart of writer 1
+        names = sorted(os.path.basename(p) for p in glob.glob(
+            str(tmp_path / ".shifu/runs/traffic/traffic-*.psv")))
+        assert names == ["traffic-w1-00001.psv", "traffic-w1-00002.psv",
+                         "traffic-w2-00001.psv"]
+
+    def test_set_writer_rebases_sequence_post_lease(self, tmp_path):
+        """The server names its writer only after the lease grant;
+        set_writer on a live log must re-derive the next seq from the
+        new writer's own chunks."""
+        from shifu_tpu.loop.traffic import TrafficLog, traffic_columns
+
+        self._log(tmp_path, "lease1")  # pre-existing chunk of lease1
+        log = TrafficLog(str(tmp_path), traffic_columns(self.NAMES),
+                         sample=1.0, chunk_rows=4)
+        log.set_writer("lease1")
+        log.record(_fake_data(self.NAMES, 4), _FakeResult(4), "s")
+        log.close()
+        assert log.snapshot()["writer"] == "lease1"
+        names = sorted(os.path.basename(p) for p in glob.glob(
+            str(tmp_path / ".shifu/runs/traffic/traffic-*.psv")))
+        assert names == ["traffic-lease1-00001.psv",
+                         "traffic-lease1-00002.psv"]
+
+    def test_readback_unions_all_writers(self, tmp_path):
+        from shifu_tpu.loop.traffic import traffic_source
+
+        self._log(tmp_path, "w1")
+        self._log(tmp_path, "w2")
+        factory, _names = traffic_source(str(tmp_path))
+        rows = sum(c.n_rows for c in factory())
+        assert rows == 8
+        solo, _ = traffic_source(str(tmp_path), scope="w2")
+        assert sum(c.n_rows for c in solo()) == 4
+
+
 # ---------------------------------------------------------------------------
 # drift monitor
 # ---------------------------------------------------------------------------
@@ -1179,17 +1267,24 @@ class TestRetrain:
 
         root = _prep_trained(str(tmp_path / "ms"), n_rows=260, epochs=6)
         names, rows, _ = make_binary_dataset(n_rows=120, seed=13)
+        writers = set()
         with _Props(shifu_loop_logSample="1.0",
                     shifu_loop_logChunkRows="64"):
-            server = ScoringServer(root=root, port=0)
-            server.start()
-            try:
-                for start in range(0, 120, 30):
-                    recs = [dict(zip(names, r))
-                            for r in rows[start:start + 30]]
-                    server.scorer.score_batch(recs)
-            finally:
-                manifest = server.shutdown()
+            # TWO serve processes in sequence (fresh lease each): the
+            # fleet-shared log keeps one chunk family per writer and the
+            # retrain below consumes the union
+            for start_at in (0, 60):
+                server = ScoringServer(root=root, port=0)
+                server.start()
+                try:
+                    writers.add(server.traffic.writer)
+                    for start in range(start_at, start_at + 60, 30):
+                        recs = [dict(zip(names, r))
+                                for r in rows[start:start + 30]]
+                        server.scorer.score_batch(recs)
+                finally:
+                    manifest = server.shutdown()
+        assert len(writers) == 2 and all(writers)
         m = json.load(open(manifest))
         assert m["traffic"]["chunks"] >= 1
         assert RetrainProcessor(root, from_traffic=True).run() == 0
@@ -1201,6 +1296,8 @@ class TestRetrain:
         assert src["kind"] == "traffic"
         assert src["trafficChunks"]
         assert src["rows"] > 0
+        # the lineage manifest records the whole fleet's writers
+        assert sorted(src["trafficWriters"]) == sorted(writers)
         assert os.path.isfile(os.path.join(root, "models.candidate",
                                            "model0.nn"))
 

@@ -136,6 +136,22 @@ class TestShardPlan:
             assert lifecycle_shards() == 3
             assert ShardPlan().n_shards == 3
 
+    def test_slices_enumerates_once_and_matches_shard_slice(self):
+        """slices() hands every shard its index view from ONE enumeration
+        of the chunk list — same pairs shard_slice yields, without S
+        re-enumerations (and it works on a one-shot generator, which a
+        re-enumerating implementation would exhaust)."""
+        from shifu_tpu.data.pipeline import ShardPlan
+
+        plan = ShardPlan(n_shards=3)
+        items = list("abcdefgh")
+        views = plan.slices(iter(items))  # one-shot: consumed exactly once
+        assert len(views) == 3
+        for s in range(3):
+            assert views[s] == list(
+                plan.shard_slice(enumerate(items), s))
+        assert sorted(ci for v in views for ci, _ in v) == list(range(8))
+
 
 class TestShardedAccumulator:
     def _group(self, rng, S, n, total_slots, Cn, present):
@@ -288,6 +304,115 @@ class TestDcnWindowReduce:
         for g, r in zip(got, ref):
             np.testing.assert_array_equal(g, r)  # integral data: exact
 
+    def _window(self, mesh, values):
+        """One folded window over the forced mesh, reduced and pulled."""
+        import jax
+
+        from shifu_tpu.ops import binagg
+        from shifu_tpu.parallel.mesh import row_shard_count
+
+        S = row_shard_count(mesh)
+        n, Cn, slots = 32, 2, 5
+        rng = np.random.default_rng(7)
+        codes = rng.integers(0, 2, size=(S, n, 2)).astype(np.int32)
+        offsets = np.array([0, 3], np.int32)
+        tags = rng.integers(0, 2, size=(S, n)).astype(np.int32)
+        weights = np.ones((S, n), np.float32)
+        win = binagg.window_init(mesh, slots, Cn)
+        win = binagg.sharded_window_fold(mesh, slots)(
+            win, codes, offsets, tags, weights, values(rng, S, n, Cn))
+        return [np.asarray(x[0], np.float64) for x in
+                jax.device_get(binagg.window_reduce(mesh)(win))]
+
+    def test_hierarchical_reduce_bit_parity_with_flat(self):
+        """The explicit two-stage (ICI psum, then one dcn hop) lowering
+        is BIT-identical to the flat one-stage psum on the forced (2,4)
+        mesh — integral data makes every plane exact."""
+        from shifu_tpu.parallel.mesh import (
+            data_mesh,
+            hierarchical_reduce,
+        )
+
+        mesh = data_mesh(dcn_slices=2)
+        assert hierarchical_reduce(mesh)  # auto: dcn axis -> staged
+
+        def values(rng, S, n, Cn):
+            return rng.integers(-4, 5, size=(S, n, Cn)).astype(np.float32)
+
+        staged = self._window(mesh, values)
+        with _Props(**{"shifu.reduce.topology": "flat"}):
+            assert not hierarchical_reduce(mesh)
+            flat = self._window(mesh, values)
+        for k, (s, f) in enumerate(zip(staged, flat)):
+            np.testing.assert_array_equal(s, f), k
+
+    def test_hierarchical_float_planes_tolerance_equal(self):
+        """On real float values the count planes (unit weights) stay
+        bit-equal and min/max are exact; the value-sum planes are
+        tolerance-equal — float sums may associate differently across
+        the two-stage tree."""
+        from shifu_tpu.parallel.mesh import data_mesh
+
+        mesh = data_mesh(dcn_slices=2)
+
+        def values(rng, S, n, Cn):
+            return rng.normal(size=(S, n, Cn)).astype(np.float32)
+
+        staged = self._window(mesh, values)
+        with _Props(**{"shifu.reduce.topology": "flat"}):
+            flat = self._window(mesh, values)
+        # planes: 0 pos,1 neg,2 wpos,3 wneg,4 vsum,5 vsumsq,6 vmin,
+        # 7 vmax,8 vcount,9 vmissing
+        for k in (0, 1, 2, 3, 6, 7, 8, 9):
+            np.testing.assert_array_equal(staged[k], flat[k]), k
+        for k in (4, 5):
+            np.testing.assert_allclose(staged[k], flat[k], rtol=1e-6)
+
+    def test_dcn_hop_counter_and_single_sync_per_window(self):
+        """A hierarchically reduced window still costs exactly ONE d2h
+        sync and one psum window, and records its single cross-dcn hop."""
+        from shifu_tpu import obs
+        from shifu_tpu.data.pipeline import DeviceAccumulator
+        from shifu_tpu.parallel.mesh import data_mesh
+
+        obs.reset()
+        S, n, slots, Cn = 8, 64, 5, 2
+        offsets = np.array([0, 3], np.int32)
+        rng = np.random.default_rng(4)
+        acc = DeviceAccumulator(n_shards=S)
+        acc._mesh = data_mesh(dcn_slices=2)  # force the (2,4) topology
+        codes = rng.integers(0, 2, size=(S, n, 2)).astype(np.int32)
+        tags = rng.integers(0, 2, size=(S, n)).astype(np.int32)
+        weights = np.ones((S, n), np.float32)
+        values = rng.integers(-5, 6, size=(S, n, Cn)).astype(np.float32)
+        acc.fold_group(codes, offsets, slots, tags, weights, values,
+                       [n] * S)
+        acc.fetch()
+        reg = obs.registry()
+        assert reg.counter("reduce.psum_windows").value == 1
+        assert reg.counter("device.d2h_syncs").value == 1
+        assert reg.counter("reduce.dcn_hops").value == 1
+
+    def test_flat_single_slice_mesh_records_no_dcn_hop(self):
+        from shifu_tpu import obs
+        from shifu_tpu.data.pipeline import DeviceAccumulator
+        from shifu_tpu.parallel.mesh import hierarchical_reduce
+
+        obs.reset()
+        acc = DeviceAccumulator(n_shards=8)
+        assert not hierarchical_reduce(acc.mesh)  # 1-slice degenerate
+        rng = np.random.default_rng(5)
+        S, n, Cn = 8, 32, 2
+        acc.fold_group(
+            rng.integers(0, 2, size=(S, n, 2)).astype(np.int32),
+            np.array([0, 3], np.int32), 5,
+            rng.integers(0, 2, size=(S, n)).astype(np.int32),
+            np.ones((S, n), np.float32),
+            rng.integers(-4, 5, size=(S, n, Cn)).astype(np.float32),
+            [n] * S)
+        acc.fetch()
+        assert obs.registry().counter("reduce.dcn_hops").value == 0
+
 
 class TestShardedStatsParity:
     def test_work_division_counters(self, tmp_path):
@@ -416,6 +541,322 @@ def glob_one(root, pattern):
     hits = glob.glob(os.path.join(root, "**", pattern), recursive=True)
     assert hits, (root, pattern)
     return hits[0]
+
+
+# ---------------------------------------------------------------------------
+# pod-scale data plane (ISSUE 18): per-host affinity + hierarchical reduce
+# ---------------------------------------------------------------------------
+
+
+class _Props:
+    """Pin environment properties for one block, cleared on exit."""
+
+    def __init__(self, **props):
+        self.props = props
+
+    def __enter__(self):
+        for k, v in self.props.items():
+            environment.set_property(k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k in self.props:
+            environment.set_property(k, "")
+
+
+def _run_hosts(fn, n_hosts=2, timeout=300):
+    """Run fn(host_index) once per host on CONCURRENT threads — the
+    hostsync merge barrier deadlocks any sequential schedule — and
+    re-raise the first failure."""
+    import threading
+
+    errs = {}
+
+    def run(h):
+        try:
+            fn(h)
+        except Exception as e:  # re-raised below with the host attached
+            errs[h] = e
+
+    ts = [threading.Thread(target=run, args=(h,), daemon=True)
+          for h in range(n_hosts)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in ts), "host thread hung"
+    if errs:
+        h = min(errs)
+        raise AssertionError(f"host {h} failed: {errs[h]!r}") from errs[h]
+
+
+class TestHostPlan:
+    def test_affinity_division_and_local_ordinals(self):
+        from shifu_tpu.data.pipeline import HostPlan
+
+        hp = HostPlan(n_hosts=3, host_index=1)
+        K = 17
+        owned = [ci for ci in range(K) if hp.owns(ci)]
+        assert owned == [ci for ci in range(K) if hp.host_of(ci) == 1]
+        assert len(owned) <= -(-K // 3)  # ceil(K/H)
+        # every host's slice is disjoint and the union is everything
+        all_owned = [ci for h in range(3)
+                     for ci in range(K)
+                     if HostPlan(n_hosts=3, host_index=h).owns(ci)]
+        assert sorted(all_owned) == list(range(K))
+        # local ordinals are dense 0..len(owned)-1 within the slice
+        assert [hp.local_index(ci) for ci in owned] == \
+            list(range(len(owned)))
+        assert hp.active and not hp.is_merge_host
+        assert HostPlan(n_hosts=3, host_index=0).is_merge_host
+
+    def test_degenerate_single_host_owns_everything(self):
+        from shifu_tpu.data.pipeline import HostPlan
+
+        hp = HostPlan()  # knobs unset -> 1 host
+        assert hp.n_hosts == 1 and hp.host_index == 0
+        assert not hp.active
+        assert all(hp.owns(ci) and hp.local_index(ci) == ci
+                   for ci in range(9))
+
+    def test_out_of_range_index_raises(self):
+        from shifu_tpu.data.pipeline import HostPlan
+
+        with pytest.raises(ValueError):
+            HostPlan(n_hosts=2, host_index=2)
+
+    def test_knobs_feed_the_default_plan(self):
+        from shifu_tpu.data.pipeline import HostPlan
+
+        with _Props(**{"shifu.lifecycle.hosts": "4",
+                       "shifu.lifecycle.hostIndex": "2"}):
+            hp = HostPlan()
+            assert (hp.n_hosts, hp.host_index) == (4, 2)
+
+    def test_shard_plan_composes_on_local_ordinals(self):
+        """Under a 2-host plan every LOCAL shard still folds ~1/S of the
+        host's slice (the round-robin runs on dense local ordinals, not
+        the gappy global indices)."""
+        from shifu_tpu.data.pipeline import HostPlan, ShardPlan
+
+        K, H, S = 24, 2, 4
+        for h in range(H):
+            plan = ShardPlan(n_shards=S,
+                             host=HostPlan(n_hosts=H, host_index=h))
+            views = plan.slices(range(K))
+            owned = [ci for v in views for ci, _ in v]
+            assert all(ci % H == h for ci in owned)
+            per_shard = [len(v) for v in views]
+            assert sum(per_shard) == K // H
+            assert max(per_shard) <= -(-(K // H) // S)
+
+
+class TestHostSyncBarrier:
+    def test_publish_await_merges_in_sorted_host_order(self, tmp_path):
+        import pickle
+
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.parallel import hostsync
+
+        root = str(tmp_path)
+        sha = "cafe" * 10
+        for h in (1, 0):  # publish out of order on purpose
+            hostsync.publish_part(
+                root, "stats-pass1", HostPlan(n_hosts=2, host_index=h),
+                sha, arrays={"acc": np.full(3, h, np.float64)},
+                meta={"nRows": 10 + h},
+                blob=pickle.dumps({"host": h}))
+        parts = hostsync.await_parts(
+            root, "stats-pass1", HostPlan(n_hosts=2, host_index=0), sha,
+            timeout_ms=5000)
+        assert [p[1]["nRows"] for p in parts] == [10, 11]
+        assert [int(p[0]["acc"][0]) for p in parts] == [0, 1]
+        assert [pickle.loads(p[2])["host"] for p in parts] == [0, 1]
+
+    def test_await_ignores_foreign_sha_and_times_out_loudly(
+            self, tmp_path):
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.parallel import hostsync
+
+        root = str(tmp_path)
+        hostsync.publish_part(
+            root, "norm", HostPlan(n_hosts=2, host_index=1),
+            "old-config-sha", arrays={"x": np.zeros(1)})
+        with pytest.raises(TimeoutError) as ei:
+            hostsync.await_parts(
+                root, "norm", HostPlan(n_hosts=2, host_index=0),
+                "new-config-sha", timeout_ms=200, poll_s=0.01)
+        assert "[0, 1]" in str(ei.value)
+
+    def test_clear_part_removes_only_own(self, tmp_path):
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.parallel import hostsync
+
+        root = str(tmp_path)
+        for h in (0, 1):
+            hostsync.publish_part(
+                root, "s", HostPlan(n_hosts=2, host_index=h), "sha",
+                arrays={"x": np.zeros(1)})
+        hostsync.clear_part(root, "s", HostPlan(n_hosts=2, host_index=0))
+        assert not os.path.exists(hostsync.part_path(root, "s", 0))
+        assert os.path.exists(hostsync.part_path(root, "s", 1))
+
+
+class TestHostCheckpointFamilies:
+    def _family(self, base, **kw):
+        from shifu_tpu.resilience.checkpoint import ShardedStreamCheckpoint
+
+        return ShardedStreamCheckpoint(base, "sha" * 12, n_shards=2,
+                                       every=1, **kw)
+
+    def test_host_count_change_rejects_family(self, tmp_path):
+        from shifu_tpu import obs
+
+        base = str(tmp_path / "stream")
+        ck = self._family(base, n_hosts=2, host_index=0)
+        per_shard = [(s, {"c": np.arange(3)}, None, None)
+                     for s in range(2)]
+        ck.save(per_shard, (None, None, None))
+        # same geometry resumes
+        assert self._family(base, n_hosts=2, host_index=0).load() \
+            is not None
+        # host-count change: same family file name (host 0 of 3), but
+        # the chunk->host assignment moved — whole family rejected
+        obs.reset()
+        assert self._family(base, n_hosts=3, host_index=0).load() is None
+        reg = obs.registry()
+        assert reg.counter("ckpt.rejected", reason="hosts").value == 1
+
+    def test_per_host_families_are_disjoint_and_legacy_named_at_h1(
+            self, tmp_path):
+        import glob
+
+        base = str(tmp_path / "stream")
+        for h in (0, 1):
+            ck = self._family(base, n_hosts=2, host_index=h)
+            ck.save([(s, {"c": np.arange(2)}, None, None)
+                     for s in range(2)], (None, None, None))
+        h0 = sorted(glob.glob(base + "-h000-*"))
+        h1 = sorted(glob.glob(base + "-h001-*"))
+        assert h0 and h1 and not set(h0) & set(h1)
+        # each host resumes its OWN cursors only
+        for h in (0, 1):
+            got = self._family(base, n_hosts=2, host_index=h).load()
+            assert got is not None
+        # the 1-host family keeps the legacy un-prefixed names
+        ck1 = self._family(str(tmp_path / "solo"))
+        ck1.save([(s, {"c": np.arange(2)}, None, None)
+                  for s in range(2)], (None, None, None))
+        assert glob.glob(str(tmp_path / "solo-shard*"))
+        assert not glob.glob(str(tmp_path / "solo-h0*"))
+
+
+class TestMultiHostParity:
+    """The tentpole acceptance: N concurrent host processes (threads
+    with explicit HostPlans here — knobs are process-global) produce
+    BYTE-identical artifacts to the 1-process run."""
+
+    def test_stats_byte_identical_and_disjoint_host_counters(
+            self, tmp_path):
+        from shifu_tpu import obs
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.stats.engine import compute_stats_streaming
+
+        mc, fresh_cols, factory, K = _integral_stats_setup(tmp_path)
+        single = fresh_cols()
+        compute_stats_streaming(mc, single, factory)
+        ref = _cols_json(single)
+
+        root = str(tmp_path / "fleet")
+        cols = {h: fresh_cols() for h in range(2)}
+        obs.reset()
+        with _Props(**{"shifu.lifecycle.hostWaitMs": "60000"}):
+            _run_hosts(lambda h: compute_stats_streaming(
+                mc, cols[h], factory, checkpoint_root=root,
+                host_plan=HostPlan(n_hosts=2, host_index=h)))
+        # every host merges the same sorted-host parts -> same bytes
+        assert _cols_json(cols[0]) == _cols_json(cols[1]) == ref
+        # affinity division: disjoint host counters summing to K
+        reg = obs.registry()
+        for stage in ("stats.pass1", "stats.pass2"):
+            per_host = [reg.counter("host.chunks", host=str(h),
+                                    stage=stage).value for h in range(2)]
+            assert sum(per_host) == K, (stage, per_host)
+            assert max(per_host) <= -(-K // 2) + 1, (stage, per_host)
+
+    def test_norm_artifacts_byte_identical_across_hosts(self, tmp_path):
+        import filecmp
+        import glob
+
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.processor.init import InitProcessor
+        from shifu_tpu.processor.norm import NormProcessor
+        from shifu_tpu.processor.stats import StatsProcessor
+
+        roots = {}
+        for tag in ("one", "two"):
+            root = str(tmp_path / tag)
+            make_model_set(root, n_rows=300, seed=11)
+            assert InitProcessor(root).run() == 0
+            assert StatsProcessor(root).run() == 0
+            roots[tag] = root
+        with _Props(**{"shifu.ingest.forceStreaming": "true",
+                       "shifu.ingest.chunkRows": "48",
+                       "shifu.lifecycle.hostWaitMs": "60000"}):
+            assert NormProcessor(roots["one"]).run() == 0
+
+            def norm_host(h):
+                assert NormProcessor(
+                    roots["two"],
+                    host_plan=HostPlan(n_hosts=2, host_index=h)
+                ).run() == 0, h
+
+            _run_hosts(norm_host)
+        for d in ("NormalizedData", "CleanedData"):
+            a = sorted(glob.glob(os.path.join(roots["one"], "**", d, "*"),
+                                 recursive=True))
+            b = sorted(glob.glob(os.path.join(roots["two"], "**", d, "*"),
+                                 recursive=True))
+            assert a and [os.path.relpath(p, roots["one"]) for p in a] \
+                == [os.path.relpath(p, roots["two"]) for p in b]
+            for fa, fb in zip(a, b):
+                assert filecmp.cmp(fa, fb, shallow=False), (fa, fb)
+
+    def test_autotype_identical_across_hosts(self, tmp_path):
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.processor.init import InitProcessor
+
+        res = {}
+        for tag in ("one", "two"):
+            root = str(tmp_path / tag)
+            make_model_set(root, n_rows=400, seed=5)
+            if tag == "one":
+                assert InitProcessor(root).run() == 0
+            else:
+                def init_host(h, root=root):
+                    assert InitProcessor(
+                        root, host_plan=HostPlan(n_hosts=2, host_index=h)
+                    ).run() == 0, h
+
+                with _Props(**{"shifu.lifecycle.hostWaitMs": "60000"}):
+                    _run_hosts(init_host)
+            res[tag] = (open(glob_one(root, "count_info.json")).read(),
+                        open(os.path.join(
+                            root, "ColumnConfig.json")).read())
+        assert res["one"] == res["two"]
+
+    def test_multi_host_rejects_paths_that_cannot_merge(self, tmp_path):
+        """Corr/PSI stats and the in-memory norm path have no per-host
+        merge; a multi-host plan must fail loudly, not fork artifacts."""
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.stats.engine import compute_stats_streaming
+
+        mc, fresh_cols, factory, _K = _integral_stats_setup(
+            tmp_path, n=120, chunk_rows=48)
+        with pytest.raises(ValueError, match="checkpoint_root"):
+            compute_stats_streaming(
+                mc, fresh_cols(), factory,
+                host_plan=HostPlan(n_hosts=2, host_index=0))
 
 
 class TestShardedCheckpointFamily:
