@@ -60,12 +60,25 @@ class Finding:
     col: int
     message: str
     suppressed: bool = False
+    baselined: bool = False
 
     def sort_key(self):
         return (self.path, self.line, self.col, self.rule)
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+    def baseline_key(self) -> str:
+        """Content-addressed identity for `--baseline` matching: rule +
+        path + message with every number normalized away, so a finding
+        keeps its key while unrelated edits move it around the file.
+        Line/col are deliberately excluded."""
+        import hashlib
+
+        norm = re.sub(r"\d+", "#", self.message)
+        return hashlib.sha256(
+            f"{self.rule}\x00{self.path}\x00{norm}".encode("utf-8")
+        ).hexdigest()[:16]
 
 
 # ---------------------------------------------------------------------------
@@ -450,6 +463,7 @@ def all_rules() -> Dict[str, Rule]:
         concurrency,
         hygiene,
         jaxrules,
+        spmd,
     )
 
     return dict(_REGISTRY)
@@ -528,10 +542,12 @@ def analyze(paths: Sequence[str],
 
 
 def counts(findings: Sequence[Finding]) -> Dict[str, int]:
-    out = {"error": 0, "warning": 0, "suppressed": 0}
+    out = {"error": 0, "warning": 0, "suppressed": 0, "baselined": 0}
     for f in findings:
         if f.suppressed:
             out["suppressed"] += 1
+        elif f.baselined:
+            out["baselined"] += 1
         else:
             out[f.severity] = out.get(f.severity, 0) + 1
     return out
@@ -540,15 +556,58 @@ def counts(findings: Sequence[Finding]) -> Dict[str, int]:
 def report_human(findings: Sequence[Finding]) -> str:
     lines = []
     for f in findings:
-        if f.suppressed:
+        if f.suppressed or f.baselined:
             continue
         lines.append(f"{f.path}:{f.line}:{f.col}: "
                      f"{f.rule} {f.severity}: {f.message}")
     c = counts(findings)
     lines.append(
         f"shifu check: {c['error']} error(s), {c['warning']} warning(s), "
-        f"{c['suppressed']} suppressed")
+        f"{c['suppressed']} suppressed, {c['baselined']} baselined")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# findings baseline: land a new rule family at `error` severity while the
+# pre-existing findings burn down incrementally. Baselined findings are
+# counted, reported, and excluded from the exit gate — the exact noqa
+# contract, but owned by a reviewed file instead of inline pragmas.
+# ---------------------------------------------------------------------------
+
+BASELINE_SCHEMA = "shifu.baseline/1"
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> int:
+    """Write the sorted, content-addressed baseline of every unsuppressed
+    finding; returns how many entries it recorded."""
+    entries = sorted(
+        {f.baseline_key(): {"key": f.baseline_key(), "rule": f.rule,
+                            "path": f.path}
+         for f in findings if not f.suppressed}.values(),
+        key=lambda e: (e["rule"], e["path"], e["key"]))
+    doc = {"schema": BASELINE_SCHEMA, "findings": entries}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return len(entries)
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(
+            f"{path}: not a {BASELINE_SCHEMA} document "
+            f"(schema={doc.get('schema')!r})")
+    return {e["key"] for e in doc.get("findings", [])}
+
+
+def apply_baseline(findings: Sequence[Finding], keys: Set[str]) -> None:
+    """Mark known findings baselined (counted-not-dropped, like noqa).
+    Suppressed findings stay suppressed — noqa wins the accounting."""
+    for f in findings:
+        if not f.suppressed and f.baseline_key() in keys:
+            f.baselined = True
 
 
 def report_json(findings: Sequence[Finding],
@@ -567,15 +626,92 @@ def report_json(findings: Sequence[Finding],
     return json.dumps(doc, indent=2, sort_keys=True)
 
 
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA_URI = ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def report_sarif(findings: Sequence[Finding],
+                 rule_ids: Optional[Iterable[str]] = None) -> str:
+    """Minimal SARIF 2.1.0 log (stdlib-only): one run, the selected rule
+    catalog under tool.driver.rules, one result per unsuppressed and
+    unbaselined finding. Suppressed/baselined findings are carried as
+    results with a `suppressions` entry so viewers show them greyed-out
+    rather than losing them (counted-not-dropped, same as the human and
+    JSON reports)."""
+    rules = all_rules()
+    selected = sorted(rid for rid in rules
+                      if rule_ids is None or rid in set(rule_ids))
+    index = {rid: i for i, rid in enumerate(selected)}
+    results = []
+    for f in sorted(findings, key=Finding.sort_key):
+        result = {
+            "ruleId": f.rule,
+            "ruleIndex": index.get(f.rule, -1),
+            "level": f.severity,
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path.replace(os.sep, "/")},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+            }],
+        }
+        if f.suppressed:
+            result["suppressions"] = [{"kind": "inSource"}]
+        elif f.baselined:
+            result["suppressions"] = [{"kind": "external"}]
+        results.append(result)
+    doc = {
+        "$schema": SARIF_SCHEMA_URI,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "shifu check",
+                "informationUri":
+                    "https://github.com/shifu-tpu/shifu-tpu",
+                "rules": [{
+                    "id": rid,
+                    "shortDescription": {"text": rules[rid].summary},
+                    "defaultConfiguration":
+                        {"level": rules[rid].severity},
+                } for rid in selected],
+            }},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
 def run_check(paths: Sequence[str], rule_ids: Optional[List[str]] = None,
-              as_json: bool = False, emit=print) -> int:
-    """CLI entry: analyze, report, exit 1 on unsuppressed errors."""
+              as_json: bool = False, emit=print, fmt: Optional[str] = None,
+              baseline: Optional[str] = None,
+              write_baseline_to: Optional[str] = None) -> int:
+    """CLI entry: analyze, report, exit 1 on unsuppressed (and
+    unbaselined) errors. `fmt` is "human"/"json"/"sarif" (`as_json` is
+    the pre-SARIF spelling of fmt="json" and loses to an explicit fmt);
+    `baseline` marks known findings; `write_baseline_to` records the
+    current findings and exits clean (the baseline IS the verdict)."""
     if rule_ids is not None:  # normalize ONCE so the JSON rules catalog
         # and the analyze() selection agree on e.g. "JX001, SH101"
         rule_ids = [r.strip() for r in rule_ids if r.strip()]
+    if fmt is None:
+        fmt = "json" if as_json else "human"
+    if fmt not in ("human", "json", "sarif"):
+        raise ValueError(f"unknown report format {fmt!r}")
     findings = analyze(paths, rule_ids)
-    if as_json:
+    if write_baseline_to is not None:
+        n = write_baseline(findings, write_baseline_to)
+        emit(f"shifu check: wrote {n} baseline entr"
+             f"{'y' if n == 1 else 'ies'} to {write_baseline_to}")
+        return 0
+    if baseline is not None:
+        apply_baseline(findings, load_baseline(baseline))
+    if fmt == "json":
         emit(report_json(findings, rule_ids))
+    elif fmt == "sarif":
+        emit(report_sarif(findings, rule_ids))
     else:
         emit(report_human(findings))
     return 1 if counts(findings)["error"] else 0

@@ -131,13 +131,16 @@ KNOBS: List[Knob] = [
        "time-series)"),
     # ---- sanitizers (PR 4, this PR) ----
     _K("shifu.sanitize", "str", "",
-       "comma list of armed sanitizer modes: transfer,nan,recompile,race"
-       " (or `all`)"),
+       "comma list of armed sanitizer modes: "
+       "transfer,nan,recompile,race,divergence (or `all`)"),
     _K("shifu.sanitize.recompileBudget", "int", "64",
        "compiles per armed stage before a recompile breach is recorded"),
     _K("shifu.sanitize.race.holdMs", "float", "250",
        "race mode: lock-hold ms above which a long-hold event is "
        "recorded (0 disables)"),
+    _K("shifu.sanitize.divergence.maxFolds", "int", "512",
+       "divergence mode: cap on per-window fold digests kept in the "
+       "verdict (folds past the cap still count, digests are dropped)"),
     # ---- resilience (PR 7) ----
     _K("shifu.faults", "str", "",
        "deterministic fault-injection spec (resilience/faults.py grammar)"),

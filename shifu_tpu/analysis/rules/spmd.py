@@ -1,0 +1,722 @@
+"""JX3xx/SH3xx rules: SPMD & multi-host determinism.
+
+The reference kept its BSP workers in lockstep with Hadoop masters and
+ZooKeeper barriers; this repo replaced that machinery with SPMD
+collectives (ops/binagg.py, parallel/mesh.py), filesystem barriers
+(parallel/hostsync.py) and a byte-identical-artifact contract enforced
+by runtime parity pins. The failure classes that come back are the
+MapReduce-era coordination bugs in JAX clothing:
+
+  * a collective or hostsync barrier guarded by a per-host predicate —
+    only SOME processes arrive, the pod deadlocks until the host-wait
+    timeout (JX301);
+  * a collective naming an axis the mesh at the dispatch site does not
+    carry — an XLA lowering error at best, a silently wrong reduce at
+    worst (JX302);
+  * an unsorted directory listing / set walk feeding an artifact writer
+    or merge — bytes differ per host and the parity contract breaks
+    (SH301);
+  * two hostsync barriers awaited in opposite orders on different call
+    paths — the cross-process deadlock SH202 catches for in-process
+    locks (SH302);
+  * wall-clock or randomness folded into a content fingerprint — the
+    sha no longer names the content, resume/dedup silently break
+    (SH303).
+
+Like the JX0xx/SH2xx families, these ride the PackageContext call graph
+(traced set, ``reachable`` closure) and the noqa/JSON/CI machinery. The
+runtime counterpart is ``-Dshifu.sanitize=divergence``
+(analysis/sanitize.py + parallel/hostsync.py): what the AST cannot see —
+actually divergent merge inputs between live hosts — the barrier stamps
+witness at the real exchange.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from shifu_tpu.analysis.engine import (
+    Module,
+    PackageContext,
+    Rule,
+    dotted_name,
+    local_bindings,
+    register,
+)
+
+# ---------------------------------------------------------------------------
+# shared vocabulary
+# ---------------------------------------------------------------------------
+
+# calls every participating host must reach together: jax collectives
+# that lower to cross-device communication, the repo's own reduce
+# entry points, and the filesystem barrier verbs.
+_COLLECTIVE_TAILS = {
+    "psum", "pmean", "pmin", "pmax", "all_gather", "all_to_all",
+    "ppermute", "pshuffle",
+}
+_BARRIER_TAILS = _COLLECTIVE_TAILS | {
+    "window_reduce", "fleet_reduce", "shard_map", "shard_map_compat",
+    "publish_part", "await_parts",
+}
+
+# predicates that differ per host/process. n_hosts/n_shards are uniform
+# across the fleet and deliberately NOT here — `if plan.n_hosts > 1:`
+# takes the same branch everywhere.
+_DIVERGENT_RE = re.compile(
+    r"process_index|host_index|hostIndex|host_idx|is_leader")
+
+# def names that compute content fingerprints (SH303 roots). Matched on
+# `_`-split tokens so `shadow_snapshot` does not match `sha`.
+_FINGERPRINT_TOKENS = {"sha", "digest", "fingerprint", "checksum"}
+
+# wall-clock / randomness sources that must never reach fingerprint
+# input.  time.monotonic/perf_counter are for durations and excluded —
+# a duration in a fingerprint is its own bug but not this rule's.
+_NONDET_CALLS = {
+    "time.time": "wall-clock", "time.time_ns": "wall-clock",
+    "datetime.now": "wall-clock", "datetime.utcnow": "wall-clock",
+    "date.today": "wall-clock",
+    "os.urandom": "randomness", "uuid.uuid1": "randomness",
+    "uuid.uuid4": "randomness", "uuid1": "randomness",
+    "uuid4": "randomness",
+}
+_NONDET_ROOTS = {"random": "randomness", "secrets": "randomness"}
+
+# listing calls whose filesystem order is arbitrary (SH301)
+_LISTING_TAILS = {"listdir": "os.listdir", "glob": "glob.glob",
+                  "iglob": "glob.iglob", "scandir": "os.scandir",
+                  "iterdir": "Path.iterdir"}
+# consumers for which ordering is immaterial
+_ORDER_FREE_WRAPPERS = {"sorted", "set", "frozenset", "len", "sum",
+                        "min", "max", "any", "all", "sorted_glob",
+                        "sorted_listdir", "Counter"}
+
+
+def _fingerprint_named(name: str) -> bool:
+    return bool(_FINGERPRINT_TOKENS
+                & set(re.split(r"[_\d]+", name.lower())))
+
+
+def _is_call_to(node: ast.AST, tails: Set[str]) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name.split(".")[-1] in tails:
+            return name
+    return None
+
+
+def _const_strs(node: ast.AST) -> Set[str]:
+    """All string constants anywhere under `node` (axis specs come as
+    "data", ("dcn", "data"), P("data", None), ...)."""
+    return {n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+# ---------------------------------------------------------------------------
+# package-wide SPMD facts (cached on the PackageContext like
+# rules/concurrency.py's _Analysis)
+# ---------------------------------------------------------------------------
+
+
+class _SpmdAnalysis:
+    def __init__(self, ctx: PackageContext) -> None:
+        self.ctx = ctx
+        # defs whose bodies (transitively) reach a collective/barrier
+        # call — computed as a fixpoint over direct-call seeds so JX301
+        # can flag `f()` under a divergent branch when f() barriers
+        # three calls down.
+        self.barrier_defs: Dict[ast.AST, str] = {}
+        self._seed_barrier_defs()
+        self._propagate_barrier_defs()
+        # axis vocabularies: def node -> literal axis names of every
+        # Mesh(...) it (transitively) constructs; "" when none found.
+        self._mesh_axes_cache: Dict[ast.AST, Set[str]] = {}
+
+    # -- barrier-containing defs (JX301) --
+    def _seed_barrier_defs(self) -> None:
+        for m in self.ctx.modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                for sub in ast.walk(node):
+                    if m.enclosing_function(sub) is not node:
+                        continue
+                    name = _is_call_to(sub, _BARRIER_TAILS)
+                    if name:
+                        self.barrier_defs.setdefault(
+                            node, f"calls `{name}` at line {sub.lineno}")
+                        break
+
+    def _propagate_barrier_defs(self) -> None:
+        """Fixpoint: a def that references a barrier-containing def is
+        barrier-containing (callers must still arrive together)."""
+        changed = True
+        while changed:
+            changed = False
+            for m in self.ctx.modules:
+                for node in ast.walk(m.tree):
+                    if not isinstance(node, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                        continue
+                    if node in self.barrier_defs:
+                        continue
+                    for target in self.ctx._referenced_defs(m, node):
+                        why = self.barrier_defs.get(target)
+                        if why is not None:
+                            self.barrier_defs[node] = (
+                                f"calls `{getattr(target, 'name', '?')}` "
+                                f"which reaches a collective")
+                            changed = True
+                            break
+
+    # -- axis vocabulary resolution (JX302) --
+    def mesh_axes_of_def(self, fn: ast.AST) -> Set[str]:
+        """Literal axis names of every Mesh(...) constructed in `fn` or
+        in defs it references (data_mesh -> {"dcn","data","model"}).
+        Empty set = unresolvable, caller must skip."""
+        cached = self._mesh_axes_cache.get(fn)
+        if cached is not None:
+            return cached
+        axes: Set[str] = set()
+        seen: Set[ast.AST] = set()
+        work = [fn]
+        while work:
+            cur = work.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            m = self.ctx.module_of(cur)
+            if m is None:
+                continue
+            for node in ast.walk(cur):
+                if _is_call_to(node, {"Mesh"}):
+                    for arg in list(node.args[1:]) + [
+                            kw.value for kw in node.keywords]:
+                        axes |= _const_strs(arg)
+            work.extend(self.ctx._referenced_defs(m, cur))
+        self._mesh_axes_cache[fn] = axes
+        return axes
+
+    def resolve_mesh_axes(self, m: Module, site: ast.AST,
+                          mesh_expr: ast.AST) -> Set[str]:
+        """Axis names the mesh at a shard_map call site carries, when
+        statically resolvable; empty set when not."""
+        # literal Mesh(devices, ("dcn", "data")) at the site
+        if _is_call_to(mesh_expr, {"Mesh"}):
+            out: Set[str] = set()
+            for arg in list(mesh_expr.args[1:]) + [
+                    kw.value for kw in mesh_expr.keywords]:
+                out |= _const_strs(arg)
+            return out
+        # call to a resolvable mesh-producing def
+        if isinstance(mesh_expr, ast.Call):
+            for d in self._resolve_name(m, site, mesh_expr.func):
+                return self.mesh_axes_of_def(d)
+            return set()
+        # a name bound in the enclosing function: mesh = lifecycle_mesh()
+        if isinstance(mesh_expr, ast.Name):
+            fn = m.enclosing_function(site)
+            if fn is None:
+                return set()
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == mesh_expr.id):
+                    return self.resolve_mesh_axes(m, site, node.value)
+        return set()
+
+    def _resolve_name(self, m: Module, site: ast.AST,
+                      func: ast.AST) -> List[ast.AST]:
+        """Defs a call target resolves to: module-local first, then a
+        unique package-wide match (the PackageContext convention)."""
+        tail = dotted_name(func).split(".")[-1]
+        if not tail:
+            return []
+        hits = self.ctx.defs_named(m, tail)
+        if hits:
+            return hits
+        g = self.ctx._defs_global.get(tail, [])
+        return g if len(g) == 1 else []
+
+
+def _spmd(ctx: PackageContext) -> _SpmdAnalysis:
+    cached = getattr(ctx, "_spmd_analysis", None)
+    if cached is None:
+        cached = _SpmdAnalysis(ctx)
+        ctx._spmd_analysis = cached
+    return cached
+
+
+def _divergent_test(m: Module, fn: Optional[ast.AST],
+                    test: ast.AST) -> Optional[str]:
+    """Why this branch predicate differs per host, or None. Matches the
+    per-host vocabulary in the test source itself, or a name the
+    enclosing function bound from a per-host expression."""
+    seg = m.segment(test)
+    hit = _DIVERGENT_RE.search(seg)
+    if hit:
+        return f"`{hit.group(0)}` in the predicate"
+    if fn is None:
+        return None
+    names = {n.id for n in ast.walk(test)
+             if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+    if not names:
+        return None
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in names):
+            hit = _DIVERGENT_RE.search(m.segment(node.value))
+            if hit:
+                return (f"`{node.targets[0].id}` is per-host "
+                        f"(`{hit.group(0)}`, line {node.lineno})")
+    return None
+
+
+@register
+class DivergentCollective(Rule):
+    """JX301 — collective/barrier reachable under per-host control flow.
+
+    Every host must arrive at a psum / window_reduce / fleet_reduce /
+    shard_map dispatch / hostsync publish-await together; a branch
+    conditioned on process_index()/host_index means only SOME do — the
+    rest deadlock until the host-wait timeout.
+
+    bad:  if plan.host_index == 0:
+              hostsync.await_parts(root, "stats", plan, sha)  # peers
+              # never publish/await -> leader times out
+    good: every host publishes and awaits; leader-ONLY work (writing the
+          merged artifact) goes after the barrier, guarded alone:
+              parts = hostsync.await_parts(root, "stats", plan, sha)
+              if plan.host_index == 0:
+                  write_merged(parts)
+    """
+
+    id = "JX301"
+    severity = "error"
+    summary = ("collective or hostsync barrier under a branch "
+               "conditioned on process_index()/host_index — only some "
+               "hosts arrive (deadlock)")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        an = _spmd(ctx)
+        for branch in ast.walk(module.tree):
+            if not isinstance(branch, (ast.If, ast.While)):
+                continue
+            fn = module.enclosing_function(branch)
+            why = _divergent_test(module, fn, branch.test)
+            if why is None:
+                continue
+            test_nodes = set(ast.walk(branch.test))
+            for sub in ast.walk(branch):
+                if sub in test_nodes or not isinstance(sub, ast.Call):
+                    continue
+                name = _is_call_to(sub, _BARRIER_TAILS)
+                if name:
+                    yield self.finding(
+                        module, sub,
+                        f"`{name}` under a per-host branch at line "
+                        f"{branch.lineno} ({why}) — every host must "
+                        f"reach this barrier; hoist it out and guard "
+                        f"only the leader-local work")
+                    continue
+                for callee in an._resolve_name(module, branch, sub.func):
+                    reason = an.barrier_defs.get(callee)
+                    if reason:
+                        yield self.finding(
+                            module, sub,
+                            f"`{dotted_name(sub.func)}` {reason}, and "
+                            f"is called under a per-host branch at "
+                            f"line {branch.lineno} ({why}) — only some "
+                            f"hosts would arrive at that barrier")
+                        break
+
+
+@register
+class AxisNameDiscipline(Rule):
+    """JX302 — collective axis names must exist in the mesh at the
+    shard_map call site.
+
+    bad:  mesh = Mesh(devs, ("data",))
+          shard_map_compat(body, mesh=mesh, ...)   # body does
+          ...jax.lax.psum(x, "model")              # no "model" axis
+    good: name only axes the mesh spec carries — thread row_axes(mesh)
+          into the body instead of hard-coding, as ops/binagg.py does.
+
+    Interprocedural: the body def is resolved through the package call
+    graph; the mesh operand resolves through literal Mesh(...) specs and
+    mesh-producing defs (data_mesh, lifecycle_mesh). Unresolvable axis
+    operands (variables) and unresolvable meshes are skipped, not
+    guessed.
+    """
+
+    id = "JX302"
+    severity = "error"
+    summary = ("collective inside shard_map names an axis absent from "
+               "the mesh spec at the dispatch site")
+
+    _AXIS_KWARGS = {"axis_name", "axis", "axis_names"}
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        an = _spmd(ctx)
+        for site in ast.walk(module.tree):
+            if not isinstance(site, ast.Call):
+                continue
+            if not _is_call_to(site, {"shard_map", "shard_map_compat"}):
+                continue
+            mesh_expr = None
+            for kw in site.keywords:
+                if kw.arg == "mesh":
+                    mesh_expr = kw.value
+            if mesh_expr is None and len(site.args) >= 2:
+                mesh_expr = site.args[1]
+            if mesh_expr is None:
+                continue
+            declared = an.resolve_mesh_axes(module, site, mesh_expr)
+            if not declared:
+                continue  # unresolvable mesh: do not guess
+            for body_m, call, axis in self._used_axes(module, an, site):
+                if axis not in declared:
+                    yield self.finding(
+                        body_m, call,
+                        f"`{dotted_name(call.func)}` names axis "
+                        f"'{axis}' but the mesh at the shard_map site "
+                        f"({module.path}:{site.lineno}) declares "
+                        f"{sorted(declared)} — name only mesh axes "
+                        f"(thread row_axes(mesh) instead of "
+                        f"hard-coding)")
+
+    def _used_axes(self, module: Module, an: _SpmdAnalysis,
+                   site: ast.Call):
+        """(module, collective call, literal axis) triples inside the
+        function the shard_map site wraps, following module-local
+        references."""
+        bodies: List[Tuple[Module, ast.AST]] = []
+        if site.args:
+            arg = site.args[0]
+            if isinstance(arg, ast.Lambda):
+                bodies.append((module, arg))
+            else:
+                for d in an._resolve_name(module, site, arg):
+                    m = an.ctx.module_of(d)
+                    if m is not None:
+                        bodies.append((m, d))
+        seen: Set[ast.AST] = set()
+        while bodies:
+            m, fn = bodies.pop()
+            if fn in seen:
+                continue
+            seen.add(fn)
+            for node in ast.walk(fn):
+                name = _is_call_to(node, _COLLECTIVE_TAILS
+                                   | {"axis_index", "pbroadcast"})
+                if name:
+                    for axis in self._axis_operand(node):
+                        yield m, node, axis
+                elif isinstance(node, ast.Call):
+                    for d in an._resolve_name(m, fn, node.func):
+                        dm = an.ctx.module_of(d)
+                        if dm is not None:
+                            bodies.append((dm, d))
+
+    def _axis_operand(self, call: ast.Call) -> Set[str]:
+        for kw in call.keywords:
+            if kw.arg in self._AXIS_KWARGS:
+                return _const_strs(kw.value)
+        if len(call.args) >= 2:
+            return _const_strs(call.args[1])
+        if len(call.args) == 1 and _is_call_to(
+                call, {"axis_index"}):
+            return _const_strs(call.args[0])
+        return set()
+
+
+@register
+class UnsortedMergeOrder(Rule):
+    """SH301 — arbitrary-order iteration where order reaches bytes.
+
+    Filesystem listings (os.listdir, glob) come back in readdir order —
+    different per host, per filesystem, per run; set iteration order is
+    hash-seed dependent. Any of these feeding an artifact writer, a
+    hostsync merge, or a fingerprint breaks the byte-identical contract
+    between hosts (and between a run and its resume).
+
+    bad:  for path in glob.glob(os.path.join(d, "part-*")):
+              merge(path)                       # readdir order
+    good: for path in fs.sorted_glob(os.path.join(d, "part-*")):
+              merge(path)                       # one shared helper
+    Order-insensitive consumption (set(...), len(...), membership,
+    set.update) is recognized and not flagged.
+    """
+
+    id = "SH301"
+    severity = "error"
+    summary = ("unsorted os.listdir/glob/set iteration — arbitrary "
+               "order where deterministic bytes are required; wrap in "
+               "sorted() / fs.sorted_glob")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                tail = _is_call_to(node, set(_LISTING_TAILS))
+                if not tail:
+                    continue
+                if not self._order_insensitive(module, node):
+                    yield self.finding(
+                        module, node,
+                        f"`{tail}` returns entries in arbitrary "
+                        f"filesystem order — wrap in sorted() (or use "
+                        f"the shared fs.sorted_glob/sorted_listdir "
+                        f"helpers) before the order can reach "
+                        f"artifact bytes")
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if self._is_set_expr(it) and not \
+                        self._order_insensitive(module, it):
+                    yield self.finding(
+                        module, it,
+                        "iterating a set — order is hash-seed "
+                        "dependent and differs across hosts; iterate "
+                        "sorted(...) when the order can reach "
+                        "artifact bytes")
+
+    @staticmethod
+    def _is_set_expr(node: ast.AST) -> bool:
+        return isinstance(node, (ast.Set, ast.SetComp)) or bool(
+            _is_call_to(node, {"set", "frozenset"}))
+
+    @staticmethod
+    def _order_insensitive(module: Module, node: ast.AST) -> bool:
+        """Is this listing consumed in a way where order cannot matter?
+        Checked lexically up the expression spine of the statement."""
+        child = node
+        for anc in module.ancestors(node):
+            if isinstance(anc, ast.Call):
+                name = dotted_name(anc.func)
+                tail = name.split(".")[-1]
+                if anc.func is child:
+                    return False  # the listing IS the callee
+                if tail in _ORDER_FREE_WRAPPERS:
+                    return True
+                if tail in ("update", "union", "intersection",
+                            "difference", "rmtree"):
+                    return True  # set algebra / recursive delete
+            elif isinstance(anc, ast.Compare) and any(
+                    isinstance(op, (ast.In, ast.NotIn))
+                    for op in anc.ops):
+                return True  # membership test
+            elif isinstance(anc, ast.SetComp):
+                return True  # result is a set: order cannot survive
+            elif isinstance(anc, (ast.comprehension, ast.ListComp,
+                                  ast.GeneratorExp)):
+                pass  # element order maps 1:1 — judged by the consumer
+            elif isinstance(anc, ast.stmt):
+                return False
+            child = anc
+        return False
+
+
+@register
+class BarrierOrderCycle(Rule):
+    """SH302 — hostsync barriers awaited in opposite orders.
+
+    The cross-process analog of SH202's lock-order graph: host A awaits
+    step "x" then "y" while host B's code path awaits "y" then "x" —
+    each is parked at a barrier the other has not published yet, and
+    both time out. One global barrier order per run, like one global
+    lock order per process.
+
+    bad:  def path_a(...):
+              hostsync.await_parts(root, "stats-pass1", ...)
+              hostsync.await_parts(root, "stats-pass2", ...)
+          def path_b(...):
+              hostsync.await_parts(root, "stats-pass2", ...)
+              hostsync.await_parts(root, "stats-pass1", ...)
+    good: every code path awaits the steps in one documented order
+          (pass1 before pass2, init before stats before norm).
+    """
+
+    id = "SH302"
+    severity = "error"
+    summary = ("two hostsync barrier steps awaited in opposite orders "
+               "on different call paths (cross-host deadlock)")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        edges = self._edges(ctx)
+        cycles = self._cycle_edges(ctx, edges)
+        for (a, b), (m, site) in sorted(
+                edges.items(), key=lambda kv: (kv[1][0].path,
+                                               kv[1][1].lineno)):
+            if m is not module or (a, b) not in cycles:
+                continue
+            others = [f"{om.path}:{osite.lineno}"
+                      for (x, y), (om, osite) in sorted(
+                          edges.items(), key=lambda kv: kv[0])
+                      if (x, y) != (a, b) and {x, y} == {a, b}]
+            yield self.finding(
+                module, site,
+                f"barrier order '{a}' -> '{b}' here is reversed "
+                f"elsewhere ({'; '.join(others) or 'see graph'}) — "
+                f"hosts taking different paths deadlock; fix ONE "
+                f"global await order for these steps")
+
+    # step-name extraction: await_parts(root, "step", ...) or step="..."
+    @staticmethod
+    def _step_of(call: ast.Call) -> Optional[str]:
+        for kw in call.keywords:
+            if kw.arg == "step" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant) \
+                and isinstance(call.args[1].value, str):
+            return call.args[1].value
+        return None
+
+    def _await_seq(self, an: _SpmdAnalysis, m: Module,
+                   fn: ast.AST, depth: int = 1
+                   ) -> List[Tuple[str, Module, ast.AST]]:
+        """Static steps awaited by `fn`, in source order, following
+        resolvable calls one hop (the SH202 convention)."""
+        out: List[Tuple[str, Module, ast.AST]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if m.enclosing_function(node) is not fn:
+                continue
+            if _is_call_to(node, {"await_parts"}):
+                step = self._step_of(node)
+                if step is not None:
+                    out.append((step, m, node))
+            elif depth > 0:
+                for callee in an._resolve_name(m, fn, node.func):
+                    cm = an.ctx.module_of(callee)
+                    if cm is not None:
+                        for (s, _sm, _sn) in self._await_seq(
+                                an, cm, callee, depth - 1):
+                            out.append((s, m, node))
+        out.sort(key=lambda t: (t[2].lineno, t[2].col_offset))
+        return out
+
+    def _edges(self, ctx: PackageContext
+               ) -> Dict[Tuple[str, str], Tuple[Module, ast.AST]]:
+        cached = getattr(ctx, "_spmd_barrier_edges", None)
+        if cached is not None:
+            return cached
+        an = _spmd(ctx)
+        edges: Dict[Tuple[str, str], Tuple[Module, ast.AST]] = {}
+        for m in ctx.modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                seq = self._await_seq(an, m, node)
+                for i in range(len(seq)):
+                    for j in range(i + 1, len(seq)):
+                        a, b = seq[i][0], seq[j][0]
+                        if a != b:
+                            edges.setdefault((a, b),
+                                             (seq[j][1], seq[j][2]))
+        ctx._spmd_barrier_edges = edges
+        return edges
+
+    @staticmethod
+    def _cycle_edges(ctx: PackageContext, edges) -> Set[Tuple[str, str]]:
+        cached = getattr(ctx, "_spmd_barrier_cycles", None)
+        if cached is not None:
+            return cached
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen, work = set(), [src]
+            while work:
+                cur = work.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                work.extend(adj.get(cur, ()))
+            return False
+
+        out = {(a, b) for (a, b) in edges if reaches(b, a)}
+        ctx._spmd_barrier_cycles = out
+        return out
+
+
+@register
+class NondeterministicFingerprint(Rule):
+    """SH303 — wall-clock/randomness reaching a content fingerprint.
+
+    A config sha / stream sha / file digest names CONTENT: two runs (or
+    two hosts) hashing the same content must get the same name, or
+    resume matching, hostsync part identity, dedup and the parity pins
+    all silently break. time.time/uuid4/random in the hash input makes
+    every fingerprint unique.
+
+    bad:  def _stream_config_sha(...):
+              ident = {..., "run": uuid.uuid4().hex}   # never matches
+              return config_sha(ident)
+    good: fingerprint only the content and config; timestamps belong in
+          the run LEDGER (manifest), never the identity.
+    """
+
+    id = "SH303"
+    severity = "error"
+    summary = ("wall-clock or randomness (time.time, uuid4, random, "
+               "os.urandom) inside a fingerprint/sha/digest "
+               "computation")
+
+    def check(self, module: Module,
+              ctx: PackageContext) -> Iterator["Finding"]:
+        closure = self._closure(ctx)
+        for fn, via in closure.items():
+            m = ctx.module_of(fn)
+            if m is not module:
+                continue
+            bound = local_bindings(fn)
+            for node in ast.walk(fn):
+                if m.enclosing_function(node) is not fn:
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                kind = _NONDET_CALLS.get(name) or _NONDET_CALLS.get(
+                    name.split(".")[-1] if name.split(".")[-1]
+                    in ("uuid1", "uuid4") else name)
+                root = name.split(".")[0]
+                if kind is None and root in _NONDET_ROOTS \
+                        and root not in bound and "." in name:
+                    kind = _NONDET_ROOTS[root]
+                if kind:
+                    yield self.finding(
+                        m, node,
+                        f"`{name}` is {kind} inside fingerprint "
+                        f"computation `{fn.name}` ({via}) — the sha "
+                        f"must name the content; move run metadata to "
+                        f"the manifest")
+
+    @staticmethod
+    def _closure(ctx: PackageContext) -> Dict[ast.AST, str]:
+        cached = getattr(ctx, "_spmd_fingerprint_closure", None)
+        if cached is not None:
+            return cached
+        roots: Dict[ast.AST, str] = {}
+        for m in ctx.modules:
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and _fingerprint_named(node.name):
+                    roots.setdefault(node, "fingerprint-named def")
+        out = ctx.reachable(roots)
+        ctx._spmd_fingerprint_closure = out
+        return out

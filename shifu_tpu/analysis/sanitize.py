@@ -21,6 +21,17 @@ pipeline. Four opt-in modes, combined freely:
              default 64); a breach is recorded and logged as a ledger
              warning — recompile storms are a perf bug, not a
              correctness trap, so the step still completes.
+  divergence multi-host lockstep witness (parallel/hostsync.py): every
+             barrier part published while armed carries a stamp — a
+             monotone per-(step, host) sequence id plus a digest of
+             (config sha, barrier step, call-site, merge-key order).
+             An awaiting peer that observes a mismatched digest or an
+             out-of-order sequence raises DivergenceError LOUDLY
+             instead of silently merging divergent state; the static
+             counterpart is JX301/SH301/SH302 (rules/spmd.py).
+             Single-process runs record per-window fold digests
+             (data/pipeline.py flush), so a re-run can diff exactly
+             which window broke determinism.
   race       lock instrumentation (analysis/racetrack.py): every
              ``tracked_lock(...)`` site constructed while armed records
              per-thread acquisition stacks; lock-order inversions and
@@ -40,7 +51,10 @@ Prometheus exports see them too.
 from __future__ import annotations
 
 import contextlib
-from typing import Iterable, List, Optional
+import hashlib
+import json
+import traceback
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from shifu_tpu.analysis.racetrack import tracked_lock
 from shifu_tpu.utils import environment
@@ -49,8 +63,18 @@ from shifu_tpu.utils.log import get_logger
 log = get_logger(__name__)
 
 SCHEMA = "shifu.sanitize/1"
-MODES = ("transfer", "nan", "recompile", "race")
+MODES = ("transfer", "nan", "recompile", "race", "divergence")
 DEFAULT_RECOMPILE_BUDGET = 64
+DEFAULT_MAX_FOLD_DIGESTS = 512
+
+
+class DivergenceError(RuntimeError):
+    """A hostsync barrier observed divergent peer state while
+    -Dshifu.sanitize=divergence was armed: a peer's stamp digest did not
+    match this host's (different config/call-site/merge-key order) or
+    its barrier sequence was out of order. Raised INSTEAD of merging —
+    a divergent merge would poison every downstream artifact silently;
+    the refusal names the step, both hosts, and both digests."""
 
 _lock = tracked_lock("analysis.sanitize")
 _current: Optional["Sanitizer"] = None
@@ -79,8 +103,30 @@ def recompile_budget() -> int:
                                DEFAULT_RECOMPILE_BUDGET)
 
 
+def max_fold_digests() -> int:
+    """shifu.sanitize.divergence.maxFolds — cap on per-window fold
+    digests kept for the verdict (a long stream would otherwise grow
+    the manifest unboundedly; the digests past the cap still count)."""
+    return environment.get_int("shifu.sanitize.divergence.maxFolds",
+                               DEFAULT_MAX_FOLD_DIGESTS)
+
+
 def _is_transfer_error(e: BaseException) -> bool:
     return "transfer" in str(e).lower() and "isallowed" in str(e)
+
+
+def _barrier_call_site() -> str:
+    """module:function of the nearest stack frame OUTSIDE the sanitizer/
+    hostsync plumbing — the publish site whose identity the divergence
+    digest pins. Deliberately not the line number: peers must agree on
+    WHICH barrier they are at, while a trailing-whitespace edit between
+    restarts must not read as divergence."""
+    skip = ("sanitize.py", "hostsync.py")
+    for frame in reversed(traceback.extract_stack()[:-1]):
+        base = frame.filename.rsplit("/", 1)[-1]
+        if base not in skip:
+            return f"{base}:{frame.name}"
+    return "?"
 
 
 class Sanitizer:
@@ -99,6 +145,17 @@ class Sanitizer:
         self.recompile_seconds = 0.0  # wall-clock of breached stages' compiles
         self.stages_armed = 0
         self.events: List[dict] = []
+        # divergence-mode state: per-(step, host) barrier sequence
+        # counters, published stamps, peer checks, and the single-host
+        # fold-digest trail (all under _lock — thread-hosts share one
+        # process-global sanitizer)
+        self.divergence_trips = 0
+        self.divergence_stamps = 0
+        self.divergence_checks = 0
+        self.fold_digests: List[dict] = []
+        self.folds_recorded = 0
+        self._barrier_seq: Dict[tuple, int] = {}
+        self._max_folds = max_fold_digests()
         # race-mode scope: the verdict reports the tracker's DELTA from
         # this sanitizer's construction (the tracker itself is
         # process-global, like the fault-injection counters)
@@ -142,6 +199,97 @@ class Sanitizer:
             "costing %.2fs wall-clock > budget %d "
             "(shifu.sanitize.recompileBudget)", stage, compiles, seconds,
             self.budget)
+
+    def record_divergence_trip(self, stage: str, detail: str) -> None:
+        with _lock:
+            self.divergence_trips += 1
+        self._record("divergence.trips", stage, detail)
+        log.warning("sanitizer[divergence] trip in %s: %s", stage,
+                    detail[:300])
+
+    # ---- divergence stamps (the hostsync barrier contract)
+    def barrier_stamp(self, step: str, host_index: int, sha: str,
+                      merge_keys: Sequence[str]) -> dict:
+        """The stamp publish_part embeds while armed: a monotone
+        per-(step, host) sequence id plus a digest of (config sha, step,
+        publishing call-site, merge-key ORDER). Peers at the same
+        barrier must compute the identical digest — anything else means
+        the fleet is not running the same merge."""
+        with _lock:
+            key = (step, int(host_index))
+            seq = self._barrier_seq.get(key, 0) + 1
+            self._barrier_seq[key] = seq
+            self.divergence_stamps += 1
+        digest = hashlib.sha256(json.dumps({
+            "configSha": sha,
+            "step": step,
+            "site": _barrier_call_site(),
+            "mergeKeys": list(merge_keys),
+        }, sort_keys=True).encode("utf-8")).hexdigest()[:16]
+        from shifu_tpu.obs import registry
+
+        registry().counter("sanitizer.divergence.stamps",
+                           step=step).inc()
+        return {"seq": seq, "digest": digest}
+
+    def check_barrier_stamps(self, step: str, own_host: int,
+                             own_stamp: Optional[dict],
+                             peer_stamps: Dict[int, Optional[dict]]
+                             ) -> None:
+        """Validate every peer's stamp against this host's at an
+        await_parts barrier. Raises DivergenceError on the first
+        mismatch — the named refusal that replaces a silent merge of
+        divergent state."""
+        from shifu_tpu.obs import registry
+
+        registry().counter("sanitizer.divergence.checks",
+                           step=step).inc()
+        with _lock:
+            self.divergence_checks += 1
+        if own_stamp is None:
+            return  # this host published unarmed (stamp-free stream)
+        for host, stamp in sorted(peer_stamps.items()):
+            if host == own_host:
+                continue
+            problem = None
+            if stamp is None:
+                problem = ("peer published NO divergence stamp — fleet "
+                           "is not uniformly armed")
+            elif stamp.get("digest") != own_stamp.get("digest"):
+                problem = (f"digest mismatch: peer {stamp.get('digest')}"
+                           f" != own {own_stamp.get('digest')} (config "
+                           f"sha, call-site or merge-key order differs)")
+            elif stamp.get("seq") != own_stamp.get("seq"):
+                problem = (f"out-of-order barrier sequence: peer "
+                           f"{stamp.get('seq')} != own "
+                           f"{own_stamp.get('seq')}")
+            if problem:
+                detail = (f"barrier '{step}': host {host} diverged from "
+                          f"host {own_host} — {problem}")
+                self.record_divergence_trip(step, detail)
+                raise DivergenceError(
+                    f"sanitizer[divergence] {detail}; refusing to merge"
+                    f" (the verdict rides the run manifest)")
+
+    def record_fold(self, stage: str, arrays) -> None:
+        """Single-process determinism trail: digest one window fold so a
+        re-run can diff exactly where the fold stream diverged."""
+        h = hashlib.sha256()
+        for a in arrays:
+            import numpy as np
+
+            h.update(np.ascontiguousarray(a).tobytes())
+        with _lock:
+            self.folds_recorded += 1
+            seq = self.folds_recorded
+            if len(self.fold_digests) < self._max_folds:
+                self.fold_digests.append(
+                    {"stage": stage, "seq": seq,
+                     "digest": h.hexdigest()[:16]})
+        from shifu_tpu.obs import registry
+
+        registry().counter("sanitizer.divergence.folds",
+                           stage=stage).inc()
 
     # ---- arming
     @contextlib.contextmanager
@@ -231,9 +379,18 @@ class Sanitizer:
                 "breachedCompileSeconds": round(self.recompile_seconds, 3),
             },
             "race": race,
+            "divergence": {
+                "armed": "divergence" in self.modes,
+                "trips": self.divergence_trips,
+                "stampsPublished": self.divergence_stamps,
+                "barriersChecked": self.divergence_checks,
+                "foldsRecorded": self.folds_recorded,
+                "foldDigests": list(self.fold_digests),
+            },
             "events": self.events,
             "clean": not (self.transfer_trips or self.nan_trips
-                          or self.recompile_breaches or race_dirty),
+                          or self.recompile_breaches or race_dirty
+                          or self.divergence_trips),
         }
 
     @staticmethod
@@ -285,3 +442,39 @@ def transfer_free(stage: str):
         return
     with san.transfer_free(stage):
         yield
+
+
+def _divergence_active() -> Optional[Sanitizer]:
+    san = _current
+    if san is not None and "divergence" in san.modes:
+        return san
+    return None
+
+
+def barrier_stamp(step: str, host_index: int, sha: str,
+                  merge_keys: Sequence[str]) -> Optional[dict]:
+    """hostsync.publish_part seam: the stamp to embed in the part
+    header, or None when divergence is disarmed (one global read)."""
+    san = _divergence_active()
+    if san is None:
+        return None
+    return san.barrier_stamp(step, host_index, sha, merge_keys)
+
+
+def check_barrier_stamps(step: str, own_host: int,
+                         own_stamp: Optional[dict],
+                         peer_stamps: Dict[int, Optional[dict]]) -> None:
+    """hostsync.await_parts seam: validate peers before the merge;
+    raises DivergenceError on mismatch, no-op when disarmed."""
+    san = _divergence_active()
+    if san is None:
+        return
+    san.check_barrier_stamps(step, own_host, own_stamp, peer_stamps)
+
+
+def record_fold(stage: str, arrays) -> None:
+    """data-pipeline seam: digest one window fold while armed (no-op
+    otherwise) — the single-process determinism trail."""
+    san = _divergence_active()
+    if san is not None:
+        san.record_fold(stage, arrays)

@@ -199,7 +199,21 @@ def build_parser() -> argparse.ArgumentParser:
                          help="files/dirs to analyze (default: the "
                               "installed shifu_tpu package)")
     p_check.add_argument("--json", action="store_true", dest="as_json",
-                         help="emit the shifu.check/1 JSON document")
+                         help="emit the shifu.check/1 JSON document "
+                              "(alias for --format json)")
+    p_check.add_argument("--format", default=None, dest="fmt",
+                         choices=("human", "json", "sarif"),
+                         help="report format (sarif = SARIF 2.1.0 for "
+                              "code-scanning uploads; default: human, or "
+                              "json when --json is given)")
+    p_check.add_argument("--baseline", default=None,
+                         help="shifu.baseline/1 file of known findings; "
+                              "matches are counted as 'baselined' and do "
+                              "not fail the check")
+    p_check.add_argument("--write-baseline", default=None,
+                         dest="write_baseline",
+                         help="record the current findings to this "
+                              "shifu.baseline/1 file and exit 0")
     p_check.add_argument("--rules", default=None,
                          help="comma-separated rule ids to run "
                               "(default: all)")
@@ -525,7 +539,9 @@ def dispatch(args: argparse.Namespace) -> int:
         rule_ids = (args.rules.split(",") if args.rules else None)
         try:
             return run_check(paths, rule_ids=rule_ids,
-                             as_json=args.as_json)
+                             as_json=args.as_json, fmt=args.fmt,
+                             baseline=args.baseline,
+                             write_baseline_to=args.write_baseline)
         except (FileNotFoundError, ValueError) as e:
             log.error("check: %s", e)
             return 2

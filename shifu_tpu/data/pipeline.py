@@ -460,6 +460,11 @@ class DeviceAccumulator:
             sync=False)
         part = [np.asarray(x[0], dtype=np.float64)
                 for x in jax.device_get(reduced)]
+        # -Dshifu.sanitize=divergence: digest every window fold so two
+        # runs of the same stream can diff WHERE determinism broke
+        from shifu_tpu.analysis import sanitize
+
+        sanitize.record_fold("pipeline.window", part)
         self._acc = None
         self._rows[:] = 0
         if self._host is None:

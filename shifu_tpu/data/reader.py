@@ -11,7 +11,6 @@ part files (part-*, ignoring dot-files), matching the reference's layout.
 
 from __future__ import annotations
 
-import glob
 import gzip
 import os
 from dataclasses import dataclass, field
@@ -20,6 +19,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from shifu_tpu.config.model_config import DEFAULT_MISSING_VALUES
+from shifu_tpu.fs.listing import sorted_glob
 from shifu_tpu.utils.errors import ErrorCode, ShifuError
 
 # Default tokens treated as missing (ModelSourceDataConf.missingOrInvalidValues).
@@ -94,15 +94,14 @@ def _expand_paths(data_path: str) -> List[str]:
         # returned URLs directly
         return expand_remote(data_path)
     if os.path.isdir(data_path):
-        parts = sorted(
-            p for p in glob.glob(os.path.join(data_path, "*")) if _is_data_file(p)
-        )
+        parts = [p for p in sorted_glob(os.path.join(data_path, "*"))
+                 if _is_data_file(p)]
         if not parts:
             raise ShifuError(ErrorCode.DATA_NOT_FOUND, f"empty directory {data_path}")
         return parts
     if os.path.isfile(data_path):
         return [data_path]
-    parts = sorted(p for p in glob.glob(data_path) if _is_data_file(p))
+    parts = [p for p in sorted_glob(data_path) if _is_data_file(p)]
     if parts:
         return parts
     raise ShifuError(ErrorCode.DATA_NOT_FOUND, data_path)

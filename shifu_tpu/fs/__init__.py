@@ -1,3 +1,4 @@
 """Filesystem layer: canonical artifact path layout + IO helpers."""
 
+from shifu_tpu.fs.listing import sorted_glob, sorted_listdir  # noqa: F401
 from shifu_tpu.fs.pathfinder import PathFinder  # noqa: F401

@@ -1,0 +1,28 @@
+"""Deterministic directory listings — the one home for artifact globs.
+
+`os.listdir`/`glob.glob` return entries in readdir order: ext4 hash
+order, tmpfs insertion order, object-store lexicographic — different
+per host, per filesystem, per run. Any listing that feeds an artifact
+writer, a hostsync merge, a checkpoint fingerprint or a retention sweep
+must therefore be SORTED before its order can reach bytes, or the
+byte-identical multi-host contract (parallel/hostsync.py) silently
+breaks. `shifu check` enforces this as SH301 (rules/spmd.py); these two
+helpers are the sanctioned spelling, so call sites stay grep-ably
+uniform and the sort is impossible to forget.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import List
+
+
+def sorted_glob(pattern: str, recursive: bool = False) -> List[str]:
+    """glob.glob in deterministic (lexicographic) order."""
+    return sorted(_glob.glob(pattern, recursive=recursive))
+
+
+def sorted_listdir(path: str) -> List[str]:
+    """os.listdir in deterministic (lexicographic) order."""
+    return sorted(os.listdir(path))

@@ -67,7 +67,7 @@ def expand_remote(path: str) -> List[str]:
         return [f"{protocol}://{p}" for p in parts]
     if fs.exists(bare) and fs.isfile(bare):
         return [path]
-    hits = fs.glob(bare)
+    hits = sorted(fs.glob(bare))
     files = []
     for h in hits:
         name = h.rsplit("/", 1)[-1]

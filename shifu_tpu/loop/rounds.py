@@ -187,8 +187,10 @@ def sweep_rounds(root: str, keep: int = KEEP_ROUNDS) -> int:
                      for n in os.listdir(d)
                      if n.endswith("-prepare.json")}, reverse=True)
     removed = 0
+    from shifu_tpu.fs.listing import sorted_listdir
+
     for rid in rounds[keep:]:
-        for name in os.listdir(d):
+        for name in sorted_listdir(d):
             if name.startswith(rid + "-"):
                 try:
                     os.unlink(os.path.join(d, name))

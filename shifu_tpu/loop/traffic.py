@@ -35,7 +35,6 @@ loop.traffic.chunks, all in the serve shutdown manifest.
 
 from __future__ import annotations
 
-import glob
 import json
 import os
 import re
@@ -46,6 +45,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from shifu_tpu.analysis.racetrack import guarded_by, tracked_lock
+from shifu_tpu.fs.listing import sorted_glob
 from shifu_tpu.loop import log_chunk_rows_setting, log_sample_setting
 from shifu_tpu.utils.log import get_logger
 
@@ -114,8 +114,8 @@ def list_chunks(root: str, stream: str = "",
     writer's own append order when a writer id is given."""
     scope = traffic_scope_setting() if scope is None else scope
     out = []
-    for path in glob.glob(os.path.join(traffic_dir(root, stream),
-                                       "traffic-*.psv")):
+    for path in sorted_glob(os.path.join(traffic_dir(root, stream),
+                                         "traffic-*.psv")):
         m = _CHUNK_RE.match(os.path.basename(path))
         if not m:
             continue
@@ -138,8 +138,8 @@ def list_writers(root: str, stream: str = "") -> List[str]:
     report as '') — the retrain lineage manifest's evidence that the
     union spanned the fleet."""
     writers = set()
-    for path in glob.glob(os.path.join(traffic_dir(root, stream),
-                                       "traffic-*.psv")):
+    for path in sorted_glob(os.path.join(traffic_dir(root, stream),
+                                         "traffic-*.psv")):
         m = _CHUNK_RE.match(os.path.basename(path))
         if m:
             writers.add(m.group(1) or "")
@@ -211,7 +211,7 @@ class TrafficLog:
         retired = os.path.join(self.dir, f"superseded-{n}")
         os.makedirs(retired)
         moved = 0
-        for path in (glob.glob(os.path.join(self.dir, "traffic-*.psv"))
+        for path in (sorted_glob(os.path.join(self.dir, "traffic-*.psv"))
                      + [meta_path]):
             if os.path.isfile(path):
                 os.replace(path,
@@ -244,7 +244,7 @@ class TrafficLog:
         unnamed chunks when no writer is set) — restarts keep the
         writer's own sequence monotone."""
         highest = 0
-        for path in glob.glob(os.path.join(self.dir, "traffic-*.psv")):
+        for path in sorted_glob(os.path.join(self.dir, "traffic-*.psv")):
             m = _CHUNK_RE.match(os.path.basename(path))
             if m and (m.group(1) or "") == self.writer:
                 highest = max(highest, int(m.group(2)))

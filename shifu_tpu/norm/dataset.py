@@ -16,13 +16,14 @@ and under cleaned_data_dir() (tree-model input, bin codes not z-scores):
 
 from __future__ import annotations
 
-import glob
 import json
 import os
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+from shifu_tpu.fs.listing import sorted_glob
 
 
 @dataclass
@@ -202,8 +203,8 @@ class HostPartWriter:
             shard_rows.append(0)
         # every host has published its part list by now, so any .part-*
         # file not in the union is debris from a dead earlier run
-        for leftover in glob.glob(os.path.join(self.out_dir,
-                                               ".part-*.npy")):
+        for leftover in sorted_glob(os.path.join(self.out_dir,
+                                                 ".part-*.npy")):
             try:
                 os.unlink(leftover)
             except OSError:

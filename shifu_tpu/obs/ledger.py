@@ -13,12 +13,13 @@ scattered Hadoop job counters and log lines that die with the console
 
 from __future__ import annotations
 
-import glob
 import hashlib
 import json
 import os
 import re
 from typing import List, Optional
+
+from shifu_tpu.fs.listing import sorted_glob
 
 SCHEMA = "shifu.run/1"
 RUNS_SUBDIR = os.path.join(".shifu", "runs")
@@ -67,7 +68,7 @@ class RunLedger:
     def next_seq(self, step: str) -> int:
         """1 + highest existing sequence number for this step."""
         highest = 0
-        for path in glob.glob(os.path.join(self.dir, f"{step}-*.json")):
+        for path in sorted_glob(os.path.join(self.dir, f"{step}-*.json")):
             m = _MANIFEST_RE.match(os.path.basename(path))
             if m and m.group("step") == step:
                 highest = max(highest, int(m.group("seq")))
@@ -143,7 +144,7 @@ def list_runs(root: str, last: Optional[int] = None,
     """Manifests under <root>/.shifu/runs, newest first; each dict gains a
     `path` key. Unparseable files are skipped."""
     out: List[dict] = []
-    for path in glob.glob(os.path.join(runs_dir(root), "*.json")):
+    for path in sorted_glob(os.path.join(runs_dir(root), "*.json")):
         name = os.path.basename(path)
         if name.endswith(".trace.json") or not _MANIFEST_RE.match(name):
             continue

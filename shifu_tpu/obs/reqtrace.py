@@ -47,7 +47,6 @@ signature changes through the SwappableRegistry indirection.
 
 from __future__ import annotations
 
-import glob
 import itertools
 import json
 import os
@@ -60,6 +59,7 @@ from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
 from shifu_tpu.analysis.racetrack import tracked_lock
+from shifu_tpu.fs.listing import sorted_glob
 from shifu_tpu.utils import environment
 
 TRACES_SCHEMA = "shifu.traces/1"
@@ -479,7 +479,7 @@ def trace_files(root: str = ".") -> List[str]:
     out = []
     base = runs_dir(root)
     for pattern in ("*.traces.json", os.path.join("*", "*.traces.json")):
-        for path in glob.glob(os.path.join(base, pattern)):
+        for path in sorted_glob(os.path.join(base, pattern)):
             m = _FILE_RE.match(os.path.basename(path))
             if m:
                 out.append((int(m.group("seq")),

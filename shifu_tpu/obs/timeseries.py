@@ -34,7 +34,6 @@ chunkWindows``, ``-Dshifu.obs.retainChunks``.
 
 from __future__ import annotations
 
-import glob
 import json
 import os
 import re
@@ -43,6 +42,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from shifu_tpu.analysis.racetrack import tracked_lock
+from shifu_tpu.fs.listing import sorted_glob
 from shifu_tpu.utils import environment
 from shifu_tpu.utils.log import get_logger
 
@@ -90,14 +90,14 @@ def list_process_dirs(root: str) -> List[str]:
     base = os.path.join(os.path.abspath(root), OBS_SUBDIR)
     if not os.path.isdir(base):
         return []
-    return sorted(p for p in glob.glob(os.path.join(base, "*"))
-                  if os.path.isdir(p))
+    return [p for p in sorted_glob(os.path.join(base, "*"))
+            if os.path.isdir(p)]
 
 
 def list_chunks(root: str, lease_id: str) -> List[str]:
     """Chunk files in sequence (append) order."""
     out = []
-    for path in glob.glob(os.path.join(obs_dir(root, lease_id),
+    for path in sorted_glob(os.path.join(obs_dir(root, lease_id),
                                        "obs-*.json")):
         m = _CHUNK_RE.match(os.path.basename(path))
         if m:

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from shifu_tpu.analysis import sanitize
 from shifu_tpu.utils import environment
 from shifu_tpu.utils.log import get_logger
 
@@ -88,6 +89,15 @@ def publish_part(root: str, step: str, host_plan, sha: str,
         "configSha": sha,
         "meta": meta or {},
     }
+    # -Dshifu.sanitize=divergence: stamp the part with a monotone
+    # per-(step, host) sequence id and a digest of (config sha, step,
+    # call-site, merge-key ORDER) — awaiting peers refuse to merge a
+    # part whose stamp disagrees with their own (analysis/sanitize.py)
+    stamp = sanitize.barrier_stamp(
+        step, host_plan.host_index, sha,
+        list(arrays or ()) + list(meta or ()))
+    if stamp is not None:
+        header["sanitize"] = stamp
     payload[META_KEY] = np.frombuffer(
         json.dumps(header, sort_keys=True).encode("utf-8"), dtype=np.uint8)
     if blob is not None:
@@ -110,9 +120,9 @@ def clear_part(root: str, step: str, host_plan) -> None:
         pass
 
 
-def _read_part(path: str, sha: str, n_hosts: int) -> Optional[Part]:
-    """(arrays, meta, blob) when the part is complete and belongs to this
-    stream (sha + host count match), else None — corrupt or foreign
+def _read_part(path: str, sha: str, n_hosts: int):
+    """(arrays, header, blob) when the part is complete and belongs to
+    this stream (sha + host count match), else None — corrupt or foreign
     parts read as 'not arrived yet' and the barrier keeps waiting for
     the owner to republish."""
     try:
@@ -125,7 +135,7 @@ def _read_part(path: str, sha: str, n_hosts: int) -> Optional[Part]:
         return None
     if header.get("configSha") != sha or header.get("hosts") != n_hosts:
         return None
-    return arrays, header.get("meta", {}), blob
+    return arrays, header, blob
 
 
 def await_parts(root: str, step: str, host_plan, sha: str,
@@ -141,7 +151,7 @@ def await_parts(root: str, step: str, host_plan, sha: str,
     H = host_plan.n_hosts
     timeout_ms = host_wait_ms_setting() if timeout_ms is None else timeout_ms
     deadline = time.monotonic() + timeout_ms / 1000.0
-    parts: Dict[int, Part] = {}
+    parts: Dict[int, tuple] = {}
     t0 = time.monotonic()
     while True:
         for h in range(H):
@@ -161,7 +171,15 @@ def await_parts(root: str, step: str, host_plan, sha: str,
                 " launched with a different config"
                 " (-Dshifu.lifecycle.hostWaitMs raises the wait)")
         time.sleep(poll_s)
+    # divergence sanitizer: refuse (DivergenceError) to hand back a
+    # merge set whose peer stamps disagree with this host's own stamp
+    own = host_plan.host_index
+    sanitize.check_barrier_stamps(
+        step, own,
+        parts[own][1].get("sanitize") if own in parts else None,
+        {h: hdr.get("sanitize") for h, (_a, hdr, _b) in parts.items()})
     reg = registry()
     reg.timer("host.await_seconds", step=step).add(time.monotonic() - t0)
     reg.counter("host.parts_merged", step=step).inc(H)
-    return [parts[h] for h in range(H)]
+    return [(a, hdr.get("meta", {}), b)
+            for a, hdr, b in (parts[h] for h in range(H))]

@@ -36,12 +36,12 @@ the diverged section named.
 from __future__ import annotations
 
 import copy
-import glob
 import hashlib
 import os
 from typing import List, Optional
 
 from shifu_tpu.config.model_config import Algorithm
+from shifu_tpu.fs.listing import sorted_glob
 from shifu_tpu.fs.pathfinder import PathFinder
 from shifu_tpu.processor.basic import BasicProcessor
 from shifu_tpu.processor.norm import NormProcessor
@@ -206,7 +206,7 @@ class RetrainProcessor(BasicProcessor):
         # stale candidates from a previous retrain with MORE members must
         # not survive as phantom ensemble members
         keep = {os.path.basename(p) for p in parent_paths}
-        for p in glob.glob(os.path.join(self.candidate_dir, "model*")):
+        for p in sorted_glob(os.path.join(self.candidate_dir, "model*")):
             if os.path.basename(p) not in keep:
                 os.unlink(p)
 

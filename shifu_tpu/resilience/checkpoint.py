@@ -476,13 +476,13 @@ class ShardedStreamCheckpoint:
         earlier multi-host run; a multi-host clear stays inside its own
         host's family — the other hosts' files are live state owned by
         running peers."""
-        import glob as _glob
+        from shifu_tpu.fs.listing import sorted_glob
 
         patterns = [self._family + "-shard*" + CKPT_SUFFIX]
         if self.n_hosts == 1:
             patterns.append(self.base + "-h*" + CKPT_SUFFIX)
         for pattern in patterns:
-            for path in _glob.glob(pattern):
+            for path in sorted_glob(pattern):
                 try:
                     os.unlink(path)
                 except OSError:  # already gone
@@ -496,7 +496,7 @@ def list_resumable(root: str) -> List[dict]:
     fold snapshots) AND the trainer checkpoint dirs (streamed NN/WDL
     state lives beside cfg.checkpoint_path — under tmp/train/ for
     `shifu train`, under tmp/retrain/train/ for `shifu retrain`)."""
-    import glob as _glob
+    from shifu_tpu.fs.listing import sorted_glob
 
     root = os.path.abspath(root)
     paths: List[str] = []
@@ -510,9 +510,9 @@ def list_resumable(root: str) -> List[dict]:
     ]
     step_of = {}
     for step, base in trainer_globs:
-        for path in sorted(_glob.glob(
+        for path in sorted_glob(
                 os.path.join(base, "**", "*" + CKPT_SUFFIX),
-                recursive=True)):
+                recursive=True):
             paths.append(path)
             step_of[path] = step
     out: List[dict] = []

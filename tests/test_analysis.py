@@ -688,6 +688,344 @@ class TestConcurrencyRules:
         assert "predicate loop" in msgs and "unbounded" in msgs
 
 
+# ---------------------------------------------------------------------------
+# JX3xx/SH3xx: SPMD & multi-host determinism rules
+# ---------------------------------------------------------------------------
+
+
+class TestSpmdRules:
+    # -- JX301: collective under per-host control flow --
+    def test_jx301_barrier_under_divergent_branch(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            from shifu_tpu.parallel import hostsync
+
+            def merge(root, plan, sha):
+                if jax.process_index() == 0:
+                    hostsync.await_parts(root, "stats", plan, sha)
+
+            def reduce_local(x):
+                idx = jax.process_index()
+                if idx == 0:
+                    return jax.lax.psum(x, "data")
+                return x
+        """, rules=["JX301"])
+        lines = rule_lines(findings, "JX301")
+        assert len(lines) == 2
+        msgs = [f.message for f in findings if f.rule == "JX301"]
+        assert any("await_parts" in m and "per-host branch" in m
+                   for m in msgs)
+        assert any("psum" in m for m in msgs)
+
+    def test_jx301_indirect_through_helper(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            from shifu_tpu.parallel import hostsync
+
+            def _publish(root, plan, sha):
+                hostsync.publish_part(root, "stats", plan, sha)
+
+            def run(root, plan, sha):
+                host = plan.host_index
+                if host == 0:
+                    _publish(root, plan, sha)
+        """, rules=["JX301"])
+        (line,) = rule_lines(findings, "JX301")
+        (f,) = [x for x in findings if x.rule == "JX301"]
+        assert "_publish" in f.message and "per-host" in f.message
+
+    def test_jx301_uniform_and_post_barrier_guards_clean(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            from shifu_tpu.parallel import hostsync
+
+            def run(root, plan, sha, write_merged):
+                # uniform predicate: every host takes the same branch
+                if plan.n_hosts > 1:
+                    hostsync.publish_part(root, "stats", plan, sha)
+                    parts = hostsync.await_parts(root, "stats", plan, sha)
+                    # leader-only work AFTER the barrier is the pattern
+                    if plan.host_index == 0:
+                        write_merged(parts)
+        """, rules=["JX301"])
+        assert rule_lines(findings, "JX301") == []
+
+    # -- JX302: axis names must exist in the mesh spec --
+    def test_jx302_axis_absent_from_mesh(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            from jax.sharding import Mesh
+
+            def body(x):
+                return jax.lax.psum(x, "model")
+
+            def dispatch(devs, x):
+                mesh = Mesh(devs, ("data",))
+                return shard_map_compat(body, mesh, x)
+        """, rules=["JX302"])
+        (f,) = [x for x in findings if x.rule == "JX302"]
+        assert "'model'" in f.message and "['data']" in f.message
+
+    def test_jx302_declared_axes_clean(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            from jax.sharding import Mesh
+
+            def body(x):
+                return jax.lax.psum(x, "data")
+
+            def dispatch(devs, x):
+                mesh = Mesh(devs, ("dcn", "data"))
+                return shard_map_compat(body, mesh, x)
+
+            def dynamic(devs, x, axes):
+                mesh = Mesh(devs, ("data",))
+                # non-literal axis operand: skipped, never guessed
+                return shard_map_compat(lambda v: jax.lax.psum(v, axes),
+                                        mesh, x)
+        """, rules=["JX302"])
+        assert rule_lines(findings, "JX302") == []
+
+    def test_jx302_mesh_through_producer_def(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import jax
+            from jax.sharding import Mesh
+
+            def data_mesh(devs):
+                return Mesh(devs, ("dcn", "data"))
+
+            def body(x):
+                return jax.lax.pmean(x, axis_name="model")
+
+            def dispatch(devs, x):
+                return shard_map_compat(body, data_mesh(devs), x)
+        """, rules=["JX302"])
+        (f,) = [x for x in findings if x.rule == "JX302"]
+        assert "'model'" in f.message
+
+    # -- SH301: unsorted listing / set walk --
+    def test_sh301_unsorted_listing_and_set_walk(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import glob
+            import os
+
+            def merge(d, fold):
+                for p in glob.glob(os.path.join(d, "part-*")):
+                    fold(p)
+                for name in os.listdir(d):
+                    fold(name)
+                for col in {"a", "b", "c"}:
+                    fold(col)
+        """, rules=["SH301"])
+        assert len(rule_lines(findings, "SH301")) == 3
+
+    def test_sh301_sorted_and_order_free_clean(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import glob
+            import os
+            from shifu_tpu.fs.listing import sorted_glob
+
+            def merge(d, fold):
+                for p in sorted(glob.glob(os.path.join(d, "part-*"))):
+                    fold(p)
+                for p in sorted_glob(os.path.join(d, "part-*")):
+                    fold(p)
+                n = len(os.listdir(d))                 # count only
+                names = set(os.listdir(d))             # set algebra
+                ok = "x" in os.listdir(d)              # membership
+                stale = sorted(p for p in glob.glob(d + "/*")
+                               if p.endswith(".tmp"))  # via genexp
+                return n, names, ok, stale
+        """, rules=["SH301"])
+        assert rule_lines(findings, "SH301") == []
+
+    # -- SH302: opposite barrier await orders --
+    def test_sh302_opposite_orders_both_witnessed(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            from shifu_tpu.parallel import hostsync
+
+            def path_a(root, plan, sha):
+                hostsync.await_parts(root, "pass1", plan, sha)
+                hostsync.await_parts(root, "pass2", plan, sha)
+
+            def path_b(root, plan, sha):
+                hostsync.await_parts(root, "pass2", plan, sha)
+                hostsync.await_parts(root, "pass1", plan, sha)
+        """, rules=["SH302"])
+        msgs = [f.message for f in findings if f.rule == "SH302"]
+        assert len(msgs) == 2      # one witness per direction
+        assert any("'pass1' -> 'pass2'" in m for m in msgs)
+        assert any("'pass2' -> 'pass1'" in m for m in msgs)
+        # each witness points at the site of the OTHER direction
+        assert all("snippet.py:" in m for m in msgs)
+
+    def test_sh302_consistent_order_clean(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            from shifu_tpu.parallel import hostsync
+
+            def pass1(root, plan, sha):
+                hostsync.await_parts(root, "pass1", plan, sha)
+
+            def both(root, plan, sha):
+                pass1(root, plan, sha)
+                hostsync.await_parts(root, "pass2", plan, sha)
+
+            def again(root, plan, sha):
+                hostsync.await_parts(root, "pass1", plan, sha)
+                hostsync.await_parts(root, "pass2", plan, sha)
+        """, rules=["SH302"])
+        assert rule_lines(findings, "SH302") == []
+
+    # -- SH303: nondeterminism in fingerprint computation --
+    def test_sh303_wall_clock_and_randomness(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import hashlib
+            import json
+            import time
+            import uuid
+
+            def config_sha(props):
+                ident = {"props": props, "at": time.time(),
+                         "run": uuid.uuid4().hex}
+                return hashlib.sha256(
+                    json.dumps(ident, sort_keys=True).encode()).hexdigest()
+        """, rules=["SH303"])
+        msgs = [f.message for f in findings if f.rule == "SH303"]
+        assert len(msgs) == 2
+        assert any("time.time" in m and "wall-clock" in m for m in msgs)
+        assert any("uuid" in m and "randomness" in m for m in msgs)
+
+    def test_sh303_reaches_through_call_graph(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import random
+
+            def _salt():
+                return random.random()
+
+            def stream_fingerprint(cols):
+                return hash((tuple(cols), _salt()))
+        """, rules=["SH303"])
+        (f,) = [x for x in findings if x.rule == "SH303"]
+        assert "random.random" in f.message and "_salt" in f.message
+
+    def test_sh303_durations_and_nonfingerprints_clean(self, tmp_path):
+        findings = check_snippet(tmp_path, """
+            import time
+            import uuid
+
+            def config_sha_age(started):
+                # durations are fine: monotonic is excluded by design
+                return time.monotonic() - started
+
+            def shadow_run_name():
+                # not fingerprint-named ("shadow" must not match "sha")
+                return uuid.uuid4().hex
+        """, rules=["SH303"])
+        assert rule_lines(findings, "SH303") == []
+
+
+# ---------------------------------------------------------------------------
+# --baseline / --write-baseline and the SARIF reporter
+# ---------------------------------------------------------------------------
+
+
+class TestBaselineAndSarif:
+    SRC = """
+        import glob
+
+        def merge(d, fold):
+            for p in glob.glob(d + "/part-*"):
+                fold(p)
+    """
+
+    def test_baseline_round_trip(self, tmp_path):
+        from shifu_tpu.analysis.engine import (
+            apply_baseline, counts, load_baseline, write_baseline)
+
+        findings = check_snippet(tmp_path, self.SRC)
+        assert counts(findings)["error"] == 1
+        base = tmp_path / "base.json"
+        assert write_baseline(findings, str(base)) == 1
+        doc = json.loads(base.read_text())
+        assert doc["schema"] == "shifu.baseline/1"
+        # counted-not-dropped: the finding stays, flagged baselined
+        apply_baseline(findings, load_baseline(str(base)))
+        c = counts(findings)
+        assert c["error"] == 0 and c["baselined"] == 1
+        assert findings[0].baselined is True
+
+    def test_baseline_key_survives_line_moves(self, tmp_path):
+        a = check_snippet(tmp_path, self.SRC, name="a.py")
+        moved = ("\n\n\n# a comment pushing everything down\n"
+                 + textwrap.dedent(self.SRC))
+        b = check_snippet(tmp_path, moved, name="a.py")
+        assert a[0].line != b[0].line
+        assert a[0].baseline_key() == b[0].baseline_key()
+
+    def test_baseline_rejects_foreign_schema(self, tmp_path):
+        from shifu_tpu.analysis.engine import load_baseline
+
+        p = tmp_path / "not-a-baseline.json"
+        p.write_text(json.dumps({"schema": "shifu.check/1"}))
+        with pytest.raises(ValueError, match="shifu.baseline/1"):
+            load_baseline(str(p))
+
+    def test_cli_baseline_gates_exit(self, tmp_path, capsys):
+        from shifu_tpu.cli import main
+
+        src = tmp_path / "bad.py"
+        src.write_text(textwrap.dedent(self.SRC))
+        base = str(tmp_path / "base.json")
+        assert main(["check", str(src)]) == 1
+        assert main(["check", "--write-baseline", base, str(src)]) == 0
+        assert main(["check", "--baseline", base, str(src)]) == 0
+        out = capsys.readouterr().out
+        assert "1 baselined" in out
+        # a NEW finding is not absorbed by the old baseline
+        src.write_text(textwrap.dedent(self.SRC) + textwrap.dedent("""
+            import os
+
+            def walk(d, fold):
+                for name in os.listdir(d):
+                    fold(name)
+        """))
+        assert main(["check", "--baseline", base, str(src)]) == 1
+
+    def test_sarif_round_trip(self, tmp_path, capsys):
+        from shifu_tpu.cli import main
+
+        src = tmp_path / "bad.py"
+        src.write_text(textwrap.dedent(self.SRC))
+        assert main(["check", "--format", "sarif", str(src)]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["version"] == "2.1.0"
+        (run,) = doc["runs"]
+        driver = run["tool"]["driver"]
+        assert driver["name"] == "shifu check"
+        ids = [r["id"] for r in driver["rules"]]
+        assert ids == sorted(ids)
+        assert {"JX301", "JX302", "SH301", "SH302", "SH303"} <= set(ids)
+        (res,) = run["results"]
+        assert res["ruleId"] == "SH301" and res["level"] == "error"
+        assert driver["rules"][res["ruleIndex"]]["id"] == "SH301"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad.py")
+        assert loc["region"]["startLine"] == 5
+        assert loc["region"]["startColumn"] >= 1  # SARIF is 1-based
+
+    def test_sarif_carries_suppressions(self, tmp_path):
+        from shifu_tpu.analysis.engine import report_sarif
+
+        findings = check_snippet(tmp_path, """
+            import glob
+
+            def merge(d, fold):
+                for p in glob.glob(d + "/*"):  # shifu: noqa[SH301] - fixture
+                    fold(p)
+        """)
+        doc = json.loads(report_sarif(findings))
+        (res,) = doc["runs"][0]["results"]
+        assert res["suppressions"] == [{"kind": "inSource"}]
+
+
 class TestKnobCatalog:
     def test_sh105_undeclared_and_mistyped(self, tmp_path):
         findings = check_snippet(tmp_path, """
@@ -776,7 +1114,12 @@ class TestKnobCatalog:
 class TestSelfCheck:
     def test_shifu_tpu_tree_is_clean(self):
         import shifu_tpu
+        from shifu_tpu.analysis.engine import all_rules
 
+        # the SPMD/multi-host family must be registered — the clean
+        # sweep below is vacuous for rules that never ran
+        assert {"JX301", "JX302", "SH301", "SH302",
+                "SH303"} <= set(all_rules())
         pkg = os.path.dirname(os.path.abspath(shifu_tpu.__file__))
         findings = analyze([pkg])
         live = [f for f in findings if not f.suppressed]
@@ -801,7 +1144,7 @@ class TestSanitizer:
             assert sanitize.modes_from_environment() == ["transfer", "nan"]
             environment.set_property("shifu.sanitize", "all")
             assert set(sanitize.modes_from_environment()) == {
-                "transfer", "nan", "recompile", "race"}
+                "transfer", "nan", "recompile", "race", "divergence"}
             environment.set_property("shifu.sanitize", "transfr")
             with pytest.raises(ValueError, match="unknown mode"):
                 sanitize.modes_from_environment()
@@ -893,9 +1236,124 @@ class TestSanitizer:
         v = sanitize.Sanitizer(["transfer", "nan", "recompile"]).verdict()
         assert v["schema"] == "shifu.sanitize/1"
         assert set(v) == {"schema", "modes", "stagesArmed", "transfer",
-                          "nan", "recompile", "race", "events", "clean"}
+                          "nan", "recompile", "race", "divergence",
+                          "events", "clean"}
         assert v["race"] == {"armed": False}
+        assert v["divergence"]["armed"] is False
         assert v["clean"] is True
+
+
+class TestDivergenceSanitizer:
+    """-Dshifu.sanitize=divergence: barrier stamps, the mismatch
+    refusal, and the single-process fold-digest trail."""
+
+    def test_stamp_seq_is_per_step_per_host(self):
+        from shifu_tpu.analysis import sanitize
+
+        san = sanitize.Sanitizer(["divergence"])
+        s0 = san.barrier_stamp("stats", 0, "sha", ["a", "b"])
+        s1 = san.barrier_stamp("stats", 1, "sha", ["a", "b"])
+        # thread-hosts share the process-global sanitizer: each host
+        # still gets seq 1 at its first barrier (keyed per (step, host))
+        assert s0["seq"] == 1 and s1["seq"] == 1
+        assert s0["digest"] == s1["digest"]
+        assert san.barrier_stamp("stats", 0, "sha", ["a", "b"])["seq"] == 2
+        assert san.barrier_stamp("other", 0, "sha", ["a", "b"])["seq"] == 1
+
+    def test_stamp_digest_covers_sha_and_merge_key_order(self):
+        from shifu_tpu.analysis import sanitize
+
+        san = sanitize.Sanitizer(["divergence"])
+        base = san.barrier_stamp("stats", 0, "sha", ["a", "b"])
+        other_sha = san.barrier_stamp("stats", 1, "sha2", ["a", "b"])
+        swapped = san.barrier_stamp("stats", 2, "sha", ["b", "a"])
+        assert other_sha["digest"] != base["digest"]
+        assert swapped["digest"] != base["digest"]
+
+    def test_check_raises_named_verdict_on_mismatch(self):
+        from shifu_tpu import obs
+        from shifu_tpu.analysis import sanitize
+
+        obs.reset()
+        san = sanitize.Sanitizer(["divergence"])
+        own = {"seq": 1, "digest": "aaaa"}
+        with pytest.raises(sanitize.DivergenceError,
+                           match="host 1 diverged .* digest mismatch"):
+            san.check_barrier_stamps(
+                "stats", 0, own, {0: own, 1: {"seq": 1, "digest": "bbbb"}})
+        with pytest.raises(sanitize.DivergenceError,
+                           match="not uniformly armed"):
+            san.check_barrier_stamps("stats", 0, own, {0: own, 1: None})
+        with pytest.raises(sanitize.DivergenceError,
+                           match="out-of-order barrier sequence"):
+            san.check_barrier_stamps(
+                "stats", 0, own, {0: own, 1: {"seq": 2, "digest": "aaaa"}})
+        v = san.verdict()
+        assert v["divergence"]["trips"] == 3
+        assert v["divergence"]["barriersChecked"] == 3
+        assert v["clean"] is False
+        assert any(e["kind"] == "divergence.trips" for e in v["events"])
+        assert (obs.registry().counter("sanitizer.divergence.checks",
+                                       step="stats").value == 3)
+
+    def test_check_tolerates_matching_and_unarmed_self(self):
+        from shifu_tpu.analysis import sanitize
+
+        san = sanitize.Sanitizer(["divergence"])
+        own = {"seq": 1, "digest": "aaaa"}
+        san.check_barrier_stamps("stats", 0, own, {0: own, 1: dict(own)})
+        # this host published unarmed: nothing to compare against
+        san.check_barrier_stamps("stats", 0, None,
+                                 {0: None, 1: {"seq": 9, "digest": "z"}})
+        assert san.verdict()["clean"] is True
+
+    def test_record_fold_digests_and_cap(self):
+        from shifu_tpu.analysis import sanitize
+        from shifu_tpu.utils import environment
+
+        environment.set_property("shifu.sanitize.divergence.maxFolds", "2")
+        try:
+            san = sanitize.Sanitizer(["divergence"])
+            for i in range(4):
+                san.record_fold("pipeline.window",
+                                [np.full(3, float(i))])
+            d = san.verdict()["divergence"]
+        finally:
+            environment.set_property("shifu.sanitize.divergence.maxFolds",
+                                     "")
+        # folds past the cap still COUNT; only their digests are dropped
+        assert d["foldsRecorded"] == 4
+        assert [f["seq"] for f in d["foldDigests"]] == [1, 2]
+        assert all(f["stage"] == "pipeline.window"
+                   for f in d["foldDigests"])
+        # same bytes -> same digest, different bytes -> different
+        a = sanitize.Sanitizer(["divergence"])
+        a.record_fold("s", [np.arange(4.0)])
+        b = sanitize.Sanitizer(["divergence"])
+        b.record_fold("s", [np.arange(4.0)])
+        c = sanitize.Sanitizer(["divergence"])
+        c.record_fold("s", [np.arange(4.0) + 1])
+        da = a.verdict()["divergence"]["foldDigests"][0]["digest"]
+        db = b.verdict()["divergence"]["foldDigests"][0]["digest"]
+        dc = c.verdict()["divergence"]["foldDigests"][0]["digest"]
+        assert da == db and da != dc
+
+    def test_module_seams_noop_when_disarmed(self):
+        from shifu_tpu.analysis import sanitize
+
+        # no sanitizer active at all
+        assert sanitize.barrier_stamp("s", 0, "sha", []) is None
+        sanitize.check_barrier_stamps("s", 0, {"seq": 1, "digest": "x"},
+                                      {1: None})
+        sanitize.record_fold("s", [np.ones(1)])
+        # active but divergence NOT in the mode set
+        with sanitize.activate(sanitize.Sanitizer(["transfer"])):
+            assert sanitize.barrier_stamp("s", 0, "sha", []) is None
+        # active and armed: the seams delegate
+        with sanitize.activate(sanitize.Sanitizer(["divergence"])) as san:
+            stamp = sanitize.barrier_stamp("s", 0, "sha", ["k"])
+            assert stamp is not None and stamp["seq"] == 1
+            assert san.divergence_stamps == 1
 
 
 # ---------------------------------------------------------------------------

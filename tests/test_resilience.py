@@ -190,6 +190,58 @@ class TestAtomicWrite:
         assert json.load(open(path)) == {"a": 2}
 
 
+class TestFsListing:
+    """The shared SH301 helpers every artifact-reading glob now routes
+    through (shifu_tpu/fs/listing.py): listings must come back in one
+    deterministic order on every host, no matter what readdir says."""
+
+    def test_sorted_glob_is_sorted(self, tmp_path):
+        from shifu_tpu.fs.listing import sorted_glob
+
+        for name in ("part-h002.npz", "part-h000.npz", "part-h001.npz"):
+            (tmp_path / name).write_bytes(b"x")
+        hits = sorted_glob(str(tmp_path / "part-*.npz"))
+        assert [os.path.basename(h) for h in hits] == [
+            "part-h000.npz", "part-h001.npz", "part-h002.npz"]
+        assert hits == sorted(hits)
+
+    def test_sorted_glob_recursive(self, tmp_path):
+        from shifu_tpu.fs.listing import sorted_glob
+
+        (tmp_path / "b" / "deep").mkdir(parents=True)
+        (tmp_path / "a").mkdir()
+        (tmp_path / "b" / "deep" / "z.ckpt").write_bytes(b"x")
+        (tmp_path / "a" / "a.ckpt").write_bytes(b"x")
+        hits = sorted_glob(str(tmp_path / "**" / "*.ckpt"),
+                           recursive=True)
+        assert [os.path.basename(h) for h in hits] == ["a.ckpt", "z.ckpt"]
+
+    def test_sorted_listdir(self, tmp_path):
+        from shifu_tpu.fs.listing import sorted_listdir
+
+        for name in ("c", "a", "b"):
+            (tmp_path / name).write_bytes(b"x")
+        assert sorted_listdir(str(tmp_path)) == ["a", "b", "c"]
+
+    def test_clear_and_list_resumable_ride_the_helper(self, tmp_path):
+        """Regression for the ShardedStreamCheckpoint.clear()/
+        list_resumable raw-glob sites: both must enumerate the family
+        deterministically (and clear must still remove every file)."""
+        root = str(tmp_path)
+        ck = ckpt_mod.ShardedStreamCheckpoint(
+            ckpt_mod.ckpt_path(root, "stats", "stream"), "sha", 2, every=1)
+        ck.save([(0, None, {"ci": 0}, None), (1, None, {"ci": 1}, None)],
+                (None, {"phase": "p"}, None))
+        names = [e["name"] for e in ckpt_mod.list_resumable(root)]
+        assert names == sorted(names) and names
+        ck.clear()
+        assert ckpt_mod.list_resumable(root) == []
+        leftovers = [p for p in os.listdir(
+            os.path.dirname(ckpt_mod.ckpt_path(root, "stats", "stream")))
+            if p.endswith(ckpt_mod.CKPT_SUFFIX)]
+        assert leftovers == []
+
+
 class TestStreamCheckpoint:
     def test_config_sha_mismatch_rejects(self, tmp_path):
         path = str(tmp_path / "s.ckpt.npz")

@@ -702,6 +702,149 @@ class TestHostSyncBarrier:
         assert os.path.exists(hostsync.part_path(root, "s", 1))
 
 
+class TestDivergenceBarrier:
+    """-Dshifu.sanitize=divergence armed end-to-end at the hostsync
+    merge barrier (two thread-hosts under the one process-global
+    sanitizer — the seq counter is keyed per (step, host) exactly so
+    this topology works)."""
+
+    def _read_header(self, path):
+        import json
+
+        from shifu_tpu.parallel import hostsync
+
+        with np.load(path) as z:
+            return json.loads(bytes(z[hostsync.META_KEY].tobytes())
+                              .decode())
+
+    def test_armed_two_host_merge_clean_and_stamped(self, tmp_path):
+        from shifu_tpu.analysis import sanitize
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.parallel import hostsync
+
+        root, sha = str(tmp_path), "feed" * 10
+        san = sanitize.Sanitizer(["divergence"])
+
+        def host(h):
+            plan = HostPlan(n_hosts=2, host_index=h)
+            hostsync.publish_part(
+                root, "stats", plan, sha,
+                arrays={"acc": np.full(3, h, np.float64)},
+                meta={"nRows": 10 + h})
+            parts = hostsync.await_parts(root, "stats", plan, sha,
+                                         timeout_ms=60000)
+            assert [p[1]["nRows"] for p in parts] == [10, 11]
+
+        with sanitize.activate(san):
+            _run_hosts(host)
+        v = san.verdict()["divergence"]
+        assert san.verdict()["clean"] is True
+        assert v["stampsPublished"] == 2 and v["barriersChecked"] == 2
+        assert v["trips"] == 0
+        # the stamps really rode the part headers, identical digests
+        h0 = self._read_header(hostsync.part_path(root, "stats", 0))
+        h1 = self._read_header(hostsync.part_path(root, "stats", 1))
+        assert h0["sanitize"]["seq"] == h1["sanitize"]["seq"] == 1
+        assert h0["sanitize"]["digest"] == h1["sanitize"]["digest"]
+
+    def test_unarmed_parts_carry_no_stamp(self, tmp_path):
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.parallel import hostsync
+
+        root = str(tmp_path)
+        hostsync.publish_part(root, "s", HostPlan(n_hosts=1, host_index=0),
+                              "sha", arrays={"x": np.zeros(1)})
+        assert "sanitize" not in self._read_header(
+            hostsync.part_path(root, "s", 0))
+
+    def test_corrupted_peer_digest_refuses_merge_with_named_verdict(
+            self, tmp_path):
+        """The injected-divergence drill: one host's stamp digest is
+        corrupted on disk; the awaiting peer must raise the NAMED
+        DivergenceError (no silent merge) and the verdict must carry
+        the trip."""
+        import io
+        import json
+
+        from shifu_tpu.analysis import sanitize
+        from shifu_tpu.data.pipeline import HostPlan
+        from shifu_tpu.parallel import hostsync
+
+        root, sha = str(tmp_path), "dead" * 10
+        san = sanitize.Sanitizer(["divergence"])
+        with sanitize.activate(san):
+            for h in (0, 1):
+                hostsync.publish_part(
+                    root, "stats", HostPlan(n_hosts=2, host_index=h),
+                    sha, arrays={"acc": np.full(3, h, np.float64)})
+            # corrupt host 1's stamp in place (what a fleet running a
+            # different merge would have published)
+            path = hostsync.part_path(root, "stats", 1)
+            with np.load(path) as z:
+                payload = {k: z[k] for k in z.files}
+            header = json.loads(
+                bytes(payload[hostsync.META_KEY].tobytes()).decode())
+            header["sanitize"]["digest"] = "deadbeefdeadbeef"
+            payload[hostsync.META_KEY] = np.frombuffer(
+                json.dumps(header, sort_keys=True).encode("utf-8"),
+                dtype=np.uint8)
+            buf = io.BytesIO()
+            np.savez(buf, **payload)
+            with open(path, "wb") as fh:
+                fh.write(buf.getvalue())
+            with pytest.raises(sanitize.DivergenceError,
+                               match="host 1 diverged from host 0 — "
+                                     "digest mismatch"):
+                hostsync.await_parts(
+                    root, "stats", HostPlan(n_hosts=2, host_index=0),
+                    sha, timeout_ms=5000)
+        v = san.verdict()
+        assert v["clean"] is False
+        assert v["divergence"]["trips"] == 1
+        (ev,) = [e for e in v["events"]
+                 if e["kind"] == "divergence.trips"]
+        assert ev["stage"] == "stats"
+
+    def test_window_folds_leave_a_digest_trail(self):
+        """Single-process determinism trail: the data pipeline's window
+        folds are digested into the verdict while armed — and the trail
+        is reproducible run-over-run on the same stream."""
+        import jax.numpy as jnp
+
+        from shifu_tpu.analysis import sanitize
+        from shifu_tpu.data.pipeline import DeviceAccumulator
+        from shifu_tpu.ops.binagg import bin_aggregate_jit
+
+        def stream():
+            rng = np.random.default_rng(7)
+            san = sanitize.Sanitizer(["divergence"])
+            with sanitize.activate(san):
+                acc = DeviceAccumulator(flush_rows=100)
+                for _ in range(3):
+                    n = 64
+                    codes = rng.integers(0, 3, (n, 1)).astype(np.int32)
+                    tags = rng.integers(0, 2, n).astype(np.int32)
+                    vals = rng.normal(size=(n, 1)).astype(np.float32)
+                    agg = bin_aggregate_jit(
+                        jnp.asarray(codes),
+                        jnp.asarray(np.zeros(1, np.int32)), 3,
+                        jnp.asarray(tags),
+                        jnp.asarray(np.ones(n, np.float32)),
+                        jnp.asarray(vals))
+                    acc.add(agg, rows=n)
+                acc.fetch()
+            return san.verdict()["divergence"]
+
+        a, b = stream(), stream()
+        assert a["foldsRecorded"] >= 2  # flush_rows=100 forces windows
+        assert all(f["stage"] == "pipeline.window"
+                   for f in a["foldDigests"])
+        assert [f["seq"] for f in a["foldDigests"]] == \
+            list(range(1, len(a["foldDigests"]) + 1))
+        # determinism: the same stream leaves the same trail
+        assert a["foldDigests"] == b["foldDigests"]
+
+
 class TestHostCheckpointFamilies:
     def _family(self, base, **kw):
         from shifu_tpu.resilience.checkpoint import ShardedStreamCheckpoint
