@@ -127,6 +127,20 @@ CONTINUOUS = dict(n=40_000, d=30, hidden=[50], epochs=60, shift=0.35,
                            append=5, depth=6),
                   serve=dict(cols=20, hidden=[50], bins=16, requests=960,
                              concurrency=8, queue_depth=256))
+# coresident_loop: the co-resident retrainer (coresident/trainer.py)
+# running as a background HBM-ledger tenant ON the serving fleet's
+# forced-8-device harness while closed-loop traffic scores. Gated:
+# serve p99 with the trainer resident <= 1.2x solo-serve p99 (min over
+# passes on both sides — a host load spike must not masquerade as
+# co-residency cost), and evict -> resume bit-identity of the final
+# weights (the PR-7 chaos contract, on the same forced devices the
+# production path uses). epochs-to-target is recorded, not gated.
+CORESIDENT = dict(cols=8, serve_hidden=64, bags=2, rows=256, replicas=2,
+                  concurrency=4, per_thread=12, reps=2,
+                  train_rows=4096, train_cols=16, train_hidden=(16,),
+                  train_shards=4, stages=2, microbatches=2, epochs=30,
+                  throttle_ms=10, ckpt_epochs=6, evict_epoch=3,
+                  p99_ceiling=1.2)
 # sharded_stats sweeps FORCED host-device counts in subprocesses (the
 # device count must be fixed before jax initializes), measuring the
 # sharded lifecycle fold's work division and sync budget. CPU-harness
@@ -1491,6 +1505,266 @@ def bench_serve_fleet():
     return out
 
 
+def _coresident_loop_child() -> None:
+    """Entry for `bench.py --coresident-loop-child`: one forced-8-device
+    measurement of co-resident retraining as a serving-fleet tenant.
+    Prints ONE JSON line: solo-serve p99, co-serve p99 with the
+    pipeline trainer resident on the same devices, epochs-to-target,
+    and the evict -> resume bit-identity verdict."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from shifu_tpu.coresident import (
+        CoresidentConfig,
+        EvictedError,
+        GrantFullError,
+        LocalGrant,
+        train_nn_coresident,
+    )
+    from shifu_tpu.models.nn import NNModelSpec, flatten_params, init_params
+    from shifu_tpu.norm.dataset import write_normalized
+    from shifu_tpu.serve.fleet import ReplicaFleet
+    from shifu_tpu.serve.registry import records_to_columnar
+    from shifu_tpu.train.nn_trainer import NNTrainConfig
+
+    spec = CORESIDENT
+    cols = [f"c{k}" for k in range(spec["cols"])]
+    sizes = [spec["cols"], spec["serve_hidden"], 1]
+    tmp = tempfile.mkdtemp(prefix="bench-coresident-")
+    models = os.path.join(tmp, "models")
+    os.makedirs(models)
+    for b in range(spec["bags"]):
+        norm_specs = [
+            {"name": c, "kind": "value", "outNames": [c], "mean": 0.0,
+             "std": 1.0, "fill": 0.0, "zscore": True} for c in cols]
+        NNModelSpec(layer_sizes=sizes, activations=["tanh"],
+                    input_columns=cols, norm_specs=norm_specs,
+                    params=init_params(sizes, seed=b),
+                    ).save(os.path.join(models, f"model{b}.nn"))
+    rng = np.random.default_rng(0)
+    pool = []
+    for _ in range(8):
+        rows = rng.normal(size=(spec["rows"], spec["cols"]))
+        recs = [{c: f"{v:.5f}" for c, v in zip(cols, row)}
+                for row in rows]
+        pool.append(records_to_columnar(recs, cols))
+
+    # the retrain stream on disk — the co-resident trainer is always
+    # shard-streamed, so the bench feeds it the same way production does
+    n, d = spec["train_rows"], spec["train_cols"]
+    trng = np.random.default_rng(7)
+    x = trng.normal(size=(n, d)).astype(np.float32)
+    t = (x @ trng.normal(size=d) > 0).astype(np.float32)
+    data_dir = os.path.join(tmp, "norm")
+    write_normalized(data_dir, x, t, np.ones(n, np.float32),
+                     [f"f{i}" for i in range(d)],
+                     n_shards=spec["train_shards"])
+
+    fleet = ReplicaFleet.build(models, n_replicas=spec["replicas"],
+                               max_batch_rows=spec["rows"],
+                               queue_depth=64)
+    fleet.warm([spec["rows"]])
+
+    def serve_pass() -> float:
+        lat = [[] for _ in range(spec["concurrency"])]
+
+        def client(ti):
+            for k in range(spec["per_thread"]):
+                t0 = time.perf_counter()
+                fleet.submit(pool[(ti + k) % len(pool)]).wait(120)
+                lat[ti].append(time.perf_counter() - t0)
+
+        threads = [threading.Thread(target=client, args=(ti,))
+                   for ti in range(spec["concurrency"])]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        flat = np.asarray([v for ts in lat for v in ts])
+        return round(float(np.percentile(flat, 99)) * 1e3, 3)
+
+    solo = min(serve_pass() for _ in range(spec["reps"]))
+
+    # ---- co-serve: the stage pipeline resident on the SAME devices ----
+    curve = []
+    cfg = NNTrainConfig(hidden_nodes=list(spec["train_hidden"]),
+                        activations=["tanh"], propagation="R",
+                        num_epochs=spec["epochs"], valid_set_rate=0.1,
+                        seed=5)
+    cfg.checkpoint_every = 1
+    cfg.progress_cb = lambda ep, tr, va: curve.append((ep, float(tr)))
+    ccfg = CoresidentConfig(
+        stages=spec["stages"], microbatches=spec["microbatches"],
+        replicas=1, tenant="bench", throttle_ms=spec["throttle_ms"],
+        family_dir=os.path.join(tmp, "fam-serve")).resolve()
+    trainer_out = {}
+
+    def run_trainer():
+        t0 = time.perf_counter()
+        trainer_out["res"] = train_nn_coresident(
+            data_dir, cfg, ccfg=ccfg, grant=LocalGrant("bench"))
+        trainer_out["seconds"] = time.perf_counter() - t0
+
+    th = threading.Thread(target=run_trainer)
+    th.start()
+    # measure past the one-time stage-program compiles: those are
+    # admission cost, not steady-state co-residency cost
+    while len(curve) < 2 and th.is_alive():
+        time.sleep(0.05)
+    co_p99s = []
+    while th.is_alive() and len(co_p99s) < spec["reps"] + 1:
+        co_p99s.append(serve_pass())
+    th.join()
+    fleet.close(60)
+    if not co_p99s:
+        raise RuntimeError("trainer finished before any co-serve pass "
+                           "overlapped it; raise CORESIDENT['epochs']")
+    co = min(co_p99s)
+    final_tr = curve[-1][1]
+    target = final_tr * 1.05
+    epochs_to_target = next((ep for ep, tr in curve if tr <= target),
+                            curve[-1][0])
+
+    # ---- evict -> resume bit-identity on the same forced devices ----
+    def ckpt_cfg() -> NNTrainConfig:
+        c = NNTrainConfig(hidden_nodes=list(spec["train_hidden"]),
+                          activations=["tanh"], propagation="R",
+                          num_epochs=spec["ckpt_epochs"],
+                          valid_set_rate=0.1, seed=5)
+        c.checkpoint_every = 10_000  # the family still saves each epoch
+        return c
+
+    def cc(tag, **kw) -> CoresidentConfig:
+        return CoresidentConfig(
+            stages=spec["stages"], microbatches=spec["microbatches"],
+            replicas=1, tenant="bench-ckpt",
+            family_dir=os.path.join(tmp, tag), **kw).resolve()
+
+    flat_a, _ = flatten_params(train_nn_coresident(
+        data_dir, ckpt_cfg(), ccfg=cc("fam-a"),
+        grant=LocalGrant("bench-ckpt")).params)
+
+    class EvictingGrant(LocalGrant):
+        """Serving pressure at a fixed epoch: the heartbeat flags the
+        eviction and re-admission never fits (wait_ms=0 surfaces
+        EvictedError immediately, as a saturated fleet would)."""
+
+        def __init__(self, name, evict_at):
+            super().__init__(name)
+            self.evict_at = evict_at
+            self.tripped = False
+
+        def heartbeat(self, epoch):
+            if epoch >= self.evict_at:
+                self.tripped = True
+            return self.tripped
+
+        def acquire(self, nbytes):
+            if self.tripped:
+                raise GrantFullError("serving pressure", int(nbytes))
+            super().acquire(nbytes)
+
+    evicted_at = None
+    try:
+        train_nn_coresident(data_dir, ckpt_cfg(), ccfg=cc(
+            "fam-b", wait_ms=0.0), grant=EvictingGrant(
+                "bench-ckpt", spec["evict_epoch"]))
+    except EvictedError as e:
+        evicted_at = e.epoch
+    flat_b, _ = flatten_params(train_nn_coresident(
+        data_dir, ckpt_cfg(), ccfg=cc("fam-b"),
+        grant=LocalGrant("bench-ckpt"), resume=True).params)
+
+    print(json.dumps({
+        "solo_p99_ms": solo,
+        "coserve_p99_ms": co,
+        "p99_ratio": round(co / solo, 4),
+        "coserve_passes": co_p99s,
+        "epochs": curve[-1][0],
+        "trainer_seconds": round(trainer_out.get("seconds", 0.0), 2),
+        "train_error": round(final_tr, 6),
+        "epochs_to_target": int(epochs_to_target),
+        "evicted_at_epoch": evicted_at,
+        "resume_bit_identical": bool(np.array_equal(flat_a, flat_b)),
+        "backend": jax.default_backend(),
+        "cores": os.cpu_count() or 1,
+    }))
+
+
+def bench_coresident_loop():
+    """Co-resident retraining as an HBM-ledger tenant of the serving
+    fleet, on the forced-8-device harness (subprocess child — the
+    device count must be fixed before jax initializes). Gated: serve
+    p99 with the trainer resident <= 1.2x solo-serve p99, and the
+    evicted trainer resumes to bit-identical final weights."""
+    import subprocess
+
+    spec = CORESIDENT
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+        + " --xla_cpu_use_thunk_runtime=false"
+        + " --xla_cpu_multi_thread_eigen=false").strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--coresident-loop-child"],
+        env=env, capture_output=True, text=True, timeout=1800)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"coresident_loop child failed:\n{proc.stderr[-2000:]}")
+    res = json.loads(proc.stdout.strip().splitlines()[-1])
+    # like serve_fleet's efficiency floors: the p99 interference gate
+    # arms only where the harness has the cores to express
+    # co-residency — the serving replicas AND the trainer each need a
+    # core-sized compute resource, or any trainer activity steals the
+    # serving core by scheduling physics no implementation can avoid
+    # (with 1 core the ratio measures the OS scheduler, not the
+    # co-resident design). Recorded everywhere; gated where armed.
+    # The evict -> resume bit-identity gate is physics-free and is
+    # armed on every harness.
+    p99_armed = (res["backend"] != "cpu"
+                 or res["cores"] >= spec["replicas"] + spec["stages"])
+    gates = {
+        "p99_within_ceiling": (res["p99_ratio"] <= spec["p99_ceiling"]
+                               if p99_armed else True),
+        "evict_resume_bit_identical": res["resume_bit_identical"],
+    }
+    out = {
+        **res,
+        "p99_ceiling": spec["p99_ceiling"],
+        "p99_gate_armed": p99_armed,
+        "gates": gates,
+        "note": ("closed-loop scoring through a "
+                 f"{spec['replicas']}-replica forced-device fleet, "
+                 "solo vs with the K-stage pipeline retrainer resident "
+                 "as a background ledger tenant on the same devices "
+                 f"(stages={spec['stages']}, microbatches="
+                 f"{spec['microbatches']}, throttleMs="
+                 f"{spec['throttle_ms']}); p99s are min-over-passes on "
+                 "both sides so a host load spike is not booked as "
+                 "co-residency cost. The p99 <= "
+                 f"{spec['p99_ceiling']}x gate arms where the harness "
+                 "has cores for the replicas AND the trainer stages "
+                 "(accelerator backends always); a core-starved CPU "
+                 "harness records the ratio — there it measures the OS "
+                 "scheduler, not the design. epochs_to_target = first "
+                 "epoch whose train error is within 5% of the final "
+                 "error (recorded, not gated). The evict leg "
+                 f"checkpoints at epoch {spec['evict_epoch']} under "
+                 "synthetic serving pressure, resumes in a fresh run, "
+                 "and the final weights must be bit-identical to the "
+                 "uninterrupted run — gated on every harness."),
+    }
+    if not all(gates.values()):
+        raise RuntimeError(
+            f"coresident_loop gates failed: {gates} {json.dumps(res)}")
+    return out
+
+
 def bench_failover():
     """Failure-domain scenario (shifu_tpu/serve/ breaker + failover):
     closed-loop load on a 2-replica fleet while replica 1's device dies
@@ -2727,6 +3001,8 @@ def main() -> None:
             "armed": True, **ro.pop("verdict")}
     continuous_loop = _with_obs_metrics(
         bench_continuous_loop, "continuous_loop")
+    # subprocess child (forced 8 devices): sanitizer stays in the child
+    coresident_loop = bench_coresident_loop()
 
     peak, chip = chip_peak_tflops()
     nw = base["n_reference_workers"]
@@ -2864,6 +3140,7 @@ def main() -> None:
                      "vs retraining P+K; p99_on_over_off = serve p99 "
                      "cost of the fused drift fold (target <= 1.05)"),
         },
+        "coresident_loop": coresident_loop,
         "bench_seconds": round(time.perf_counter() - t_start, 1),
     }))
 
@@ -2875,5 +3152,7 @@ if __name__ == "__main__":
         _tree_sweep_child()
     elif "--serve-fleet-child" in sys.argv:
         _serve_fleet_child()
+    elif "--coresident-loop-child" in sys.argv:
+        _coresident_loop_child()
     else:
         main()

@@ -220,6 +220,25 @@ KNOBS: List[Knob] = [
     _K("shifu.serve.slo.*.target", "float", "shifu.serve.sloTarget",
        "per-tenant SLO objective override (also drives the per-tenant "
        "burn in /fleet/healthz and `shifu top`)"),
+    # ---- co-resident trainer (PR 20) ----
+    _K("shifu.coresident.stages", "int", "0 (= from the grant)",
+       "pipeline stage count K for the co-resident retrainer; 0 sizes "
+       "K from the ledger grant's free budget (plan.default_stages)"),
+    _K("shifu.coresident.microbatches", "int", "1",
+       "GPipe microbatches per shard filling the pipeline (1 = whole "
+       "shard at once; accumulation order is pinned sequential)"),
+    _K("shifu.coresident.waitMs", "float", "30000",
+       "how long an evicted co-resident trainer polls the ledger for "
+       "re-admission before giving up with EvictedError"),
+    _K("shifu.coresident.throttleMs", "float", "0 (= flat out)",
+       "host sleep between epochs — the background tenant yields its "
+       "devices to serving traffic for this long each epoch"),
+    _K("shifu.coresident.tenant", "str", "retrain",
+       "ledger tenant name the trainer registers under (its /admin and "
+       "/healthz identity, and the checkpoint family prefix)"),
+    _K("shifu.coresident.replicas", "int", "1",
+       "data-parallel pipeline replicas; per-stage gradients all-reduce "
+       "through parallel/mesh.fleet_reduce when > 1"),
     # ---- failure domains (PR 14): replica circuit breaker ----
     _K("shifu.serve.breaker.failures", "int", "3",
        "consecutive device-dispatch failures that trip a replica's "

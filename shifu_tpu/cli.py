@@ -100,6 +100,20 @@ def build_parser() -> argparse.ArgumentParser:
                                 "traffic stream "
                                 "(.shifu/runs/traffic/<SET>/ — zoo "
                                 "servers log per set)")
+    p_retrain.add_argument("--coresident", action="store_true",
+                           help="run the NN/WDL retrain as a co-resident "
+                                "background tenant of the serving "
+                                "fleet's HBM ledger: pipeline stages "
+                                "pinned per device, evictable first "
+                                "under serving pressure, resumable "
+                                "bit-identically after eviction "
+                                "(-Dshifu.coresident.* knobs)")
+    p_retrain.add_argument("--serve-url", default=None, dest="serve_url",
+                           help="with --coresident: a running server "
+                                "base URL — the trainer registers with "
+                                "THAT process's ledger via "
+                                "/admin/coresident/* instead of a "
+                                "private local grant")
     p_retrain.add_argument("--resume", action="store_true",
                            help=_RESUME_HELP)
 
@@ -472,12 +486,27 @@ def dispatch(args: argparse.Namespace) -> int:
     if cmd == "retrain":
         from shifu_tpu.processor.retrain import RetrainProcessor
 
-        return RetrainProcessor(
+        proc = RetrainProcessor(
             from_traffic=args.from_traffic, data_path=args.data_path,
             candidate_dir=args.candidate_dir,
             append_trees=args.append_trees,
             traffic_stream=args.traffic_stream or "",
-        ).run()
+            coresident=args.coresident, serve_url=args.serve_url,
+        )
+        if not args.coresident:
+            return proc.run()
+        from shifu_tpu.coresident import EvictedError
+
+        try:
+            return proc.run()
+        except EvictedError as e:
+            log.error("co-resident retrain evicted: %s", e)
+            print(f"trainer `{e.tenant}` was evicted by serving "
+                  f"pressure at epoch {e.epoch} and re-admission did "
+                  f"not land within the wait window. State is "
+                  f"checkpointed; resume bit-identically with:\n"
+                  f"  shifu retrain --coresident --resume")
+            return 3
     if cmd == "promote":
         from shifu_tpu.loop.promote import run_promote
         from shifu_tpu.processor.retrain import DEFAULT_CANDIDATE_DIR
@@ -752,14 +781,28 @@ def dispatch(args: argparse.Namespace) -> int:
             else:
                 print(f"{'STREAM':<24} {'CHUNK':>6} {'BYTES':>10} "
                       f"CONFIG-SHA")
+                coresident = False
                 for e in entries:
                     if e.get("corrupt"):
                         print(f"{e['name']:<24} {'?':>6} "
                               f"{e['bytes']:>10} (corrupt)")
+                    elif e.get("family") == "coresident":
+                        # an evicted co-resident trainer snapshot: one
+                        # aggregated row for the whole per-stage family
+                        coresident = True
+                        print(f"{e['name']:<24} {'-':>6} "
+                              f"{e['bytes']:>10} {e['configSha']} "
+                              f"(coresident epoch={e.get('epoch')} "
+                              f"stages={e.get('stages')})")
                     else:
                         print(f"{e['name']:<24} {e['chunkIndex']:>6} "
                               f"{e['bytes']:>10} {e['configSha']}")
                 print("resume with: shifu <step> --resume")
+                if coresident:
+                    print("coresident rows resume with: shifu retrain "
+                          "--coresident --resume (same stage count — a "
+                          "changed -Dshifu.coresident.stages rejects "
+                          "the snapshot and starts fresh)")
             return 0
         if args.diff:
             from shifu_tpu.obs.profile import (

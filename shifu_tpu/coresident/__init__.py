@@ -1,0 +1,51 @@
+"""Co-resident preemptible trainer: retrain as an HBM-ledger tenant.
+
+The continuous loop (PR 9) still needed 2x hardware at steady state —
+`shifu retrain` shared the host with the serving fleet but never the
+chips. The reference got co-residency for free: Guagua BSP training ran
+*inside* the shared Hadoop cluster and MapReduce's scheduler preempted
+it under serving pressure (PAPER.md). This package is the TPU rebuild's
+equivalent, with the PR-15 `HbmLedger` as the admission authority:
+
+  plan.py      stage partitioning — split the NN/WDL step program into
+               K contiguous layer groups (MPMD pipeline parallelism),
+               each a separately compiled program pinned to one device.
+  pipeline.py  the per-stage jitted forward/backward programs; stage
+               boundaries carry f32 activations (bf16 lives only inside
+               matmuls, the PR-11 precision policy) and backward
+               rematerializes the forward inside one jit (GPipe).
+  tenant.py    the grant protocol — the trainer is a `background`
+               ledger tenant: bytes acquired BEFORE every device_put,
+               evictable strictly-first under serving pressure, never
+               the other way around.
+  trainer.py   the epoch loops. `stages=1, microbatches=1` is
+               bit-identical to train_nn_streamed / train_wdl_streamed
+               (the PR-8/PR-11 parity discipline); eviction checkpoints
+               through a ShardedStreamCheckpoint family (per-stage
+               parts) and resume is bit-identical to an uninterrupted
+               run (the PR-7 contract).
+"""
+
+from shifu_tpu.coresident.config import CoresidentConfig
+from shifu_tpu.coresident.tenant import (
+    EvictedError,
+    GrantFullError,
+    HttpGrant,
+    LocalGrant,
+    ZooGrant,
+)
+from shifu_tpu.coresident.trainer import (
+    train_nn_coresident,
+    train_wdl_coresident,
+)
+
+__all__ = [
+    "CoresidentConfig",
+    "EvictedError",
+    "GrantFullError",
+    "HttpGrant",
+    "LocalGrant",
+    "ZooGrant",
+    "train_nn_coresident",
+    "train_wdl_coresident",
+]

@@ -1,0 +1,926 @@
+"""Co-resident epoch loops: NN/WDL retraining as a background tenant.
+
+Two execution shapes behind one loop:
+
+  stages=1          the DEGENERATE path — it calls the exact same
+                    compiled shard program as train/streaming.py (same
+                    module cache entry) and folds gradients in the same
+                    order, so `stages=1, microbatches=1` is
+                    BIT-IDENTICAL to `train_nn_streamed` /
+                    `train_wdl_streamed` (pinned in
+                    tests/test_coresident_parity.py). microbatches>1
+                    slices each shard into M row groups and folds them
+                    SEQUENTIALLY in m order (no pairwise-reduction
+                    drift — the `_score_existing` discipline).
+  stages=K>=2       the MPMD pipeline: per-stage programs pinned to
+                    granted devices by committed-input placement,
+                    boundary activations forwarded stage-to-stage (f32,
+                    PR-11 policy), backward rematerialized per stage,
+                    per-stage gradients folded sequentially per
+                    microbatch then per shard. With
+                    `-Dshifu.coresident.replicas=R` > 1 the shard list
+                    partitions round-robin over R pipeline replicas and
+                    the per-stage epoch gradients all-reduce through
+                    `parallel/mesh.fleet_reduce` (the DrJAX shape: the
+                    trainer's reduce rides the serving fleet's
+                    collective substrate).
+
+Ledger discipline: every host-counted buffer is grant-acquired BEFORE
+its device_put; after the first epoch the compiled programs'
+`fn_memory` numbers true the charge up (the serving-tenant two-step).
+Eviction (grant heartbeat) checkpoints through a
+`ShardedStreamCheckpoint` family (one part per STAGE, stamped
+`part_kind="stages"`), releases every buffer and charge, then polls for
+re-admission — resume is bit-identical to an uninterrupted run at any
+epoch boundary (the PR-7 contract).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from shifu_tpu.analysis import sanitize
+from shifu_tpu.coresident.config import CoresidentConfig
+from shifu_tpu.coresident.plan import (
+    StagePlan,
+    default_stages,
+    nn_plan,
+    wdl_plan,
+)
+from shifu_tpu.coresident.pipeline import (
+    make_nn_stage_programs,
+    make_wdl_stage_programs,
+)
+from shifu_tpu.coresident.tenant import EvictedError, Grant, LocalGrant
+from shifu_tpu.obs import profile
+from shifu_tpu.train.nn_trainer import NNTrainConfig, TrainResult
+from shifu_tpu.train.updaters import make_updater
+from shifu_tpu.utils.log import get_logger
+
+log = get_logger(__name__)
+
+F32 = 4
+
+
+def _opt_leaves(init_state) -> int:
+    from jax import tree_util as jtu
+
+    return len(jtu.tree_flatten(init_state(1))[0])
+
+
+def _microbatches(arrs, m: int):
+    """Split row-aligned host arrays into m equal microbatches (zero-
+    padded tail rows carry zero significance, so they contribute
+    nothing to gradients or error sums)."""
+    rows = int(arrs[0].shape[0])
+    mb = -(-rows // m)
+    pad = mb * m - rows
+    padded = []
+    for a in arrs:
+        if pad:
+            a = np.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1))
+        padded.append(a)
+    return [tuple(a[i * mb:(i + 1) * mb] for a in padded)
+            for i in range(m)], mb
+
+
+def _family_checkpoint(root: str, family: str, sha: str, sections,
+                       n_stages: int):
+    from shifu_tpu.resilience import checkpoint as ckpt_mod
+
+    base = ckpt_mod.ckpt_base(root, "coresident", family)
+    return ckpt_mod.ShardedStreamCheckpoint(
+        base, sha, n_shards=n_stages, every=0, sections=sections,
+        part_kind="stages")
+
+
+def _stage_devices(k: int, replicas: int):
+    """Stage device map: replica r's stage s -> jax.devices()[(r*K+s) %
+    ndev]. Deterministic, and on a forced-8-device CI fleet a K=2 R=1
+    trainer occupies exactly two of the serving fleet's devices."""
+    import jax
+
+    devs = jax.devices()
+    return [[devs[(r * k + s) % len(devs)] for s in range(k)]
+            for r in range(replicas)]
+
+
+class _SingleExec:
+    """stages=1: the monolithic shard program (shared with the streamed
+    trainers — same cache entry, bit-identical math)."""
+
+    def __init__(self, kind: str, cfg, feed, flat0: np.ndarray,
+                 prog, updater, grant: Grant, microbatches: int,
+                 seam: str) -> None:
+        import jax.numpy as jnp
+
+        self.kind = kind
+        self.cfg = cfg
+        self.feed = feed
+        self.prog = prog
+        self.init_state, self.apply_update = updater
+        self.m = max(1, int(microbatches))
+        self.seam = seam
+        self.grant = grant
+        leaves = _opt_leaves(self.init_state)
+        shard_cols = self._shard_bytes_per_row()
+        self._act_estimate = 2 * feed.pad_rows * shard_cols
+        self.total_bytes = (flat0.nbytes * (1 + leaves)
+                            + self._act_estimate)
+        # the invariant: acquired BEFORE the device_put below
+        grant.acquire(self.total_bytes)
+        self.flat = jnp.asarray(flat0)
+        self.opt = self.init_state(flat0.size)
+        self.nts = jnp.float32(feed.n_train_size)
+        self._g = None
+
+    def _shard_bytes_per_row(self) -> int:
+        if self.kind == "nn":
+            return (len(self.feed.meta.columns) + 3) * F32
+        return (len(self.feed.num_idx) + len(self.feed.cat_idx) + 3) * F32
+
+    # ---- epoch ----
+    def epoch_grads(self, key, tclass):
+        import jax
+        import jax.numpy as jnp
+
+        g_sum = tr_sum = va_sum = tr_w = va_w = None
+
+        def fold(parts):
+            nonlocal g_sum, tr_sum, va_sum, tr_w, va_w
+            g, trs, vas, trw, vaw = parts
+            if g_sum is None:
+                g_sum, tr_sum, va_sum, tr_w, va_w = g, trs, vas, trw, vaw
+            else:
+                g_sum = g_sum + g
+                tr_sum, va_sum = tr_sum + trs, va_sum + vas
+                tr_w, va_w = tr_w + trw, va_w + vaw
+
+        if self.m == 1:
+            # the parity path: identical iteration, seam names and fold
+            # order to train_nn_streamed / train_wdl_streamed
+            for s, arrs in enumerate(self.feed):
+                args = self._prog_args(arrs, key, s, tclass)
+                with sanitize.transfer_free(self.seam):
+                    fold(profile.dispatch(self.seam, self.prog,
+                                          self.flat, *args, sync=False))
+        else:
+            for s in range(self.feed.n_shards):
+                host = self.feed._load_host(s)
+                mbs, _rows = _microbatches(host, self.m)
+                for chunk in mbs:  # SEQUENTIAL m order — pinned
+                    dev = tuple(jax.device_put(a) for a in chunk)
+                    args = self._prog_args(dev, key, s, tclass)
+                    with sanitize.transfer_free(self.seam):
+                        fold(profile.dispatch(
+                            f"coresident.{self.kind}.mb", self.prog,
+                            self.flat, *args, sync=False))
+        self._g = g_sum
+        tr_e = float(tr_sum / jnp.maximum(tr_w, 1.0))
+        va_e = float(va_sum / jnp.maximum(va_w, 1.0))
+        return tr_e, va_e
+
+    def _prog_args(self, arrs, key, s, tclass):
+        if self.kind == "nn":
+            import jax
+
+            x, t, sig_t, sig_v = arrs
+            return (x, t, sig_t, sig_v, jax.random.fold_in(key, s),
+                    tclass)
+        return arrs  # wdl: (dense, codes, t, sig_t, sig_v)
+
+    def apply(self, lr: float, it: int) -> None:
+        import jax.numpy as jnp
+
+        self.flat, self.opt = self.apply_update(
+            self.opt, self.flat, self._g, jnp.float32(lr),
+            jnp.int32(it), self.nts)
+
+    # ---- state ----
+    def full_flat(self) -> np.ndarray:
+        return np.asarray(self.flat)
+
+    def stage_arrays(self) -> List[dict]:
+        from jax import tree_util as jtu
+
+        leaves, _ = jtu.tree_flatten(self.opt)
+        arrays = {"flat": np.asarray(self.flat)}
+        arrays.update({f"opt{i}": np.asarray(leaf)
+                       for i, leaf in enumerate(leaves)})
+        return [arrays]
+
+    def restore(self, per_stage: List[dict]) -> None:
+        import jax.numpy as jnp
+        from jax import tree_util as jtu
+
+        arrays = per_stage[0]
+        self.flat = jnp.asarray(arrays["flat"])
+        if self.opt is None:  # restoring after drop(): rebuild the tree
+            self.opt = self.init_state(int(arrays["flat"].size))
+        leaves, treedef = jtu.tree_flatten(self.opt)
+        self.opt = jtu.tree_unflatten(
+            treedef, [jnp.asarray(arrays[f"opt{i}"])
+                      for i in range(len(leaves))])
+
+    def true_up(self) -> None:
+        measured = sum(
+            e["tempOutBytes"]
+            for nm in (self.seam, f"coresident.{self.kind}.mb")
+            for e in profile.fn_memory(nm, self.prog))
+        extra = int(measured) - self._act_estimate
+        if extra > 0:
+            self.grant.acquire(extra)
+            self.total_bytes += extra
+            self._act_estimate += extra
+
+    def drop(self) -> List[dict]:
+        state = self.stage_arrays()
+        self.flat = None
+        self.opt = None
+        self._g = None
+        return state
+
+    def replace(self, per_stage: List[dict]) -> None:
+        # re-admission already re-acquired total_bytes — device_puts
+        # land inside the held charge
+        self.restore(per_stage)
+
+
+class _PipelineExec:
+    """stages>=2: per-stage programs on per-stage devices, GPipe
+    microbatching, optional data-parallel replicas riding
+    fleet_reduce."""
+
+    def __init__(self, kind: str, cfg, feed, flat0: np.ndarray,
+                 plan: StagePlan, progs, updater, grant: Grant,
+                 microbatches: int, replicas: int) -> None:
+        self.kind = kind
+        self.cfg = cfg
+        self.feed = feed
+        self.plan = plan
+        self.progs = progs
+        self.init_state, self.apply_update = updater
+        self.k = plan.n_stages
+        self.m = max(1, int(microbatches))
+        self.r = max(1, int(replicas))
+        self.grant = grant
+        self.devices = _stage_devices(self.k, self.r)
+        self.leaves = _opt_leaves(self.init_state)
+        self.mb_rows = -(-feed.pad_rows // self.m)
+        self.nts = float(feed.n_train_size)
+        self._slices = [np.asarray(flat0[s.lo:s.hi], np.float32)
+                        for s in plan.stages]
+        self._act_estimate = sum(
+            plan.resident_bytes(k, 0, self.mb_rows) - plan.param_bytes(k)
+            for k in range(self.k)) * self.r
+        self.total_bytes = 0
+        self.flats: List[list] = []
+        self.opts: List[list] = []
+        self._place([{"flat": s} for s in self._slices], fresh_opt=True)
+        self._g: Optional[List] = None
+
+    # ---- placement / ledger ----
+    def _place(self, per_stage: List[dict], fresh_opt: bool) -> None:
+        import jax
+        import jax.numpy as jnp
+        from jax import tree_util as jtu
+
+        self.flats = [[None] * self.k for _ in range(self.r)]
+        self.opts = [[None] * self.k for _ in range(self.r)]
+        for r in range(self.r):
+            for k in range(self.k):
+                dev = self.devices[r][k]
+                ask = self.plan.resident_bytes(
+                    k, self.leaves, self.mb_rows)
+                # acquired BEFORE the device_put — the serving-tenant
+                # invariant, per stage per replica
+                self.grant.acquire(ask)
+                self.total_bytes += ask
+                flat_k = np.asarray(per_stage[k]["flat"], np.float32)
+                self.flats[r][k] = jax.device_put(flat_k, dev)
+                if fresh_opt:
+                    opt = self.init_state(flat_k.size)
+                    leaves, treedef = jtu.tree_flatten(opt)
+                    self.opts[r][k] = jtu.tree_unflatten(
+                        treedef, [jax.device_put(jnp.asarray(le), dev)
+                                  for le in leaves])
+                else:
+                    opt = self.init_state(flat_k.size)
+                    leaves, treedef = jtu.tree_flatten(opt)
+                    self.opts[r][k] = jtu.tree_unflatten(
+                        treedef,
+                        [jax.device_put(
+                            np.asarray(per_stage[k][f"opt{i}"]), dev)
+                         for i in range(len(leaves))])
+
+    # ---- epoch ----
+    def epoch_grads(self, key, tclass):
+        import jax
+        import jax.numpy as jnp
+
+        g = [[None] * self.k for _ in range(self.r)]
+        met = [None] * self.r  # (tr_sum, va_sum, tr_w, va_w) on device
+        for s in range(self.feed.n_shards):
+            r = s % self.r
+            host = self.feed._load_host(s)
+            mbs, _rows = _microbatches(host, self.m)
+            for chunk in mbs:  # SEQUENTIAL m order — pinned
+                parts = self._one_microbatch(r, chunk, tclass)
+                gs, metrics = parts
+                for k in range(self.k):
+                    g[r][k] = (gs[k] if g[r][k] is None
+                               else g[r][k] + gs[k])
+                met[r] = (metrics if met[r] is None else
+                          tuple(a + b for a, b in zip(met[r], metrics)))
+        if self.r == 1:
+            self._g = [g[0]]
+            tr_sum, va_sum, tr_w, va_w = met[0]
+            tr_e = float(tr_sum / jnp.maximum(tr_w, 1.0))
+            va_e = float(va_sum / jnp.maximum(va_w, 1.0))
+            return tr_e, va_e
+        # data-parallel replicas: per-stage epoch gradients (and the
+        # metric sums) all-reduce through the serving fleet's collective
+        from shifu_tpu.parallel.mesh import fleet_mesh, fleet_reduce
+
+        mesh = fleet_mesh(self.r)
+        reduced = []
+        for k in range(self.k):
+            parts = np.stack([np.asarray(g[r][k]) for r in range(self.r)])
+            reduced.append(
+                fleet_reduce(mesh, parts).astype(np.float32))
+        mparts = np.stack([
+            np.asarray([float(v) for v in met[r]], np.float32)
+            for r in range(self.r)])
+        msum = fleet_reduce(mesh, mparts)
+        self._g = [[jax.device_put(reduced[k], self.devices[r][k])
+                    for k in range(self.k)] for r in range(self.r)]
+        tr_e = float(msum[0] / max(msum[2], 1.0))
+        va_e = float(msum[1] / max(msum[3], 1.0))
+        return tr_e, va_e
+
+    def _one_microbatch(self, r: int, chunk, tclass):
+        import jax
+
+        devs = self.devices[r]
+        if self.kind == "nn":
+            x, t, sig_t, sig_v = chunk
+            h = jax.device_put(np.asarray(x, np.float32), devs[0])
+            bounds = [h]
+            for k in range(self.k - 1):
+                with sanitize.transfer_free(f"coresident.nn.s{k}"):
+                    h = profile.dispatch(
+                        f"coresident.nn.s{k}", self.progs["fwd"][k],
+                        self.flats[r][k], h, sync=False)
+                h = jax.device_put(h, devs[k + 1])  # the boundary hop
+                bounds.append(h)
+            last = devs[self.k - 1]
+            td = jax.device_put(np.asarray(t, np.float32), last)
+            std = jax.device_put(np.asarray(sig_t, np.float32), last)
+            svd = jax.device_put(np.asarray(sig_v, np.float32), last)
+            tcd = jax.device_put(np.int32(tclass), last)
+            with sanitize.transfer_free("coresident.nn.head"):
+                g_last, cot, trs, vas, trw, vaw = profile.dispatch(
+                    "coresident.nn.head", self.progs["head"],
+                    self.flats[r][self.k - 1], h, td, std, svd, tcd,
+                    sync=False)
+            gs = [None] * self.k
+            gs[self.k - 1] = g_last
+            for k in range(self.k - 2, -1, -1):
+                cot = jax.device_put(cot, devs[k])
+                with sanitize.transfer_free(f"coresident.nn.b{k}"):
+                    gs[k], cot = profile.dispatch(
+                        f"coresident.nn.b{k}", self.progs["bwd"][k],
+                        self.flats[r][k], bounds[k], cot, sync=False)
+            return gs, (trs, vas, trw, vaw)
+        # ---- wdl: the carry is (deep activation, wide logit) ----
+        dense, codes, t, sig_t, sig_v = chunk
+        dd = jax.device_put(np.asarray(dense, np.float32), devs[0])
+        cd = jax.device_put(np.asarray(codes, np.int32), devs[0])
+        with sanitize.transfer_free("coresident.wdl.s0"):
+            h, wl = profile.dispatch(
+                "coresident.wdl.s0", self.progs["first_fwd"],
+                self.flats[r][0], dd, cd, sync=False)
+        bounds = [None]
+        for k in range(1, self.k - 1):
+            h = jax.device_put(h, devs[k])
+            wl = jax.device_put(wl, devs[k])
+            bounds.append((h, wl))
+            with sanitize.transfer_free(f"coresident.wdl.s{k}"):
+                h, wl = profile.dispatch(
+                    f"coresident.wdl.s{k}",
+                    self.progs["mid_fwd"][k - 1],
+                    self.flats[r][k], h, wl, sync=False)
+        last = devs[self.k - 1]
+        h = jax.device_put(h, last)
+        wl = jax.device_put(wl, last)
+        td = jax.device_put(np.asarray(t, np.float32), last)
+        std = jax.device_put(np.asarray(sig_t, np.float32), last)
+        svd = jax.device_put(np.asarray(sig_v, np.float32), last)
+        with sanitize.transfer_free("coresident.wdl.head"):
+            g_last, cot_h, cot_wl, trs, vas, trw, vaw = profile.dispatch(
+                "coresident.wdl.head", self.progs["head"],
+                self.flats[r][self.k - 1], h, wl, td, std, svd,
+                sync=False)
+        gs = [None] * self.k
+        gs[self.k - 1] = g_last
+        for k in range(self.k - 2, 0, -1):
+            cot_h = jax.device_put(cot_h, devs[k])
+            cot_wl = jax.device_put(cot_wl, devs[k])
+            hb, wlb = bounds[k]
+            with sanitize.transfer_free(f"coresident.wdl.b{k}"):
+                gs[k], cot_h, cot_wl = profile.dispatch(
+                    f"coresident.wdl.b{k}",
+                    self.progs["mid_bwd"][k - 1],
+                    self.flats[r][k], hb, wlb, cot_h, cot_wl,
+                    sync=False)
+        cot_h = jax.device_put(cot_h, devs[0])
+        cot_wl = jax.device_put(cot_wl, devs[0])
+        with sanitize.transfer_free("coresident.wdl.b0"):
+            gs[0] = profile.dispatch(
+                "coresident.wdl.b0", self.progs["first_bwd"],
+                self.flats[r][0], dd, cd, cot_h, cot_wl, sync=False)
+        return gs, (trs, vas, trw, vaw)
+
+    def apply(self, lr: float, it: int) -> None:
+        import jax.numpy as jnp
+
+        for r in range(self.r):
+            for k in range(self.k):
+                # elementwise update rules: per-slice updates on the
+                # stage device concatenate bit-identically to the
+                # full-vector update
+                self.flats[r][k], self.opts[r][k] = self.apply_update(
+                    self.opts[r][k], self.flats[r][k], self._g[r][k],
+                    jnp.float32(lr), jnp.int32(it),
+                    jnp.float32(self.nts))
+
+    # ---- state ----
+    def full_flat(self) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(self.flats[0][k]) for k in range(self.k)])
+
+    def stage_arrays(self) -> List[dict]:
+        from jax import tree_util as jtu
+
+        out = []
+        for k in range(self.k):
+            leaves, _ = jtu.tree_flatten(self.opts[0][k])
+            arrays = {"flat": np.asarray(self.flats[0][k])}
+            arrays.update({f"opt{i}": np.asarray(le)
+                           for i, le in enumerate(leaves)})
+            out.append(arrays)
+        return out
+
+    def restore(self, per_stage: List[dict]) -> None:
+        import jax
+        from jax import tree_util as jtu
+
+        for r in range(self.r):
+            for k in range(self.k):
+                dev = self.devices[r][k]
+                self.flats[r][k] = jax.device_put(
+                    np.asarray(per_stage[k]["flat"], np.float32), dev)
+                leaves, treedef = jtu.tree_flatten(self.opts[r][k])
+                self.opts[r][k] = jtu.tree_unflatten(
+                    treedef,
+                    [jax.device_put(
+                        np.asarray(per_stage[k][f"opt{i}"]), dev)
+                     for i in range(len(leaves))])
+
+    def true_up(self) -> None:
+        names = []
+        if self.kind == "nn":
+            names = ([(f"coresident.nn.s{k}", self.progs["fwd"][k])
+                      for k in range(self.k - 1)]
+                     + [(f"coresident.nn.b{k}", self.progs["bwd"][k])
+                        for k in range(self.k - 1)]
+                     + [("coresident.nn.head", self.progs["head"])])
+        else:
+            names = ([("coresident.wdl.s0", self.progs["first_fwd"]),
+                      ("coresident.wdl.b0", self.progs["first_bwd"]),
+                      ("coresident.wdl.head", self.progs["head"])]
+                     + [(f"coresident.wdl.s{k}",
+                         self.progs["mid_fwd"][k - 1])
+                        for k in range(1, self.k - 1)]
+                     + [(f"coresident.wdl.b{k}",
+                         self.progs["mid_bwd"][k - 1])
+                        for k in range(1, self.k - 1)])
+        measured = sum(e["tempOutBytes"] for nm, fn in names
+                       for e in profile.fn_memory(nm, fn)) * self.r
+        extra = int(measured) - self._act_estimate
+        if extra > 0:
+            self.grant.acquire(extra)
+            self.total_bytes += extra
+            self._act_estimate += extra
+
+    def drop(self) -> List[dict]:
+        state = self.stage_arrays()
+        self.flats = []
+        self.opts = []
+        self._g = None
+        return state
+
+    def replace(self, per_stage: List[dict]) -> None:
+        # the wait_readmit acquire holds total_bytes already: rebuild
+        # the placement without double-charging
+        held, self.total_bytes = self.total_bytes, 0
+        grant, self.grant = self.grant, _PrepaidGrant(held)
+        try:
+            self._place(per_stage, fresh_opt=True)
+            self.restore(per_stage)
+        finally:
+            self.grant = grant
+            self.total_bytes = held
+
+
+class _PrepaidGrant(Grant):
+    """Placement-time stand-in after wait_readmit already holds the
+    whole charge: acquires are accounted against the prepaid total."""
+
+    def __init__(self, held: int) -> None:
+        self.held = int(held)
+
+    def acquire(self, nbytes: int) -> None:
+        self.held -= int(nbytes)
+        if self.held < 0:
+            raise AssertionError(
+                "re-placement asked for more bytes than re-admission "
+                "granted")
+
+
+def _make_grant(ccfg: CoresidentConfig) -> Grant:
+    if ccfg.serve_url:
+        from shifu_tpu.coresident.tenant import HttpGrant
+
+        return HttpGrant(ccfg.serve_url, ccfg.tenant)
+    return LocalGrant(ccfg.tenant)
+
+
+def _resolve_stages(ccfg: CoresidentConfig, grant: Grant,
+                    total_param_bytes: int, max_stages: int,
+                    opt_leaves: int) -> int:
+    if ccfg.stages:
+        return int(ccfg.stages)
+    k = default_stages(grant.free_bytes(), total_param_bytes,
+                       max_stages, opt_leaves)
+    log.info("coresident: stages not pinned; grant free budget chose "
+             "K=%d", k)
+    return k
+
+
+def _handle_heartbeat(grant: Grant, exec_, ccfg: CoresidentConfig,
+                      it_done: int) -> None:
+    """The preemption channel, honored at the epoch boundary AFTER the
+    epoch's checkpoint landed: drop every device buffer, release the
+    charge, poll for re-admission, re-place — or surface EvictedError
+    with the state safely on disk."""
+    if not grant.heartbeat(it_done):
+        return
+    log.warning("coresident trainer %s evicted at epoch %d; state is "
+                "checkpointed, polling %.0fms for re-admission",
+                ccfg.tenant, it_done, ccfg.wait_ms)
+    state = exec_.drop()
+    grant.release(final=False)
+    if not grant.wait_readmit(exec_.total_bytes, ccfg.wait_ms):
+        raise EvictedError(ccfg.tenant, it_done)
+    exec_.replace(state)
+    log.info("coresident trainer %s re-admitted at epoch %d",
+             ccfg.tenant, it_done)
+
+
+def train_nn_coresident(
+    data_dir: str,
+    cfg: NNTrainConfig,
+    ccfg: Optional[CoresidentConfig] = None,
+    init_flat: Optional[np.ndarray] = None,
+    target_class: Optional[int] = None,
+    grant: Optional[Grant] = None,
+    resume: bool = False,
+    ident_extra: Optional[dict] = None,
+) -> TrainResult:
+    """`shifu retrain --coresident` for NN: the streamed full-batch BSP
+    epoch loop, run as a background HBM-ledger tenant. With `stages=1,
+    microbatches=1` this is BIT-IDENTICAL to train_nn_streamed (pinned
+    in tests); K>=2 pipelines the layer groups over granted devices."""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.models.nn import (
+        flatten_params,
+        init_params,
+        unflatten_params,
+    )
+    from shifu_tpu.resilience import checkpoint as ckpt_mod
+    from shifu_tpu.resilience import faults
+    from shifu_tpu.resilience.checkpoint import sectioned_sha
+    from shifu_tpu.train.streaming import ShardFeed, _get_shard_program
+
+    ccfg = (ccfg or CoresidentConfig()).resolve()
+    grant = grant or _make_grant(ccfg)
+    feed = ShardFeed(data_dir, cfg)
+    d = len(feed.meta.columns)
+    out_dim = cfg.n_classes if cfg.n_classes > 2 else 1
+    layer_sizes = [d] + list(cfg.hidden_nodes) + [out_dim]
+    params0 = init_params(layer_sizes, seed=cfg.seed, init=cfg.weight_init)
+    flat0, shapes = flatten_params(params0)
+    if init_flat is not None and init_flat.size == flat0.size:
+        flat0 = init_flat.astype(np.float32)
+
+    updater = make_updater(
+        cfg.propagation, momentum=cfg.momentum,
+        reg=cfg.regularized_constant, reg_level=cfg.reg_level,
+        adam_beta1=cfg.adam_beta1, adam_beta2=cfg.adam_beta2)
+    leaves = _opt_leaves(updater[0])
+
+    grant.admit(meta={"algo": "nn", **(ccfg.meta or {})})
+    k = _resolve_stages(ccfg, grant, flat0.nbytes, len(shapes), leaves)
+    if k > 1 and cfg.dropout_rate > 0:
+        raise ValueError(
+            "coresident stages>1 cannot honor dropout (the mask key is "
+            "drawn per monolithic program) — set stages=1 or "
+            "DropoutRate=0")
+    # second admit = meta update only: K was sized FROM the grant, so
+    # it cannot ride the first call; /healthz and `shifu top` read it
+    grant.admit(meta={"algo": "nn", "stages": k, **(ccfg.meta or {})})
+    m = ccfg.microbatches
+    r = ccfg.replicas if k > 1 else 1
+
+    if k == 1:
+        exec_ = _SingleExec("nn", cfg, feed, flat0,
+                            _get_shard_program(cfg, shapes), updater,
+                            grant, m, "nn.shard_grad")
+    else:
+        plan = nn_plan(shapes, k)
+        exec_ = _PipelineExec("nn", cfg, feed, flat0, plan,
+                              make_nn_stage_programs(cfg, plan),
+                              updater, grant, m, r)
+
+    # the family identity deliberately EXCLUDES stages: a resume under a
+    # different K must reject with reason="stages" (the part-count
+    # stamp), not dissolve into an anonymous config mismatch
+    sections = {
+        "train": {kk: v for kk, v in cfg.__dict__.items()
+                  if not callable(v) and kk != "progress_cb"},
+        "data": {"shardRows": list(feed.meta.shard_rows),
+                 "columns": list(feed.meta.columns),
+                 "targetClass": target_class},
+        "coresident": {"microbatches": m, "replicas": r},
+    }
+    if ident_extra:
+        sections["loop"] = dict(ident_extra)
+    sha, sha_sections = sectioned_sha(sections)
+    family = f"{ccfg.tenant}-nn" + (
+        f"-c{target_class}" if target_class is not None else "")
+    ck = _family_checkpoint(ccfg.family_dir, family, sha, sha_sections, k)
+
+    lr = cfg.learning_rate
+    key0 = jax.random.PRNGKey(cfg.seed)
+    tclass = jnp.int32(-1 if target_class is None else target_class)
+    best_val = math.inf
+    best_flat = exec_.full_flat()
+    bad = 0
+    tr_e = va_e = 0.0
+    it_done = 0
+    start_epoch = 0
+
+    if resume:
+        loaded = ck.load()
+        if loaded is not None:
+            _cursors, per_stage, shared = loaded
+            meta = shared[1]
+            start_epoch = it_done = int(meta["it"])
+            lr = float(meta["lr"])
+            best_val = float(meta["bestVal"])
+            bad = int(meta["bad"])
+            tr_e, va_e = float(meta["trE"]), float(meta["vaE"])
+            best_flat = np.asarray(shared[0]["bestFlat"])
+            exec_.restore([arrays for (arrays, _m, _b) in per_stage])
+            faults.survived("preempt")
+            log.info("resuming coresident NN at epoch %d (K=%d)",
+                     start_epoch, k)
+
+    trued = False
+    for it in range(start_epoch, cfg.num_epochs):
+        faults.fault_point("epoch")
+        key = jax.random.fold_in(key0, it)
+        tr_e, va_e = exec_.epoch_grads(key, tclass)
+        if not trued:
+            exec_.true_up()
+            trued = True
+        if va_e < best_val:
+            best_val = va_e
+            best_flat = exec_.full_flat()
+            bad = 0
+        else:
+            bad += 1
+        exec_.apply(lr, it + 1)
+        lr *= 1.0 - cfg.learning_decay
+        it_done = it + 1
+        if cfg.progress_cb and cfg.checkpoint_every and (
+            it_done % cfg.checkpoint_every == 0
+        ):
+            cfg.progress_cb(it_done, tr_e, va_e)
+        # the eviction checkpoint: EVERY epoch boundary is resumable
+        # (the grant can preempt the trainer at any heartbeat)
+        per_stage_arrays = exec_.stage_arrays()
+        meta = {"it": it_done, "lr": lr, "bestVal": best_val,
+                "bad": bad, "trE": tr_e, "vaE": va_e, "algo": "nn",
+                "tenant": ccfg.tenant}
+        ck.save([(it_done, arrays, None, None)
+                 for arrays in per_stage_arrays],
+                ({"bestFlat": np.asarray(best_flat)}, meta, None))
+        if cfg.checkpoint_path and cfg.checkpoint_every and (
+            it_done % cfg.checkpoint_every == 0
+        ):
+            ckpt_mod.atomic_save_npy(cfg.checkpoint_path,
+                                     exec_.full_flat())
+        _handle_heartbeat(grant, exec_, ccfg, it_done)
+        if cfg.early_stop_window and bad >= cfg.early_stop_window:
+            log.info("coresident NN early stop at epoch %d", it_done)
+            break
+        if cfg.convergence_threshold and (
+            (tr_e + va_e) / 2.0 <= cfg.convergence_threshold
+        ):
+            break
+        if ccfg.throttle_ms > 0:
+            time.sleep(ccfg.throttle_ms / 1000.0)
+
+    ck.clear()  # completed: nothing left to resume
+    grant.release(final=True)
+    use_best = cfg.valid_set_rate > 0 and math.isfinite(best_val)
+    chosen = best_flat if use_best else exec_.full_flat()
+    log.info("coresident NN done: %d epochs, K=%d M=%d R=%d, train %.6f "
+             "valid %.6f", it_done, k, m, r, tr_e,
+             best_val if use_best else va_e)
+    return TrainResult(
+        params=unflatten_params(chosen, shapes),
+        train_error=tr_e,
+        valid_error=best_val if use_best else va_e,
+        iterations=it_done,
+    )
+
+
+def train_wdl_coresident(
+    norm_dir: str,
+    codes_dir: str,
+    num_idx,
+    cat_idx,
+    vocab_sizes,
+    cfg,
+    ccfg: Optional[CoresidentConfig] = None,
+    init_flat: Optional[np.ndarray] = None,
+    grant: Optional[Grant] = None,
+    resume: bool = False,
+):
+    """`shifu retrain --coresident` for WDL — same loop shape as the NN
+    path (stages=1, microbatches=1 is bit-identical to
+    train_wdl_streamed); the pipeline splits the DENSE tower, with the
+    embedding/wide block welded to stage 0."""
+    import jax.numpy as jnp
+
+    from shifu_tpu.models.wdl import (
+        WDLParams,
+        flatten_wdl,
+        init_wdl_params,
+        unflatten_wdl,
+        wdl_shapes,
+    )
+    from shifu_tpu.resilience import checkpoint as ckpt_mod
+    from shifu_tpu.resilience import faults
+    from shifu_tpu.resilience.checkpoint import sectioned_sha
+    from shifu_tpu.train.streaming_wdl import (
+        WDLShardFeed,
+        _get_shard_program,
+    )
+    from shifu_tpu.train.wdl_trainer import WDLTrainResult
+
+    ccfg = (ccfg or CoresidentConfig()).resolve()
+    grant = grant or _make_grant(ccfg)
+    feed = WDLShardFeed(norm_dir, codes_dir, num_idx, cat_idx, cfg)
+    template = init_wdl_params(
+        len(num_idx), vocab_sizes, cfg.embed_dim, cfg.hidden,
+        seed=cfg.seed)
+    flat0 = flatten_wdl(template)
+    if init_flat is not None and init_flat.size == flat0.size:
+        flat0 = init_flat.astype(np.float32)
+    shapes = wdl_shapes(template)
+    n_cat = len(template.embed)
+    n_dense = len(template.dense_layers)
+
+    updater = make_updater(
+        cfg.optimizer if cfg.optimizer != "GD" else "B",
+        momentum=0.0, reg=cfg.l2_reg,
+        reg_level="L2" if cfg.l2_reg else "NONE")
+    leaves = _opt_leaves(updater[0])
+
+    grant.admit(meta={"algo": "wdl", **(ccfg.meta or {})})
+    k = _resolve_stages(ccfg, grant, flat0.nbytes, n_dense, leaves)
+    grant.admit(meta={"algo": "wdl", "stages": k, **(ccfg.meta or {})})
+    m = ccfg.microbatches
+    r = ccfg.replicas if k > 1 else 1
+
+    if k == 1:
+        exec_ = _SingleExec("wdl", cfg, feed, flat0,
+                            _get_shard_program(cfg, template), updater,
+                            grant, m, "wdl.shard_grad")
+    else:
+        plan = wdl_plan(shapes, n_cat, k)
+        exec_ = _PipelineExec("wdl", cfg, feed, flat0, plan,
+                              make_wdl_stage_programs(cfg, plan),
+                              updater, grant, m, r)
+
+    sections = {
+        "train": {kk: v for kk, v in cfg.__dict__.items()
+                  if not callable(v) and kk != "progress_cb"},
+        "data": {"shardRows": list(feed.meta.shard_rows),
+                 "numIdx": list(num_idx), "catIdx": list(cat_idx),
+                 "vocab": list(vocab_sizes)},
+        "coresident": {"microbatches": m, "replicas": r},
+    }
+    sha, sha_sections = sectioned_sha(sections)
+    ck = _family_checkpoint(ccfg.family_dir, f"{ccfg.tenant}-wdl", sha,
+                            sha_sections, k)
+
+    best_val = math.inf
+    best_flat = exec_.full_flat()
+    bad = 0
+    tr_e = va_e = 0.0
+    it_done = 0
+    start_epoch = 0
+
+    if resume:
+        loaded = ck.load()
+        if loaded is not None:
+            _cursors, per_stage, shared = loaded
+            meta = shared[1]
+            start_epoch = it_done = int(meta["it"])
+            best_val = float(meta["bestVal"])
+            bad = int(meta["bad"])
+            tr_e, va_e = float(meta["trE"]), float(meta["vaE"])
+            best_flat = np.asarray(shared[0]["bestFlat"])
+            exec_.restore([arrays for (arrays, _m, _b) in per_stage])
+            faults.survived("preempt")
+            log.info("resuming coresident WDL at epoch %d (K=%d)",
+                     start_epoch, k)
+
+    trued = False
+    for it in range(start_epoch, cfg.num_epochs):
+        faults.fault_point("epoch")
+        tr_e, va_e = exec_.epoch_grads(None, None)
+        if not trued:
+            exec_.true_up()
+            trued = True
+        if va_e < best_val:
+            best_val = va_e
+            best_flat = exec_.full_flat()
+            bad = 0
+        else:
+            bad += 1
+        exec_.apply(cfg.learning_rate, it + 1)
+        it_done = it + 1
+        if cfg.checkpoint_every and it_done % cfg.checkpoint_every == 0:
+            if cfg.progress_cb:
+                cfg.progress_cb(it_done, tr_e, va_e)
+            if cfg.checkpoint_path:
+                ckpt_mod.atomic_save_npy(cfg.checkpoint_path,
+                                         exec_.full_flat())
+        per_stage_arrays = exec_.stage_arrays()
+        meta = {"it": it_done, "bestVal": best_val, "bad": bad,
+                "trE": tr_e, "vaE": va_e, "algo": "wdl",
+                "tenant": ccfg.tenant}
+        ck.save([(it_done, arrays, None, None)
+                 for arrays in per_stage_arrays],
+                ({"bestFlat": np.asarray(best_flat)}, meta, None))
+        _handle_heartbeat(grant, exec_, ccfg, it_done)
+        if cfg.early_stop_window and bad >= cfg.early_stop_window:
+            log.info("coresident WDL early stop at epoch %d", it_done)
+            break
+        if ccfg.throttle_ms > 0:
+            time.sleep(ccfg.throttle_ms / 1000.0)
+
+    ck.clear()  # completed: nothing left to resume
+    grant.release(final=True)
+    use_best = cfg.valid_set_rate > 0 and math.isfinite(best_val)
+    chosen = best_flat if use_best else exec_.full_flat()
+    params = unflatten_wdl(chosen, template)
+    params = WDLParams(
+        embed=[np.asarray(a) for a in params.embed],
+        wide=[np.asarray(a) for a in params.wide],
+        wide_dense=np.asarray(params.wide_dense),
+        dense_layers=[{kk: np.asarray(v) for kk, v in layer.items()}
+                      for layer in params.dense_layers],
+        bias=np.asarray(params.bias),
+    )
+    log.info("coresident WDL done: %d epochs, K=%d M=%d R=%d, train "
+             "%.6f valid %.6f", it_done, k, m, r, tr_e,
+             best_val if use_best else va_e)
+    return WDLTrainResult(
+        params=params, train_error=tr_e,
+        valid_error=best_val if use_best else va_e,
+        iterations=it_done,
+    )

@@ -43,11 +43,21 @@ def _http_get(url: str, timeout_s: float = 5.0) -> bytes:
 def fetch_view(base_url: str,
                timeout_s: float = 5.0) -> Tuple[dict, Dict[str, float]]:
     """One poll: (the /fleet/healthz payload, the /fleet/metrics flat
-    samples)."""
+    samples). The answering process's own /healthz zoo detail rides
+    along as `background` — co-resident trainers are per-process ledger
+    tenants, not part of the merged fleet view."""
     payload = json.loads(
         _http_get(base_url + "/fleet/healthz", timeout_s).decode("utf-8"))
     samples = parse_prometheus(
         _http_get(base_url + "/fleet/metrics", timeout_s).decode("utf-8"))
+    try:
+        hz = json.loads(
+            _http_get(base_url + "/healthz", timeout_s).decode("utf-8"))
+        bg = (hz.get("zoo") or {}).get("background")
+        if bg:
+            payload["background"] = bg
+    except (OSError, ValueError):  # draining (503) / no zoo: no rows
+        pass
     return payload, samples
 
 
@@ -136,6 +146,21 @@ def render_frame(payload: dict, samples: Dict[str, float],
                 f"{t:<16} {scope.get('burn', 0.0):>9g} "
                 f"{hbm.get(t, 0.0) / 1e6:>9.1f} "
                 f"{int(queues.get(t, 0.0)):>6}")
+    background = payload.get("background") or {}
+    if background:
+        lines.append("")
+        lines.append(f"{'TRAINER':<16} {'STATE':<9} {'EPOCH':>6} "
+                     f"{'STAGES':>7} {'HBM MB':>9} {'EVICTS':>7}")
+        for t in sorted(background):
+            b = background[t] or {}
+            state = ("evicting" if b.get("evictRequested")
+                     else "resident")
+            stages = b.get("stages")
+            lines.append(
+                f"{t:<16} {state:<9} {b.get('epoch', -1):>6} "
+                f"{(str(stages) if stages else '-'):>7} "
+                f"{b.get('hbmMB', 0.0):>9.1f} "
+                f"{b.get('evictions', 0):>7}")
     n_breakers, open_b = _open_breakers(samples)
     if n_breakers:
         lines.append("")

@@ -110,7 +110,9 @@ class RetrainProcessor(BasicProcessor):
                  data_path: Optional[str] = None,
                  candidate_dir: Optional[str] = None,
                  append_trees: Optional[int] = None,
-                 traffic_stream: str = "") -> None:
+                 traffic_stream: str = "",
+                 coresident: bool = False,
+                 serve_url: Optional[str] = None) -> None:
         super().__init__(root)
         if from_traffic and data_path is not None:
             raise ShifuError(
@@ -135,6 +137,15 @@ class RetrainProcessor(BasicProcessor):
             if candidate_dir else os.path.join(self.root,
                                                DEFAULT_CANDIDATE_DIR))
         self.append_trees = append_trees
+        # --coresident: run the warm-start NN/WDL train as a background
+        # HBM-ledger tenant of the serving fleet (coresident/trainer.py)
+        self.coresident = bool(coresident)
+        self.serve_url = serve_url
+        if serve_url and not coresident:
+            raise ShifuError(
+                ErrorCode.ILLEGAL_ARGUMENT,
+                "--serve-url applies to --coresident retraining only "
+                "(promotion has its own --serve-url on `shifu promote`)")
 
     # ---- source resolution ----
     def _resolve_source(self, mc):
@@ -221,6 +232,14 @@ class RetrainProcessor(BasicProcessor):
         assert mc is not None
         alg = mc.train.algorithm
 
+        if self.coresident and alg not in (Algorithm.NN, Algorithm.LR,
+                                           Algorithm.WDL):
+            raise ShifuError(
+                ErrorCode.ILLEGAL_ARGUMENT,
+                f"--coresident applies to the streamed NN/LR/WDL "
+                f"retrainers; {alg.value} retrains in one pass without "
+                f"a resident epoch loop to co-schedule")
+
         parent_dir = self.paths.models_dir()
         parent_paths = find_model_paths(parent_dir)
         if not parent_paths:
@@ -287,6 +306,21 @@ class RetrainProcessor(BasicProcessor):
         # section: a snapshot from a retrain against a different parent
         # set must reject, naming the section
         rt.train_ident_extra = {"parentModelSetSha": parent_sha}
+        ccfg = None
+        if self.coresident:
+            from shifu_tpu.coresident import CoresidentConfig
+
+            # family_dir = repo root: the per-stage checkpoint family
+            # lands under .shifu/runs/ckpt beside every other resumable
+            # stream so `shifu runs --resumable` lists it
+            ccfg = CoresidentConfig(
+                serve_url=self.serve_url, family_dir=self.root,
+                meta={"step": "retrain",
+                      "parentModelSetSha": parent_sha}).resolve()
+            rt.coresident_cfg = ccfg
+            log.info("retrain --coresident: tenant %r as a background "
+                     "HBM-ledger tenant (%s)", ccfg.tenant,
+                     self.serve_url or "local grant")
         rt.run_step()
 
         candidate_paths = find_model_paths(self.candidate_dir)
@@ -338,6 +372,13 @@ class RetrainProcessor(BasicProcessor):
                 "appendedTrees": (append if alg == Algorithm.GBT
                                   else None),
             },
+            "coresident": ({
+                "tenant": ccfg.tenant,
+                "stages": ccfg.stages or None,
+                "microbatches": ccfg.microbatches,
+                "replicas": ccfg.replicas,
+                "serveUrl": self.serve_url,
+            } if ccfg is not None else None),
         }
         log.info("retrain done: candidate %s (%d model(s)) from parent %s "
                  "on %d new rows — promote with `shifu promote`",

@@ -85,8 +85,12 @@ class TrainProcessor(BasicProcessor):
 
         from shifu_tpu.train.streaming import should_stream_training
 
-        if should_stream_training(norm_dir,
-                                  force_attr=bool(mc.train.train_on_disk)):
+        # a co-resident run (retrain --coresident) always rides the
+        # shard-streamed epoch loop: the stage pipeline feeds from the
+        # same ShardFeed whatever the matrix size
+        if (getattr(self, "coresident_cfg", None) is not None
+                or should_stream_training(
+                    norm_dir, force_attr=bool(mc.train.train_on_disk))):
             # spill composes with the mesh: shards stream row-sharded and
             # XLA all-reduces each shard gradient (the reference spills
             # inside every distributed worker, AbstractNNWorker.java:485)
@@ -215,11 +219,18 @@ class TrainProcessor(BasicProcessor):
         from shifu_tpu.train.streaming import train_nn_streamed
 
         mc = self.model_config
+        cc_base = getattr(self, "coresident_cfg", None)
         composites = flatten_params(
             mc.train.params or {},
             self.resolve(mc.train.grid_config_file)
             if mc.train.grid_config_file else None,
         )
+        if cc_base is not None and (len(composites) > 1
+                                    or (mc.train.num_k_fold or -1) > 0):
+            raise ShifuError(
+                ErrorCode.INVALID_MODEL_CONFIG,
+                "--coresident trains the final member(s) only — grid "
+                "search / k-fold explore on the dedicated trainer first")
         multi = mc.is_multi_classification()
         is_ova = multi and mc.train.is_one_vs_all()
         if len(composites) > 1:
@@ -257,11 +268,29 @@ class TrainProcessor(BasicProcessor):
                          if mc.train.is_continuous else None)
             from shifu_tpu.resilience.checkpoint import resume_requested
 
-            res = train_nn_streamed(norm_dir, cfg, init_flat=init_flat,
-                                    target_class=i if ova else None,
-                                    mesh=mesh, resume=resume_requested(),
-                                    ident_extra=getattr(
-                                        self, "train_ident_extra", None))
+            if cc_base is not None:
+                from dataclasses import replace as dc_replace
+
+                from shifu_tpu.coresident import train_nn_coresident
+
+                # bagging members need distinct checkpoint families +
+                # ledger identities (OVA classes already split on the
+                # family's -c<class> suffix)
+                ccfg_i = dc_replace(
+                    cc_base,
+                    tenant=(cc_base.tenant if i == 0 or ova
+                            else f"{cc_base.tenant}-m{i}"))
+                res = train_nn_coresident(
+                    norm_dir, cfg, ccfg=ccfg_i, init_flat=init_flat,
+                    target_class=i if ova else None,
+                    resume=resume_requested(),
+                    ident_extra=getattr(self, "train_ident_extra", None))
+            else:
+                res = train_nn_streamed(
+                    norm_dir, cfg, init_flat=init_flat,
+                    target_class=i if ova else None,
+                    mesh=mesh, resume=resume_requested(),
+                    ident_extra=getattr(self, "train_ident_extra", None))
             spec = self._make_spec(alg, cfg, res, meta_cols, norm_json,
                                    class_tags=class_tags)
             path = self.paths.model_path(i, suffix)

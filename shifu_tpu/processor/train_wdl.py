@@ -78,8 +78,11 @@ def train_wdl_models(proc) -> None:
 
     from shifu_tpu.train.streaming import should_stream_training
 
-    if (should_stream_training(norm_dir,
-                               force_attr=bool(mc.train.train_on_disk))
+    # co-resident runs always stream: the stage pipeline feeds from the
+    # paired (norm, codes) shard feed whatever the matrix size
+    if (getattr(proc, "coresident_cfg", None) is not None
+            or should_stream_training(
+                norm_dir, force_attr=bool(mc.train.train_on_disk))
             or should_stream_training(codes_dir)):
         _train_wdl_streamed(proc)
         return
@@ -331,8 +334,23 @@ def _train_wdl_streamed(proc) -> None:
                     log.warning("cannot resume from %s (%s)", path, e)
         from shifu_tpu.resilience.checkpoint import resume_requested
 
-        res = train_wdl_streamed(norm_dir, codes_dir, num_idx, cat_idx,
-                                 vocab_sizes, cfg, init_flat=init_flat,
-                                 mesh=mesh, resume=resume_requested())
+        cc_base = getattr(proc, "coresident_cfg", None)
+        if cc_base is not None:
+            from dataclasses import replace as dc_replace
+
+            from shifu_tpu.coresident import train_wdl_coresident
+
+            ccfg_i = dc_replace(
+                cc_base, tenant=(cc_base.tenant if i == 0
+                                 else f"{cc_base.tenant}-m{i}"))
+            res = train_wdl_coresident(
+                norm_dir, codes_dir, num_idx, cat_idx, vocab_sizes, cfg,
+                ccfg=ccfg_i, init_flat=init_flat,
+                resume=resume_requested())
+        else:
+            res = train_wdl_streamed(norm_dir, codes_dir, num_idx,
+                                     cat_idx, vocab_sizes, cfg,
+                                     init_flat=init_flat, mesh=mesh,
+                                     resume=resume_requested())
         _save_wdl_member(proc, i, cfg, res, num_names, cat_names,
                          vocab_sizes, dense_specs, plan.cutoff, categories)

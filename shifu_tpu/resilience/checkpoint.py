@@ -352,12 +352,22 @@ class ShardedStreamCheckpoint:
     def __init__(self, base: str, config_sha: str, n_shards: int,
                  every: Optional[int] = None,
                  sections: Optional[Dict[str, str]] = None,
-                 n_hosts: int = 1, host_index: int = 0) -> None:
+                 n_hosts: int = 1, host_index: int = 0,
+                 part_kind: str = "shards") -> None:
         self.base = base
         self.n_shards = max(1, int(n_shards))
         self.n_hosts = max(1, int(n_hosts))
         self.host_index = int(host_index)
         self.config_sha = config_sha
+        # what a part IS: "shards" for the row-sharded folds (legacy
+        # byte-identical), "stages" for the co-resident trainer's
+        # per-pipeline-stage family. The kind names the stamp key, the
+        # per-part file infix, and the rejection reason when the count
+        # moved between runs.
+        self.part_kind = part_kind
+        self._part_infix = ("shard" if part_kind == "shards"
+                            else (part_kind[:-1] if part_kind.endswith("s")
+                                  else part_kind) or "part")
         self.every = every_chunks_setting() if every is None else int(every)
         self._since = 0
         self._epoch = 0
@@ -366,7 +376,7 @@ class ShardedStreamCheckpoint:
         self._family = family
         self._shards = [
             {slot: StreamCheckpoint(
-                f"{family}-shard{s:05d}-{slot}{CKPT_SUFFIX}",
+                f"{family}-{self._part_infix}{s:05d}-{slot}{CKPT_SUFFIX}",
                 config_sha, every=0, sections=sections)
              for slot in self._SLOTS}
             for s in range(self.n_shards)]
@@ -388,7 +398,7 @@ class ShardedStreamCheckpoint:
             (len(per_shard), self.n_shards)
         epoch = self._epoch + 1
         slot = self._slot(epoch)
-        stamp = {"epoch": epoch, "shards": self.n_shards}
+        stamp = {"epoch": epoch, self.part_kind: self.n_shards}
         if self.n_hosts > 1:
             stamp["hosts"] = self.n_hosts
             stamp["host"] = self.host_index
@@ -436,11 +446,17 @@ class ShardedStreamCheckpoint:
         if epoch is None or slot not in self._SLOTS:
             registry().counter("ckpt.rejected", reason="partial").inc()
             return None
-        if shared[2].get("shards") != self.n_shards:
-            log.warning("sharded checkpoint %s was written with %s shards "
+        if shared[2].get(self.part_kind) != self.n_shards:
+            # e.g. `ckpt.rejected{reason="stages"}` when a co-resident
+            # resume asks for a different pipeline partitioning than the
+            # family was written under — every stored part covers a
+            # different flat slice, so resuming would be silently wrong
+            log.warning("sharded checkpoint %s was written with %s %s "
                         "(now %d); starting fresh", self.base,
-                        shared[2].get("shards"), self.n_shards)
-            registry().counter("ckpt.rejected", reason="shards").inc()
+                        shared[2].get(self.part_kind), self.part_kind,
+                        self.n_shards)
+            registry().counter("ckpt.rejected",
+                               reason=self.part_kind).inc()
             return None
         if shared[2].get("hosts", 1) != self.n_hosts:
             # the chunk -> host assignment moved: every stored cursor
@@ -478,7 +494,8 @@ class ShardedStreamCheckpoint:
         running peers."""
         from shifu_tpu.fs.listing import sorted_glob
 
-        patterns = [self._family + "-shard*" + CKPT_SUFFIX]
+        patterns = [self._family + "-" + self._part_infix + "*"
+                    + CKPT_SUFFIX]
         if self.n_hosts == 1:
             patterns.append(self.base + "-h*" + CKPT_SUFFIX)
         for pattern in patterns:
@@ -515,9 +532,17 @@ def list_resumable(root: str) -> List[dict]:
                 recursive=True):
             paths.append(path)
             step_of[path] = step
+    import re
+
+    # a co-resident family is MANY files (per-stage slots + the shared
+    # commit pointer) but ONE resumable run: list the pointer as one
+    # aggregated entry and hide the per-stage slot files behind it
+    part_re = re.compile(r"^coresident-.+-stage\d{5}-[ab]$")
     out: List[dict] = []
     for path in paths:
         name = os.path.basename(path)[: -len(CKPT_SUFFIX)]
+        if os.path.dirname(path) == d and part_re.match(name):
+            continue
         if os.path.dirname(path) != d:
             # trainer snapshot: qualify with its checkpoint dir so bagged
             # members (checkpoint_0, checkpoint_1, ...) stay distinct,
@@ -537,6 +562,16 @@ def list_resumable(root: str) -> List[dict]:
             entry["chunkIndex"] = header.get("chunkIndex")
             entry["configSha"] = header.get("configSha")
             entry["meta"] = header.get("meta", {})
+            if (os.path.dirname(path) == d
+                    and name.startswith("coresident-")
+                    and name.endswith("-shared")):
+                # the family's commit pointer: surface the run identity
+                # (trainer epoch + stage count) for `shifu runs
+                # --resumable`
+                entry["name"] = name[: -len("-shared")]
+                entry["family"] = "coresident"
+                entry["epoch"] = entry["meta"].get("it")
+                entry["stages"] = entry["meta"].get("stages")
         except Exception:  # unreadable: still listed, marked corrupt
             entry["corrupt"] = True
         out.append(entry)

@@ -42,6 +42,7 @@ from shifu_tpu.serve import wire
 from shifu_tpu.serve.queue import AdmissionQueue, RejectedError
 from shifu_tpu.serve.registry import ModelRegistry
 from shifu_tpu.serve.zoo import ColdStartError
+from shifu_tpu.utils.errors import ShifuError
 from shifu_tpu.utils.log import get_logger
 
 log = get_logger(__name__)
@@ -753,6 +754,9 @@ class ScoringServer:
                                  "/admin/evict"):
                     self._do_admin()
                     return
+                if self.path.startswith("/admin/coresident/"):
+                    self._do_coresident()
+                    return
                 # /score (single-tenant, or the zoo's default set) and
                 # /score/<set> (one tenant of the model zoo)
                 set_name = None
@@ -971,6 +975,59 @@ class ScoringServer:
                 except KeyError as e:
                     self._reply(404, {"error": str(e)})
                 except (ValueError, OSError) as e:
+                    self._reply(409, {"error": str(e)})
+
+            def _do_coresident(self):
+                """The co-resident trainer's grant plane (HttpGrant,
+                coresident/tenant.py): admit / charge / heartbeat /
+                release against the zoo's HBM ledger as a
+                `priority=background` tenant. A charge that does not
+                fit answers 409 with the byte deficit — the trainer
+                backs off; it NEVER evicts a serving tenant."""
+                from shifu_tpu.serve.zoo import LedgerFullError
+
+                if server.zoo is None:
+                    self._reply(409, {"error": "co-resident training "
+                                               "needs zoo mode"})
+                    return
+                action = self.path[len("/admin/coresident/"):]
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = self.rfile.read(length) if length else b"{}"
+                    doc = json.loads(body.decode("utf-8") or "{}")
+                except ValueError as e:
+                    self._reply(400, {"error": f"bad request body: {e}"})
+                    return
+                tenant = doc.get("tenant") or ""
+                try:
+                    if action == "admit":
+                        self._reply(200, server.zoo.admit_background(
+                            tenant, meta=doc.get("meta") or {}))
+                    elif action == "charge":
+                        nbytes = int(doc.get("bytes", 0))
+                        if nbytes >= 0:
+                            server.zoo.background_acquire(tenant, nbytes)
+                        else:
+                            server.zoo.background_reduce(tenant, -nbytes)
+                        self._reply(200, {"charged": nbytes})
+                    elif action == "heartbeat":
+                        evicted = server.zoo.background_heartbeat(
+                            tenant, int(doc.get("epoch", -1)))
+                        self._reply(200, {"evicted": evicted})
+                    elif action == "release":
+                        server.zoo.background_release(
+                            tenant, final=bool(doc.get("final")))
+                        self._reply(200, {"released": tenant})
+                    else:
+                        self._reply(404, {
+                            "error": f"unknown coresident action "
+                                     f"{action!r}"})
+                except LedgerFullError as e:
+                    self._reply(409, {"error": str(e),
+                                      "deficit": e.deficit})
+                except KeyError as e:
+                    self._reply(404, {"error": str(e)})
+                except (ValueError, ShifuError) as e:
                     self._reply(409, {"error": str(e)})
 
         return Handler

@@ -277,6 +277,39 @@ class HbmLedger:
         }
 
 
+class BackgroundTenant:
+    """A `priority=background` ledger tenant — the co-resident trainer.
+
+    Background tenants hold ledger bytes but never serve scores; under
+    serving pressure they are evicted STRICTLY FIRST (the LRU never
+    picks a serving tenant while any background charge remains), and
+    their own acquires are fit-or-fail (a trainer never evicts a
+    serving tenant to stay resident). Eviction here is a FLAG plus an
+    immediate charge drop: the trainer observes the flag at its next
+    epoch-boundary heartbeat, checkpoints, and frees its device buffers
+    — a grace window bounded by one epoch (see docs/SERVING.md)."""
+
+    def __init__(self, name: str, reg_seq: int,
+                 meta: Optional[dict] = None) -> None:
+        self.name = name
+        self.reg_seq = int(reg_seq)
+        self.meta = dict(meta or {})
+        self.epoch = -1                 # last heartbeat epoch
+        self.evict_requested = False
+        self.evictions = 0
+        self.admitted_at = time.time()
+
+    def snapshot(self) -> dict:
+        return {
+            "priority": "background",
+            "epoch": self.epoch,
+            "stages": self.meta.get("stages"),
+            "algo": self.meta.get("algo"),
+            "evictRequested": self.evict_requested,
+            "evictions": self.evictions,
+        }
+
+
 def load_set_configs(root: str):
     """Best-effort (column_configs, model_config) from a model-set root
     — same degrade-never-fail contract as the single-tenant server."""
@@ -396,6 +429,7 @@ class ModelZoo:
         # stream wired after that — see ServeServer._finish_init
         self.writer = ""
         self._tenants: Dict[str, ZooTenant] = {}
+        self._background: Dict[str, BackgroundTenant] = {}
         self._reg_seq = 0
         self._default_name: Optional[str] = None  # first registered
         self._closed = False
@@ -438,6 +472,11 @@ class ModelZoo:
                 raise ShifuError(
                     ErrorCode.ILLEGAL_ARGUMENT,
                     f"tenant {name} is already registered")
+            if name in self._background:
+                raise ShifuError(
+                    ErrorCode.ILLEGAL_ARGUMENT,
+                    f"tenant name {name!r} is held by a background "
+                    "(co-resident trainer) tenant")
         n_rep = self._replica_count()
         weights = estimate_weights_bytes(models_dir, column_configs,
                                          model_config) * n_rep
@@ -453,6 +492,11 @@ class ModelZoo:
                 raise ShifuError(
                     ErrorCode.ILLEGAL_ARGUMENT,
                     f"tenant {name} is already registered")
+            if name in self._background:  # raced background admit
+                raise ShifuError(
+                    ErrorCode.ILLEGAL_ARGUMENT,
+                    f"tenant name {name!r} is held by a background "
+                    "(co-resident trainer) tenant")
             tenant = ZooTenant(name, path, models_dir,
                                column_configs=column_configs,
                                model_config=model_config,
@@ -732,6 +776,15 @@ class ModelZoo:
                 self.ledger.acquire(tenant.name, kind, nbytes)
                 return
             except LedgerFullError as e:
+                if evict:
+                    # background tenants (co-resident trainers) go
+                    # STRICTLY FIRST: the LRU never evicts a serving
+                    # tenant while any background charge remains
+                    bg = self._claim_background_victim()
+                    if bg is not None:
+                        self._evict_background(
+                            bg, reason="pressure_background")
+                        continue
                 victim = (self._claim_victim(exclude=tenant)
                           if evict else None)
                 if victim is None:
@@ -760,6 +813,11 @@ class ModelZoo:
         """Explicit eviction. Refused for a tenant mid-stage/mid-promote
         or with a staged shadow — evicting the swap target would strand
         the rollout half-rolled."""
+        with self._lock:
+            bt = self._background.get(name)
+        if bt is not None:
+            self._evict_background(bt, reason=reason)
+            return
         tenant = self._get(name)
         with self._lock:
             if tenant.state != RESIDENT:
@@ -820,6 +878,116 @@ class ModelZoo:
             n = sum(1 for t in self._tenants.values()
                     if t.state == RESIDENT)
         registry().gauge("serve.zoo.resident_tenants").set(n)
+
+    # ---- background tenants (the co-resident trainer plane) ----
+    def admit_background(self, name: str,
+                         meta: Optional[dict] = None) -> dict:
+        """Admit (or re-admit) `name` as a `priority=background` ledger
+        tenant. Idempotent: a re-admit clears a pending eviction flag —
+        that is how an evicted trainer comes back once pressure
+        subsides. Returns the grant info the trainer sizes its stage
+        plan from."""
+        import jax
+
+        if not _NAME_RE.match(name or ""):
+            raise ShifuError(
+                ErrorCode.ILLEGAL_ARGUMENT,
+                f"background tenant name {name!r} must match "
+                f"{_NAME_RE.pattern}")
+        with self._lock:
+            if self._closed:
+                raise ValueError("zoo is closed")
+            if name in self._tenants:
+                raise ValueError(
+                    f"{name!r} is a registered serving tenant — pick a "
+                    "different -Dshifu.coresident.tenant name")
+            bt = self._background.get(name)
+            if bt is None:
+                bt = BackgroundTenant(name, self._reg_seq, meta)
+                self._reg_seq += 1
+                self._background[name] = bt
+                log.info("zoo: admitted background tenant %s", name)
+            else:
+                bt.evict_requested = False
+                if meta:
+                    bt.meta.update(meta)
+        free = (max(0, self.ledger.budget_bytes - self.ledger.used)
+                if self.ledger.budget_bytes else None)
+        return {"freeBytes": free, "devices": len(jax.devices())}
+
+    def _get_background(self, name: str) -> BackgroundTenant:
+        with self._lock:
+            bt = self._background.get(name)
+        if bt is None:
+            raise KeyError(
+                f"unknown background tenant {name!r} "
+                f"(admitted: {sorted(self._background)})")
+        return bt
+
+    def background_acquire(self, name: str, nbytes: int) -> None:
+        """Fit-or-fail: a background tenant NEVER triggers eviction —
+        the trainer waits out serving pressure instead of creating
+        it."""
+        bt = self._get_background(name)
+        if bt.evict_requested:
+            raise LedgerFullError(
+                f"background tenant {name} is flagged for eviction — "
+                "heartbeat, checkpoint, and re-admit", int(nbytes))
+        self.ledger.acquire(name, "background", int(nbytes))
+
+    def background_reduce(self, name: str, nbytes: int) -> None:
+        self._get_background(name)
+        self.ledger.reduce(name, "background", int(nbytes))
+
+    def background_heartbeat(self, name: str, epoch: int) -> bool:
+        """Record training progress; returns True when the zoo wants
+        the devices back (the trainer then checkpoints + releases)."""
+        bt = self._get_background(name)
+        with self._lock:
+            bt.epoch = max(bt.epoch, int(epoch))
+            return bt.evict_requested
+
+    def background_release(self, name: str, final: bool = False) -> None:
+        """Drop the tenant's whole charge. `final=True` (training
+        completed) forgets the tenant; an eviction release keeps the
+        record so `/healthz` still lists the checkpointed epoch."""
+        bt = self._get_background(name)
+        self.ledger.release(name, "background")
+        if final:
+            with self._lock:
+                self._background.pop(name, None)
+            log.info("zoo: background tenant %s completed and released",
+                     name)
+
+    def _claim_background_victim(self) -> Optional[BackgroundTenant]:
+        with self._lock:
+            candidates = [bt for bt in self._background.values()
+                          if not bt.evict_requested]
+        candidates = [bt for bt in candidates
+                      if self.ledger.charge_of(bt.name, "background") > 0]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda bt: bt.reg_seq)
+
+    def _evict_background(self, bt: BackgroundTenant,
+                          reason: str = "pressure_background") -> int:
+        """Flag + immediate charge drop. The trainer sees the flag at
+        its next epoch-boundary heartbeat and frees its device buffers
+        then — the byte-accounting grace window is bounded by one
+        training epoch."""
+        from shifu_tpu.obs import registry as obs_registry
+
+        with self._lock:
+            bt.evict_requested = True
+            bt.evictions += 1
+        freed = self.ledger.release(bt.name, "background")
+        obs_registry().counter("serve.zoo.evictions",
+                               tenant=bt.name, reason=reason).inc()
+        log.warning("zoo: evicted background tenant %s (%s): freed %d "
+                    "ledgered bytes (trainer checkpoints at its next "
+                    "heartbeat, epoch %d last seen)",
+                    bt.name, reason, freed, bt.epoch)
+        return freed
 
     # ---- scoring ----
     def _cold_retry_after(self, tenant: ZooTenant) -> float:
@@ -1134,10 +1302,17 @@ class ModelZoo:
                            if t.state == RESIDENT)
             admitting = sorted(t.name for t in self._tenants.values()
                                if t.state == ADMITTING)
+            background = {name: bt.snapshot()
+                          for name, bt in
+                          sorted(self._background.items())}
+        for name, snap in background.items():
+            snap["hbmMB"] = round(
+                self.ledger.charge_of(name, "background") / MB, 3)
         return {
             "tenants": tenants,
             "residentTenants": resident,
             "admitting": admitting,
+            "background": background,
             "hbmBudgetMB": ledger["budgetMB"],
             "hbmBudgetUsedMB": ledger["usedMB"],
             "hbmPeakUsedMB": ledger["peakMB"],
@@ -1157,6 +1332,9 @@ class ModelZoo:
         }
         with self._lock:
             items = list(self._tenants.items())
+            out["background"] = {name: bt.snapshot()
+                                 for name, bt in
+                                 sorted(self._background.items())}
         for name, tenant in sorted(items):
             snap = tenant.snapshot()
             fleet = tenant.fleet
@@ -1199,4 +1377,10 @@ class ModelZoo:
                 tenant.state = COLD
                 tenant.fleet = None
                 tenant.scorer = None
+        with self._lock:
+            backgrounds = list(self._background.values())
+        for bt in backgrounds:
+            # the trainer's own process frees its buffers; the closing
+            # zoo just zeroes the accounting
+            self.ledger.release(bt.name, "background")
         self._publish_resident()

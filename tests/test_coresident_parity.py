@@ -1,0 +1,183 @@
+"""Numerical contracts of the co-resident pipeline trainer
+(shifu_tpu/coresident/trainer.py + pipeline.py):
+
+* `stages=1, microbatches=1` is BIT-identical to the existing streamed
+  trainers (NN and WDL) — the co-resident path is the same math with a
+  grant wrapped around it, never a different trainer;
+* microbatch gradient accumulation order is pinned sequential, so any
+  M is bit-identical to M=1 (GPipe microbatching is a memory shape,
+  not a numerics choice);
+* stage-boundary activations are always f32; bf16 appears only inside
+  stage matmuls when `mixed_precision` is armed (the PR-11 policy).
+
+Runs under the conftest-forced 8-virtual-device CPU mesh, so a K=2
+pipeline really pins its stages to distinct devices.
+"""
+
+import numpy as np
+import pytest
+
+from shifu_tpu.coresident import CoresidentConfig, train_nn_coresident
+from shifu_tpu.coresident.tenant import LocalGrant
+from shifu_tpu.norm.dataset import write_codes, write_normalized
+from shifu_tpu.train.nn_trainer import NNTrainConfig
+
+
+def _write_shards(tmp_path, n=600, d=6, n_shards=2, seed=3):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    t = ((1.2 * x[:, 0] - x[:, 1]) > 0).astype(np.int8)
+    w = np.ones(n, dtype=np.float32)
+    out = str(tmp_path / "NormalizedData")
+    write_normalized(out, x, t, w, [f"c{i}" for i in range(d)],
+                     n_shards=n_shards)
+    return out
+
+
+def _cfg(**kw):
+    base = dict(hidden_nodes=[6, 5], activations=["tanh"],
+                propagation="R", num_epochs=8, valid_set_rate=0.2,
+                seed=11)
+    base.update(kw)
+    return NNTrainConfig(**base)
+
+
+def _flat(params):
+    from shifu_tpu.models.nn import flatten_params
+
+    flat, _shapes = flatten_params(params)
+    return np.asarray(flat)
+
+
+def _run(data_dir, cfg, stages, microbatches, family_dir):
+    ccfg = CoresidentConfig(stages=stages, microbatches=microbatches,
+                            family_dir=str(family_dir))
+    return train_nn_coresident(data_dir, cfg, ccfg, grant=LocalGrant())
+
+
+def test_nn_stages1_bit_identical_to_streamed(tmp_path):
+    from shifu_tpu.train.streaming import train_nn_streamed
+
+    data_dir = _write_shards(tmp_path)
+    cfg = _cfg()
+    streamed = train_nn_streamed(data_dir, cfg)
+    co = _run(data_dir, cfg, 1, 1, tmp_path / "fam")
+    assert co.iterations == streamed.iterations
+    assert co.train_error == streamed.train_error
+    assert co.valid_error == streamed.valid_error
+    np.testing.assert_array_equal(_flat(co.params),
+                                  _flat(streamed.params))
+
+
+def test_nn_microbatch_accumulation_order_is_pinned(tmp_path):
+    """M only reshapes the pipeline fill; the sequential fold makes the
+    result bit-identical to whole-shard dispatch."""
+    data_dir = _write_shards(tmp_path)
+    cfg = _cfg()
+    base = _run(data_dir, cfg, 1, 1, tmp_path / "a")
+    m3 = _run(data_dir, cfg, 1, 3, tmp_path / "b")
+    np.testing.assert_array_equal(_flat(base.params), _flat(m3.params))
+
+
+def test_nn_two_stage_pipeline_bit_identical(tmp_path):
+    data_dir = _write_shards(tmp_path)
+    cfg = _cfg()
+    base = _run(data_dir, cfg, 1, 1, tmp_path / "a")
+    piped = _run(data_dir, cfg, 2, 2, tmp_path / "b")
+    np.testing.assert_array_equal(_flat(base.params),
+                                  _flat(piped.params))
+
+
+def _wdl_fixture(tmp_path, n=600, nd=4, nc=2, vocab=6, seed=5):
+    rng = np.random.default_rng(seed)
+    dense = rng.normal(size=(n, nd)).astype(np.float32)
+    codes = rng.integers(0, vocab, size=(n, nc)).astype(np.int16)
+    t = ((dense[:, 0] + (codes[:, 0] >= 3)) > 0.5).astype(np.int8)
+    w = np.ones(n, np.float32)
+    norm_dir = str(tmp_path / "NormalizedData")
+    codes_dir = str(tmp_path / "CleanedData")
+    cols = [f"d{i}" for i in range(nd)] + [f"c{i}" for i in range(nc)]
+    write_normalized(norm_dir, np.concatenate(
+        [dense, codes.astype(np.float32)], 1), t, w, cols, n_shards=2)
+    write_codes(codes_dir, np.concatenate(
+        [np.zeros((n, nd), np.int16), codes], 1), t, w, cols,
+        [1] * nd + [vocab] * nc, n_shards=2)
+    return norm_dir, codes_dir, list(range(nd)), [nd, nd + 1], \
+        [vocab] * nc
+
+
+def test_wdl_stages1_bit_identical_to_streamed(tmp_path):
+    from shifu_tpu.coresident import train_wdl_coresident
+    from shifu_tpu.models.wdl import flatten_wdl
+    from shifu_tpu.train.streaming_wdl import train_wdl_streamed
+    from shifu_tpu.train.wdl_trainer import WDLTrainConfig
+
+    norm_dir, codes_dir, num_idx, cat_idx, vocabs = \
+        _wdl_fixture(tmp_path)
+    cfg = WDLTrainConfig(hidden=[8], activations=["relu"], embed_dim=4,
+                         num_epochs=6, valid_set_rate=0.2, seed=3)
+    streamed = train_wdl_streamed(norm_dir, codes_dir, num_idx,
+                                  cat_idx, vocabs, cfg)
+    ccfg = CoresidentConfig(stages=1, microbatches=1,
+                            family_dir=str(tmp_path / "fam"))
+    co = train_wdl_coresident(norm_dir, codes_dir, num_idx, cat_idx,
+                              vocabs, cfg, ccfg, grant=LocalGrant())
+    assert co.iterations == streamed.iterations
+    np.testing.assert_array_equal(flatten_wdl(co.params),
+                                  flatten_wdl(streamed.params))
+
+
+def test_wdl_pipeline_tracks_single_stage(tmp_path):
+    """WDL K=3 reproduces K=1 bit-exactly (pure partitioning); K=2/M=2
+    additionally re-times the wide-logit add — pinned to float noise,
+    never drift."""
+    from shifu_tpu.coresident import train_wdl_coresident
+    from shifu_tpu.models.wdl import flatten_wdl
+    from shifu_tpu.train.wdl_trainer import WDLTrainConfig
+
+    norm_dir, codes_dir, num_idx, cat_idx, vocabs = \
+        _wdl_fixture(tmp_path)
+    cfg = WDLTrainConfig(hidden=[8, 5], activations=["relu"],
+                         embed_dim=4, num_epochs=6, valid_set_rate=0.2,
+                         seed=3)
+
+    def run(k, m, fam):
+        ccfg = CoresidentConfig(stages=k, microbatches=m,
+                                family_dir=str(tmp_path / fam))
+        return train_wdl_coresident(norm_dir, codes_dir, num_idx,
+                                    cat_idx, vocabs, cfg, ccfg,
+                                    grant=LocalGrant())
+
+    base = run(1, 1, "a")
+    k3 = run(3, 1, "b")
+    np.testing.assert_array_equal(flatten_wdl(base.params),
+                                  flatten_wdl(k3.params))
+    k2m2 = run(2, 2, "c")
+    np.testing.assert_allclose(flatten_wdl(base.params),
+                               flatten_wdl(k2m2.params), atol=1e-6)
+
+
+def test_stage_boundary_dtype_is_f32_bf16_only_inside(tmp_path):
+    """PR-11 policy at the pipeline seam: the activation handed
+    stage-to-stage is f32 whether or not mixed precision is armed;
+    arming it puts bf16 INSIDE the stage matmuls only."""
+    import jax
+    import jax.numpy as jnp
+
+    from shifu_tpu.coresident.pipeline import make_nn_stage_programs
+    from shifu_tpu.coresident.plan import nn_plan
+    from shifu_tpu.models.nn import flatten_params, init_params
+
+    sizes = [6, 8, 1]
+    flat, shapes = flatten_params(init_params(sizes, seed=0))
+    plan = nn_plan(shapes, 2)
+    h = jnp.zeros((4, 6), jnp.float32)
+    for mixed in (False, True):
+        cfg = _cfg(mixed_precision=mixed)
+        progs = make_nn_stage_programs(cfg, plan)
+        flat0 = jnp.asarray(np.asarray(flat))[plan.stages[0].lo:
+                                              plan.stages[0].hi]
+        out = progs["fwd"][0](flat0, h)
+        assert out.dtype == jnp.float32  # the boundary contract
+        jaxpr = str(jax.make_jaxpr(progs["fwd"][0])(flat0, h))
+        assert ("bf16" in jaxpr) == mixed

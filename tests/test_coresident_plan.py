@@ -1,0 +1,121 @@
+"""Stage partitioning for the co-resident trainer
+(shifu_tpu/coresident/plan.py): contiguous flat-vector slices, welded
+prefixes, boundary widths, and budget-derived default stage counts.
+
+The invariant everything else leans on: a stage IS a contiguous
+`[lo, hi)` slice of the flat parameter vector, the slices tile the
+vector exactly, and the elementwise updaters therefore make per-stage
+updates concatenate bit-identically to full-vector updates (the
+`stages=1` parity proof in test_coresident_parity.py rides this).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from shifu_tpu.coresident.plan import (
+    default_stages,
+    nn_plan,
+    wdl_plan,
+)
+
+
+def _nn_shapes(sizes):
+    return [(sizes[i], sizes[i + 1]) for i in range(len(sizes) - 1)]
+
+
+class TestNNPlan:
+    def test_slices_tile_the_flat_vector_exactly(self):
+        shapes = _nn_shapes([12, 8, 6, 4, 1])
+        total = sum(fi * fo + fo for fi, fo in shapes)
+        for k in (1, 2, 3, 4):
+            plan = nn_plan(shapes, k)
+            assert plan.n_stages == k
+            assert plan.stages[0].lo == 0
+            assert plan.stages[-1].hi == total
+            for a, b in zip(plan.stages, plan.stages[1:]):
+                assert a.hi == b.lo  # contiguous, no gap, no overlap
+            flat = np.arange(total, dtype=np.float32)
+            pieces = plan.slices(flat)
+            np.testing.assert_array_equal(np.concatenate(pieces), flat)
+
+    def test_loss_head_lands_in_the_last_stage(self):
+        shapes = _nn_shapes([10, 7, 5, 1])
+        for k in (1, 2, 3):
+            plan = nn_plan(shapes, k)
+            assert plan.stages[-1].layer_hi == len(shapes)
+
+    def test_boundary_widths_are_the_cut_layers_outputs(self):
+        shapes = _nn_shapes([12, 8, 6, 4, 1])
+        plan = nn_plan(shapes, 2)
+        # K=2 over 4 layers cuts after layer 1 -> boundary width = 6
+        assert plan.boundary_widths == [shapes[plan.stages[0].layer_hi
+                                               - 1][1]]
+        plan4 = nn_plan(shapes, 4)
+        assert plan4.boundary_widths == [8, 6, 4]
+
+    def test_more_stages_than_layers_rejected(self):
+        shapes = _nn_shapes([6, 4, 1])
+        with pytest.raises(ValueError, match="stages"):
+            nn_plan(shapes, 3)
+        with pytest.raises(ValueError, match="stages"):
+            nn_plan(shapes, 0)
+
+
+class TestWDLPlan:
+    def _shapes(self, nd=4, nc=2, vocab=6, embed=4, hidden=(8, 5)):
+        # models/wdl.wdl_arrays order: embed tables, wide tables,
+        # wide_dense, (W, b) per dense layer, bias
+        shapes = [(vocab, embed)] * nc + [(vocab, 1)] * nc + [(nd, 1)]
+        widths = [nd + nc * embed] + list(hidden) + [1]
+        for i in range(len(widths) - 1):
+            shapes += [(widths[i], widths[i + 1]), (widths[i + 1],)]
+        shapes += [(1,)]
+        return shapes, nc
+
+    def test_prefix_and_bias_welded_yet_contiguous(self):
+        shapes, nc = self._shapes()
+        total = sum(int(math.prod(s)) for s in shapes)
+        for k in (1, 2, 3):
+            plan = wdl_plan(shapes, nc, k)
+            assert plan.stages[0].lo == 0       # embed/wide prefix
+            assert plan.stages[-1].hi == total  # trailing bias
+            for a, b in zip(plan.stages, plan.stages[1:]):
+                assert a.hi == b.lo
+            flat = np.arange(total, dtype=np.float32)
+            np.testing.assert_array_equal(
+                np.concatenate(plan.slices(flat)), flat)
+
+    def test_boundary_carries_deep_width_plus_wide_logit(self):
+        shapes, nc = self._shapes(hidden=(8, 5))
+        plan = wdl_plan(shapes, nc, 2)
+        # 3 dense layers cut 2|1: boundary after the 2nd dense layer
+        # (width 5) + the wide logit column riding beside it
+        assert plan.boundary_widths == [5 + 1]
+
+
+class TestDefaultStages:
+    def test_unbounded_grant_means_one_stage(self):
+        assert default_stages(None, 10_000, 4) == 1
+        assert default_stages(0, 10_000, 4) == 1
+
+    def test_tight_budget_grows_k_and_caps_at_max(self):
+        total = 1000 * 4  # bytes
+        roomy = default_stages(100_000, total, 8, opt_leaves=1)
+        assert roomy == 1
+        tight = default_stages(total, total, 8, opt_leaves=1)
+        assert tight == 3  # (2 + 1 leaf) x params / free
+        assert default_stages(1, total, 8) == 8  # capped
+
+    def test_resident_bytes_accounts_weights_opt_and_boundaries(self):
+        shapes = _nn_shapes([12, 8, 1])
+        plan = nn_plan(shapes, 2)
+        s0 = plan.stages[0].n_params
+        # stage 0: weights + 2 opt leaves + its outgoing boundary
+        assert plan.resident_bytes(0, opt_leaves=2, mb_rows=16) == (
+            s0 * 4 * 3 + plan.boundary_widths[0] * 16 * 4)
+        s1 = plan.stages[1].n_params
+        # last stage: only the incoming boundary
+        assert plan.resident_bytes(1, opt_leaves=2, mb_rows=16) == (
+            s1 * 4 * 3 + plan.boundary_widths[0] * 16 * 4)

@@ -1,0 +1,159 @@
+"""Preemption as a ledger policy (shifu_tpu/coresident/): the grant's
+heartbeat can evict the trainer at ANY epoch boundary; the per-stage
+checkpoint family makes that loss-free — resume is bit-identical to an
+uninterrupted run (the PR-7 chaos contract), re-admission self-heals
+in-process, and resuming under a CHANGED stage count is refused with
+`ckpt.rejected{reason="stages"}` instead of silently mixing slices.
+"""
+
+import numpy as np
+import pytest
+
+from shifu_tpu.coresident import (
+    CoresidentConfig,
+    EvictedError,
+    train_nn_coresident,
+)
+from shifu_tpu.coresident.tenant import GrantFullError, LocalGrant
+from shifu_tpu.norm.dataset import write_normalized
+from shifu_tpu.train.nn_trainer import NNTrainConfig
+
+
+def _write_shards(tmp_path, n=500, d=6, seed=7):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    t = ((x[:, 0] - x[:, 1]) > 0).astype(np.int8)
+    w = np.ones(n, np.float32)
+    out = str(tmp_path / "NormalizedData")
+    write_normalized(out, x, t, w, [f"c{i}" for i in range(d)],
+                     n_shards=2)
+    return out
+
+
+def _cfg(**kw):
+    base = dict(hidden_nodes=[6, 5], activations=["tanh"],
+                propagation="R", num_epochs=8, valid_set_rate=0.2,
+                seed=11)
+    base.update(kw)
+    return NNTrainConfig(**base)
+
+
+def _flat(params):
+    from shifu_tpu.models.nn import flatten_params
+
+    flat, _ = flatten_params(params)
+    return np.asarray(flat)
+
+
+class _EvictingGrant(LocalGrant):
+    """Trips the eviction flag at one epoch. `readmit=False` also
+    refuses the re-admission acquire (sustained pressure), which is
+    what surfaces EvictedError; `readmit=True` models pressure that
+    clears immediately — the trainer must self-heal in-process."""
+
+    def __init__(self, evict_at, readmit=False):
+        super().__init__("t")
+        self.evict_at = int(evict_at)
+        self.readmit = readmit
+        self.tripped = False
+
+    def heartbeat(self, epoch):
+        if epoch == self.evict_at:
+            self.tripped = True
+            return True
+        return False
+
+    def acquire(self, nbytes):
+        if self.tripped and not self.readmit:
+            raise GrantFullError("pressure holds", int(nbytes))
+        super().acquire(nbytes)
+
+
+def _run(data_dir, cfg, fam, stages=2, microbatches=2, grant=None,
+         resume=False, wait_ms=-1.0):
+    ccfg = CoresidentConfig(stages=stages, microbatches=microbatches,
+                            family_dir=str(fam), wait_ms=wait_ms)
+    return train_nn_coresident(data_dir, cfg, ccfg,
+                               grant=grant or LocalGrant(),
+                               resume=resume)
+
+
+def test_evict_resume_bit_identical(tmp_path):
+    data_dir = _write_shards(tmp_path)
+    cfg = _cfg()
+    ref = _run(data_dir, cfg, tmp_path / "a")
+
+    with pytest.raises(EvictedError) as ei:
+        _run(data_dir, cfg, tmp_path / "b",
+             grant=_EvictingGrant(4), wait_ms=0.0)
+    assert ei.value.epoch == 4
+    assert "resume" in str(ei.value)
+
+    res = _run(data_dir, cfg, tmp_path / "b", resume=True)
+    assert res.iterations == ref.iterations
+    np.testing.assert_array_equal(_flat(ref.params), _flat(res.params))
+
+
+def test_readmission_self_heals_in_process(tmp_path):
+    """When the wait window finds room again, the trainer re-places its
+    stages and finishes — same bits as never-evicted, no operator in
+    the loop."""
+    data_dir = _write_shards(tmp_path)
+    cfg = _cfg()
+    ref = _run(data_dir, cfg, tmp_path / "a")
+    healed = _run(data_dir, cfg, tmp_path / "b",
+                  grant=_EvictingGrant(3, readmit=True), wait_ms=50.0)
+    assert healed.iterations == cfg.num_epochs
+    np.testing.assert_array_equal(_flat(ref.params),
+                                  _flat(healed.params))
+
+
+def test_resume_across_changed_stages_rejected(tmp_path):
+    """K is a placement choice, never training state: each stored part
+    covers a different flat slice under a different K, so the family is
+    refused (counted) and training starts fresh — still correct."""
+    from shifu_tpu import obs
+
+    data_dir = _write_shards(tmp_path)
+    cfg = _cfg()
+    with pytest.raises(EvictedError):
+        _run(data_dir, cfg, tmp_path / "fam",
+             grant=_EvictingGrant(3), wait_ms=0.0)
+
+    obs.reset()
+    res = _run(data_dir, cfg, tmp_path / "fam", stages=1,
+               microbatches=2, resume=True)
+    reg = obs.registry()
+    assert reg.counter("ckpt.rejected", reason="stages").value >= 1
+    # fresh start, full run — and the fresh K=1 result is the ordinary
+    # streamed trajectory
+    assert res.iterations == cfg.num_epochs
+    ref = _run(data_dir, cfg, tmp_path / "ref", stages=1,
+               microbatches=2)
+    np.testing.assert_array_equal(_flat(ref.params), _flat(res.params))
+
+
+def test_evicted_snapshot_listed_resumable(tmp_path):
+    """`shifu runs --resumable` material: an evicted co-resident family
+    surfaces one aggregated row (family name, epoch, stage count), not
+    K raw slot files."""
+    from shifu_tpu.resilience.checkpoint import list_resumable
+
+    data_dir = _write_shards(tmp_path)
+    cfg = _cfg()
+    with pytest.raises(EvictedError):
+        _run(data_dir, cfg, tmp_path / "fam",
+             grant=_EvictingGrant(4), wait_ms=0.0)
+
+    entries = [e for e in list_resumable(str(tmp_path / "fam"))
+               if e.get("family") == "coresident"]
+    assert len(entries) == 1, entries
+    e = entries[0]
+    assert e["epoch"] == 4
+    assert e["stages"] == 2
+    assert e["configSha"]
+    assert e["bytes"] > 0
+    # completion clears the family: nothing left to resume
+    _run(data_dir, cfg, tmp_path / "fam", resume=True)
+    assert not [e for e in list_resumable(str(tmp_path / "fam"))
+                if e.get("family") == "coresident"]

@@ -520,3 +520,105 @@ class TestZooServer:
         assert "ledger" in man["zoo"]
         assert man["zoo"]["tenants"]["a"]["requests"] == 1
         assert "memory" in man["zoo"]["tenants"]["a"]
+
+
+class TestBackgroundTenants:
+    """Co-resident trainer tenancy (PR 20): background charges share
+    the serving budget but sit on the far side of a strict priority
+    line — background acquires are fit-or-fail (never evict serving),
+    serving pressure evicts background STRICTLY first, and an evicted
+    trainer's record survives for /healthz until it completes."""
+
+    def test_background_acquire_is_fit_or_fail(self, three_sets):
+        root, _cols = three_sets
+        from shifu_tpu.serve.zoo import LedgerFullError
+
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        zoo = _zoo(root, budget_mb=2.5 * cost / (1024 * 1024))
+        zoo.ensure_resident("a")
+        zoo.ensure_resident("b")
+        grant = zoo.admit_background("retrain", meta={"algo": "nn"})
+        assert grant["freeBytes"] is not None
+        ask = grant["freeBytes"] + 1  # one byte past the free budget
+        with pytest.raises(LedgerFullError) as ei:
+            zoo.background_acquire("retrain", ask)
+        assert ei.value.deficit >= 1
+        # fit-or-fail: no serving tenant was evicted to make room
+        assert zoo._get("a").state == "resident"
+        assert zoo._get("b").state == "resident"
+        zoo.background_acquire("retrain", grant["freeBytes"])  # fits
+        zoo.close()
+
+    def test_serving_pressure_evicts_background_first(self, three_sets):
+        from shifu_tpu import obs
+
+        root, _cols = three_sets
+        cost = _set_cost(os.path.join(root, "a", "models"))
+        zoo = _zoo(root, budget_mb=2.5 * cost / (1024 * 1024))
+        zoo.ensure_resident("a")
+        zoo.ensure_resident("b")
+        grant = zoo.admit_background("retrain", meta={"stages": 2})
+        zoo.background_acquire("retrain", grant["freeBytes"])
+        assert zoo.background_heartbeat("retrain", 3) is False
+        zoo.ensure_resident("c")  # needs the trainer's bytes AND a's
+        counters = obs.registry().snapshot()["counters"]
+        assert counters.get('serve.zoo.evictions{'
+                            'reason="pressure_background",'
+                            'tenant="retrain"}') == 1
+        # the trainer went FIRST; only then did LRU touch serving
+        assert counters.get(
+            'serve.zoo.evictions{reason="pressure",tenant="a"}') == 1
+        assert zoo._get("b").state == "resident"
+        # the flag reaches the trainer at its next heartbeat
+        assert zoo.background_heartbeat("retrain", 4) is True
+        zoo.close()
+
+    def test_evicted_record_survives_until_final_release(
+            self, three_sets):
+        root, _cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        zoo.admit_background("retrain", meta={"algo": "nn",
+                                              "stages": 2})
+        zoo.background_acquire("retrain", 4096)
+        zoo.background_heartbeat("retrain", 5)
+        zoo.evict("retrain")  # the /admin/evict path, background branch
+        snap = zoo.health_snapshot()["background"]["retrain"]
+        assert snap["evictRequested"] and snap["evictions"] == 1
+        assert snap["epoch"] == 5 and snap["stages"] == 2
+        # the eviction release keeps the record (checkpointed epoch
+        # stays visible); re-admission clears the flag
+        zoo.background_release("retrain", final=False)
+        assert "retrain" in zoo.health_snapshot()["background"]
+        zoo.admit_background("retrain")
+        assert zoo.background_heartbeat("retrain", 6) is False
+        # completion forgets the tenant
+        zoo.background_release("retrain", final=True)
+        assert "retrain" not in (zoo.health_snapshot().get("background")
+                                 or {})
+        zoo.close()
+
+    def test_name_collisions_rejected_both_ways(self, three_sets):
+        root, _cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        with pytest.raises(ValueError, match="serving tenant"):
+            zoo.admit_background("a")  # "a" is a registered serving set
+        zoo.admit_background("retrain")
+        with pytest.raises(ShifuError) as ei:
+            zoo.register("retrain", os.path.join(root, "a"))
+        assert ei.value.code is ErrorCode.ILLEGAL_ARGUMENT
+        zoo.close()
+
+    def test_flagged_tenant_cannot_reacquire(self, three_sets):
+        """Between the eviction flag and the trainer's checkpoint there
+        is a one-epoch grace window; the ledger refuses NEW charges in
+        it so a slow trainer cannot grow while flagged."""
+        from shifu_tpu.serve.zoo import LedgerFullError
+
+        root, _cols = three_sets
+        zoo = _zoo(root, budget_mb=0)
+        zoo.admit_background("retrain")
+        zoo.background_acquire("retrain", 1024)
+        zoo.evict("retrain")
+        with pytest.raises(LedgerFullError, match="flagged"):
+            zoo.background_acquire("retrain", 1024)
+        zoo.close()
